@@ -1,0 +1,117 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: joey12300/Paddle @ /root/reference),
+rebuilt from scratch on JAX/XLA/Pallas.
+
+Top-level namespace mirrors `import paddle` (ref: python/paddle/__init__.py):
+tensors, ops, nn, optimizer, static, distributed, amp, io, jit, metric,
+vision, incubate. Execution defaults to dygraph (eager) exactly like the
+reference 2.0 API; `paddle_tpu.enable_static()` switches to the
+Program/Executor path, and `paddle_tpu.jit.to_static` compiles eager code
+into a single XLA computation.
+"""
+from __future__ import annotations
+
+# core first (ops patches Tensor methods on import)
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,
+    is_compiled_with_cuda, is_compiled_with_tpu, is_compiled_with_xpu,
+    set_device,
+)
+from .core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tensor import Parameter, Tensor, is_tensor, to_tensor  # noqa: F401
+from .core.param_attr import ParamAttr  # noqa: F401
+from .core import autograd as _autograd
+from .core.autograd import enable_grad, grad  # noqa: F401
+from .core.mode import disable_static, enable_static, in_dygraph_mode  # noqa: F401
+
+no_grad = _autograd._NoGradDecorator()
+
+from . import ops  # noqa: E402  (patches Tensor)
+from .ops import *  # noqa: F401,F403,E402
+from .ops import sum, max, min, abs, all, any, pow, round, slice  # noqa: F401,A004,E402
+
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from .framework.io import load, save  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from .hapi.model import Model  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from . import parallel  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import version  # noqa: E402,F401
+
+__version__ = version.full_version
+
+
+def ones(shape, dtype=None, name=None):
+    return ops.ones(shape, dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return ops.zeros(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return ops.rand(shape, dtype)
+
+
+def randn(shape, dtype=None, name=None):
+    return ops.randn(shape, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    return ops.arange(start, end, step, dtype)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    return ops.full(shape, fill_value, dtype)
+
+
+def set_grad_enabled(flag):
+    import contextlib
+
+    from .core import autograd as ag
+
+    @contextlib.contextmanager
+    def cm():
+        prev = ag._grad_enabled
+        ag._grad_enabled = bool(flag)
+        try:
+            yield
+        finally:
+            ag._grad_enabled = prev
+    return cm()
+
+
+def is_grad_enabled():
+    return _autograd.grad_enabled()
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    total = sum(int(__import__("numpy").prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(__import__("numpy").prod(p.shape))
+                    for p in net.parameters() if p.trainable)
+    info = {"total_params": total, "trainable_params": trainable}
+    print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+    return info
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0

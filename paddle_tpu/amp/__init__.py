@@ -1,0 +1,157 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast, GradScaler) + fluid/contrib/
+mixed_precision/. TPU-first: the native mixed-precision dtype is bfloat16 —
+same exponent range as fp32, so loss scaling is a no-op (GradScaler keeps the
+reference API but scales by 1 on TPU unless fp16 is forced). `auto_cast`
+switches a process-global compute policy that the matmul/conv ops consult.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_amp_state = {"enable": False, "dtype": "bfloat16", "level": "O1",
+              "custom_white_list": None, "custom_black_list": None}
+
+# O1 default lists (ref: fluid/contrib/mixed_precision/fp16_lists.py)
+WHITE_LIST = {"matmul", "mm", "bmm", "conv1d", "conv2d", "conv3d", "linear",
+              "einsum", "addmm"}
+BLACK_LIST = {"exp", "log", "mean", "sum", "softmax", "log_softmax",
+              "cross_entropy", "softmax_with_cross_entropy", "layer_norm",
+              "batch_norm", "norm", "cumsum", "logsumexp"}
+
+
+def amp_enabled():
+    return _amp_state["enable"]
+
+
+def amp_dtype():
+    return _amp_state["dtype"]
+
+
+def amp_should_cast(opname):
+    if not _amp_state["enable"]:
+        return False
+    white = WHITE_LIST | set(_amp_state["custom_white_list"] or ())
+    black = BLACK_LIST | set(_amp_state["custom_black_list"] or ())
+    if _amp_state["level"] == "O2":
+        return opname not in black
+    return opname in white and opname not in black
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = dict(_amp_state)
+    _amp_state.update(enable=enable, dtype=dtype, level=level,
+                      custom_white_list=custom_white_list,
+                      custom_black_list=custom_black_list)
+    try:
+        yield
+    finally:
+        _amp_state.clear()
+        _amp_state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype (ref: amp.decorate)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Loss scaling (ref: python/paddle/amp/grad_scaler.py). With bfloat16 on
+    TPU the dynamic range matches fp32, so scale stays 1.0 and this is a
+    transparent pass-through that still tracks the reference API/semantics."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable and amp_dtype() == "float16"
+        self._scale = init_loss_scaling if self._enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p is not None and p.grad is not None:
+                g = p.grad._value * inv
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad = Tensor(g)
+        self._found_inf = found
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_scale_ratio(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]

@@ -1,0 +1,227 @@
+"""Eager autograd engine.
+
+Reference: paddle/fluid/imperative/ (tracer + basic_engine, partial_grad).
+TPU-first rework: instead of per-op handwritten grad kernels, every eager op
+records a `jax.vjp` pullback closure as a Node in a dynamic graph hanging off
+output Tensors. `backward()` walks the graph in reverse topological order and
+accumulates cotangents into leaf `.grad`. Everything stays on-device; the
+pullbacks are XLA computations. The jitted/static paths bypass this entirely
+(whole-step `jax.grad`), so this engine only pays its cost in pure-eager code.
+"""
+from __future__ import annotations
+
+import contextlib
+import weakref
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+_grad_enabled = True
+_hooks: dict = {}  # id(tensor) -> list[hook]
+
+
+class Node:
+    __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "name", "multi",
+                 "_out_mask")
+
+    def __init__(self, vjp_fn, inputs, outputs, name, multi):
+        self.vjp_fn = vjp_fn
+        self.inputs: List[Tensor] = inputs          # strong refs upstream
+        self.out_refs = [weakref.ref(o) for o in outputs]
+        self.out_avals = [(o._value.shape, o._value.dtype) for o in outputs]
+        self.name = name
+        self.multi = multi
+        self._out_mask = None  # True per original output position kept as Tensor
+
+
+def grad_enabled() -> bool:
+    return _grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    global _grad_enabled
+    prev, _grad_enabled = _grad_enabled, False
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    global _grad_enabled
+    prev, _grad_enabled = _grad_enabled, True
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+class _NoGradDecorator:
+    """paddle.no_grad usable as both context manager and decorator."""
+
+    def __call__(self, fn=None):
+        if fn is None:
+            return no_grad()
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+    def __enter__(self):
+        self._cm = no_grad()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+def register_hook(tensor: Tensor, hook):
+    _hooks.setdefault(id(tensor), []).append(hook)
+
+    class _Handle:
+        def remove(self_inner):
+            lst = _hooks.get(id(tensor), [])
+            if hook in lst:
+                lst.remove(hook)
+    return _Handle()
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _run_hooks(tensor: Tensor, g):
+    for hook in _hooks.get(id(tensor), []):
+        out = hook(Tensor(g, stop_gradient=True))
+        if out is not None:
+            g = out._value if isinstance(out, Tensor) else out
+    return g
+
+
+def _accumulate_leaf(tensor: Tensor, g):
+    if tensor.stop_gradient:
+        return
+    g = _run_hooks(tensor, g)
+    if tensor.grad is None:
+        tensor.grad = Tensor(g, stop_gradient=True)
+    else:
+        tensor.grad = Tensor(tensor.grad._value + g, stop_gradient=True)
+
+
+def _topo_from(root: Node) -> List[Node]:
+    order, seen = [], set()
+    stack = [(root, False)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None:
+                stack.append((t._node, False))
+    return order  # post-order: dependencies first; iterate reversed for backward
+
+
+def backward(tensor: Tensor, grad_tensor: Optional[Tensor] = None,
+             retain_graph: bool = False):
+    if grad_tensor is None:
+        seed = jnp.ones(tensor._value.shape, tensor._value.dtype)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if tensor._node is None:
+        _accumulate_leaf(tensor, seed)
+        return
+
+    topo = _topo_from(tensor._node)
+    # node id -> list of cotangents (one slot per output)
+    cots: dict = {}
+
+    def seed_output(node: Node, t: Tensor, g):
+        slots = cots.setdefault(id(node), [None] * len(node.out_refs))
+        for i, ref in enumerate(node.out_refs):
+            if ref() is t:
+                slots[i] = g if slots[i] is None else slots[i] + g
+                return
+        raise RuntimeError("tensor not found among its node outputs")
+
+    seed_output(tensor._node, tensor, seed)
+
+    for node in reversed(topo):
+        slots = cots.pop(id(node), None)
+        if slots is None:
+            continue
+        full = []
+        for s, (shape, dtype) in zip(slots, node.out_avals):
+            full.append(_zero_cotangent(shape, dtype) if s is None else s)
+        if node._out_mask is not None and len(node._out_mask) != len(full):
+            # re-insert None cotangents for None outputs of the primal fn
+            it = iter(full)
+            full = [next(it) if keep else None for keep in node._out_mask]
+        ct = tuple(full) if node.multi else full[0]
+        in_grads = node.vjp_fn(ct)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                continue
+            if t._node is not None:
+                seed_output(t._node, t, g)
+                if id(t) in _hooks:
+                    _run_hooks(t, g)
+            else:
+                _accumulate_leaf(t, g)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    if not retain_graph:
+        for node in topo:
+            for ref in node.out_refs:
+                t = ref()
+                if t is not None:
+                    t._node = None
+            node.inputs = []
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad — functional gradient of outputs wrt inputs (no .grad writes).
+
+    Implemented by running backward with temporary grad capture.
+    """
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gos = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs] * len(outputs)
+    saved = [(t.grad, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t.stop_gradient = False
+    try:
+        for o, go in zip(outputs, gos):
+            backward(o, go, retain_graph=True if retain_graph is None else retain_graph)
+        result = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    result.append(Tensor(jnp.zeros(t._value.shape, t._value.dtype)))
+                else:
+                    result.append(None)
+            else:
+                result.append(t.grad)
+    finally:
+        for t, (g, sg) in zip(inputs, saved):
+            t.grad, t.stop_gradient = g, sg
+    return result

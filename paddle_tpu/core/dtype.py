@@ -1,0 +1,98 @@
+"""Dtype registry.
+
+TPU-first: bfloat16 is a first-class dtype. Mirrors the dtype surface of the
+reference (paddle/fluid/framework/data_type.h; python/paddle/fluid/data_feeder.py)
+without the protobuf VarType enum — names map straight onto XLA element types.
+
+64-bit policy: TPUs have no native 64-bit compute and JAX runs with x64
+disabled, so "int64"/"float64"/"complex128" canonicalize to their 32-bit
+counterparts (the standard JAX/flax convention). Reference code that feeds
+int64 labels etc. runs unchanged; values are stored as int32.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype
+_DTYPES = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float32,  # canonicalized: no native f64 on TPU
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int32,  # canonicalized: no native i64 on TPU
+    "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex64,  # canonicalized
+}
+
+_ALIASES = {
+    "fp16": "float16",
+    "bf16": "bfloat16",
+    "fp32": "float32",
+    "fp64": "float64",
+    "half": "float16",
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float32
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int32
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex64
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = dtype_name(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str alias, np/jnp dtype, None) to a jnp dtype."""
+    if dtype is None:
+        return _DTYPES[_default_dtype]
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _DTYPES:
+            raise ValueError(f"unsupported dtype {dtype!r}")
+        return _DTYPES[name]
+    return jnp.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_name(dtype) -> str:
+    if dtype is None:
+        return _default_dtype
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _DTYPES:
+            return name
+        raise ValueError(f"unsupported dtype {dtype!r}")
+    return np.dtype(dtype).name if np.dtype(dtype).name in _DTYPES else str(np.dtype(dtype))
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(convert_dtype(dtype)), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(convert_dtype(dtype)), jnp.integer)

@@ -1,0 +1,38 @@
+"""Global execution mode: dygraph (eager, default — as in reference 2.0) vs static.
+
+Reference: python/paddle/fluid/framework.py `in_dygraph_mode` / `_dygraph_guard`.
+In static mode op wrappers append to the current Program instead of executing;
+the hook is registered by paddle_tpu.static to avoid an import cycle.
+"""
+from __future__ import annotations
+
+_static_mode = False
+# set by paddle_tpu.static: fn(opname, fn, args, kwargs, meta) -> outputs
+_static_append_op_hook = None
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dygraph_mode() -> bool:
+    return not _static_mode
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def register_static_hook(hook):
+    global _static_append_op_hook
+    _static_append_op_hook = hook
+
+
+def static_hook():
+    return _static_append_op_hook

@@ -1,0 +1,64 @@
+"""Seeding and PRNG-key management.
+
+Reference: python/paddle/fluid/generator.py + paddle.seed. JAX has explicit
+functional PRNG keys; we keep a process-global generator so the paddle-style
+imperative API (dropout, uniform, ...) works, while jitted/static paths thread
+keys explicitly (each static Program run derives per-op keys from a root key).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Draw a fresh key (fold_in of a monotone counter — cheap, traceable)."""
+        with self._lock:
+            self._count += 1
+            return jax.random.fold_in(self._key, self._count)
+
+    def split(self, n: int):
+        return jax.random.split(self.next_key(), n)
+
+
+_default_generator = Generator(seed=np.random.SeedSequence().entropy % (2**31) if False else 0)
+
+
+def seed(s: int):
+    """paddle.seed — reseed the global generator (and numpy for host-side aug)."""
+    _default_generator.manual_seed(s)
+    np.random.seed(s % (2**32))
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return {"seed": _default_generator._seed, "count": _default_generator._count}
+
+
+def set_rng_state(state):
+    _default_generator.manual_seed(state["seed"])
+    _default_generator._count = state["count"]

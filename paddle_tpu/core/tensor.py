@@ -1,0 +1,225 @@
+"""Tensor — the imperative array type.
+
+Reference: paddle/fluid/imperative (VarBase) + python/paddle/fluid/dygraph/
+varbase_patch_methods.py + math_op_patch.py. TPU-first: a Tensor is a thin
+handle on a `jax.Array`; every method lowers to XLA ops, autograd records a
+per-op `jax.vjp` pullback graph (see autograd.py) so eager mode is correct
+while `@to_static`/jitted paths trace the same code into one XLA computation.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from .place import CPUPlace, TPUPlace, _expected_place
+
+
+def _to_jax(data, dtype=None, place=None):
+    if isinstance(data, Tensor):
+        data = data._value
+    if isinstance(data, (jax.Array,)):
+        arr = data if dtype is None else data.astype(dtype_mod.convert_dtype(dtype))
+    else:
+        npd = np.asarray(data)
+        if dtype is not None:
+            npd = npd.astype(np.dtype(jnp.dtype(dtype_mod.convert_dtype(dtype))))
+        elif npd.dtype == np.float64:
+            npd = npd.astype(np.float32)  # paddle default: fp32
+        arr = jnp.asarray(npd)
+    if place is not None:
+        arr = jax.device_put(arr, place.jax_device())
+    return arr
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
+                 "_node", "trainable", "__weakref__")
+
+    # ops resolve higher than numpy arrays in dunders
+    __array_priority__ = 100
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True,
+                 name=None, persistable=False):
+        self._value = _to_jax(value, dtype, place)
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name
+        self.persistable = persistable
+        self._node = None  # autograd.Node that produced this tensor
+        self.trainable = False
+
+    # ---- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = next(iter(self._value.devices()))
+            return CPUPlace() if dev.platform == "cpu" else TPUPlace(dev.id)
+        except Exception:
+            return _expected_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *idx):
+        return self._value[idx].item() if idx else self._value.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __jax_array__(self):
+        return self._value
+
+    # ---- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import autograd
+        autograd.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.assign(self)
+
+    def stop_gradient_(self, flag=True):
+        self.stop_gradient = flag
+        return self
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    def register_hook(self, hook):
+        from . import autograd
+        return autograd.register_hook(self, hook)
+
+    # ---- conversion / movement -------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(self._value, CPUPlace().jax_device()),
+                      stop_gradient=self.stop_gradient)
+
+    def tpu(self, device_id=0) -> "Tensor":
+        return Tensor(jax.device_put(self._value, TPUPlace(device_id).jax_device()),
+                      stop_gradient=self.stop_gradient)
+
+    cuda = tpu
+
+    def pin_memory(self):
+        return self.cpu()
+
+    def set_value(self, value):
+        """In-place update of the payload (used by optimizers/checkpoint load)."""
+        if isinstance(value, Tensor):
+            value = value._value
+        arr = _to_jax(value)
+        if tuple(arr.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._value.shape}")
+        self._value = arr.astype(self._value.dtype)
+
+    def copy_(self, other, *a):
+        self.set_value(other)
+        return self
+
+    # ---- repr -------------------------------------------------------------
+    def __repr__(self):
+        grad_txt = f", stop_gradient={self.stop_gradient}"
+        return (f"Tensor(shape={self.shape}, dtype={self._value.dtype.name}"
+                f"{grad_txt},\n       {np.asarray(self._value)!r})")
+
+    __str__ = __repr__
+
+    def __hash__(self):
+        return id(self)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return object.__format__(self, spec)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # dunder arithmetic is patched in by ops (math_op_patch pattern)
+
+
+class Parameter(Tensor):
+    """Trainable tensor owned by a Layer (ref: framework.py Parameter)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name, persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    def __repr__(self):
+        return ("Parameter containing:\n" + super().__repr__())
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor"""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

@@ -1,0 +1,34 @@
+"""Unique name generator (ref: python/paddle/fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+_counters = defaultdict(int)
+_prefix = []
+
+
+def generate(key: str) -> str:
+    _counters[key] += 1
+    name = f"{key}_{_counters[key] - 1}"
+    return "/".join(_prefix + [name]) if _prefix else name
+
+
+@contextlib.contextmanager
+def guard(new_prefix=None):
+    global _counters
+    old = _counters
+    _counters = defaultdict(int)
+    if new_prefix:
+        _prefix.append(new_prefix.rstrip("/"))
+    try:
+        yield
+    finally:
+        _counters = old
+        if new_prefix:
+            _prefix.pop()
+
+
+def switch():
+    global _counters
+    _counters = defaultdict(int)

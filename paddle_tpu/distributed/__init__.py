@@ -1,0 +1,26 @@
+"""paddle.distributed — collectives + Fleet.
+
+Reference: python/paddle/distributed/. Full collective implementation in
+collective.py; fleet/ holds the DistributedStrategy machinery.
+"""
+from __future__ import annotations
+
+from .collective import (  # noqa: F401
+    ParallelEnv, all_gather, all_reduce, barrier, broadcast, get_rank,
+    get_world_size, init_parallel_env, reduce, ReduceOp, scatter, split,
+    reduce_scatter, alltoall, wait,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """Single-host TPU runtime: jax owns all local chips in one process, so
+    spawn degenerates to a direct call (ref: python/paddle/distributed/spawn.py
+    forks one process per GPU)."""
+    func(*args)
+
+
+def launch():
+    from . import launch as launch_mod
+    launch_mod.main()

@@ -1,0 +1,18 @@
+"""paddle.distributed.fleet — unified distributed training API.
+
+Reference: python/paddle/distributed/fleet/ (base/fleet_base.py,
+base/distributed_strategy.py, meta_optimizers/). TPU-first rework: a
+DistributedStrategy no longer rewrites the graph with collective ops — its
+flags select mesh axes + sharding rules + XLA-native mechanisms
+(amp→bf16, recompute→jax.checkpoint, sharding→ZeRO param sharding,
+gradient_merge→microbatch scan, pipeline→pp mesh axis), applied when building
+the pjit'ed train step. See meta.py for the strategy lowering.
+"""
+from __future__ import annotations
+
+from .base import (  # noqa: F401
+    DistributedStrategy, Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+    fleet, init, is_first_worker, worker_index, worker_num,
+    distributed_optimizer, distributed_model,
+)
+from .meta import apply_strategy, build_hybrid_train_step  # noqa: F401

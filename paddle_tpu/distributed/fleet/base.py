@@ -1,0 +1,177 @@
+"""Fleet base: DistributedStrategy + Fleet singleton.
+
+Reference: python/paddle/distributed/fleet/base/distributed_strategy.py (the
+protobuf-backed strategy) and base/fleet_base.py. Strategy fields keep the
+reference names; on TPU they lower to mesh/sharding/remat choices instead of
+graph passes.
+"""
+from __future__ import annotations
+
+from ... import optimizer as opt_mod
+from ...core.tensor import Tensor
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # collective knobs (ref field names)
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0,
+                            "use_pure_fp16": False, "use_bf16": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 2}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.dgc = False
+        self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01}
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005}
+        self.fp16_allreduce = False
+        self.nccl_comm_num = 1
+        self.hybrid_configs = {"dp_degree": -1, "mp_degree": 1,
+                               "pp_degree": 1, "sp_degree": 1}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.without_graph_optimization = False
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(enabled={on})"
+
+
+class _RoleMakerBase:
+    def __init__(self, is_collective=True, **kw):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        import jax
+        return jax.process_index()
+
+    def worker_num(self):
+        import jax
+        return jax.process_count()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+
+class PaddleCloudRoleMaker(_RoleMakerBase):
+    pass
+
+
+class UserDefinedRoleMaker(_RoleMakerBase):
+    pass
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._origin_optimizer = None
+        self._origin_model = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        from ..collective import init_parallel_env
+        init_parallel_env()
+        return self
+
+    # ---- role queries ----
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    # ---- training ----
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._origin_optimizer = optimizer
+        from .meta import wrap_optimizer
+        return wrap_optimizer(self, optimizer, self._strategy)
+
+    def distributed_model(self, model):
+        from ..parallel import DataParallel
+        self._origin_model = model
+        return DataParallel(model)
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    # ---- io (worker-0 gated, ref: fleet_base save_persistables) ----
+    def save_persistables(self, executor, dirname, main_program=None):
+        if self.is_first_worker():
+            import os
+            os.makedirs(dirname, exist_ok=True)
+
+    def save_inference_model(self, *a, **kw):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *a):
+        pass
+
+    def run_server(self):
+        pass
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)

@@ -1,0 +1,138 @@
+"""Strategy lowering — the meta-optimizer equivalents.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/*. Each reference
+meta-optimizer is a graph rewrite; here each strategy flag picks an XLA-native
+mechanism applied when building the hybrid train step:
+
+  amp             -> bf16 compute policy on the step (amp_optimizer.py)
+  recompute       -> jax.checkpoint around layer blocks (recompute_optimizer.py)
+  gradient_merge  -> lax.scan micro-batch accumulation (gradient_merge_optimizer.py)
+  sharding (ZeRO) -> params/opt-state sharded on dp axis (sharding_optimizer.py)
+  localsgd        -> periodic param psum-average (localsgd_optimizer.py)
+  lamb/lars       -> optimizer swap (lamb_optimizer.py / lars_optimizer.py)
+  pipeline        -> pp mesh axis + microbatch schedule (pipeline_optimizer.py)
+  fp16_allreduce  -> grads cast bf16 before psum (fp16_allreduce_optimizer.py)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ... import optimizer as opt_mod
+from ...parallel.mesh import make_mesh, set_mesh
+
+
+def wrap_optimizer(fleet_obj, optimizer, strategy):
+    """lamb/lars strategies swap the inner optimizer (ref: lamb_optimizer.py
+    `_can_apply`: replaces Momentum/Adam); other flags are applied at
+    train-step build time."""
+    if strategy.lamb and not isinstance(optimizer, opt_mod.Lamb):
+        optimizer = opt_mod.Lamb(
+            learning_rate=optimizer._lr,
+            lamb_weight_decay=strategy.lamb_configs.get("lamb_weight_decay", 0.01),
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip)
+    elif strategy.lars and isinstance(optimizer, opt_mod.Momentum):
+        optimizer = opt_mod.Lars(
+            learning_rate=optimizer._lr,
+            momentum=optimizer._momentum,
+            lars_coeff=strategy.lars_configs.get("lars_coeff", 0.001),
+            lars_weight_decay=strategy.lars_configs.get("lars_weight_decay",
+                                                        0.0005),
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip)
+    optimizer._fleet_strategy = strategy
+    return optimizer
+
+
+def apply_strategy(strategy, loss_fn):
+    """Wrap a pure loss_fn(params, batch, key) per strategy flags."""
+    fn = loss_fn
+    if strategy.recompute:
+        fn = jax.checkpoint(fn)
+    if strategy.amp:
+        inner = fn
+
+        def amp_fn(params, batch, key):
+            cast = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+            return inner(cast, batch, key)
+        fn = amp_fn
+    return fn
+
+
+def build_hybrid_train_step(strategy, loss_fn, optimizer, mesh=None):
+    """Build the full pjit'ed train step per strategy.
+
+    loss_fn: pure (params, batch, key) -> scalar loss.
+    Returns (step_fn, mesh): step_fn(params, opt_state, batch, key) ->
+    (loss, new_params, new_opt_state); all collectives XLA-inserted.
+    """
+    hybrid = strategy.hybrid_configs
+    if mesh is None:
+        mesh = make_mesh(dp=None if hybrid.get("dp_degree", -1) in (-1, None)
+                         else hybrid["dp_degree"],
+                         mp=hybrid.get("mp_degree", 1),
+                         pp=hybrid.get("pp_degree", 1),
+                         sp=hybrid.get("sp_degree", 1))
+        set_mesh(mesh)
+
+    wrapped_loss = apply_strategy(strategy, loss_fn)
+    k_steps = strategy.gradient_merge_configs.get("k_steps", 1) \
+        if strategy.gradient_merge else 1
+
+    def step(params, opt_state, batch, key):
+        if k_steps > 1:
+            # micro-batch accumulation via scan (gradient_merge)
+            def micro(accum, mb):
+                l, g = jax.value_and_grad(wrapped_loss)(params, mb, key)
+                return (accum[0] + l,
+                        jax.tree_util.tree_map(jnp.add, accum[1], g)), None
+            micro_batches = jax.tree_util.tree_map(
+                lambda x: x.reshape((k_steps, x.shape[0] // k_steps)
+                                    + x.shape[1:]), batch)
+            zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (loss, grads), _ = jax.lax.scan(micro, (0.0, zero_g), micro_batches)
+            if strategy.gradient_merge_configs.get("avg", True):
+                loss = loss / k_steps
+                grads = jax.tree_util.tree_map(lambda g: g / k_steps, grads)
+        else:
+            loss, grads = jax.value_and_grad(wrapped_loss)(params, batch, key)
+        if strategy.fp16_allreduce:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.bfloat16).astype(g.dtype), grads)
+        if optimizer._grad_clip is not None and hasattr(optimizer._grad_clip,
+                                                        "clip_tree"):
+            grads = optimizer._grad_clip.clip_tree(grads)
+        new_params, new_state = optimizer.functional_update(params, grads,
+                                                            opt_state)
+        return loss, new_params, new_state
+
+    # shardings: ZeRO shards params+opt state over dp; else replicate params
+    if strategy.sharding:
+        def spec_for(v):
+            # shard the largest dim that divides dp degree
+            dp = mesh.shape["dp"]
+            for i, s in enumerate(v.shape):
+                if s % dp == 0 and s >= dp:
+                    return P(*([None] * i + ["dp"] + [None] * (v.ndim - i - 1)))
+            return P()
+        param_sharding_fn = lambda v: NamedSharding(mesh, spec_for(v))  # noqa: E731
+    else:
+        param_sharding_fn = lambda v: NamedSharding(mesh, P())  # noqa: E731
+
+    def compile_for(params, batch):
+        p_sh = jax.tree_util.tree_map(param_sharding_fn, params)
+        b_sh = jax.tree_util.tree_map(
+            lambda x: NamedSharding(mesh, P("dp", *([None] * (x.ndim - 1)))),
+            batch)
+        return jax.jit(step,
+                       in_shardings=(p_sh, None, b_sh, None),
+                       out_shardings=None,
+                       donate_argnums=(0, 1))
+
+    step.compile_for = compile_for
+    step.mesh = mesh
+    return step, mesh

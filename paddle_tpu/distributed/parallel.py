@@ -1,0 +1,39 @@
+"""DataParallel wrapper (ref: python/paddle/fluid/dygraph/parallel.py).
+
+TPU-first: the reference allreduces grads via NCCL after backward (reducer.cc
+bucketing). Here data parallelism is expressed as sharding — the wrapped
+layer's train step should run under `paddle_tpu.parallel.data_parallel_step`
+(pjit over the dp mesh axis) where XLA inserts the gradient all-reduce. The
+eager wrapper is therefore a transparent pass-through that keeps the reference
+API (scale_loss/apply_collective_grads are folded into the sharded step).
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    @property
+    def _inner_layers(self):
+        return self._layers

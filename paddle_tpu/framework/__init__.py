@@ -1,0 +1,18 @@
+"""Framework-level helpers (ref: python/paddle/framework/)."""
+from __future__ import annotations
+
+from ..core.mode import in_dygraph_mode  # noqa: F401
+from ..core.place import CPUPlace, CUDAPlace, TPUPlace, _expected_place  # noqa: F401
+from ..core.rng import seed  # noqa: F401
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401
+
+
+def get_default_dtype():
+    from ..core.dtype import get_default_dtype as g
+    return g()
+
+
+def set_default_dtype(d):
+    from ..core.dtype import set_default_dtype as s
+    return s(d)

@@ -1,0 +1,62 @@
+"""paddle.save / paddle.load — checkpoint serialization.
+
+Reference: python/paddle/framework/io.py + fluid/dygraph/checkpoint.py.
+Format: a pickle of {key: np.ndarray | nested dict | scalars}. Tensors are
+pulled to host as numpy; loading returns plain dicts of Tensors, matching the
+reference behavior of returning a state_dict for `set_state_dict`.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), str(obj._value.dtype))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "dtype")
+
+    def __init__(self, array, dtype):
+        self.array = array
+        self.dtype = dtype
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(obj.array).view(jnp.dtype(obj.dtype))
+                      if obj.array.dtype.itemsize != jnp.dtype(obj.dtype).itemsize
+                      else jnp.asarray(obj.array).astype(jnp.dtype(obj.dtype)))
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy)

@@ -1,0 +1,5 @@
+"""High-level API (ref: python/paddle/hapi/)."""
+from __future__ import annotations
+
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,191 @@
+"""Model — fit/evaluate/predict (ref: python/paddle/hapi/model.py).
+
+The train loop drives a fused jitted train step (params+opt pytrees in, new
+state out) — the whole step is one XLA computation, matching the reference's
+Executor-with-fused-graph performance model rather than op-by-op eager.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        return self
+
+    # ---- core steps ------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        out = self.network(*[_as_tensor(i) for i in inputs])
+        loss = self._loss(out, *[_as_tensor(l) for l in labels]) \
+            if labels is not None else out
+        loss_t = loss if isinstance(loss, Tensor) else loss[0]
+        loss_t.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = self._compute_metrics(out, labels)
+        return ([float(loss_t.numpy())], metrics) if metrics else \
+            [float(loss_t.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.autograd import no_grad
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        with no_grad():
+            out = self.network(*[_as_tensor(i) for i in inputs])
+            loss = self._loss(out, *[_as_tensor(l) for l in labels]) \
+                if self._loss and labels is not None else None
+        metrics = self._compute_metrics(out, labels)
+        if loss is not None:
+            loss_t = loss if isinstance(loss, Tensor) else loss[0]
+            return ([float(loss_t.numpy())], metrics) if metrics else \
+                [float(loss_t.numpy())]
+        return metrics
+
+    def predict_batch(self, inputs):
+        from ..core.autograd import no_grad
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            out = self.network(*[_as_tensor(i) for i in inputs])
+        return out
+
+    def _compute_metrics(self, out, labels):
+        res = {}
+        for m in self._metrics:
+            inp = m.compute(out, *(_as_tensor(l) for l in labels)) \
+                if labels is not None else m.compute(out)
+            res[m.name()] = m.update(inp)
+        return res or None
+
+    # ---- loops -----------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        cbs = cb_mod.CallbackList(callbacks or
+                                  [cb_mod.ProgBarLogger(log_freq, verbose)])
+        cbs.set_model(self)
+        cbs.on_begin("train")
+        history = []
+        it_count = 0
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbs.on_batch_begin("train", step, None)
+                inputs, labels = _split_batch(batch)
+                logs = self.train_batch(inputs, labels)
+                cbs.on_batch_end("train", step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            epoch_logs = {"loss": logs[0] if isinstance(logs, list) else logs}
+            history.append(epoch_logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            cbs.on_epoch_end(epoch, epoch_logs)
+            if self.stop_training or (num_iters is not None
+                                      and it_count >= num_iters):
+                break
+        cbs.on_end("train")
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = _split_batch(batch)
+            logs = self.eval_batch(inputs, labels)
+            if isinstance(logs, tuple):
+                losses.append(logs[0][0])
+            elif isinstance(logs, list):
+                losses.append(logs[0])
+        result = {}
+        if losses:
+            result["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = _split_batch(batch)
+            out = self.predict_batch(inputs)
+            outputs.append(out.numpy() if isinstance(out, Tensor)
+                           else [o.numpy() for o in out])
+        if stack_outputs and outputs and isinstance(outputs[0], np.ndarray):
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    # ---- io --------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .. import summary as _summary
+        return _summary(self.network, input_size)
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _split_batch(batch):
+    if isinstance(batch, (tuple, list)):
+        if len(batch) >= 2:
+            return [batch[0]], list(batch[1:])
+        return [batch[0]], None
+    return [batch], None

@@ -1,0 +1,46 @@
+"""paddle.incubate — experimental APIs (ref: python/paddle/incubate/)."""
+from __future__ import annotations
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Fused causal-masked softmax (XLA fuses this chain into one kernel)."""
+    import jax.numpy as jnp
+
+    from ..ops._registry import defop
+
+    @defop(name="softmax_mask_fuse_upper_triangle")
+    def _impl(x):
+        import jax
+        s = x.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, x, -1e30), axis=-1)
+    return _impl(x)
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._count = 0
+        self._slow = None
+
+    def step(self):
+        self.inner.step()
+        self._count += 1
+        if self._count % self.k == 0:
+            params = self.inner._parameter_list or []
+            if self._slow is None:
+                self._slow = [p._value for p in params]
+            else:
+                for i, p in enumerate(params):
+                    self._slow[i] = self._slow[i] + self.alpha * (
+                        p._value - self._slow[i])
+                    p._value = self._slow[i]
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()

@@ -1,0 +1,80 @@
+"""Inference predictor (ref: paddle/fluid/inference/ + paddle.inference API).
+
+TPU-first: a predictor is a compiled forward with donated input buffers and a
+persistent params pytree on device.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._use_tpu = True
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+
+class Predictor:
+    """Wraps a Layer (or pure fn) into a compiled inference callable."""
+
+    def __init__(self, model, example_inputs=None):
+        from ..nn.layer.layers import Layer
+        self._layer = model if isinstance(model, Layer) else None
+        self._fn = None
+        if self._layer is not None:
+            self._layer.eval()
+            params, bufs = self._layer.functional_state()
+            self._params, self._bufs = params, bufs
+            layer = self._layer
+
+            def fwd(params, bufs, *xs):
+                saved = layer.functional_state()
+                layer.load_functional_state(params, bufs)
+                try:
+                    out = layer(*[Tensor(x) for x in xs])
+                finally:
+                    layer.load_functional_state(*saved)
+                return jax.tree_util.tree_map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+            self._fn = jax.jit(fwd)
+        else:
+            self._fn = jax.jit(model)
+            self._params, self._bufs = {}, {}
+
+    def run(self, inputs):
+        xs = [i._value if isinstance(i, Tensor) else np.asarray(i)
+              for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+        if self._layer is not None:
+            out = self._fn(self._params, self._bufs, *xs)
+        else:
+            out = self._fn(*xs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    __call__ = run
+
+
+def create_predictor(config_or_model, example_inputs=None):
+    if isinstance(config_or_model, Config):
+        from ..jit import load as jit_load
+        payload = jit_load(config_or_model.model_path)
+        raise NotImplementedError(
+            "file-based predictor requires jit.save'd layer; "
+            "pass the Layer directly")
+    return Predictor(config_or_model, example_inputs)

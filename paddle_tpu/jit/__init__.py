@@ -1,0 +1,183 @@
+"""paddle.jit — dygraph→static compilation.
+
+Reference: python/paddle/fluid/dygraph/jit.py + dygraph_to_static/. TPU-first
+rework: instead of AST transpilation to ProgramDesc, `to_static` functionalizes
+the layer (params become pytree inputs) and hands the SAME eager code to
+`jax.jit` — XLA compiles the whole forward (or train step) into one fused TPU
+computation, cached per input shape.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def _wrap(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(x, Tensor):
+        return Tensor(x)
+    return x
+
+
+class StaticFunction:
+    """Compiled callable. Parameters and buffers of every Layer touched are
+    passed functionally so weight updates between calls don't retrigger
+    compilation (they're inputs, not constants)."""
+
+    def __init__(self, fn, layer=None, input_spec=None, donate_params=False):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._compiled = None
+        self._training = None
+
+    def _get_layer(self, args):
+        if self._layer is not None:
+            return self._layer, args
+        if args and isinstance(args[0], Layer):
+            return args[0], args[1:]
+        return None, args
+
+    def __call__(self, *args, **kwargs):
+        from ..core import rng
+        layer, call_args = self._get_layer(args)
+
+        if layer is None:
+            if self._compiled is None:
+                self._compiled = jax.jit(
+                    lambda a, k: jax.tree_util.tree_map(
+                        _unwrap, self._fn(*a, **k),
+                        is_leaf=lambda x: isinstance(x, Tensor)))
+            raw_args = jax.tree_util.tree_map(
+                _unwrap, call_args, is_leaf=lambda x: isinstance(x, Tensor))
+            raw_kw = jax.tree_util.tree_map(
+                _unwrap, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+            out = self._compiled(raw_args, raw_kw)
+            return jax.tree_util.tree_map(_wrap, out)
+
+        # layer path: functionalize params/buffers
+        if self._compiled is None or self._training != layer.training:
+            self._training = layer.training
+            fn = self._fn
+
+            def pure(params, buffers, a, k, key):
+                rng_saved = rng._default_generator._key, rng._default_generator._count
+                rng._default_generator._key = key
+                rng._default_generator._count = 0
+                saved_p, saved_b = layer.functional_state()
+                layer.load_functional_state(params, buffers)
+                try:
+                    out = fn(layer, *a, **k) if not hasattr(fn, "__self__") \
+                        else fn(*a, **k)
+                    out_raw = jax.tree_util.tree_map(
+                        _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+                    _, new_bufs = layer.functional_state()
+                    return out_raw, new_bufs
+                finally:
+                    # restore concrete values so the live layer never holds
+                    # trace-time tracers after compilation
+                    layer.load_functional_state(saved_p, saved_b)
+                    (rng._default_generator._key,
+                     rng._default_generator._count) = rng_saved
+            self._compiled = jax.jit(pure)
+
+        params, buffers = layer.functional_state()
+        raw_args = jax.tree_util.tree_map(
+            _unwrap, call_args, is_leaf=lambda x: isinstance(x, Tensor))
+        raw_kw = jax.tree_util.tree_map(
+            _unwrap, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+        out, new_bufs = self._compiled(params, buffers, raw_args, raw_kw,
+                                       rng.next_key())
+        layer.load_functional_state(None, new_bufs)
+        return jax.tree_util.tree_map(_wrap, out)
+
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<compiled>"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static — compile a function or Layer.forward with XLA."""
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(type(layer).forward, layer=layer,
+                                input_spec=input_spec)
+            layer.forward = sf
+            return layer
+        wrapped = StaticFunction(fn, input_spec=input_spec)
+        functools.update_wrapper(wrapped, fn, updated=[])
+        return wrapped
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+class TranslatedLayer(Layer):
+    """Inference-loaded model (ref: fluid/dygraph/io.py TranslatedLayer)."""
+
+    def __init__(self, state, forward_fn):
+        super().__init__()
+        self._state = state
+        self._forward_fn = forward_fn
+
+    def forward(self, *args):
+        return self._forward_fn(self._state, *args)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — params + a spec of the forward for later load."""
+    from ..framework.io import save as fsave
+    state = {k: v for k, v in layer.state_dict().items()}
+    fsave({"state_dict": state,
+           "class_name": type(layer).__name__}, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as fload
+    payload = fload(path + ".pdparams")
+    return payload
+
+
+def not_to_static(fn):
+    fn.__not_to_static__ = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class ProgramTranslator:
+    """API-parity shim (ref: dygraph_to_static/program_translator.py)."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag):
+        ProgramTranslator.enable_to_static = flag
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator.get_instance().enable(flag)

@@ -1,0 +1,175 @@
+"""BERT / ERNIE — encoder-only transformer for pretraining.
+
+Reference configs: "BERT-base pretraining (fluid transformer ops → XLA)" and
+"ERNIE-large under paddle.distributed.fleet collective" (BASELINE.json).
+ERNIE shares BERT's architecture (it differs in masking strategy/data, which
+lives in the input pipeline), so ErnieConfig aliases BertConfig sizes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn, ops
+from ..core.tensor import Tensor
+from ..nn import initializer as I
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+
+    @classmethod
+    def base(cls):
+        return cls()
+
+    @classmethod
+    def large(cls):
+        return cls(hidden_size=1024, num_layers=24, num_heads=16,
+                   intermediate_size=4096)
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=1024, hidden_size=128, num_layers=2,
+                   num_heads=4, intermediate_size=512, max_position=128)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        attr = I.Normal(0.0, 0.02)
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=attr)
+        self.position_embeddings = nn.Embedding(cfg.max_position,
+                                                cfg.hidden_size,
+                                                weight_attr=attr)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=attr)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(0, s, dtype="int32")
+        if token_type_ids is None:
+            token_type_ids = ops.zeros(input_ids.shape, "int32")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class Bert(nn.Layer):
+    def __init__(self, cfg: BertConfig = None, **kw):
+        super().__init__()
+        cfg = cfg or BertConfig(**kw)
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.dropout, activation="gelu")
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        # MLM head
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_epsilon)
+        self.mlm_bias = self.create_parameter(
+            (cfg.vocab_size,), is_bias=True,
+            default_initializer=I.Constant(0.0))
+        # NSP head
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        mask = None
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            m = ops.unsqueeze(attention_mask.astype("float32"), [1, 2])
+            mask = (1.0 - m) * -1e30
+        seq = self.encoder(x, mask)
+        pooled = ops.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+    def mlm_logits(self, seq):
+        h = ops.gelu(self.mlm_transform(seq))
+        h = self.mlm_norm(h)
+        logits = ops.matmul(h, self.embeddings.word_embeddings.weight,
+                            transpose_y=True) + self.mlm_bias
+        return logits
+
+    def pretraining_loss(self, input_ids, labels, next_sentence_label=None,
+                         token_type_ids=None, attention_mask=None):
+        """MLM (+ optional NSP) loss; labels use -100 for unmasked tokens."""
+        seq, pooled = self(input_ids, token_type_ids, attention_mask)
+        logits = self.mlm_logits(seq)
+        mlm = ops.cross_entropy(
+            ops.reshape(logits, [-1, self.cfg.vocab_size]),
+            ops.reshape(labels, [-1]), ignore_index=-100)
+        if next_sentence_label is not None:
+            nsp = ops.cross_entropy(self.nsp(pooled),
+                                    ops.reshape(next_sentence_label, [-1]))
+            return mlm + nsp
+        return mlm
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    @classmethod
+    def large(cls):
+        return cls(vocab_size=18000, hidden_size=1024, num_layers=24,
+                   num_heads=16, intermediate_size=4096)
+
+
+class Ernie(Bert):
+    """ERNIE-large: BERT architecture + entity-level masking (data-side)."""
+
+    def __init__(self, cfg: ErnieConfig = None, **kw):
+        super().__init__(cfg or ErnieConfig(**kw))
+
+
+def build_train_step(cfg: BertConfig, remat=False):
+    """Pure (params, batch, key) -> loss for pjit/fleet (same pattern as
+    gpt2.build_train_step)."""
+    import jax
+
+    from ..core import rng as rng_mod
+
+    model = Bert(cfg)
+    model.train()
+
+    def init_params():
+        p, _ = model.functional_state()
+        return p
+
+    def loss_fn(params, batch, key):
+        saved_p, saved_b = model.functional_state()
+        rng_saved = (rng_mod._default_generator._key,
+                     rng_mod._default_generator._count)
+        rng_mod._default_generator._key = key
+        rng_mod._default_generator._count = 0
+        model.load_functional_state(params, None)
+        try:
+            loss = model.pretraining_loss(
+                Tensor(batch["input_ids"]), Tensor(batch["labels"]),
+                next_sentence_label=None)
+            return loss._value
+        finally:
+            model.load_functional_state(saved_p, saved_b)
+            (rng_mod._default_generator._key,
+             rng_mod._default_generator._count) = rng_saved
+
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
+    return loss_fn, init_params, model

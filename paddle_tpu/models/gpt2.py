@@ -1,0 +1,163 @@
+"""GPT-2 — decoder-only transformer LM.
+
+Reference config: "GPT-2 medium with fused_attention_op → Pallas flash-attn,
+pipeline-parallel Fleet" (BASELINE.json). TPU-first construction:
+  * attention → ops.scaled_dot_product_attention (Pallas flash-attn on TPU)
+  * pre-LN blocks, tied embeddings, bf16-friendly
+  * `build_train_step` returns a pure (params, batch, key) -> loss function
+    for pjit/fleet hybrid-parallel execution; `jax.checkpoint` per block when
+    remat=True (recompute strategy).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn, ops
+from ..core.tensor import Tensor
+from ..nn import initializer as I
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position: int = 1024
+    intermediate_size: int = None  # defaults to 4*hidden
+    dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    tie_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @classmethod
+    def small(cls):
+        return cls()
+
+    @classmethod
+    def medium(cls):
+        return cls(hidden_size=1024, num_layers=24, num_heads=16)
+
+    @classmethod
+    def large(cls):
+        return cls(hidden_size=1280, num_layers=36, num_heads=20)
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=1024, hidden_size=128, num_layers=2,
+                   num_heads=4, max_position=256)
+
+
+class GPT2Block(nn.Layer):
+    def __init__(self, cfg: GPT2Config):
+        super().__init__()
+        h = cfg.hidden_size
+        self.ln_1 = nn.LayerNorm(h, epsilon=cfg.layer_norm_epsilon)
+        self.attn = nn.MultiHeadAttention(h, cfg.num_heads, cfg.dropout)
+        self.ln_2 = nn.LayerNorm(h, epsilon=cfg.layer_norm_epsilon)
+        self.fc1 = nn.Linear(h, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, h)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        a = self.ln_1(x)
+        q = self.attn.q_proj(a)
+        k = self.attn.k_proj(a)
+        v = self.attn.v_proj(a)
+        b, s = a.shape[0], a.shape[1]
+        nh, hd = self.attn.num_heads, self.attn.head_dim
+        q = ops.transpose(ops.reshape(q, [b, s, nh, hd]), [0, 2, 1, 3])
+        k = ops.transpose(ops.reshape(k, [b, s, nh, hd]), [0, 2, 1, 3])
+        v = ops.transpose(ops.reshape(v, [b, s, nh, hd]), [0, 2, 1, 3])
+        o, _ = ops.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=True,
+            dropout_p=self.attn.dropout if self.training else 0.0)
+        o = ops.reshape(ops.transpose(o, [0, 2, 1, 3]), [b, s, nh * hd])
+        x = x + self.dropout(self.attn.out_proj(o))
+        m = self.ln_2(x)
+        m = self.fc2(ops.gelu(self.fc1(m), approximate=True))
+        return x + self.dropout(m)
+
+
+class GPT2(nn.Layer):
+    def __init__(self, cfg: GPT2Config = None, **kw):
+        super().__init__()
+        cfg = cfg or GPT2Config(**kw)
+        self.cfg = cfg
+        init_std = 0.02
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=I.Normal(0.0, init_std))
+        self.wpe = nn.Embedding(cfg.max_position, cfg.hidden_size,
+                                weight_attr=I.Normal(0.0, init_std))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.h = nn.LayerList([GPT2Block(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x, attn_mask)
+        x = self.ln_f(x)
+        if self.cfg.tie_embeddings:
+            logits = ops.matmul(x, self.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return ops.cross_entropy(
+            ops.reshape(logits, [-1, self.cfg.vocab_size]),
+            ops.reshape(labels, [-1]))
+
+
+def build_train_step(cfg: GPT2Config, remat=False, dtype="float32"):
+    """Pure functional GPT-2 loss for pjit/fleet: returns
+    (loss_fn(params, batch, key), init_params()). The module tree above is
+    used once to materialize params; the pure fn re-binds them per call.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import rng as rng_mod
+
+    model = GPT2(cfg)
+    model.train()
+    if dtype != "float32":
+        model.to(dtype=dtype)
+
+    def init_params():
+        p, _ = model.functional_state()
+        return p
+
+    def loss_fn(params, batch, key):
+        saved_p, saved_b = model.functional_state()
+        rng_saved = (rng_mod._default_generator._key,
+                     rng_mod._default_generator._count)
+        rng_mod._default_generator._key = key
+        rng_mod._default_generator._count = 0
+        model.load_functional_state(params, None)
+        try:
+            input_ids, labels = batch["input_ids"], batch["labels"]
+            loss = model.loss(Tensor(input_ids), Tensor(labels))
+            return loss._value
+        finally:
+            model.load_functional_state(saved_p, saved_b)
+            (rng_mod._default_generator._key,
+             rng_mod._default_generator._count) = rng_saved
+
+    if remat:
+        import jax
+        loss_fn = jax.checkpoint(loss_fn)
+    return loss_fn, init_params, model

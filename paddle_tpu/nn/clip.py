@@ -1,0 +1,100 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """Operate on list of (param, grad Tensor) pairs (static-graph style)."""
+        params = [p for p, _ in params_grads]
+        grads = [g._value if isinstance(g, Tensor) else g for _, g in params_grads]
+        clipped = self._clip_raw(params, grads)
+        return [(p, Tensor(g)) for p, g in zip(params, clipped)]
+
+    def _clip_raw(self, params, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _clip_raw(self, params, grads):
+        return [jnp.clip(g, self.min, self.max) if _clippable(p) else g
+                for p, g in zip(params, grads)]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip_raw(self, params, grads):
+        out = []
+        for p, g in zip(params, grads):
+            if not _clippable(p):
+                out.append(g)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append(g * scale)
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def _clip_raw(self, params, grads):
+        sq = [jnp.sum(jnp.square(g)) for p, g in zip(params, grads)
+              if _clippable(p)]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [g * scale if _clippable(p) else g
+                for p, g in zip(params, grads)]
+
+    def clip_tree(self, grads_tree):
+        """Pure pytree version for jitted steps."""
+        import jax
+        leaves = jax.tree_util.tree_leaves(grads_tree)
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads_tree)
+
+
+def _clippable(p):
+    return getattr(p, "need_clip", True)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """paddle.nn.utils.clip_grad_norm_ equivalent (eager, in-place on .grad)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p.grad._value), norm_type))
+                              for p in params), 1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad = Tensor(p.grad._value * scale)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._value, -clip_value, clip_value))
+
+
+# fluid-era aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

@@ -1,0 +1,44 @@
+"""paddle.nn.functional namespace (ref: python/paddle/nn/functional/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops import (  # noqa: F401
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, affine_grid, alpha_dropout,
+    avg_pool1d, avg_pool2d, avg_pool3d, batch_norm,
+    binary_cross_entropy, binary_cross_entropy_with_logits, celu,
+    conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
+    conv3d_transpose, cosine_embedding_loss, cosine_similarity,
+    cross_entropy, ctc_loss, dropout, dropout2d, dropout3d, elu, embedding,
+    gelu, glu, grid_sample, group_norm, gumbel_softmax, hardshrink,
+    hardsigmoid, hardswish, hardtanh, hinge_loss, instance_norm,
+    interpolate, kl_div, l1_loss, label_smooth, layer_norm, leaky_relu,
+    linear, local_response_norm, log_loss, log_sigmoid, log_softmax,
+    margin_ranking_loss, max_pool1d, max_pool2d, max_pool3d, maxout, mish,
+    mse_loss, nll_loss, normalize, npair_loss, one_hot, pad,
+    pairwise_distance, pixel_shuffle, pixel_unshuffle, prelu, relu, relu6,
+    rms_norm, selu, sigmoid, sigmoid_focal_loss, silu, smooth_l1_loss,
+    softmax, softmax_with_cross_entropy, softplus, softshrink, softsign,
+    square_error_cost, stanh, swish, tanh, tanhshrink, temporal_shift,
+    thresholded_relu, triplet_margin_loss, unfold, upsample,
+)
+from ...ops._registry import defop
+
+grid_sampler = grid_sample
+sigmoid_cross_entropy_with_logits = binary_cross_entropy_with_logits
+
+
+@defop(name="sequence_mask", nondiff=True)
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    from ...core import dtype as dtype_mod
+    ln = jnp.asarray(lengths)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(ln))
+    rng_ = jnp.arange(m)
+    return (rng_[None, :] < ln[..., None]).astype(dtype_mod.convert_dtype(dtype))
+
+
+@defop(name="diag_embed_f")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    from ...ops.creation import diag_embed as _de
+    return _de.__raw_fn__(x, offset, dim1, dim2)

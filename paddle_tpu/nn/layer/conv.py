@@ -1,0 +1,124 @@
+"""Convolution layers (ref: python/paddle/nn/layer/conv.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from .. import initializer as I
+from .layers import Layer
+
+
+def _ntuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nsp,
+                 stride=1, padding=0, dilation=1, groups=1, transpose=False,
+                 output_padding=0, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.kernel_size = _ntuple(kernel_size, nsp)
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        self.output_padding = output_padding
+        self.data_format = data_format
+        self._nsp = nsp
+        self._transpose = transpose
+        if transpose:
+            w_shape = (in_channels, out_channels // groups) + self.kernel_size
+        else:
+            w_shape = (out_channels, in_channels // groups) + self.kernel_size
+        fan_in = (in_channels // groups) * int(np.prod(self.kernel_size))
+        std = (2.0 / fan_in) ** 0.5
+        self.weight = self.create_parameter(
+            w_shape, attr=weight_attr, default_initializer=I.Normal(0.0, std))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, "
+                f"kernel_size={self.kernel_size}, stride={self.stride}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, False, 0, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv1d(x, self.weight, self.bias, self.stride, self.padding,
+                          self.dilation, self.groups, self.data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, False, 0, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                          self.dilation, self.groups, self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, False, 0, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                          self.dilation, self.groups, self.data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, True, output_padding,
+                         "zeros", weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                    self.padding, self.output_padding,
+                                    self.dilation, self.groups, self.data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, True, output_padding,
+                         "zeros", weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv2d_transpose(x, self.weight, self.bias, self.stride,
+                                    self.padding, self.output_padding,
+                                    self.dilation, self.groups, self.data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, True, output_padding,
+                         "zeros", weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                    self.padding, self.output_padding,
+                                    self.dilation, self.groups, self.data_format)

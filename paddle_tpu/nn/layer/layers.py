@@ -1,0 +1,389 @@
+"""Layer — the module base class.
+
+Reference: python/paddle/fluid/dygraph/layers.py (Layer) + container.py
+(Sequential/LayerList/ParameterList). A Layer owns Parameters (trainable
+Tensors) and buffers; `functional_state`/`load_functional_state` expose the
+whole tree as a JAX pytree so jitted/pjit'ed train steps can run the SAME
+layer code functionally — that is the TPU perf path.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ...core import dtype as dtype_mod
+from ...core.param_attr import ParamAttr
+from ...core.tensor import Parameter, Tensor
+from ...core import unique_name
+from .. import initializer as I
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._name_scope = name_scope or type(self).__name__.lower()
+        self._full_name = unique_name.generate(self._name_scope)
+
+    # ---- construction ----------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = init(shape, dtype)
+        p = Parameter(value, name=attr.name or unique_name.generate("param"),
+                      trainable=attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (layers, buffers):
+                d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (params, buffers):
+                d.pop(name, None)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+        return list(super().__dir__()) + extra
+
+    # ---- traversal -------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(sub_prefix, False)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def apply(self, fn):
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # ---- modes -----------------------------------------------------------
+    def train(self):
+        self.training = True
+        for layer in self.children():
+            layer.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.children():
+            layer.eval()
+        return self
+
+    # ---- hooks -----------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        hid = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[hid] = hook
+        return _HookRemover(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        hid = len(self._forward_post_hooks)
+        self._forward_post_hooks[hid] = hook
+        return _HookRemover(self._forward_post_hooks, hid)
+
+    # ---- call ------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    # ---- state -----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(structured_name_prefix.rstrip("."),
+                                             include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(structured_name_prefix.rstrip("."),
+                                          include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            # find owning layer to check persistability
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+                t.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ---- functional bridge (TPU perf path) --------------------------------
+    def functional_state(self):
+        """(param_pytree, buffer_pytree) of raw jax arrays, keyed by name."""
+        params = {n: p._value for n, p in self.named_parameters()}
+        bufs = {n: b._value for n, b in self.named_buffers()}
+        return params, bufs
+
+    def load_functional_state(self, params=None, buffers=None):
+        if params:
+            lookup = dict(self.named_parameters())
+            for n, v in params.items():
+                lookup[n]._value = v
+        if buffers:
+            lookup = dict(self.named_buffers())
+            for n, v in buffers.items():
+                lookup[n]._value = v
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = dtype_mod.convert_dtype(dtype)
+            for p in self.parameters():
+                if dtype_mod.is_floating(p._value.dtype):
+                    p._value = p._value.astype(dt)
+            for b in self.buffers():
+                if dtype_mod.is_floating(b._value.dtype):
+                    b._value = b._value.astype(dt)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = "\n".join("  " + l for l in mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str.strip()}" if "\n" not in mod_str
+                         else f"({name}): " + mod_str.strip())
+        main = type(self).__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+
+class _HookRemover:
+    def __init__(self, store, hid):
+        self._store, self._hid = store, hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx % len(self))]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx % len(self))]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, p):
+        self.add_parameter(str(len(self)), p)
+        return self

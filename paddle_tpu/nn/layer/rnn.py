@@ -1,0 +1,371 @@
+"""Recurrent layers.
+
+Reference: python/paddle/nn/layer/rnn.py (RNNCellBase, SimpleRNNCell,
+LSTMCell, GRUCell, RNN, SimpleRNN/LSTM/GRU) + the CUDNN rnn_op. TPU-first:
+the time loop is ONE `lax.scan` per layer/direction — XLA compiles it into a
+single fused while-loop with the gate matmuls on the MXU (batched [B, 4H]
+projections), replacing cuDNN's fused RNN kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...ops._registry import defop
+from .. import initializer as I
+from .layers import Layer, LayerList
+
+
+# ---------------------------------------------------------------- scan ops --
+
+@defop()
+def rnn_scan_simple(x, h0, wi, wh, bi, bh, activation="tanh"):
+    """x: [B, T, I] -> (out [B, T, H], h_T [B, H])."""
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    xt = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+    x_proj = jnp.einsum("tbi,hi->tbh", xt, wi)
+    if bi is not None:
+        x_proj = x_proj + bi
+
+    def step(h, xp):
+        h_new = act(xp + h @ wh.T + (bh if bh is not None else 0.0))
+        return h_new, h_new
+
+    h_t, out = jax.lax.scan(step, h0, x_proj)
+    return jnp.swapaxes(out, 0, 1), h_t
+
+
+@defop()
+def lstm_scan(x, h0, c0, wi, wh, bi, bh):
+    """x: [B, T, I]; weights [4H, I]/[4H, H] gate order i,f,g,o (paddle:
+    input, forget, cell, output). Returns (out, h_T, c_T)."""
+    hsz = wh.shape[1]
+    xt = jnp.swapaxes(x, 0, 1)
+    x_proj = jnp.einsum("tbi,hi->tbh", xt, wi)  # [T, B, 4H] — batched MXU GEMM
+    if bi is not None:
+        x_proj = x_proj + bi
+
+    def step(carry, xp):
+        h, c = carry
+        gates = xp + h @ wh.T + (bh if bh is not None else 0.0)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (h_t, c_t), out = jax.lax.scan(step, (h0, c0), x_proj)
+    return jnp.swapaxes(out, 0, 1), h_t, c_t
+
+
+@defop()
+def gru_scan(x, h0, wi, wh, bi, bh):
+    """Gate order r,z,c (paddle GRUCell: reset, update, cell)."""
+    xt = jnp.swapaxes(x, 0, 1)
+    x_proj = jnp.einsum("tbi,hi->tbh", xt, wi)
+    if bi is not None:
+        x_proj = x_proj + bi
+
+    def step(h, xp):
+        h_proj = h @ wh.T + (bh if bh is not None else 0.0)
+        xr, xz, xc = jnp.split(xp, 3, axis=-1)
+        hr, hz, hc = jnp.split(h_proj, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        h_new = (1 - z) * c + z * h
+        return h_new, h_new
+
+    h_t, out = jax.lax.scan(step, h0, x_proj)
+    return jnp.swapaxes(out, 0, 1), h_t
+
+
+# --------------------------------------------------------------- cells ------
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or (self.hidden_size,)
+        if isinstance(shape, int):
+            shape = (shape,)
+        return ops.full([b] + list(shape), init_value, dtype)
+
+    def _init_weights(self, input_size, hidden_size, n_gates, weight_ih_attr,
+                      weight_hh_attr, bias_ih_attr, bias_hh_attr):
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (n_gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (n_gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (n_gates * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=u) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter(
+            (n_gates * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=u) if bias_hh_attr is not False else None
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self._init_weights(input_size, hidden_size, 1, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs)
+        act = ops.tanh if self.activation == "tanh" else ops.relu
+        pre = ops.linear(inputs, ops.t(self.weight_ih)) + \
+            ops.linear(h, ops.t(self.weight_hh))
+        if self.bias_ih is not None:
+            pre = pre + self.bias_ih
+        if self.bias_hh is not None:
+            pre = pre + self.bias_hh
+        h_new = act(pre)
+        return h_new, h_new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._init_weights(input_size, hidden_size, 4, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        gates = ops.linear(inputs, ops.t(self.weight_ih)) + \
+            ops.linear(h, ops.t(self.weight_hh))
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        i, f, g, o = ops.split(gates, 4, axis=-1)
+        i, f, o = ops.sigmoid(i), ops.sigmoid(f), ops.sigmoid(o)
+        g = ops.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * ops.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._init_weights(input_size, hidden_size, 3, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs)
+        xp = ops.linear(inputs, ops.t(self.weight_ih))
+        if self.bias_ih is not None:
+            xp = xp + self.bias_ih
+        hp = ops.linear(h, ops.t(self.weight_hh))
+        if self.bias_hh is not None:
+            hp = hp + self.bias_hh
+        xr, xz, xc = ops.split(xp, 3, axis=-1)
+        hr, hz, hc = ops.split(hp, 3, axis=-1)
+        r = ops.sigmoid(xr + hr)
+        z = ops.sigmoid(xz + hz)
+        c = ops.tanh(xc + r * hc)
+        h_new = (1 - z) * c + z * h
+        return h_new, h_new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (ref: nn.RNN). For the fused
+    built-in cells the multi-layer classes below call the scan ops directly;
+    this generic wrapper drives arbitrary cells step-by-step."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else ops.transpose(
+            inputs, [1, 0] + list(range(2, inputs.ndim)))
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = ops.stack(outs, axis=0)
+        if not self.time_major:
+            out = ops.transpose(out, [1, 0] + list(range(2, out.ndim)))
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw_states, bw_states = initial_states if initial_states else (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states)
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _FusedRNNBase(Layer):
+    """Multi-layer (optionally bidirectional) RNN over the fused scan ops."""
+
+    _mode = "LSTM"
+    _gates = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        ng = self._gates[self._mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                isz = input_size if layer == 0 else hidden_size * ndir
+                wi = self.create_parameter((ng * hidden_size, isz),
+                                           attr=weight_ih_attr,
+                                           default_initializer=u)
+                wh = self.create_parameter((ng * hidden_size, hidden_size),
+                                           attr=weight_hh_attr,
+                                           default_initializer=u)
+                bi = self.create_parameter((ng * hidden_size,),
+                                           attr=bias_ih_attr, is_bias=True,
+                                           default_initializer=u)
+                bh = self.create_parameter((ng * hidden_size,),
+                                           attr=bias_hh_attr, is_bias=True,
+                                           default_initializer=u)
+                sfx = f"l{layer}" + ("_reverse" if d else "")
+                self.add_parameter(f"weight_ih_{sfx}", wi)
+                self.add_parameter(f"weight_hh_{sfx}", wh)
+                self.add_parameter(f"bias_ih_{sfx}", bi)
+                self.add_parameter(f"bias_hh_{sfx}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def _run_single(self, x, weights, h0, c0, reverse):
+        if reverse:
+            x = ops.flip(x, axis=1)
+        wi, wh, bi, bh = weights
+        bias = bi + bh if bi is not None else None
+        if self._mode == "LSTM":
+            out, h, c = lstm_scan(x, h0, c0, wi, wh, bias, None)
+        elif self._mode == "GRU":
+            # GRU needs separate bh for the reset gating of hc
+            out, h = gru_scan(x, h0, wi, wh, bi, bh)
+            c = None
+        else:
+            out, h = rnn_scan_simple(x, h0, wi, wh, bias, None,
+                                     self.activation)
+            c = None
+        if reverse:
+            out = ops.flip(out, axis=1)
+        return out, h, c
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if not self.time_major else ops.transpose(
+            inputs, [1, 0, 2])
+        b = x.shape[0]
+        ndir = self.num_directions
+        nl = self.num_layers
+        if initial_states is None:
+            h0 = ops.zeros([nl * ndir, b, self.hidden_size], "float32")
+            c0 = ops.zeros([nl * ndir, b, self.hidden_size], "float32")
+        else:
+            if self._mode == "LSTM":
+                h0, c0 = initial_states
+            else:
+                h0, c0 = initial_states, None
+        h_outs, c_outs = [], []
+        out = x
+        for layer in range(nl):
+            outs_dir = []
+            for d in range(ndir):
+                idx = layer * ndir + d
+                hc = h0[idx]
+                cc = c0[idx] if c0 is not None and self._mode == "LSTM" else None
+                o, h, c = self._run_single(out, self._all_weights[idx], hc, cc,
+                                           reverse=bool(d))
+                outs_dir.append(o)
+                h_outs.append(h)
+                if c is not None:
+                    c_outs.append(c)
+            out = outs_dir[0] if ndir == 1 else ops.concat(outs_dir, axis=-1)
+            if self.dropout > 0 and layer < nl - 1:
+                out = ops.dropout(out, p=self.dropout, training=self.training)
+        final_h = ops.stack(h_outs, axis=0)
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        if self._mode == "LSTM":
+            final_c = ops.stack(c_outs, axis=0)
+            return out, (final_h, final_c)
+        return out, final_h
+
+
+class LSTM(_FusedRNNBase):
+    _mode = "LSTM"
+
+
+class GRU(_FusedRNNBase):
+    _mode = "GRU"
+
+
+class SimpleRNN(_FusedRNNBase):
+    _mode = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        self._mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)

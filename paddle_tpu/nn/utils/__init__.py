@@ -1,0 +1,21 @@
+"""paddle.nn.utils (clip_grad_norm_, weight_norm, spectral_norm helpers)."""
+from __future__ import annotations
+
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+
+def parameters_to_vector(parameters):
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+    return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters):
+    import numpy as np
+    offset = 0
+    v = vec.numpy()
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(v[offset:offset + n].reshape(p.shape))
+        offset += n

@@ -1,0 +1,124 @@
+"""Op definition machinery — the TPU-native "operator registry".
+
+Reference: paddle/fluid/framework/op_registry.h + the 349-file operator library.
+Rework: each op is ONE pure JAX function. The `defop` wrapper gives it the
+three execution paths of the reference for free:
+  * dygraph eager   — run now; record a jax.vjp pullback Node if grads needed
+                      (replaces per-op GradOpMaker + handwritten grad kernels);
+  * dygraph no-grad — run now, nothing recorded;
+  * static graph    — append an op node to the current Program (shape inference
+                      via jax.eval_shape, replacing InferShape), executed later
+                      as one fused XLA computation.
+Stochastic ops declare `stochastic=True` and receive an explicit PRNG `key`
+kwarg (eager: drawn from the global generator; static: threaded per-run).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import mode, rng
+from ..core.autograd import Node, grad_enabled
+from ..core.tensor import Tensor
+
+OPS: dict = {}
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _flatten(args, kwargs):
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor_leaf)
+    return leaves, treedef
+
+
+def _wrap_outputs(res, record_node, name, diff_tensors, vjp_fn):
+    multi = isinstance(res, (tuple, list))
+    outs_raw = list(res) if multi else [res]
+    outs = [None if o is None else Tensor(o, stop_gradient=not record_node)
+            for o in outs_raw]
+    if record_node:
+        live = [o for o in outs if o is not None]
+        node = Node(vjp_fn, diff_tensors, live, name, multi)
+        node._out_mask = [o is not None for o in outs]
+        for o in live:
+            o._node = node
+    if multi:
+        return type(res)(outs) if isinstance(res, tuple) else outs
+    return outs[0]
+
+
+def apply_op(fn, name, args, kwargs, nondiff=False, stochastic=False):
+    if mode.in_static_mode():
+        hook = mode.static_hook()
+        if hook is not None:
+            return hook(name, fn, args, kwargs,
+                        {"nondiff": nondiff, "stochastic": stochastic})
+    if stochastic and kwargs.get("key") is None:
+        kwargs = dict(kwargs)
+        kwargs["key"] = rng.next_key()
+
+    leaves, treedef = _flatten(args, kwargs)
+    vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
+
+    diff_idx = []
+    if not nondiff and grad_enabled():
+        for i, l in enumerate(leaves):
+            if (isinstance(l, Tensor) and not l.stop_gradient
+                    and jnp.issubdtype(l._value.dtype, jnp.inexact)):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, vals)
+        res = fn(*a2, **k2)
+        return _wrap_outputs(res, False, name, [], None)
+
+    diff_tensors = [leaves[i] for i in diff_idx]
+
+    def pure(*diff_vals):
+        v = list(vals)
+        for i, dv in zip(diff_idx, diff_vals):
+            v[i] = dv
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, v)
+        return fn(*a2, **k2)
+
+    res, vjp_fn = jax.vjp(pure, *[t._value for t in diff_tensors])
+    return _wrap_outputs(res, True, name, diff_tensors, vjp_fn)
+
+
+def defop(name=None, nondiff=False, stochastic=False):
+    """Register a pure JAX function as a framework op."""
+    def deco(fn):
+        opname = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return apply_op(fn, opname, args, kwargs, nondiff, stochastic)
+
+        wrapper.__opname__ = opname
+        wrapper.__raw_fn__ = fn
+        wrapper.__nondiff__ = nondiff
+        wrapper.__stochastic__ = stochastic
+        OPS[opname] = wrapper
+        return wrapper
+    return deco
+
+
+def raw(x):
+    """Unwrap Tensor → jax array (pass through everything else)."""
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(raw(e) for e in x)
+    return x
+
+
+def as_jax(x, dtype=None):
+    if isinstance(x, Tensor):
+        x = x._value
+    x = jnp.asarray(x)
+    return x if dtype is None else x.astype(dtype)

@@ -1,0 +1,100 @@
+"""Attention ops.
+
+Reference: the fused attention ops (paddle/fluid/operators/fused/ — north-star
+names fused_attention_op) and python/paddle/nn/functional/transformer.py.
+TPU-first: `scaled_dot_product_attention` dispatches to the Pallas
+flash-attention kernel on TPU (MXU-tiled, online softmax, O(S) memory);
+elsewhere it runs the plain einsum path, which XLA fuses well at small S.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _xla_attention(q, k, v, mask=None, scale=None, causal=False):
+    # q: [B, H, Sq, D]; k/v: [B, H, Sk, D]
+    scale = (q.shape[-1] ** -0.5) if scale is None else scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(cm, s, -1e30)
+    if mask is not None:
+        s = s + mask
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    return out, w
+
+
+@defop(stochastic=True)
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, scale=None,
+                                 return_weights=False, key=None):
+    """q,k,v: [B, H, S, D] (head-major). Dispatches to flash attention when
+    profitable; the weights output is only materialized when requested."""
+    use_flash = (_on_tpu() and attn_mask is None and dropout_p == 0.0
+                 and not return_weights and q.shape[-2] >= 128
+                 and q.shape[-1] in (32, 64, 128, 256)
+                 and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0)
+    if use_flash:
+        try:
+            from .pallas.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=is_causal, scale=scale)
+            return out, None
+        except Exception:
+            pass
+    out, w = _xla_attention(q, k, v, attn_mask, scale, is_causal)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, w.shape)
+        w_d = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w_d, v)
+    return out, (w if return_weights else None)
+
+
+@defop()
+def fused_multi_head_attention(x, qkv_weight, qkv_bias, out_weight, out_bias,
+                               num_heads, attn_mask=None, dropout_p=0.0,
+                               is_causal=False):
+    """Fused QKV projection + attention + output projection (ref:
+    fused_attention_op.cc). One einsum chain; XLA fuses the bias/reshape glue.
+
+    x: [B, S, E]; qkv_weight: [E, 3E]; out_weight: [E, E].
+    """
+    b, s, e = x.shape
+    d = e // num_heads
+    qkv = jnp.einsum("bse,ef->bsf", x, qkv_weight)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias
+    qkv = qkv.reshape(b, s, 3, num_heads, d)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+    out, _ = _xla_attention(q, k, v, attn_mask, None, is_causal)
+    out = jnp.moveaxis(out, 1, 2).reshape(b, s, e)
+    out = jnp.einsum("bse,ef->bsf", out, out_weight)
+    if out_bias is not None:
+        out = out + out_bias
+    return out
+
+
+@defop()
+def fused_feedforward(x, w1, b1, w2, b2, activation="gelu"):
+    """Fused FFN (ref: fused_feedforward_op) — XLA fuses act into the matmul."""
+    h = jnp.einsum("bse,ef->bsf", x, w1)
+    if b1 is not None:
+        h = h + b1
+    h = jax.nn.gelu(h) if activation == "gelu" else jax.nn.relu(h)
+    out = jnp.einsum("bsf,fe->bse", h, w2)
+    if b2 is not None:
+        out = out + b2
+    return out

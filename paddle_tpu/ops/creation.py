@@ -1,0 +1,142 @@
+"""Tensor creation ops.
+
+Reference: paddle/fluid/operators/fill_constant_op.cc, range_op.cc,
+linspace_op.cc, eye_op.cc, tril_triu_op.cc; python/paddle/tensor/creation.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ._registry import defop
+
+
+def _dt(dtype):
+    return dtype_mod.convert_dtype(dtype)
+
+
+@defop(nondiff=True)
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+@defop(nondiff=True)
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _dt(dtype))
+
+
+@defop(nondiff=True)
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, _dt(dtype) if dtype is not None else None)
+
+
+@defop()
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype) if dtype else None)
+
+
+@defop()
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt(dtype) if dtype else None)
+
+
+@defop()
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype) if dtype else None)
+
+
+@defop(nondiff=True)
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=_dt(dtype) if dtype else None)
+
+
+@defop(nondiff=True)
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype) if dtype else None)
+
+
+@defop(nondiff=True)
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=_dt(dtype) if dtype else None)
+
+
+@defop(nondiff=True)
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+@defop()
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop()
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@defop()
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, padding_value)
+    return jnp.diag(x, k=offset)
+
+
+@defop()
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@defop()
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + (-offset if offset < 0 else 0)
+    c = idx + (offset if offset > 0 else 0)
+    out = base.at[..., r, c].set(x)
+    # move the two new dims into position
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    order = sorted([(d1, nd - 2), (d2, nd - 1)])
+    for pos, src in order:
+        perm.insert(pos, src)
+    return jnp.transpose(out, perm)
+
+
+@defop(nondiff=True)
+def meshgrid(*xs):
+    xs = xs[0] if len(xs) == 1 and isinstance(xs[0], (list, tuple)) else xs
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+@defop()
+def assign(x):
+    return jnp.asarray(x)
+
+
+@defop()
+def clone(x):
+    return jnp.asarray(x)
+
+
+@defop(nondiff=True)
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+@defop(nondiff=True)
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype) if dtype else None)
+
+
+@defop(nondiff=True)
+def complex_(real, imag):
+    return jax.lax.complex(real, imag)

@@ -1,0 +1,205 @@
+"""Linear algebra ops.
+
+Reference: paddle/fluid/operators/{matmul_v2,mul,bmm,addmm,dot,cholesky,
+inverse,matrix_power,svd?,norm,dist,p_norm}_op.* and python/paddle/tensor/linalg.py.
+matmul/dot_general are the MXU workhorses — keep operands bf16-friendly and let
+XLA pick the contraction tiling.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop
+
+
+@defop()
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@defop()
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop()
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop()
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop()
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@defop()
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@defop()
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@defop()
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))
+
+
+@defop()
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@defop()
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro" and axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p if p != "fro" else "fro",
+                               axis=tuple(axis), keepdims=keepdim)
+    if p == jnp.inf or p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -jnp.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+p_norm = norm
+
+
+@defop()
+def dist(x, y, p=2):
+    d = jnp.abs(x - y)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+
+@defop()
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@defop()
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@defop()
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@defop()
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop(nondiff=True)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop()
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop()
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@defop()
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@defop()
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@defop()
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@defop()
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop()
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop()
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@defop()
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@defop()
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop()
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@defop()
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(jnp.asarray(x).reshape(-1), weights=weights,
+                        minlength=minlength)
+
+
+@defop()
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@defop()
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop()
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

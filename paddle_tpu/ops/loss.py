@@ -1,0 +1,280 @@
+"""Loss ops.
+
+Reference: paddle/fluid/operators/{softmax_with_cross_entropy,cross_entropy,
+bce_loss,sigmoid_cross_entropy_with_logits,smooth_l1_loss,kldiv_loss,
+margin_rank_loss,log_loss,huber_loss,hinge_loss,square_error_cost,
+sigmoid_focal_loss}_op.* and python/paddle/nn/functional/loss.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop
+
+
+def _reduce(loss, reduction, weight_sum=None):
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if weight_sum is not None:
+        return jnp.sum(loss) / jnp.maximum(weight_sum, 1e-12)
+    return jnp.mean(loss)
+
+
+@defop()
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    axis = axis % input.ndim
+    logp = jax.nn.log_softmax(input, axis=axis) if use_softmax else jnp.log(
+        jnp.maximum(input, 1e-30))
+    if soft_label:
+        labels = label
+        if label_smoothing > 0:
+            k = input.shape[axis]
+            labels = labels * (1 - label_smoothing) + label_smoothing / k
+        loss = -jnp.sum(labels * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(labels * weight, axis=axis)
+            loss = loss * w
+            return _reduce(loss, reduction, jnp.sum(w))
+        return _reduce(loss, reduction)
+    lbl = jnp.asarray(label)
+    if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe_lbl = jnp.where(valid, lbl, 0)
+    k = input.shape[axis]
+    if label_smoothing > 0:
+        onehot = jax.nn.one_hot(safe_lbl, k, axis=axis, dtype=logp.dtype)
+        onehot = onehot * (1 - label_smoothing) + label_smoothing / k
+        loss = -jnp.sum(onehot * logp, axis=axis)
+    else:
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe_lbl, axis),
+                                    axis=axis).squeeze(axis)
+    if weight is not None:
+        w = jnp.take(weight, safe_lbl) * valid.astype(logp.dtype)
+        loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        return _reduce(loss, reduction, jnp.sum(w))
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = jnp.asarray(label)
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            pass
+        else:
+            lbl = jnp.expand_dims(lbl, axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        loss = -jnp.take_along_axis(logp, jnp.where(valid, lbl, 0), axis=axis)
+        loss = jnp.where(valid, loss, 0.0)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+@defop()
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    x = jnp.clip(input, 1e-12, 1 - 1e-7)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop()
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    neg_abs = -jnp.abs(logit)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(neg_abs))
+                                              + jnp.maximum(-logit, 0.0))
+    else:
+        loss = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+sigmoid_cross_entropy_with_logits = binary_cross_entropy_with_logits
+
+
+@defop()
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@defop()
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(input - label)
+
+
+@defop()
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@defop()
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def huber_loss(input, label, delta=1.0):  # noqa: A002
+    d = jnp.abs(input - label)
+    return jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+
+
+@defop()
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    loss = label * (jnp.log(jnp.maximum(label, 1e-30)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@defop()
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
+    lbl = jnp.asarray(label).astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    loss = -jnp.take_along_axis(input, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+    w = jnp.ones_like(loss) if weight is None else jnp.take(weight, safe)
+    w = w * valid.astype(loss.dtype)
+    loss = loss * w
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+@defop()
+def hinge_loss(logits, labels):
+    return jnp.maximum(1.0 - logits * (2.0 * labels - 1.0), 0.0)
+
+
+@defop()
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    return -label * jnp.log(input + epsilon) \
+        - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+@defop()
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@defop()
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def d(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1),
+                         1.0 / p)
+    dp = d(anchor, positive)
+    dn = d(anchor, negative)
+    if swap:
+        dn = jnp.minimum(dn, d(positive, negative))
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+@defop()
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean"):
+    """CTC via the standard dynamic-programming recursion under lax.scan.
+
+    log_probs: [T, B, C] log-softmaxed; labels: [B, S] padded with any value.
+    """
+    T, B, C = log_probs.shape
+    S = labels.shape[1]
+    L = 2 * S + 1
+    lab = jnp.asarray(labels).astype(jnp.int32)
+    ext = jnp.full((B, L), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    neg_inf = -1e30
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    init = jnp.full((B, L), neg_inf)
+    init = init.at[:, 0].set(log_probs[0, jnp.arange(B), ext[:, 0]])
+    init = init.at[:, 1].set(jnp.where(S > 0, log_probs[0, jnp.arange(B), ext[:, 1]],
+                                       neg_inf))
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        return jnp.where(jnp.isfinite(m),
+                         m + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe)), m)
+
+    def step(alpha, logp_t):
+        shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(same_as_prev2, neg_inf, shift2)
+        a = lse(lse(alpha, shift1), shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        new = a + emit
+        return new, new
+
+    _, alphas = jax.lax.scan(step, init, log_probs[1:])
+    alphas = jnp.concatenate([init[None], alphas], axis=0)  # [T, B, L]
+    t_idx = jnp.asarray(input_lengths).astype(jnp.int32) - 1
+    final = alphas[t_idx, jnp.arange(B)]  # [B, L]
+    last = 2 * jnp.asarray(label_lengths).astype(jnp.int32)
+    p_blank = jnp.take_along_axis(final, last[:, None], axis=1)[:, 0]
+    p_label = jnp.take_along_axis(final, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    loss = -lse(p_blank, jnp.where(label_lengths > 0, p_label, neg_inf))
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(jnp.asarray(label_lengths), 1))
+    return _reduce(loss, reduction)
+
+
+@defop()
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = jnp.matmul(anchor, positive.T)
+    lbl = jnp.asarray(labels).reshape(-1)
+    target = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), axis=1))) / 2
+    return ce + reg

@@ -1,0 +1,343 @@
+"""Shape/layout manipulation ops.
+
+Reference: paddle/fluid/operators/{reshape,transpose,concat,split,stack,slice,
+strided_slice,gather,gather_nd,scatter,scatter_nd_add,tile,expand,pad,flip,
+roll,squeeze,unsqueeze,flatten,unbind,unstack,where_index}_op.* and
+python/paddle/tensor/manipulation.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ._registry import defop
+
+
+@defop()
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(int(s) for s in shape))
+
+
+@defop()
+def transpose(x, perm):
+    return jnp.transpose(x, tuple(int(p) for p in perm))
+
+
+@defop()
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+@defop()
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@defop()
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@defop()
+def concat(xs, axis=0):
+    return jnp.concatenate(list(xs), axis=int(axis))
+
+
+@defop()
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=int(axis))
+
+
+@defop()
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list, -1 allowed once (infer)
+    secs = list(num_or_sections)
+    if -1 in secs:
+        known = sum(s for s in secs if s != -1)
+        secs[secs.index(-1)] = x.shape[axis] - known
+    idx = []
+    acc = 0
+    for s in secs[:-1]:
+        acc += s
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@defop()
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=int(axis)))
+
+
+@defop()
+def unstack(x, axis=0, num=None):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+unbind = unstack
+
+
+@defop()
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(a % x.ndim for a in axes)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+@defop()
+def unsqueeze(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    final_nd = x.ndim + len(axes)
+    for a in sorted(a % final_nd for a in axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@defop()
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, shape)
+
+
+@defop()
+def slice(x, axes, starts, ends):  # noqa: A001
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = jnp.s_[s:e]
+    return x[tuple(idx)]
+
+
+@defop()
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = jnp.s_[s:e:st]
+    return x[tuple(idx)]
+
+
+@defop()
+def gather(x, index, axis=0):
+    index = jnp.asarray(index)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    return jnp.take(x, index, axis=int(axis))
+
+
+@defop()
+def gather_nd(x, index):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop()
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@defop()
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "add":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False, mode="add") \
+            if hasattr(jnp, "put_along_axis") else _put_along(x, indices, values, axis, True)
+    return _put_along(x, indices, values, axis, False)
+
+
+def _put_along(x, indices, values, axis, add):
+    axis = axis % x.ndim
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    idx = list(grids)
+    idx[axis] = indices
+    values = jnp.broadcast_to(jnp.asarray(values, x.dtype), indices.shape)
+    if add:
+        return x.at[tuple(idx)].add(values)
+    return x.at[tuple(idx)].set(values)
+
+
+@defop()
+def scatter(x, index, updates, overwrite=True):
+    index = jnp.asarray(index)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@defop()
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(jnp.asarray(index), -1, 0))
+    return x.at[idx].add(updates)
+
+
+@defop()
+def scatter_nd(index, updates, shape):
+    base = jnp.zeros(tuple(shape), jnp.asarray(updates).dtype)
+    idx = tuple(jnp.moveaxis(jnp.asarray(index), -1, 0))
+    return base.at[idx].add(updates)
+
+
+@defop()
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@defop()
+def expand(x, shape):
+    shape = list(shape)
+    # paddle: -1 keeps original dim
+    nd_new = len(shape)
+    x_shape = (1,) * (nd_new - x.ndim) + tuple(x.shape)
+    out_shape = tuple(x_shape[i] if shape[i] == -1 else int(shape[i])
+                      for i in range(nd_new))
+    return jnp.broadcast_to(jnp.reshape(x, x_shape), out_shape)
+
+
+@defop()
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@defop()
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@defop()
+def broadcast_tensors(xs):
+    shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+    return tuple(jnp.broadcast_to(x, shape) for x in xs)
+
+
+@defop()
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full per-dim spec: [d0_lo, d0_hi, d1_lo, d1_hi, ...] paddle uses
+        # flattened [lo,hi] per dim starting from dim 0
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (NCHW: last len/2 dims;
+        # paddle convention: pad is [left,right,top,bottom,...] over spatial
+        # dims in reverse order)
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC/NLC/NDHWC: spatial dims before C
+            dims = list(range(nd - 1 - k, nd - 1))
+        else:
+            dims = list(range(nd - k, nd))
+        for i, d in enumerate(reversed(dims)):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, width, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, width, mode=jmode)
+
+
+@defop()
+def flip(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(axes))
+
+
+@defop()
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@defop()
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@defop()
+def cast(x, dtype):
+    return jnp.asarray(x).astype(dtype_mod.convert_dtype(dtype))
+
+
+@defop()
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@defop()
+def index_select(x, index, axis=0):
+    index = jnp.asarray(index)
+    if index.ndim > 1:
+        index = index.reshape(-1)
+    return jnp.take(x, index, axis=axis)
+
+
+@defop()
+def index_sample(x, index):
+    # x: [N, D], index: [N, K] -> out[i, k] = x[i, index[i, k]]
+    return jnp.take_along_axis(x, jnp.asarray(index), axis=1)
+
+
+@defop()
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        raise ValueError("where with only condition: use nonzero")
+    return jnp.where(condition, x, y)
+
+
+@defop(nondiff=True)
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    shard = x // size
+    local = x % size
+    return jnp.where(shard == shard_id, local, ignore_value)
+
+
+@defop()
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@defop()
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@defop()
+def real(x):
+    return jnp.real(x)
+
+
+@defop()
+def imag(x):
+    return jnp.imag(x)
+
+
+@defop()
+def conj(x):
+    return jnp.conj(x)
+
+
+@defop()
+def crop(x, shape, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(jnp.s_[o:o + s] for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+@defop()
+def getitem(x, idx):
+    return x[idx]
+
+
+@defop()
+def setitem(x, idx, value):
+    return x.at[idx].set(value)

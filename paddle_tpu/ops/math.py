@@ -1,0 +1,363 @@
+"""Elementwise math, binary ops, reductions, comparisons, logic.
+
+Reference: paddle/fluid/operators/elementwise/*, activation_op.cc, reduce_ops/*,
+controlflow/compare_op.cc, python/paddle/tensor/math.py. Each op is a pure JAX
+function — XLA fuses chains of these into single kernels, so there is no need
+for the reference's fused elementwise kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp_special
+
+from ._registry import defop
+
+# ---------------------------------------------------------------- binary ----
+
+@defop()
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@defop()
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@defop()
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@defop()
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@defop(nondiff=True)
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@defop()
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+@defop()
+def pow(x, y):  # noqa: A001
+    return jnp.power(x, y)
+
+
+@defop()
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@defop()
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@defop()
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@defop()
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@defop()
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@defop()
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@defop()
+def add_n(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@defop()
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+# ----------------------------------------------------------------- unary ----
+
+def _unary(name, f, nondiff=False):
+    @defop(name=name, nondiff=nondiff)
+    def op(x):
+        return f(x)
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+abs = _unary("abs", jnp.abs)  # noqa: A001
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.lax.erf)
+erfinv = _unary("erfinv", jax.lax.erf_inv)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+digamma = _unary("digamma", jsp_special.digamma)
+lgamma = _unary("lgamma", jsp_special.gammaln)
+sigmoid_raw = None  # defined in nn_ops (activations)
+
+isnan = _unary("isnan", jnp.isnan, nondiff=True)
+isinf = _unary("isinf", jnp.isinf, nondiff=True)
+isfinite = _unary("isfinite", jnp.isfinite, nondiff=True)
+
+
+@defop()
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@defop()
+def increment(x, value=1.0):
+    return x + value
+
+
+@defop(nondiff=True)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@defop(nondiff=True)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@defop(nondiff=True)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@defop(nondiff=True)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@defop(nondiff=True)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@defop(nondiff=True)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@defop(nondiff=True)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@defop(nondiff=True)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+# ----------------------------------------------------------- comparisons ----
+
+@defop(nondiff=True)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@defop(nondiff=True)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@defop(nondiff=True)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@defop(nondiff=True)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@defop(nondiff=True)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@defop(nondiff=True)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@defop(nondiff=True)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@defop(nondiff=True)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop(nondiff=True)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# ------------------------------------------------------------ reductions ----
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop(name="sum")
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return jnp.sum(x, axis=_norm_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@defop()
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(name="max")
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(name="min")
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@defop(nondiff=True)
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(nondiff=True)
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def logsumexp(x, axis=None, keepdim=False):
+    return jsp_special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop()
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop(nondiff=True)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop()
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@defop()
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = jnp.reshape(x, (-1,))
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+@defop()
+def cummax(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jax.lax.cummax(x, axis=axis)
+
+
+@defop()
+def cummin(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jax.lax.cummin(x, axis=axis)
+
+
+@defop()
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop()
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@defop(nondiff=True)
+def nan_to_num_(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop()
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)

@@ -1,0 +1,121 @@
+"""Random sampling ops.
+
+Reference: paddle/fluid/operators/{uniform_random,gaussian_random,randint,
+randperm,bernoulli,multinomial,truncated_gaussian_random}_op.*.
+All are `stochastic` ops: eager mode draws a key from the global generator
+(paddle.seed); jitted/static paths pass `key=` explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ._registry import defop
+
+
+def _dt(dtype, default="float32"):
+    return dtype_mod.convert_dtype(dtype if dtype is not None else default)
+
+
+@defop(stochastic=True, nondiff=True)
+def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None):  # noqa: A002
+    return jax.random.uniform(key, tuple(shape), _dt(dtype), min, max)
+
+
+@defop(stochastic=True, nondiff=True)
+def rand(shape, dtype=None, key=None):
+    return jax.random.uniform(key, tuple(shape), _dt(dtype))
+
+
+@defop(stochastic=True, nondiff=True)
+def randn(shape, dtype=None, key=None):
+    return jax.random.normal(key, tuple(shape), _dt(dtype))
+
+
+@defop(stochastic=True, nondiff=True)
+def normal(mean=0.0, std=1.0, shape=None, key=None):
+    base_shape = tuple(shape) if shape is not None else jnp.shape(mean)
+    return mean + std * jax.random.normal(key, base_shape, jnp.float32)
+
+
+gaussian = normal
+
+
+@defop(stochastic=True, nondiff=True)
+def standard_normal(shape, dtype=None, key=None):
+    return jax.random.normal(key, tuple(shape), _dt(dtype))
+
+
+@defop(stochastic=True, nondiff=True)
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, tuple(shape), low, high, _dt(dtype, "int64"))
+
+
+@defop(stochastic=True, nondiff=True)
+def randint_like(x, low=0, high=None, dtype=None, key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, x.shape, low, high,
+                              _dt(dtype, "int64") if dtype else x.dtype)
+
+
+@defop(stochastic=True, nondiff=True)
+def randperm(n, dtype="int64", key=None):
+    return jax.random.permutation(key, n).astype(_dt(dtype, "int64"))
+
+
+@defop(stochastic=True, nondiff=True)
+def bernoulli(x, key=None):
+    return jax.random.bernoulli(key, x).astype(jnp.float32)
+
+
+@defop(stochastic=True, nondiff=True)
+def poisson(x, key=None):
+    return jax.random.poisson(key, x).astype(jnp.float32)
+
+
+@defop(stochastic=True, nondiff=True)
+def multinomial(x, num_samples=1, replacement=False, key=None):
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if x.ndim == 1:
+        logits = logits[None]
+    out = jax.random.categorical(key, logits, axis=-1,
+                                 shape=(logits.shape[0], num_samples)) \
+        if replacement else _sample_without_replacement(key, logits, num_samples)
+    return (out[0] if x.ndim == 1 else out).astype(jnp.int64)
+
+
+def _sample_without_replacement(key, logits, k):
+    # Gumbel top-k trick
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, logits.shape, minval=1e-20)))
+    _, idx = jax.lax.top_k(logits + g, k)
+    return idx
+
+
+@defop(stochastic=True, nondiff=True)
+def truncated_normal(shape, mean=0.0, std=1.0, dtype=None, key=None):
+    out = jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape), _dt(dtype))
+    return out * std + mean
+
+
+@defop(stochastic=True, nondiff=True)
+def uniform_random_like(x, min=-1.0, max=1.0, key=None):  # noqa: A002
+    return jax.random.uniform(key, x.shape, x.dtype, min, max)
+
+
+@defop(stochastic=True, nondiff=True)
+def normal_like(x, mean=0.0, std=1.0, key=None):
+    return mean + std * jax.random.normal(key, x.shape, x.dtype)
+
+
+@defop(stochastic=True, nondiff=True)
+def shuffle(x, axis=0, key=None):
+    return jax.random.permutation(key, x, axis=axis, independent=False)
+
+
+@defop(stochastic=True, nondiff=True)
+def exponential(x, lam=1.0, key=None):
+    return jax.random.exponential(key, x.shape, x.dtype) / lam

@@ -1,0 +1,189 @@
+"""Search / sort / index ops.
+
+Reference: paddle/fluid/operators/{arg_max,arg_min,argsort,top_k_v2,where_index,
+masked_select,unique,index_select,kthvalue,mode,searchsorted}_op.*.
+Dynamic-output-shape ops (nonzero, masked_select, unique) are eager-only —
+XLA needs static shapes, so inside jit/static graphs use masked alternatives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ._registry import defop
+
+
+@defop(nondiff=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype_mod.convert_dtype(dtype))
+
+
+@defop(nondiff=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype_mod.convert_dtype(dtype))
+
+
+@defop(nondiff=True)
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(-x if descending else x, axis=axis, stable=True)
+    return idx.astype(jnp.int32)
+
+
+@defop()
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@defop()
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx.astype(jnp.int32), -1, axis)
+
+
+@defop()
+def kthvalue(x, k, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    sorted_x = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis, stable=True)
+    val = jnp.take(sorted_x, k - 1, axis=axis)
+    ind = jnp.take(idx, k - 1, axis=axis).astype(jnp.int32)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        ind = jnp.expand_dims(ind, axis)
+    return val, ind
+
+
+@defop()
+def mode(x, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    same = jnp.concatenate(
+        [jnp.ones_like(jnp.take(sorted_x, jnp.array([0]), axis=axis), dtype=jnp.int32),
+         (jnp.take(sorted_x, jnp.arange(1, n), axis=axis)
+          == jnp.take(sorted_x, jnp.arange(0, n - 1), axis=axis)).astype(jnp.int32)],
+        axis=axis)
+    run = jax.lax.associative_scan(
+        lambda a, b: b * (a + b != b) + (a + b) * (a * b != 0) * 0 + jnp.where(b != 0, a + b, 0) * 0,
+        same, axis=axis) if False else _runlen(same, axis)
+    best = jnp.argmax(run, axis=axis)
+    val = jnp.take_along_axis(sorted_x, jnp.expand_dims(best, axis), axis=axis)
+    val_s = jnp.squeeze(val, axis) if not keepdim else val
+    # index of last occurrence in original array
+    eq = x == (val if keepdim else jnp.expand_dims(val_s, axis))
+    idx = jnp.max(jnp.where(eq, jnp.arange(n).reshape(
+        [-1 if i == axis else 1 for i in range(x.ndim)]), -1), axis=axis,
+        keepdims=keepdim).astype(jnp.int32)
+    return val_s, idx
+
+
+def _runlen(same, axis):
+    def f(carry, s):
+        run = jnp.where(s != 0, carry + 1, 1)
+        return run, run
+    moved = jnp.moveaxis(same, axis, 0)
+    init = jnp.zeros(moved.shape[1:], moved.dtype)
+    _, runs = jax.lax.scan(f, init, moved)
+    return jnp.moveaxis(runs, 0, axis)
+
+
+@defop(nondiff=True)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int32)
+
+
+@defop(nondiff=True)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    return jnp.searchsorted(sorted_sequence, x, side=side).astype(
+        jnp.int32 if out_int32 else jnp.int32)
+
+
+# ---- dynamic-shape (eager-only) ----
+
+@defop(nondiff=True)
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in idx)
+    return jnp.stack([jnp.asarray(i) for i in idx], axis=1).astype(jnp.int32) \
+        if idx else jnp.zeros((0, x.ndim), jnp.int32)
+
+
+@defop()
+def masked_select(x, mask):
+    import numpy as np
+    m = np.asarray(mask)
+    return jnp.asarray(x)[jnp.asarray(m)]
+
+
+@defop()
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@defop(nondiff=True)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    import numpy as np
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@defop(nondiff=True)
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+    arr = np.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[keep]
+        rets = [jnp.asarray(out)]
+        if return_inverse:
+            rets.append(jnp.asarray(np.cumsum(keep) - 1))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, arr.size))
+            rets.append(jnp.asarray(counts))
+        return tuple(rets) if len(rets) > 1 else rets[0]
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+@defop()
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+@defop()
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+@defop(nondiff=True)
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    lo, hi = (min, max) if (min != 0 or max != 0) else (jnp.min(x), jnp.max(x))
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist

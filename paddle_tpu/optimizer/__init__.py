@@ -1,0 +1,8 @@
+"""paddle.optimizer namespace (ref: python/paddle/optimizer/)."""
+from __future__ import annotations
+
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, Momentum,
+    Optimizer, RMSProp,
+)

@@ -1,0 +1,414 @@
+"""Optimizers.
+
+Reference: python/paddle/optimizer/ (Adam/AdamW/SGD/Momentum/...) and the C++
+kernels in paddle/fluid/operators/optimizers/. TPU-first split: each optimizer
+defines a pure functional rule (`init_slots` / `rule`) over raw jax arrays;
+the stateful paddle API (`step`, `minimize`, `clear_grad`) drives it in eager
+mode, and jitted/pjit train steps call `functional_update` on whole pytrees so
+the update fuses into the compiled step (and shards with the params).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._slots = {}  # id(param) -> dict of slot arrays
+        self._step_count = 0
+        self._name = name
+
+    # ---- functional core (override in subclasses) ------------------------
+    def init_slots(self, p):
+        """Return dict of slot arrays for one param value `p` (jax array)."""
+        return {}
+
+    def rule(self, p, g, slots, lr, t):
+        """Pure update: returns (new_p, new_slots). t is the 1-based step."""
+        raise NotImplementedError
+
+    # ---- lr --------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return self._lr
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("optimizer lr is a scheduler; call sched.step()")
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ---- weight decay / clip --------------------------------------------
+    def _decay_coeff(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "coeff"):  # L2Decay / L1Decay instance
+            return float(wd.coeff) if wd.__class__.__name__ == "L2Decay" else 0.0
+        return float(wd)
+
+    def _decoupled(self):
+        return False  # AdamW overrides
+
+    def _apply_regularization(self, p, g):
+        """Couple L2 decay into grads (reference: regularization appended as
+        grad-op). L1Decay adds sign(p)*coeff."""
+        wd = self._weight_decay
+        reg = getattr(p, "regularizer", None) or wd
+        if reg is None or self._decoupled():
+            return g
+        if hasattr(reg, "coeff"):
+            if reg.__class__.__name__ == "L1Decay":
+                return g + reg.coeff * jnp.sign(p._value)
+            return g + reg.coeff * p._value
+        return g + float(reg) * p._value
+
+    # ---- stateful API ----------------------------------------------------
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        self._step_count += 1
+        lr = self.get_lr()
+        grads = []
+        live = []
+        for p in params:
+            if p is None or p.grad is None or not p.trainable:
+                continue
+            g = p.grad._value.astype(p._value.dtype)
+            g = self._apply_regularization(p, g)
+            live.append(p)
+            grads.append(g)
+        if self._grad_clip is not None:
+            grads = self._grad_clip._clip_raw(live, grads)
+        for p, g in zip(live, grads):
+            slots = self._slots.get(id(p))
+            if slots is None:
+                slots = self.init_slots(p._value)
+                self._slots[id(p)] = slots
+            p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if isinstance(p, Parameter) and hasattr(p, "optimize_attr") else lr
+            new_p, new_slots = self.rule(p._value, g, slots, p_lr,
+                                         self._step_count)
+            if self._decoupled() and self._decay_coeff() > 0.0 and \
+                    getattr(p, "no_weight_decay", False) is False:
+                new_p = new_p - p_lr * self._decay_coeff() * p._value
+            p._value = new_p
+            self._slots[id(p)] = new_slots
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core import mode
+        if mode.in_static_mode():
+            from ..static import program as static_program
+            return static_program._minimize(self, loss)
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            if p is not None:
+                p.grad = None
+
+    clear_gradients = clear_grad
+
+    # ---- functional bridge (jit/pjit path) -------------------------------
+    def functional_init(self, params_tree):
+        """params_tree: pytree of jax arrays -> opt state pytree."""
+        slots = jax.tree_util.tree_map(lambda p: self.init_slots(p), params_tree,
+                                       is_leaf=lambda x: isinstance(x, jnp.ndarray) or hasattr(x, "shape"))
+        return {"slots": slots, "t": jnp.zeros((), jnp.int32)}
+
+    def functional_update(self, params_tree, grads_tree, opt_state, lr=None,
+                          wd_mask=None):
+        """Pure whole-tree update, safe under jit/pjit. wd_mask: pytree of
+        bools controlling decoupled weight decay per leaf."""
+        t = opt_state["t"] + 1
+        if lr is None:
+            lr = self.get_lr() if not isinstance(self._lr, LRScheduler) \
+                else self._lr.lr_at(t)
+        coeff = self._decay_coeff()
+        decoupled = self._decoupled()
+
+        leaves_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        leaves_g = treedef.flatten_up_to(grads_tree)
+        leaves_s = treedef.flatten_up_to(opt_state["slots"])
+        leaves_m = treedef.flatten_up_to(wd_mask) if wd_mask is not None \
+            else [True] * len(leaves_p)
+
+        new_p, new_s = [], []
+        for p, g, s, m in zip(leaves_p, leaves_g, leaves_s, leaves_m):
+            if not decoupled and coeff > 0.0 and m:
+                g = g + coeff * p
+            np_, ns_ = self.rule(p, g.astype(p.dtype), s, lr, t)
+            if decoupled and coeff > 0.0 and m:
+                np_ = np_ - lr * coeff * p
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                {"slots": jax.tree_util.tree_unflatten(treedef, new_s), "t": t})
+
+    # ---- state dict ------------------------------------------------------
+    def state_dict(self):
+        out = {"@step": self._step_count}
+        names = self._param_names()
+        for p, name in names.items():
+            for k, v in self._slots.get(p, {}).items():
+                out[f"{name}.{k}"] = Tensor(v)
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        names = {name: pid for pid, name in self._param_names().items()}
+        by_param = {}
+        for key, v in state.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            pname, slot = key.rsplit(".", 1)
+            if pname in names:
+                arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                by_param.setdefault(names[pname], {})[slot] = arr
+        self._slots.update(by_param)
+
+    set_dict = set_state_dict
+
+    def _param_names(self):
+        out = {}
+        for i, p in enumerate(self._parameter_list or []):
+            if p is not None:
+                out[id(p)] = p.name or f"param_{i}"
+        return out
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def rule(self, p, g, slots, lr, t):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def rule(self, p, g, slots, lr, t):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        return p - lr * update, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, name=None,
+                 multi_precision=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def rule(self, p, g, slots, lr, t):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        t = jnp.asarray(t, jnp.float32) if not isinstance(t, int) else t
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, name=None, multi_precision=False, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    def step(self):
+        # mark params excluded from decay by name predicate
+        if self._apply_decay_param_fun is not None:
+            for p in self._parameter_list or []:
+                if p is not None:
+                    p.no_weight_decay = not self._apply_decay_param_fun(p.name)
+        super().step()
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p)}
+
+    def rule(self, p, g, slots, lr, t):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(g))
+        t = jnp.asarray(t, jnp.float32) if not isinstance(t, int) else t
+        new_p = p - lr / (1 - b1 ** t) * m / (u + self._eps)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slots(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def rule(self, p, g, slots, lr, t):
+        acc = slots["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def init_slots(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p),
+                "avg_squared_update": jnp.zeros_like(p)}
+
+    def rule(self, p, g, slots, lr, t):
+        rho, eps = self._rho, self._eps
+        asg = rho * slots["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        update = -jnp.sqrt((slots["avg_squared_update"] + eps) / (asg + eps)) * g
+        asu = rho * slots["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return p + lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_slots(self, p):
+        s = {"mean_square": jnp.zeros_like(p), "momentum": jnp.zeros_like(p)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p)
+        return s
+
+    def rule(self, p, g, slots, lr, t):
+        rho = self._rho
+        ms = rho * slots["mean_square"] + (1 - rho) * jnp.square(g)
+        new = {"mean_square": ms}
+        if self._centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            new["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        new["momentum"] = mom
+        return p - mom, new
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def rule(self, p, g, slots, lr, t):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        t = jnp.asarray(t, jnp.float32) if not isinstance(t, int) else t
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class Lars(Optimizer):
+    """LARS (ref: fleet meta_optimizers/lars_optimizer.py wraps Momentum)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+
+    def init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def rule(self, p, g, slots, lr, t):
+        w_norm = jnp.linalg.norm(p)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + self._wd * w_norm + 1e-12), 1.0)
+        v = self._momentum * slots["velocity"] + lr * local_lr * (
+            g + self._wd * p)
+        return p - v, {"velocity": v}

@@ -1,0 +1,17 @@
+"""paddle_tpu.parallel — mesh construction + sharded train steps.
+
+TPU-native heart of distributed execution: build a Mesh over (dp, mp, pp, sp),
+annotate parameter/activation shardings, and pjit whole train steps so XLA
+emits ICI collectives (replacing the reference's NCCL ops + Fleet graph
+rewrites). See mesh.py, api.py, ring_attention.py, pipeline.py.
+"""
+from __future__ import annotations
+
+from .mesh import (  # noqa: F401
+    current_mesh, get_mesh, make_mesh, mesh_guard, MeshConfig,
+)
+from .api import (  # noqa: F401
+    data_parallel_shardings, replicate, shard_batch, shard_params_tp,
+    sharded_train_step,
+)
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,74 @@
+"""Ring attention — sequence/context parallelism over the `sp` mesh axis.
+
+Long sequences are sharded along seq; K/V blocks rotate around the ring via
+ppermute while each shard accumulates blockwise online-softmax partial
+attention (Liu et al. ring attention; public pattern). Runs inside shard_map
+over axis "sp". Causal masking is handled via global block offsets.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, bias, scale, causal, q_off, k_off):
+    # q: [B, H, Sq, D], k/v: [B, H, Sk, D]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qi = q_off + jnp.arange(q.shape[2])
+        ki = k_off + jnp.arange(k.shape[2])
+        mask = qi[:, None] >= ki[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Blockwise ring attention inside shard_map over `axis_name`.
+
+    q, k, v: [B, H, S_local, D] — the local sequence shard.
+    Returns [B, H, S_local, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    q_off = idx * s_local
+
+    def body(carry, i):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src_idx = (idx - i) % n  # whose K/V block we currently hold
+        k_off = src_idx * s_local
+        o, m, l = _block_attn(q, k_cur, v_cur, None, scale, causal, q_off, k_off)
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m - m_new)
+        o_acc = o_acc * alpha + o * beta
+        l_acc = l_acc * alpha + l * beta
+        # rotate K/V around the ring (skip after last step)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, m_new, l_acc, k_nxt, v_nxt), None
+
+    b, h, s, d = q.shape
+    o0 = jnp.zeros((b, h, s, d), q.dtype)
+    m0 = jnp.full((b, h, s, 1), -1e30, q.dtype)
+    l0 = jnp.zeros((b, h, s, 1), q.dtype)
+    (o, m, l, _, _), _ = jax.lax.scan(body, (o0, m0, l0, k, v),
+                                      jnp.arange(n))
+    return o / jnp.maximum(l, 1e-30)
+
+
+def ring_attention_sharded(mesh, q, v_spec=None):
+    raise NotImplementedError("use ring_attention inside shard_map")

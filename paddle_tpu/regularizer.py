@@ -1,0 +1,22 @@
+"""Regularizers (ref: python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    pass
+
+
+class L1Decay(WeightDecayRegularizer):
+    pass
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

@@ -1,0 +1,34 @@
+"""paddle.static namespace — Program/Executor static graph.
+
+Reference: python/paddle/static/ + python/paddle/fluid/framework.py,
+executor.py. Full implementation in program.py / executor.py.
+"""
+from __future__ import annotations
+
+from ..core.mode import in_dygraph_mode  # noqa: F401
+from .program import (  # noqa: F401
+    Program, Variable, append_backward, data, default_main_program,
+    default_startup_program, global_scope, name_scope, program_guard,
+    InputSpec,
+)
+from .executor import Executor, scope_guard  # noqa: F401
+from . import nn  # noqa: F401
+
+
+class CompiledProgram:
+    """Shim: programs are always XLA-compiled at Executor.run (ref:
+    python/paddle/fluid/compiler.py CompiledProgram.with_data_parallel)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, loss_name=None, **kw):
+        return self
+
+
+class BuildStrategy:
+    pass
+
+
+class ExecutionStrategy:
+    pass

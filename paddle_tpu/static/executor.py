@@ -1,0 +1,209 @@
+"""Executor — runs Programs as single XLA computations.
+
+Reference: python/paddle/fluid/executor.py + paddle/fluid/framework/executor.cc.
+TPU-first rework: instead of a C++ op-by-op interpreter over a Scope, `run`
+lowers the whole Program (forward + jax.grad backward + optimizer update) into
+ONE pure function `(params, feeds, key) -> (fetches, new_params)` and jits it.
+The Scope is a host-side dict of device arrays holding persistables
+(parameters + optimizer slots); compiled executables are cached per
+(program version, feed shapes, fetch names).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.place import Place, _expected_place
+from ..core.tensor import Tensor
+from .program import (OpNode, Program, Variable, default_main_program,
+                      default_startup_program)
+
+
+class Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def keys(self):
+        return self._vars.keys()
+
+    def __contains__(self, name):
+        return name in self._vars
+
+
+_global_scope = Scope()
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+def _forward_env(program: Program, param_vals: dict, feed_vals: dict, key):
+    """Execute the op list symbolically; returns env name->value."""
+    env = {}
+    env.update(param_vals)
+    env.update(feed_vals)
+    kcount = 0
+    for op in program.global_block().ops:
+        vals = []
+        for kind, payload in op.leaves:
+            if kind == "var":
+                if payload.name not in env:
+                    raise KeyError(
+                        f"variable {payload.name!r} used before definition "
+                        f"(op {op.type})")
+                vals.append(env[payload.name])
+            else:
+                vals.append(payload)
+        args, kwargs = jax.tree_util.tree_unflatten(op.treedef, vals)
+        if op.stochastic and kwargs.get("key") is None:
+            kwargs = dict(kwargs)
+            kwargs["key"] = jax.random.fold_in(key, kcount)
+            kcount += 1
+        out = op.fn(*args, **kwargs)
+        outs = list(out) if op.multi else [out]
+        for v, o in zip(op.out_vars, outs):
+            env[v.name] = o
+    return env
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place if place is not None else _expected_place()
+        self._cache = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program if program is not None else default_main_program()
+        if hasattr(program, "_program"):  # CompiledProgram
+            program = program._program
+        scope = scope if scope is not None else _global_scope
+        feed = feed or {}
+
+        # startup program: run initializers host-side into the scope
+        if program.initializers and not program.global_block().ops \
+                and program._loss is None:
+            for var, init in program.initializers:
+                if scope.find_var(var.name) is None:
+                    from ..nn import initializer as I
+                    fn = init or I.XavierUniform()
+                    scope.set(var.name, jnp.asarray(fn(var.shape, var.dtype)))
+            return []
+
+        fetch_list = fetch_list or []
+        fetch_vars = [v for v in fetch_list]
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_vars]
+
+        feed_vals = {}
+        for name, val in feed.items():
+            if isinstance(val, Tensor):
+                val = val._value
+            feed_vals[name] = jnp.asarray(np.asarray(val)) \
+                if not isinstance(val, jax.Array) else val
+
+        # parameters currently in scope (created by startup program)
+        param_names = sorted(
+            v.name for v in program.global_block().vars.values()
+            if v.persistable and scope.find_var(v.name) is not None)
+        # lazily initialize any persistable that startup didn't cover
+        for v in program.global_block().vars.values():
+            if v.persistable and scope.find_var(v.name) is None \
+                    and v.initializer is not None:
+                scope.set(v.name, jnp.asarray(v.initializer(v.shape, v.dtype)))
+                param_names.append(v.name)
+        param_names = sorted(set(param_names))
+        param_vals = {n: scope.find_var(n) for n in param_names}
+
+        opt_states = {}
+        if program._optimizers:
+            for i, (opt, loss, params) in enumerate(program._optimizers):
+                sname = f"@opt_state_{i}"
+                st = scope.find_var(sname)
+                if st is None:
+                    ptree = {p.name: param_vals[p.name] for p in params}
+                    st = opt.functional_init(ptree)
+                    scope.set(sname, st)
+                opt_states[sname] = st
+
+        key_shapes = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                                  for n, v in feed_vals.items()))
+        cache_key = (id(program), program._version, key_shapes,
+                     tuple(fetch_names))
+        compiled = self._cache.get(cache_key) if use_program_cache else None
+
+        if compiled is None:
+            trainable = {p.name for _, _, params in program._optimizers
+                         for p in params}
+
+            def step(param_vals, opt_states, feed_vals, key):
+                if program._optimizers:
+                    opt, loss_var, params = program._optimizers[0]
+                    pnames = [p.name for p in params]
+
+                    def loss_fn(ptree):
+                        pv = dict(param_vals)
+                        pv.update(ptree)
+                        env = _forward_env(program, pv, feed_vals, key)
+                        return env[loss_var.name], env
+
+                    ptree = {n: param_vals[n] for n in pnames}
+                    grads, env = jax.grad(loss_fn, has_aux=True)(ptree)
+                    sname = "@opt_state_0"
+                    lr = opt.get_lr() if not hasattr(opt._lr, "lr_at") else None
+                    if opt._grad_clip is not None and hasattr(
+                            opt._grad_clip, "clip_tree"):
+                        grads = opt._grad_clip.clip_tree(grads)
+                    new_p, new_state = opt.functional_update(
+                        ptree, grads, opt_states[sname], lr=lr)
+                    out_params = dict(param_vals)
+                    out_params.update(new_p)
+                    new_states = dict(opt_states)
+                    new_states[sname] = new_state
+                    for p in params:
+                        env[p.name + "@GRAD"] = grads[p.name]
+                else:
+                    env = _forward_env(program, param_vals, feed_vals, key)
+                    out_params = param_vals
+                    new_states = opt_states
+                fetches = []
+                for name in fetch_names:
+                    if name not in env:
+                        raise KeyError(f"fetch target {name!r} not produced")
+                    fetches.append(env[name])
+                return fetches, out_params, new_states
+
+            compiled = jax.jit(step)
+            self._cache[cache_key] = compiled
+
+        from ..core import rng
+        fetches, new_params, new_states = compiled(param_vals, opt_states,
+                                                   feed_vals, rng.next_key())
+        for n, v in new_params.items():
+            scope.set(n, v)
+        for n, v in new_states.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]

@@ -1,0 +1,120 @@
+"""paddle.static.nn — fluid-style functional layers for static graphs.
+
+Reference: python/paddle/fluid/layers/nn.py (fc, conv2d, ...) — each creates
+parameters in the current program + appends compute ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..core import unique_name
+from ..core.param_attr import ParamAttr
+from ..nn import initializer as I
+from .program import default_main_program
+
+
+def _create_param(shape, dtype, attr, is_bias=False, default_init=None):
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_init or (
+        I.Constant(0.0) if is_bias else I.XavierUniform())
+    block = default_main_program().global_block()
+    v = block.create_parameter(shape, dtype, name=attr.name,
+                               initializer=init, trainable=attr.trainable)
+    v.optimize_attr = {"learning_rate": attr.learning_rate}
+    v.regularizer = attr.regularizer
+    v.stop_gradient = not attr.trainable
+    return v
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None, param_attr=None):
+    weight_attr = weight_attr or param_attr
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    if len(x.shape) > num_flatten_dims + 1:
+        x = ops.flatten(x, num_flatten_dims, -1) if num_flatten_dims > 0 else x
+    w = _create_param((in_dim, size), "float32", weight_attr)
+    b = _create_param((size,), "float32", bias_attr, is_bias=True)
+    out = ops.linear(x, w, b)
+    if activation:
+        out = getattr(ops, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,  # noqa: A002
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None, use_cudnn=True):
+    ksize = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    fan_in = (cin // groups) * int(np.prod(ksize))
+    w = _create_param((num_filters, cin // groups) + tuple(ksize), "float32",
+                      param_attr, default_init=I.Normal(0.0, (2.0 / fan_in) ** 0.5))
+    b = _create_param((num_filters,), "float32", bias_attr, is_bias=True)
+    out = ops.conv2d(input, w, b, stride, padding, dilation, groups, data_format)
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           data_format="NCHW", name=None, use_cudnn=True, exclusive=True):
+    if global_pooling:
+        return ops.adaptive_avg_pool2d(input, 1) if pool_type == "avg" \
+            else ops.adaptive_max_pool2d(input, 1)
+    if pool_type == "max":
+        return ops.max_pool2d(input, pool_size, pool_stride, pool_padding,
+                              ceil_mode, data_format)
+    return ops.avg_pool2d(input, pool_size, pool_stride, pool_padding,
+                          ceil_mode, exclusive, None, data_format)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, data_layout="NCHW", is_test=False,
+               use_global_stats=False, name=None, **kw):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _create_param((c,), "float32", param_attr,
+                          default_init=I.Constant(1.0))
+    bias = _create_param((c,), "float32", bias_attr, is_bias=True)
+    mean = _create_param((c,), "float32", ParamAttr(
+        name=unique_name.generate("bn_mean"), trainable=False),
+        default_init=I.Constant(0.0))
+    var = _create_param((c,), "float32", ParamAttr(
+        name=unique_name.generate("bn_var"), trainable=False),
+        default_init=I.Constant(1.0))
+    out, _, _ = ops.batch_norm(input, mean, var, scale, bias,
+                               training=not is_test, momentum=momentum,
+                               epsilon=epsilon, data_format=data_layout,
+                               use_global_stats=use_global_stats)
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def embedding(input, size, padding_idx=None, param_attr=None, dtype="float32",  # noqa: A002
+              is_sparse=False, name=None):
+    w = _create_param(tuple(size), dtype, param_attr,
+                      default_init=I.Normal(0.0, 1.0))
+    return ops.embedding(input, w, padding_idx=padding_idx)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None, **kw):
+    return ops.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    norm_shape = tuple(input.shape[begin_norm_axis:])
+    w = _create_param(norm_shape, "float32", param_attr,
+                      default_init=I.Constant(1.0)) if scale else None
+    b = _create_param(norm_shape, "float32", bias_attr, is_bias=True) \
+        if shift else None
+    out = ops.layer_norm(input, w, b, epsilon,
+                         normalized_ndim=len(norm_shape))
+    if act:
+        out = getattr(ops, act)(out)
+    return out

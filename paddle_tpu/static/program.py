@@ -1,0 +1,344 @@
+"""Program / Block / Variable — the static-graph IR.
+
+Reference: python/paddle/fluid/framework.py (Program, Block, Operator,
+Variable) + backward.py (append_backward). TPU-first rework: an op node stores
+the SAME pure JAX function the eager path runs, plus the arg tree with
+Variables as holes. Lowering (executor.py) walks the op list to build one pure
+python function over (params, feeds) and jits it — the whole Program becomes a
+single XLA computation; append_backward marks the loss so lowering adds
+jax.grad + optimizer update into the same compiled step (replacing the
+reference's per-op grad-op graph rewrite).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core import mode, unique_name
+from ..core.tensor import Tensor
+
+
+class Variable:
+    def __init__(self, block, name, shape, dtype, persistable=False,
+                 is_data=False, stop_gradient=True, initializer=None,
+                 trainable=False):
+        self.block = block
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.persistable = persistable
+        self.is_data = is_data
+        self.stop_gradient = stop_gradient
+        self.initializer = initializer
+        self.trainable = trainable
+        self.op = None  # producer OpNode
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.grad = None  # populated with grad Variable by append_backward
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def aval(self, dim_map=None):
+        dim_map = dim_map or {}
+        shape = []
+        for i, s in enumerate(self.shape):
+            if i in dim_map:
+                shape.append(int(dim_map[i]))
+            elif s is None or s < 0:
+                shape.append(1)  # unknown dim placeholder (shape-infer only)
+            else:
+                shape.append(int(s))
+        return jax.ShapeDtypeStruct(tuple(shape), self.dtype)
+
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        kind = "data" if self.is_data else ("param" if self.persistable else "tmp")
+        return f"Variable({self.name}, shape={self.shape}, {kind})"
+
+
+def _patch_variable():
+    from .. import ops
+
+    def binop(fn, reverse=False):
+        def method(self, other):
+            return fn(other, self) if reverse else fn(self, other)
+        return method
+
+    V = Variable
+    V.__add__ = binop(ops.add)
+    V.__radd__ = binop(ops.add, True)
+    V.__sub__ = binop(ops.subtract)
+    V.__rsub__ = binop(ops.subtract, True)
+    V.__mul__ = binop(ops.multiply)
+    V.__rmul__ = binop(ops.multiply, True)
+    V.__truediv__ = binop(ops.divide)
+    V.__rtruediv__ = binop(ops.divide, True)
+    V.__pow__ = binop(ops.pow)
+    V.__matmul__ = binop(ops.matmul)
+    V.__neg__ = lambda self: ops.neg(self)
+    V.__lt__ = binop(ops.less_than)
+    V.__le__ = binop(ops.less_equal)
+    V.__gt__ = binop(ops.greater_than)
+    V.__ge__ = binop(ops.greater_equal)
+    V.__eq__ = binop(ops.equal)
+    V.__ne__ = binop(ops.not_equal)
+    for name in ("sum", "mean", "max", "min", "reshape", "transpose", "matmul",
+                 "flatten", "squeeze", "unsqueeze", "cast", "clip", "sqrt",
+                 "exp", "log", "tanh", "abs", "square"):
+        setattr(V, name, (lambda f: lambda self, *a, **k: f(self, *a, **k))(
+            getattr(ops, name)))
+
+
+class OpNode:
+    __slots__ = ("type", "fn", "leaves", "treedef", "out_vars", "stochastic",
+                 "multi")
+
+    def __init__(self, type_, fn, leaves, treedef, out_vars, stochastic, multi):
+        self.type = type_
+        self.fn = fn
+        # each leaf: ("var", Variable) | ("const", raw value)
+        self.leaves = leaves
+        self.treedef = treedef
+        self.out_vars = out_vars
+        self.stochastic = stochastic
+        self.multi = multi
+
+
+class Block:
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.vars = {}
+        self.ops = []
+
+    def create_var(self, name=None, shape=(), dtype="float32", **kw):
+        name = name or unique_name.generate("tmp")
+        v = Variable(self, name, shape, dtype_mod.convert_dtype(dtype), **kw)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, shape, dtype, name=None, initializer=None,
+                         trainable=True, **kw):
+        name = name or unique_name.generate("param")
+        v = Variable(self, name, shape, dtype_mod.convert_dtype(dtype),
+                     persistable=True, stop_gradient=not trainable,
+                     initializer=initializer, trainable=trainable)
+        self.vars[name] = v
+        # record the init in the startup program (ref: initializer appends
+        # an init op to startup)
+        startup = default_startup_program()
+        startup.initializers.append((v, initializer))
+        return v
+
+    def var(self, name):
+        return self.vars[name]
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if v.persistable and v.trainable]
+
+
+class Program:
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.random_seed = 0
+        self.initializers = []  # startup-only: [(Variable, initializer)]
+        self._loss = None
+        self._optimizers = []  # [(optimizer, loss_var, param_vars)]
+        self._version = 0
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[-1]
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def data_vars(self):
+        return [v for v in self.global_block().vars.values() if v.is_data]
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def clone(self, for_test=False):
+        # programs are append-only descriptors; a clone shares structure but
+        # drops the optimizer ops when for_test (ref: Program.clone)
+        import copy
+        p = copy.copy(self)
+        if for_test:
+            p = Program.__new__(Program)
+            p.__dict__.update(self.__dict__)
+            p._optimizers = []
+            p._loss = self._loss
+        return p
+
+    def __repr__(self):
+        ops = "\n".join(f"  {op.type} -> {[v.name for v in op.out_vars]}"
+                        for op in self.global_block().ops)
+        return f"Program(\n{ops}\n)"
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    old_main, old_startup = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = old_main, old_startup
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    with unique_name.guard(prefix):
+        yield
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data — feed placeholder."""
+    prog = default_main_program()
+    v = Variable(prog.global_block(), name, shape,
+                 dtype_mod.convert_dtype(dtype), is_data=True)
+    prog.global_block().vars[name] = v
+    return v
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(t.shape, t.dtype, name)
+
+
+# ---------------------------------------------------------------------------
+# op capture hook (registered into core.mode)
+# ---------------------------------------------------------------------------
+
+def _is_leaf(x):
+    return isinstance(x, (Variable, Tensor))
+
+
+def _append_op(opname, fn, args, kwargs, meta):
+    prog = default_main_program()
+    block = prog.current_block()
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_leaf)
+
+    spec = []
+    avals = []
+    any_diff = False
+    for l in leaves:
+        if isinstance(l, Variable):
+            spec.append(("var", l))
+            avals.append(l.aval())
+            if not l.stop_gradient:
+                any_diff = True
+        elif isinstance(l, Tensor):
+            spec.append(("const", l._value))
+            avals.append(l._value)
+        else:
+            spec.append(("const", l))
+            avals.append(l)
+
+    # shape inference via eval_shape (replaces InferShape)
+    def infer(*vals):
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, list(vals))
+        if meta.get("stochastic"):
+            k2 = dict(k2)
+            k2["key"] = jax.random.key(0)
+        return fn(*a2, **k2)
+
+    try:
+        out_shape = jax.eval_shape(infer, *avals)
+    except Exception:
+        out_shape = jax.ShapeDtypeStruct((), jnp.float32)
+
+    multi = isinstance(out_shape, (tuple, list))
+    outs_meta = list(out_shape) if multi else [out_shape]
+    out_vars = []
+    for om in outs_meta:
+        shape = list(getattr(om, "shape", ()))
+        dt = getattr(om, "dtype", jnp.float32)
+        v = block.create_var(unique_name.generate(opname), shape, dt)
+        v.stop_gradient = (not any_diff) or bool(meta.get("nondiff", False))
+        out_vars.append(v)
+
+    node = OpNode(opname, fn, spec, treedef, out_vars,
+                  bool(meta.get("stochastic")), multi)
+    for v in out_vars:
+        v.op = node
+    block.ops.append(node)
+    prog._version += 1
+    if multi:
+        return tuple(out_vars)
+    return out_vars[0]
+
+
+mode.register_static_hook(_append_op)
+_patch_variable()
+
+
+# ---------------------------------------------------------------------------
+# backward + minimize capture
+# ---------------------------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """Mark loss; grads materialize at lowering via jax.grad (ref:
+    python/paddle/fluid/backward.py append_backward)."""
+    prog = default_main_program()
+    prog._loss = loss
+    params = parameter_list or prog.all_parameters()
+    result = []
+    for p in params:
+        g = Variable(prog.global_block(), p.name + "@GRAD", p.shape, p.dtype)
+        prog.global_block().vars[g.name] = g
+        p.grad = g
+        result.append((p, g))
+    return result
+
+
+def _minimize(optimizer, loss):
+    prog = default_main_program()
+    params = prog.all_parameters()
+    pgs = append_backward(loss, params)
+    prog._optimizers.append((optimizer, loss, params))
+    return pgs
+
+
+def global_scope():
+    from .executor import _global_scope
+    return _global_scope

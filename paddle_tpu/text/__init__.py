@@ -1,0 +1,73 @@
+"""paddle.text (ref: python/paddle/text/) — dataset APIs; synthetic fallbacks
+in the zero-egress environment."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        n = 2000 if mode == "train" else 400
+        rng = np.random.RandomState(7)
+        self.docs = [rng.randint(1, 5000, rng.randint(20, 200)).astype(np.int64)
+                     for _ in range(n)]
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class WMT14(Dataset):
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        n = 1000 if mode == "train" else 200
+        rng = np.random.RandomState(8)
+        self.src = [rng.randint(1, dict_size, rng.randint(5, 50)).astype(np.int64)
+                    for _ in range(n)]
+        self.tgt = [rng.randint(1, dict_size, rng.randint(5, 50)).astype(np.int64)
+                    for _ in range(n)]
+
+    def __getitem__(self, idx):
+        return self.src[idx], self.tgt[idx][:-1], self.tgt[idx][1:]
+
+    def __len__(self):
+        return len(self.src)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """CRF Viterbi decode via lax.scan (ref: viterbi_decode_op)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    pot = potentials._value if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._value if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+
+    def step(alpha, logit_t):
+        scores = alpha[:, :, None] + trans[None]
+        best = jnp.max(scores, axis=1) + logit_t
+        idx = jnp.argmax(scores, axis=1)
+        return best, idx
+
+    alpha0 = pot[:, 0]
+    _, idxs = jax.lax.scan(step, alpha0, jnp.moveaxis(pot[:, 1:], 1, 0))
+    alpha_final, _ = jax.lax.scan(step, alpha0, jnp.moveaxis(pot[:, 1:], 1, 0))
+    scores = jnp.max(alpha_final, axis=-1)
+    last = jnp.argmax(alpha_final, axis=-1)
+
+    def backtrack(carry, idx_t):
+        tag = carry
+        prev = jnp.take_along_axis(idx_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(backtrack, last, jnp.flip(idxs, 0))
+    path = jnp.concatenate([jnp.flip(path_rev, 0),
+                            last[None]], axis=0)
+    return Tensor(scores), Tensor(jnp.moveaxis(path, 0, 1))

@@ -1,0 +1,32 @@
+"""paddle.utils (ref: python/paddle/utils/)."""
+from __future__ import annotations
+
+from . import profiler  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        raise ImportError(f"module {name} not available in this environment")
+
+
+def run_check():
+    import jax
+    print("paddle_tpu is installed successfully!")
+    print(f"devices: {jax.devices()}")
+    from .. import nn, optimizer, to_tensor
+    lin = nn.Linear(4, 2)
+    out = lin(to_tensor([[1.0, 2.0, 3.0, 4.0]]))
+    loss = out.sum()
+    loss.backward()
+    opt = optimizer.SGD(0.1, parameters=lin.parameters())
+    opt.step()
+    print("single-device training check: OK")
+
+
+def deprecated(since=None, update_to=None, reason=None):
+    def deco(fn):
+        return fn
+    return deco

@@ -1,0 +1,69 @@
+"""Profiler (ref: python/paddle/fluid/profiler.py).
+
+TPU-first: wraps jax.profiler — traces land in a TensorBoard-compatible dir
+with XLA HLO + TPU timeline instead of the reference's chrome-trace of CUDA
+kernels. Also provides a light host-side step timer.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+_records = defaultdict(list)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.start_trace(profile_path)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        print(f"[profiler] trace written to {profile_path} "
+              f"({time.time() - t0:.2f}s)")
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def record_event(name):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _records[name].append(time.perf_counter() - t0)
+
+
+class RecordEvent:
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _records[self.name].append(time.perf_counter() - self._t0)
+
+
+def summary():
+    out = {}
+    for name, times in _records.items():
+        out[name] = {"count": len(times), "total": sum(times),
+                     "mean": sum(times) / len(times)}
+    return out
+
+
+def reset():
+    _records.clear()

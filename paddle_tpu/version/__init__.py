@@ -1,0 +1,12 @@
+"""Version info (ref: python/paddle/version.py generated at build time)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit})")

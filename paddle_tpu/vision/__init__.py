@@ -1,0 +1,17 @@
+"""paddle.vision (ref: python/paddle/vision/)."""
+from __future__ import annotations
+
+from . import datasets, models, transforms  # noqa: F401
+from .models import LeNet, MobileNetV1, MobileNetV2, ResNet, VGG  # noqa: F401
+from .models import (  # noqa: F401
+    mobilenet_v1, mobilenet_v2, resnet18, resnet34, resnet50, resnet101,
+    resnet152, vgg11, vgg13, vgg16, vgg19,
+)
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,182 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/).
+
+Zero-egress environment: MNIST/Cifar generate deterministic synthetic data
+unless a local file path is provided (`image_path`/`data_file`). The API
+(mode, transform, __getitem__ semantics) matches the reference.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        else:
+            # synthetic fallback: class-conditional patterns so models can
+            # actually fit (loss decreases) in tests/benchmarks
+            n = 6000 if mode == "train" else 1000
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            base = rng.rand(10, 28, 28) * 255
+            noise = rng.rand(n, 28, 28) * 64
+            self.images = np.clip(base[self.labels] * 0.75 + noise, 0,
+                                  255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.array([self.labels[idx]], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img[None].astype(np.float32) / 255.0
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.num_classes = 10
+        if data_file and os.path.exists(data_file):
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            self.images = d[b"data"].reshape(-1, 3, 32, 32)
+            self.labels = np.asarray(d[b"labels"], np.int64)
+        else:
+            n = 5000 if mode == "train" else 1000
+            rng = np.random.RandomState(2 if mode == "train" else 3)
+            self.labels = rng.randint(0, self.num_classes, n).astype(np.int64)
+            base = rng.rand(self.num_classes, 3, 32, 32) * 255
+            noise = rng.rand(n, 3, 32, 32) * 64
+            self.images = np.clip(base[self.labels] * 0.75 + noise, 0,
+                                  255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.array([self.labels[idx]], np.int64)
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.num_classes = 100
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        self.samples = []
+        for dirpath, _, files in os.walk(root):
+            for fn in sorted(files):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append(os.path.join(dirpath, fn))
+
+    def __getitem__(self, idx):
+        path = self.samples[idx]
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            img = _load_image(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = np.load(path) if path.endswith(".npy") else _load_image(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _load_image(path):
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError:
+        raise RuntimeError(f"cannot decode {path}: PIL unavailable; "
+                           "use .npy files")
+
+
+class Flowers(Dataset):
+    """Synthetic stand-in matching the reference Flowers dataset API."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        n = 600 if mode == "train" else 100
+        rng = np.random.RandomState(4)
+        self.labels = rng.randint(0, 102, n).astype(np.int64)
+        self.images = (rng.rand(n, 3, 64, 64) * 255).astype(np.uint8)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, np.array([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)

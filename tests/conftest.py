@@ -1,0 +1,27 @@
+"""Test config: force a virtual 8-device CPU mesh so distributed/sharding
+tests run without TPU hardware.
+
+The session environment pins JAX_PLATFORMS to the real TPU plugin and its
+sitecustomize locks the platform choice at interpreter start, so we must
+override via jax.config (env vars alone are read too early to help).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+jax.devices()  # force CPU backend init before anything else can
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

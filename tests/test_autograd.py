@@ -1,0 +1,162 @@
+"""Autograd engine tests (eager vjp-tape vs analytic/finite-diff grads)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def leaf(x):
+    t = paddle.to_tensor(np.asarray(x, np.float32))
+    t.stop_gradient = False
+    return t
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = leaf([2.0, 3.0])
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_two_paths(self):
+        x = leaf([1.0])
+        y = x * 2 + x * 3  # dy/dx = 5
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_matmul_grad(self):
+        a = leaf(np.random.rand(3, 4))
+        b = leaf(np.random.rand(4, 2))
+        loss = (a @ b).sum()
+        loss.backward()
+        np.testing.assert_allclose(a.grad.numpy(),
+                                   np.ones((3, 2)) @ b.numpy().T, rtol=1e-5)
+        np.testing.assert_allclose(b.grad.numpy(),
+                                   a.numpy().T @ np.ones((3, 2)), rtol=1e-5)
+
+    def test_stop_gradient(self):
+        x = leaf([1.0])
+        c = paddle.to_tensor([2.0])  # stop_gradient=True
+        y = (x * c).sum()
+        y.backward()
+        assert c.grad is None
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_detach(self):
+        x = leaf([3.0])
+        y = x * x
+        z = (y.detach() * x).sum()  # z = y_const * x -> dz/dx = 9
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [9.0])
+
+    def test_accumulation_and_clear(self):
+        x = leaf([1.0])
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_no_grad(self):
+        x = leaf([1.0])
+        with paddle.no_grad():
+            y = x * 2
+        assert y._node is None and y.stop_gradient
+
+    def test_grad_api(self):
+        x = leaf([2.0])
+        y = x * x * x
+        (g,) = paddle.grad(y, x, retain_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-5)
+        assert x.grad is None  # functional: no side effects
+
+    def test_multi_output_op(self):
+        x = leaf(np.array([[1.0, 5.0, 3.0]]))
+        vals, idx = ops.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[0.0, 1.0, 1.0]])
+
+    def test_softmax_ce_grad_matches_analytic(self):
+        logits = leaf(np.random.rand(4, 5))
+        labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        loss = ops.cross_entropy(logits, labels)
+        loss.backward()
+        p = np.exp(logits.numpy()) / np.exp(logits.numpy()).sum(1, keepdims=True)
+        onehot = np.eye(5)[[0, 1, 2, 3]]
+        np.testing.assert_allclose(logits.grad.numpy(), (p - onehot) / 4,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_backward_nonscalar_with_grad(self):
+        x = leaf([1.0, 2.0])
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_hook(self):
+        x = leaf([1.0])
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy()))
+        (x * 2).sum().backward()
+        assert len(seen) == 1 and seen[0][0] == 2.0
+
+    def test_conv_grad_finite_diff(self):
+        x = leaf(np.random.rand(1, 2, 5, 5))
+        w = leaf(np.random.rand(3, 2, 3, 3) * 0.1)
+        loss = ops.conv2d(x, w, padding=1).sum()
+        loss.backward()
+        # finite-difference check on one weight element
+        eps = 1e-3
+        wp = w.numpy().copy()
+        wp[0, 0, 0, 0] += eps
+        lp = ops.conv2d(paddle.to_tensor(x.numpy()), paddle.to_tensor(wp),
+                        padding=1).sum().numpy()
+        wm = w.numpy().copy()
+        wm[0, 0, 0, 0] -= eps
+        lm = ops.conv2d(paddle.to_tensor(x.numpy()), paddle.to_tensor(wm),
+                        padding=1).sum().numpy()
+        fd = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(w.grad.numpy()[0, 0, 0, 0], fd, rtol=1e-2)
+
+
+class TestLayerTraining:
+    def test_linear_regression_converges(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        paddle.seed(0)
+        true_w = np.array([[2.0], [-3.0]], np.float32)
+        x_data = np.random.rand(64, 2).astype(np.float32)
+        y_data = x_data @ true_w + 0.5
+
+        lin = nn.Linear(2, 1)
+        optimizer = opt.SGD(learning_rate=0.5, parameters=lin.parameters())
+        for _ in range(200):
+            x = paddle.to_tensor(x_data)
+            y = paddle.to_tensor(y_data)
+            pred = lin(x)
+            loss = ((pred - y) ** 2).mean()
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+        assert float(loss.numpy()) < 1e-3
+        np.testing.assert_allclose(lin.weight.numpy(), true_w, atol=0.05)
+
+    def test_mlp_classification(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        paddle.seed(1)
+        n = 128
+        x_data = np.random.randn(n, 4).astype(np.float32)
+        y_data = (x_data.sum(1) > 0).astype(np.int64)
+        model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        optimizer = opt.Adam(0.01, parameters=model.parameters())
+        ce = nn.CrossEntropyLoss()
+        first = None
+        for _ in range(100):
+            logits = model(paddle.to_tensor(x_data))
+            loss = ce(logits, paddle.to_tensor(y_data))
+            if first is None:
+                first = float(loss.numpy())
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+        assert float(loss.numpy()) < first * 0.3

@@ -1,0 +1,144 @@
+"""Dataset/DataLoader + save/load checkpoint tests."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, TensorDataset)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.array([i], np.float32), np.array([i % 3], np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class TestData:
+    def test_tensor_dataset_and_loader(self):
+        xs = np.arange(10, dtype=np.float32).reshape(10, 1)
+        ys = np.arange(10, dtype=np.int64)
+        ds = TensorDataset([xs, ys])
+        loader = DataLoader(ds, batch_size=4, drop_last=False)
+        batches = list(loader)
+        assert len(batches) == 3
+        assert batches[0][0].shape == [4, 1]
+        assert batches[2][0].shape == [2, 1]
+
+    def test_shuffle_covers_all(self):
+        ds = RangeDataset(20)
+        loader = DataLoader(ds, batch_size=5, shuffle=True)
+        seen = []
+        for x, y in loader:
+            seen.extend(int(v) for v in x.numpy().reshape(-1))
+        assert sorted(seen) == list(range(20))
+
+    def test_batch_sampler(self):
+        ds = RangeDataset(10)
+        bs = BatchSampler(ds, batch_size=3, drop_last=True)
+        assert len(bs) == 3
+        assert all(len(b) == 3 for b in bs)
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = RangeDataset(16)
+        samplers = [DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                            rank=r) for r in range(4)]
+        all_idx = []
+        for s in samplers:
+            for batch in s:
+                all_idx.extend(batch)
+        assert sorted(all_idx) == list(range(16))
+
+    def test_num_workers_prefetch(self):
+        ds = RangeDataset(12)
+        loader = DataLoader(ds, batch_size=4, num_workers=2)
+        assert len(list(loader)) == 3
+
+    def test_iterable_dataset(self):
+        from paddle_tpu.io import IterableDataset
+
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.array([i], np.float32)
+
+        loader = DataLoader(Stream(), batch_size=3)
+        batches = list(loader)
+        assert len(batches) == 3
+
+
+class TestCheckpoint:
+    def test_save_load_state_dict(self, tmp_path):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net2.set_state_dict(paddle.load(path))
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+    def test_save_load_optimizer(self, tmp_path):
+        p = paddle.Parameter(np.ones(3, np.float32))
+        o = opt.Adam(learning_rate=0.1, parameters=[p])
+        (p * p).sum().backward()
+        o.step()
+        path = str(tmp_path / "opt.pdopt")
+        paddle.save(o.state_dict(), path)
+        loaded = paddle.load(path)
+        assert loaded["@step"] == 1
+
+    def test_full_train_state_resume(self, tmp_path):
+        """checkpoint/resume: params + opt + lr sched + rng (SURVEY §2.36)."""
+        net = nn.Linear(2, 2)
+        sched = opt.lr.StepDecay(0.1, step_size=10)
+        o = opt.Momentum(learning_rate=sched, parameters=net.parameters())
+        x = paddle.to_tensor(np.random.rand(4, 2).astype(np.float32))
+        for _ in range(3):
+            net(x).sum().backward()
+            o.step()
+            o.clear_grad()
+            sched.step()
+        state = {"model": net.state_dict(), "opt": o.state_dict(),
+                 "rng": paddle.get_rng_state()}
+        paddle.save(state, str(tmp_path / "ckpt"))
+        restored = paddle.load(str(tmp_path / "ckpt"))
+        net2 = nn.Linear(2, 2)
+        net2.set_state_dict(restored["model"])
+        o2 = opt.Momentum(learning_rate=opt.lr.StepDecay(0.1, step_size=10),
+                          parameters=net2.parameters())
+        for p, p2 in zip(net.parameters(), net2.parameters()):
+            p2.name = p.name
+        o2.set_state_dict(restored["opt"])
+        paddle.set_rng_state(restored["rng"])
+        assert o2._step_count == 3
+
+    def test_jit_save_load(self, tmp_path):
+        net = nn.Linear(3, 2)
+        path = str(tmp_path / "jit_model")
+        paddle.jit.save(net, path)
+        payload = paddle.jit.load(path)
+        assert "state_dict" in payload
+
+
+class TestHapiModel:
+    def test_fit_evaluate(self):
+        paddle.seed(3)
+        n = 64
+        x = np.random.randn(n, 4).astype(np.float32)
+        y = (x.sum(1, keepdims=True) > 0).astype(np.int64)
+        ds = TensorDataset([x, y])
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        model = paddle.Model(net)
+        from paddle_tpu.metric import Accuracy
+        model.prepare(opt.Adam(0.01, parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        model.fit(ds, batch_size=16, epochs=3, verbose=0)
+        res = model.evaluate(ds, batch_size=16, verbose=0)
+        assert res["acc"] > 0.8

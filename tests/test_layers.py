@@ -1,0 +1,218 @@
+"""nn.Layer API + individual layer numerical tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert len(net.parameters()) == 4
+        assert len(net.sublayers()) == 2
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(3, 3)
+        sd = net.state_dict()
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(sd)
+        np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+    def test_train_eval_modes(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        assert net.training
+        net.eval()
+        assert not net[1].training
+        x = t(np.ones((2, 4)))
+        out1 = net(x).numpy()
+        out2 = net(x).numpy()
+        np.testing.assert_allclose(out1, out2)  # dropout off in eval
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        buf_names = [n for n, _ in bn.named_buffers()]
+        assert "_mean" in buf_names and "_variance" in buf_names
+
+    def test_apply_and_to(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype == paddle.bfloat16
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        net(t(np.ones((1, 2))))
+        assert calls == [1]
+        h.remove()
+        net(t(np.ones((1, 2))))
+        assert calls == [1]
+
+    def test_layerlist_parameterlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4 and len(ll.parameters()) == 8
+
+
+class TestLayersNumerics:
+    def test_linear_matches_manual(self):
+        lin = nn.Linear(3, 2)
+        x = np.random.rand(4, 3).astype(np.float32)
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(lin(t(x)).numpy(), ref, rtol=1e-5)
+
+    def test_conv2d_shape_and_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = np.random.rand(2, 3, 16, 16).astype(np.float32)
+        out = conv(t(x))
+        assert out.shape == [2, 8, 8, 8]
+        tconv = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1)
+        with torch.no_grad():
+            tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+            tconv.bias.copy_(torch.from_numpy(conv.bias.numpy()))
+            ref = tconv(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_transpose_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        conv = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1,
+                                  output_padding=1)
+        x = np.random.rand(2, 4, 8, 8).astype(np.float32)
+        out = conv(t(x))
+        tconv = torch.nn.ConvTranspose2d(4, 6, 3, stride=2, padding=1,
+                                         output_padding=1)
+        with torch.no_grad():
+            tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+            tconv.bias.copy_(torch.from_numpy(conv.bias.numpy()))
+            ref = tconv(torch.from_numpy(x)).numpy()
+        assert out.shape == list(ref.shape)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_train_and_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = np.random.rand(4, 3, 5, 5).astype(np.float32) * 2 + 1
+        out = bn(t(x))
+        # normalized output: ~0 mean, ~1 var per channel
+        o = out.numpy()
+        assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-5
+        assert abs(o.var(axis=(0, 2, 3)) - 1).max() < 1e-2
+        # running stats moved toward batch stats
+        assert abs(bn._mean.numpy()).max() > 0
+        bn.eval()
+        out_eval = bn(t(x))
+        assert out_eval.shape == [4, 3, 5, 5]
+
+    def test_layernorm_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        ln = nn.LayerNorm(8)
+        x = np.random.rand(2, 4, 8).astype(np.float32)
+        tln = torch.nn.LayerNorm(8)
+        with torch.no_grad():
+            tln.weight.copy_(torch.from_numpy(ln.weight.numpy()))
+            tln.bias.copy_(torch.from_numpy(ln.bias.numpy()))
+            ref = tln(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(ln(t(x)).numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = emb(ids)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_pools(self):
+        x = t(np.arange(16).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, 2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        aap = nn.AdaptiveAvgPool2D(1)(x)
+        assert float(aap.numpy()) == 7.5
+
+    def test_activations(self):
+        x = t([-2.0, 0.0, 2.0])
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+        np.testing.assert_allclose(nn.Hardtanh()(x).numpy(), [-1, 0, 1])
+        assert nn.GELU()(x).numpy()[2] == pytest.approx(1.9545, abs=1e-3)
+        np.testing.assert_allclose(nn.Softmax()(t([[1.0, 1.0]])).numpy(),
+                                   [[0.5, 0.5]])
+
+    def test_losses(self):
+        ce = nn.CrossEntropyLoss()
+        logits = t([[10.0, 0.0], [0.0, 10.0]])
+        labels = paddle.to_tensor(np.array([0, 1]))
+        assert float(ce(logits, labels).numpy()) < 1e-3
+        mse = nn.MSELoss()
+        assert float(mse(t([1.0, 2.0]), t([1.0, 4.0])).numpy()) == 2.0
+        bce = nn.BCEWithLogitsLoss()
+        v = float(bce(t([0.0]), t([1.0])).numpy())
+        assert v == pytest.approx(np.log(2), rel=1e-4)
+
+    def test_rnn_lstm_gru(self):
+        x = t(np.random.rand(2, 5, 4))
+        lstm = nn.LSTM(4, 8)
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 8] and h.shape == [1, 2, 8]
+        gru = nn.GRU(4, 8, num_layers=2)
+        out, h = gru(x)
+        assert out.shape == [2, 5, 8] and h.shape == [2, 2, 8]
+        rnn = nn.SimpleRNN(4, 8, direction="bidirect")
+        out, h = rnn(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_cell_matches_scan(self):
+        cell = nn.LSTMCell(4, 8)
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        # step-by-step via RNN wrapper
+        rnn = nn.RNN(cell)
+        out, (h, c) = rnn(t(x))
+        assert out.shape == [2, 3, 8]
+
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.rand(2, 6, 16))
+        out = mha(x, x, x)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.rand(2, 6, 16))
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = t(np.random.rand(2, 5, 16))
+        tgt = t(np.random.rand(2, 3, 16))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_grad_flows_through_layers(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        x = t(np.random.rand(3, 4))
+        loss = net(x).sum()
+        loss.backward()
+        for p in net.parameters():
+            assert p.grad is not None

@@ -1,0 +1,171 @@
+"""Optimizer + lr scheduler + clip tests (vs torch reference where cheap)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def quad_param(val=(3.0, -2.0)):
+    p = paddle.Parameter(np.asarray(val, np.float32))
+    return p
+
+
+def run_steps(optimizer, p, n=50):
+    for _ in range(n):
+        loss = (p * p).sum()
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+    return p
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (opt.SGD, {}),
+        (opt.Momentum, {"momentum": 0.9}),
+        (opt.Adam, {}),
+        (opt.AdamW, {"weight_decay": 0.01}),
+        (opt.Adamax, {}),
+        (opt.Adagrad, {}),
+        (opt.Adadelta, {}),
+        (opt.RMSProp, {}),
+        (opt.Lamb, {}),
+    ])
+    def test_minimizes_quadratic(self, cls, kw):
+        p = quad_param()
+        o = cls(learning_rate=0.1, parameters=[p], **kw)
+        run_steps(o, p, 80)
+        assert float((p * p).sum().numpy()) < 0.5
+
+    def test_adam_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.random.rand(3).astype(np.float32)
+        p = paddle.Parameter(w0.copy())
+        o = opt.Adam(learning_rate=0.01, parameters=[p])
+        tp = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        to = torch.optim.Adam([tp], lr=0.01)
+        for _ in range(10):
+            (p * p).sum().backward()
+            o.step()
+            o.clear_grad()
+            to.zero_grad()
+            (tp * tp).sum().backward()
+            to.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4)
+
+    def test_momentum_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.random.rand(3).astype(np.float32)
+        p = paddle.Parameter(w0.copy())
+        o = opt.Momentum(learning_rate=0.01, momentum=0.9, parameters=[p])
+        tp = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        to = torch.optim.SGD([tp], lr=0.01, momentum=0.9)
+        for _ in range(10):
+            (p * p).sum().backward()
+            o.step()
+            o.clear_grad()
+            to.zero_grad()
+            (tp * tp).sum().backward()
+            to.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4)
+
+    def test_weight_decay_l2(self):
+        p = quad_param((1.0,))
+        o = opt.SGD(learning_rate=0.1, parameters=[p],
+                    weight_decay=paddle.L2Decay(0.5))
+        (p * 0.0).sum().backward()  # zero grad; only decay acts
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-5)
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.Parameter(np.zeros(4, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        o = opt.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        (p * 100.0).sum().backward()  # grad = 100s, norm=200
+        o.step()
+        assert np.linalg.norm(p.numpy()) == pytest.approx(1.0, rel=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        p = quad_param()
+        o = opt.Adam(learning_rate=0.1, parameters=[p])
+        run_steps(o, p, 3)
+        sd = o.state_dict()
+        p2 = quad_param()
+        p2.name = p.name
+        o2 = opt.Adam(learning_rate=0.1, parameters=[p2])
+        o2.set_state_dict(sd)
+        assert o2._step_count == 3
+
+    def test_optimizer_minimize(self):
+        p = quad_param()
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        loss = (p * p).sum()
+        o.minimize(loss)
+        assert float((p * p).sum().numpy()) < float(
+            (3.0 ** 2 + 2.0 ** 2))
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_piecewise(self):
+        s = opt.lr.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1])
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.1])
+
+    def test_warmup(self):
+        s = opt.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+        vals = [s()]
+        for _ in range(4):
+            s.step()
+            vals.append(s())
+        assert vals[0] == 0.0 and vals[-1] == pytest.approx(0.1)
+
+    def test_noam(self):
+        s = opt.lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        v1 = s()
+        for _ in range(9):
+            s.step()
+        v10 = s()
+        s.step()
+        for _ in range(50):
+            s.step()
+        assert v10 > v1 and s() < v10
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_reduce_on_plateau(self):
+        s = opt.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        for m in [1.0, 1.0, 1.0, 1.0]:
+            s.step(m)
+        assert s() < 1.0
+
+    def test_optimizer_uses_scheduler(self):
+        sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        p = quad_param()
+        o = opt.SGD(learning_rate=sched, parameters=[p])
+        assert o.get_lr() == 0.1
+        sched.step()
+        assert o.get_lr() == pytest.approx(0.01)
+
+    def test_lr_at_traceable(self):
+        import jax.numpy as jnp
+        s = opt.lr.PolynomialDecay(0.1, decay_steps=100, end_lr=0.0)
+        v = s.lr_at(jnp.asarray(50))
+        assert 0.04 < float(v) < 0.06

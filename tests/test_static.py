@@ -1,0 +1,115 @@
+"""Static graph Program/Executor tests (ref test style: fluid Executor tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+class TestStaticBasics:
+    def test_data_and_ops(self):
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            y = x * 2.0 + 1.0
+            z = y.mean()
+        exe = static.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                         fetch_list=[z])
+        assert out == np.float32(3.0)
+
+    def test_fc_forward(self):
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            out = static.nn.fc(x, size=3)
+        exe = static.Executor()
+        exe.run(startup)
+        (res,) = exe.run(main, feed={"x": np.random.rand(2, 4).astype(np.float32)},
+                         fetch_list=[out])
+        assert res.shape == (2, 3)
+
+    def test_minimize_trains(self):
+        import paddle_tpu.optimizer as opt
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 2], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, size=1)
+            loss = ((pred - y) * (pred - y)).mean()
+            sgd = opt.SGD(learning_rate=0.1)
+            sgd.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        true_w = np.array([[2.0], [-1.0]], np.float32)
+        xd = np.random.rand(32, 2).astype(np.float32)
+        yd = xd @ true_w
+        losses = []
+        for _ in range(150):
+            (lv,) = exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.05, losses[::30]
+
+    def test_shape_change_recompiles(self):
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            out = (x * x).sum()
+        exe = static.Executor()
+        exe.run(startup)
+        (a,) = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                       fetch_list=[out])
+        (b,) = exe.run(main, feed={"x": np.ones((5, 3), np.float32)},
+                       fetch_list=[out])
+        assert a == 6.0 and b == 15.0
+
+    def test_stochastic_op_in_program(self):
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 4], "float32")
+            y = paddle.ops.dropout(x, p=0.5, training=True)
+            s = y.sum()
+        exe = static.Executor()
+        exe.run(startup)
+        outs = {float(exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+                              fetch_list=[s])[0]) for _ in range(5)}
+        assert len(outs) > 1  # fresh randomness per run
+
+
+class TestStaticDygraphParity:
+    def test_layer_norm_parity(self):
+        # same op implementations serve both modes: run static fc vs manual
+        paddle.disable_static()
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 2)
+        x = np.random.rand(3, 4).astype(np.float32)
+        eager_out = lin(paddle.to_tensor(x)).numpy()
+        paddle.enable_static()
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            xv = static.data("x", [None, 4], "float32")
+            from paddle_tpu.core.param_attr import ParamAttr
+            from paddle_tpu.nn.initializer import Assign
+            out = static.nn.fc(xv, size=2,
+                               weight_attr=ParamAttr(initializer=Assign(
+                                   lin.weight.numpy())),
+                               bias_attr=ParamAttr(initializer=Assign(
+                                   lin.bias.numpy())))
+        exe = static.Executor()
+        exe.run(startup)
+        (res,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(res, eager_out, rtol=1e-5)

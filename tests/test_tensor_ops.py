@@ -1,0 +1,196 @@
+"""Op-level numerical tests vs numpy (reference test style: test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestArithmetic:
+    def test_add(self):
+        a, b = t([1.0, 2.0]), t([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4.0, 6.0])
+
+    def test_broadcast(self):
+        a = t(np.ones((2, 3)))
+        b = t(np.arange(3))
+        np.testing.assert_allclose((a * b).numpy(), np.ones((2, 3)) * np.arange(3))
+
+    def test_scalar(self):
+        a = t([1.0, 2.0])
+        np.testing.assert_allclose((a + 1).numpy(), [2.0, 3.0])
+        np.testing.assert_allclose((2 * a).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose((1 / a).numpy(), [1.0, 0.5])
+
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose((t(a) @ t(b)).numpy(), a @ b, rtol=1e-5)
+
+    def test_comparisons(self):
+        a, b = t([1.0, 5.0]), t([2.0, 2.0])
+        assert (a < b).numpy().tolist() == [True, False]
+        assert (a >= b).numpy().tolist() == [False, True]
+
+    def test_pow_mod(self):
+        a = t([2.0, 3.0])
+        np.testing.assert_allclose((a ** 2).numpy(), [4.0, 9.0])
+        np.testing.assert_allclose(ops.remainder(t([5.0]), t([3.0])).numpy(), [2.0])
+
+
+class TestReductions:
+    def test_sum_mean(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(t(x).sum().numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(t(x).mean(axis=1).numpy(), x.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            t(x).sum(axis=0, keepdim=True).numpy(), x.sum(0, keepdims=True),
+            rtol=1e-5)
+
+    def test_max_min_prod(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(t(x).max(axis=1).numpy(), x.max(1))
+        np.testing.assert_allclose(t(x).min().numpy(), x.min())
+        np.testing.assert_allclose(t(x).prod(axis=0).numpy(), x.prod(0), rtol=1e-5)
+
+    def test_std_var(self):
+        x = np.random.rand(10).astype(np.float32)
+        np.testing.assert_allclose(t(x).std().numpy(), x.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(t(x).var(unbiased=False).numpy(), x.var(),
+                                   rtol=1e-5)
+
+    def test_logsumexp_cumsum(self):
+        x = np.random.rand(5).astype(np.float32)
+        np.testing.assert_allclose(ops.logsumexp(t(x)).numpy(),
+                                   np.log(np.exp(x).sum()), rtol=1e-5)
+        np.testing.assert_allclose(ops.cumsum(t(x)).numpy(), np.cumsum(x),
+                                   rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        assert ops.reshape(t(x), [4, 6]).shape == [4, 6]
+        np.testing.assert_allclose(
+            ops.transpose(t(x), [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_split_stack(self):
+        a, b = t(np.ones((2, 3))), t(np.zeros((2, 3)))
+        assert ops.concat([a, b], axis=0).shape == [4, 3]
+        assert ops.stack([a, b]).shape == [2, 2, 3]
+        parts = ops.split(t(np.arange(12).reshape(2, 6)), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = ops.split(t(np.arange(12).reshape(2, 6)), [1, 2, -1], axis=1)
+        assert parts[2].shape == [2, 3]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = t(np.ones((1, 3, 1, 4)))
+        assert ops.squeeze(x).shape == [3, 4]
+        assert ops.squeeze(x, axis=0).shape == [3, 1, 4]
+        assert ops.unsqueeze(t(np.ones((3,))), [0, 2]).shape == [1, 3, 1]
+        assert ops.flatten(t(np.ones((2, 3, 4))), 1).shape == [2, 12]
+
+    def test_gather_scatter(self):
+        x = t(np.arange(12).reshape(4, 3))
+        idx = paddle.to_tensor(np.array([0, 2]))
+        np.testing.assert_allclose(ops.gather(x, idx).numpy(),
+                                   np.arange(12).reshape(4, 3)[[0, 2]])
+        base = t(np.zeros((4, 3)))
+        upd = t(np.ones((2, 3)))
+        out = ops.scatter(base, idx, upd)
+        assert out.numpy()[0].sum() == 3
+
+    def test_tile_expand_pad(self):
+        x = t(np.ones((2, 2)))
+        assert ops.tile(x, [2, 3]).shape == [4, 6]
+        assert ops.expand(t(np.ones((1, 3))), [5, 3]).shape == [5, 3]
+        assert ops.pad(t(np.ones((2, 2))), [1, 1, 1, 1]).shape == [4, 4]
+
+    def test_where_masked(self):
+        x = t([1.0, -2.0, 3.0])
+        out = ops.where(x > 0, x, paddle.zeros([3]))
+        np.testing.assert_allclose(out.numpy(), [1.0, 0.0, 3.0])
+
+    def test_getitem(self):
+        x = t(np.arange(12).reshape(3, 4))
+        np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(x[:, 1:3].numpy(),
+                                   np.arange(12).reshape(3, 4)[:, 1:3])
+
+
+class TestSearch:
+    def test_argmax_topk(self):
+        x = t([[1.0, 5.0, 2.0], [7.0, 0.0, 3.0]])
+        np.testing.assert_array_equal(ops.argmax(x, axis=1).numpy(), [1, 0])
+        vals, idx = ops.topk(x, 2)
+        np.testing.assert_allclose(vals.numpy(), [[5.0, 2.0], [7.0, 3.0]])
+        np.testing.assert_array_equal(idx.numpy(), [[1, 2], [0, 2]])
+
+    def test_sort_argsort(self):
+        x = np.random.rand(5).astype(np.float32)
+        np.testing.assert_allclose(ops.sort(t(x)).numpy(), np.sort(x))
+        np.testing.assert_array_equal(ops.argsort(t(x)).numpy(), np.argsort(x))
+
+    def test_unique_nonzero(self):
+        x = paddle.to_tensor(np.array([1, 2, 2, 3, 1]))
+        np.testing.assert_array_equal(ops.unique(x).numpy(), [1, 2, 3])
+        nz = ops.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+class TestLinalg:
+    def test_norm(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(ops.norm(t(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(ops.norm(t(x), p=1, axis=1).numpy(),
+                                   np.abs(x).sum(1), rtol=1e-5)
+
+    def test_inverse_solve(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        np.testing.assert_allclose(ops.inverse(t(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(ops.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+                                   a @ b, rtol=1e-5)
+
+
+class TestCreation:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], "int64").dtype == paddle.int64
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        assert ops.eye(3).numpy().trace() == 3
+        assert ops.full([2, 2], 7.0).numpy().sum() == 28
+        assert ops.linspace(0, 1, 5).shape == [5]
+        assert ops.tril(t(np.ones((3, 3)))).numpy().sum() == 6
+
+    def test_random(self):
+        paddle.seed(42)
+        a = ops.randn([100])
+        assert abs(float(a.mean().numpy())) < 0.5
+        u = ops.uniform([1000], min=0.0, max=1.0)
+        assert 0 <= float(u.min().numpy()) and float(u.max().numpy()) <= 1
+        p = ops.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = ops.randn([4]).numpy()
+        paddle.seed(7)
+        b = ops.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestCast:
+    def test_cast(self):
+        x = t([1.5, 2.5])
+        assert x.astype("int32").dtype == paddle.int32
+        assert x.astype(paddle.float64).dtype == paddle.float64
