@@ -1,0 +1,91 @@
+"""Benchmark: GPT-2 small causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.40 (A100-class reference MFU target for
+transformer pretraining, SURVEY.md §6 — BASELINE.json publishes no absolute
+numbers this round).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu import optimizer as opt_mod
+    from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = GPT2Config()  # GPT-2 small, 124M params
+        batch, seq = 8, 1024
+        warmup, iters = 3, 10
+    else:  # CI/smoke fallback
+        cfg = GPT2Config.tiny()
+        batch, seq = 4, 128
+        warmup, iters = 2, 5
+    cfg.dropout = 0.0
+
+    loss_fn, init_params, model = build_train_step(cfg, remat=False)
+    params = init_params()
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+
+    optimizer = opt_mod.AdamW(learning_rate=1e-4, weight_decay=0.01)
+    opt_state = optimizer.functional_init(params)
+
+    def train_step(params, opt_state, batch_data, key):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch_data, key)
+        new_params, new_state = optimizer.functional_update(params, grads,
+                                                            opt_state)
+        return loss, new_params, new_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    data = {
+        "input_ids": jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+        "labels": jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+    }
+    key = jax.random.key(0)
+
+    for i in range(warmup):
+        loss, params, opt_state = jitted(params, opt_state, data,
+                                         jax.random.fold_in(key, i))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        loss, params, opt_state = jitted(params, opt_state, data,
+                                         jax.random.fold_in(key, 100 + i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * iters / dt
+    flops_per_token = 6 * n_params  # fwd+bwd transformer rule of thumb
+    achieved_flops = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
+    mfu = achieved_flops / peak
+
+    print(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+    print(f"# loss={float(loss):.4f} params={n_params/1e6:.1f}M "
+          f"mfu={mfu:.3f} step={dt/iters*1000:.1f}ms backend="
+          f"{jax.default_backend()}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
