@@ -65,6 +65,9 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     o0 = jnp.zeros((b, h, s, d), q.dtype)
     m0 = jnp.full((b, h, s, 1), -1e30, q.dtype)
     l0 = jnp.zeros((b, h, s, 1), q.dtype)
+    # constants start axis-unvarying under shard_map's type system; the carry
+    # becomes sp-varying after the first step, so pre-mark them varying
+    o0, m0, l0 = (jax.lax.pvary(t, axis_name) for t in (o0, m0, l0))
     (o, m, l, _, _), _ = jax.lax.scan(body, (o0, m0, l0, k, v),
                                       jnp.arange(n))
     return o / jnp.maximum(l, 1e-30)
