@@ -1,0 +1,81 @@
+"""Native C++ runtime tests (queue, arena, prefetching DataLoader)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+native = pytest.importorskip("paddle_tpu.io.native_loader")
+
+try:
+    native.get_lib()
+    HAVE_CC = True
+except Exception:
+    HAVE_CC = False
+
+pytestmark = pytest.mark.skipif(not HAVE_CC, reason="no C++ toolchain")
+
+
+class TestByteQueue:
+    def test_roundtrip_order(self):
+        import ctypes
+        lib = native.get_lib()
+        q = lib.ptq_create(4, 1 << 20)
+        for i in range(10):
+            data = bytes([i]) * (i + 1)
+            if i >= 4:
+                break
+            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+            assert lib.ptq_push(q, buf, len(data)) == 0
+        assert lib.ptq_size(q) == 4
+        for i in range(4):
+            n = lib.ptq_peek_size(q)
+            out = (ctypes.c_uint8 * n)()
+            assert lib.ptq_pop(q, out, n) == n == i + 1
+            assert bytes(out) == bytes([i]) * (i + 1)
+        lib.ptq_close(q)
+        assert lib.ptq_peek_size(q) == -1
+        lib.ptq_destroy(q)
+
+    def test_blocking_producer_consumer(self):
+        import threading
+        items = list(range(50))
+        out = []
+
+        def gen():
+            for i in items:
+                yield np.full((16,), i, np.float32)
+
+        pf = native.NativePrefetcher(gen(), depth=3)
+        for arr in pf:
+            out.append(int(arr[0]))
+        assert out == items
+
+
+class TestArena:
+    def test_alloc_free_reuse(self):
+        a = native.HostArena(limit_bytes=1 << 24)
+        p1 = a.alloc(1000)
+        a.free(p1)
+        p2 = a.alloc(900)  # same bucket (1024) -> reused block
+        assert p2 == p1
+        r = a.reserved_bytes
+        assert r >= 1024
+
+    def test_buffer_view(self):
+        a = native.HostArena()
+        view, ptr = a.buffer(4096)
+        view[:] = 7
+        assert view.sum() == 7 * 4096
+        a.free(ptr)
+
+
+class TestLoaderIntegration:
+    def test_dataloader_native_path(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        xs = np.arange(40, dtype=np.float32).reshape(40, 1)
+        ds = TensorDataset([xs])
+        loader = DataLoader(ds, batch_size=8, num_workers=2)
+        seen = []
+        for (x,) in loader:
+            seen.extend(x.numpy().reshape(-1).tolist())
+        assert sorted(seen) == list(range(40))
