@@ -1,0 +1,153 @@
+"""Probability distributions (ref: python/paddle/distribution.py —
+Distribution/Uniform/Normal/Categorical)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .core import rng as rng_mod
+from .core.tensor import Tensor
+from .ops._registry import raw
+
+
+def _as(x):
+    return jnp.asarray(raw(x), jnp.float32)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as(low)
+        self.high = _as(high)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key() if not seed else jax.random.key(seed)
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(key, shape)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _as(value)
+        lp = -jnp.log(self.high - self.low)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as(loc)
+        self.scale = _as(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key() if not seed else jax.random.key(seed)
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return Tensor(self.loc + self.scale * jax.random.normal(key, shape))
+
+    def log_prob(self, value):
+        v = _as(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _as(logits)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key() if not seed else jax.random.key(seed)
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.logits.shape[:-1]))
+
+    @property
+    def _probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def probs(self, value=None):
+        if value is None:
+            return Tensor(self._probs)
+        idx = jnp.asarray(raw(value)).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(self._probs, idx[..., None],
+                                          axis=-1)[..., 0])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = jnp.asarray(raw(value)).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        p = self._probs
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+    def kl_divergence(self, other):
+        p = self._probs
+        return Tensor(jnp.sum(
+            p * (jax.nn.log_softmax(self.logits, -1)
+                 - jax.nn.log_softmax(other.logits, -1)), axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _as(probs)
+            self.logits = jnp.log(self.probs_ / (1 - self.probs_))
+        else:
+            self.logits = _as(logits)
+            self.probs_ = jax.nn.sigmoid(self.logits)
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key() if not seed else jax.random.key(seed)
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_, tuple(shape) + self.probs_.shape
+        ).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _as(value)
+        return Tensor(v * jax.nn.log_sigmoid(self.logits)
+                      + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs_
+        return Tensor(-(p * jnp.log(jnp.maximum(p, 1e-30))
+                        + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-30))))
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

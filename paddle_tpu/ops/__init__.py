@@ -12,6 +12,7 @@ from .attention import (  # noqa: F401
     fused_feedforward, fused_multi_head_attention,
     scaled_dot_product_attention,
 )
+from .control import case, cond, fori_loop, scan, switch_case, while_loop  # noqa: F401
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403

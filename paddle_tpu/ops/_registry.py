@@ -52,7 +52,34 @@ def _wrap_outputs(res, record_node, name, diff_tensors, vjp_fn):
     return outs[0]
 
 
+def _amp_cast_fn(fn, name):
+    """Wrap fn to run in the AMP compute dtype when the policy says so
+    (ref: fluid/contrib/mixed_precision auto-insertion of cast ops)."""
+    from ..amp import amp_dtype, amp_should_cast
+    if not amp_should_cast(name):
+        return fn
+    from ..core.dtype import convert_dtype
+    dt = convert_dtype(amp_dtype())
+
+    def wrapped(*a, **k):
+        def cast(x):
+            if hasattr(x, "dtype") and hasattr(x, "astype") and \
+                    jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                return jnp.asarray(x).astype(dt)
+            return x
+        a = jax.tree_util.tree_map(cast, a)
+        out = fn(*a, **k)
+        return jax.tree_util.tree_map(
+            lambda o: o.astype(jnp.float32)
+            if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating)
+            and o.dtype == dt else o, out)
+    return wrapped
+
+
 def apply_op(fn, name, args, kwargs, nondiff=False, stochastic=False):
+    from ..amp import amp_enabled
+    if amp_enabled():
+        fn = _amp_cast_fn(fn, name)
     if mode.in_static_mode():
         hook = mode.static_hook()
         if hook is not None:

@@ -1,0 +1,115 @@
+"""Control-flow ops.
+
+Reference: python/paddle/fluid/layers/control_flow.py (cond, while_loop, case,
+switch_case — C++ ConditionalBlock/While ops). TPU-first: these ARE
+lax.cond/lax.while_loop/lax.switch, so control flow stays inside the compiled
+XLA computation instead of bouncing to a host-side interpreter.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._registry import apply_op, defop, raw
+
+
+def _wrap(x):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if hasattr(v, "shape") and not isinstance(v, Tensor)
+        else v, x)
+
+
+def _unwrap_tree(x):
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v, x,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """paddle.static.nn.cond — lax.cond under the hood. Branch fns take no
+    args and may close over Tensors (traced as constants-by-reference)."""
+    p = raw(pred)
+    p = jnp.asarray(p).reshape(())
+
+    def tf(_):
+        return _unwrap_tree(true_fn())
+
+    def ff(_):
+        return _unwrap_tree(false_fn())
+
+    out = jax.lax.cond(p.astype(bool), tf, ff, operand=None)
+    return _wrap(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop — lax.while_loop."""
+    init = tuple(_unwrap_tree(v) for v in loop_vars)
+
+    def c(state):
+        out = cond_fn(*_wrap(list(state)))
+        return jnp.asarray(raw(out)).reshape(()).astype(bool)
+
+    def b(state):
+        out = body_fn(*_wrap(list(state)))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(_unwrap_tree(v) for v in out)
+
+    final = jax.lax.while_loop(c, b, init)
+    return _wrap(list(final))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case — nested lax.cond chain."""
+    def build(pairs):
+        if not pairs:
+            if default is None:
+                raise ValueError("case: no default and no predicate matched "
+                                 "statically")
+            return _unwrap_tree(default())
+        pred, fn = pairs[0]
+        p = jnp.asarray(raw(pred)).reshape(()).astype(bool)
+        return jax.lax.cond(p, lambda _: _unwrap_tree(fn()),
+                            lambda _: build(pairs[1:]), operand=None)
+    return _wrap(build(list(pred_fn_pairs)))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case — lax.switch."""
+    if isinstance(branch_fns, dict):
+        max_idx = max(branch_fns)
+        fns = [branch_fns.get(i, default) for i in range(max_idx + 1)]
+    else:
+        fns = list(branch_fns)
+        if fns and isinstance(fns[0], (tuple, list)):
+            d = dict(fns)
+            max_idx = max(d)
+            fns = [d.get(i, default) for i in range(max_idx + 1)]
+    if default is not None:
+        fns = fns + [default]
+    idx = jnp.asarray(raw(branch_index)).reshape(()).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, len(fns) - 1)
+    out = jax.lax.switch(idx, [(lambda f: lambda _: _unwrap_tree(f()))(f)
+                               for f in fns], None)
+    return _wrap(out)
+
+
+@defop(nondiff=True)
+def increment_inplace(x, value=1.0):
+    return x + value
+
+
+def fori_loop(lower, upper, body_fn, init):
+    """Convenience: lax.fori_loop with Tensor carry."""
+    out = jax.lax.fori_loop(int(lower), int(upper),
+                            lambda i, s: _unwrap_tree(body_fn(i, _wrap(s))),
+                            _unwrap_tree(init))
+    return _wrap(out)
+
+
+def scan(f, init, xs):
+    """lax.scan with Tensor pytrees."""
+    carry, ys = jax.lax.scan(
+        lambda c, x: tuple(_unwrap_tree(f(_wrap(c), _wrap(x)))),
+        _unwrap_tree(init), _unwrap_tree(xs))
+    return _wrap(carry), _wrap(ys)

@@ -1,0 +1,115 @@
+"""AMP autocast/GradScaler, control-flow ops, distributions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import ops
+from paddle_tpu.amp import GradScaler, auto_cast
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestAMP:
+    def test_autocast_matmul_bf16(self):
+        a = t(np.random.rand(8, 8))
+        b = t(np.random.rand(8, 8))
+        with auto_cast(True):
+            out = ops.matmul(a, b)
+        # conservative O1: compute in bf16, result cast back to f32
+        assert out.dtype == paddle.float32
+        ref = ops.matmul(a, b)
+        # bf16 compute → visible precision difference vs f32 in general,
+        # values still close
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-2)
+
+    def test_blacklist_stays_f32(self):
+        x = t(np.random.rand(4, 4))
+        with auto_cast(True):
+            out = ops.softmax(x)
+        np.testing.assert_allclose(out.numpy(), ops.softmax(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_grad_scaler_bf16_passthrough(self):
+        lin = nn.Linear(4, 2)
+        import paddle_tpu.optimizer as opt
+        o = opt.SGD(0.1, parameters=lin.parameters())
+        scaler = GradScaler()
+        with auto_cast(True):
+            loss = lin(t(np.ones((2, 4)))).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(o)
+        scaler.update()
+        assert lin.weight.grad is not None
+
+    def test_decorate_o2(self):
+        from paddle_tpu.amp import decorate
+        lin = nn.Linear(4, 2)
+        decorate(lin, level="O2", dtype="bfloat16")
+        assert lin.weight.dtype == paddle.bfloat16
+
+
+class TestControlFlow:
+    def test_cond(self):
+        x = t([2.0])
+        out = ops.cond(x.sum() > 1.0, lambda: x * 10, lambda: x * -1)
+        np.testing.assert_allclose(out.numpy(), [20.0])
+        out = ops.cond(x.sum() > 5.0, lambda: x * 10, lambda: x * -1)
+        np.testing.assert_allclose(out.numpy(), [-2.0])
+
+    def test_while_loop(self):
+        i = t([0.0])
+        s = t([0.0])
+        i_f, s_f = ops.while_loop(
+            lambda i, s: i.sum() < 5,
+            lambda i, s: [i + 1, s + i],
+            [i, s])
+        assert float(i_f.numpy()) == 5.0
+        assert float(s_f.numpy()) == 10.0  # 0+1+2+3+4
+
+    def test_switch_case(self):
+        x = t([1.0])
+        out = ops.switch_case(paddle.to_tensor(np.array(1)), [
+            lambda: x * 1, lambda: x * 2, lambda: x * 3])
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+    def test_case(self):
+        x = t([3.0])
+        out = ops.case([(x.sum() > 5, lambda: x * 0),
+                        (x.sum() > 1, lambda: x * 7)],
+                       default=lambda: x)
+        np.testing.assert_allclose(out.numpy(), [21.0])
+
+
+class TestDistributions:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+        d = Normal(0.0, 1.0)
+        s = d.sample([1000])
+        assert abs(float(s.numpy().mean())) < 0.2
+        lp = d.log_prob(paddle.to_tensor(0.0))
+        assert float(lp.numpy()) == pytest.approx(-0.9189, abs=1e-3)
+        assert float(d.entropy().numpy()) == pytest.approx(1.4189, abs=1e-3)
+
+    def test_uniform(self):
+        from paddle_tpu.distribution import Uniform
+        d = Uniform(0.0, 2.0)
+        s = d.sample([500])
+        assert 0 <= float(s.numpy().min()) and float(s.numpy().max()) <= 2
+        assert float(d.log_prob(paddle.to_tensor(1.0)).numpy()) == \
+            pytest.approx(np.log(0.5), abs=1e-5)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+        d = Categorical(paddle.to_tensor([0.0, 0.0]))
+        np.testing.assert_allclose(d.probs().numpy(), [0.5, 0.5])
+        assert float(d.entropy().numpy()) == pytest.approx(np.log(2), abs=1e-5)
+
+    def test_normal_kl(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        p = Normal(0.0, 1.0)
+        q = Normal(0.0, 1.0)
+        assert float(kl_divergence(p, q).numpy()) == pytest.approx(0.0, abs=1e-6)
