@@ -1,0 +1,108 @@
+"""Pipeline parallelism — GPipe schedule over the `pp` mesh axis.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/pipeline_optimizer.py
+(graph-partitioned pipeline with send/recv ops over NCCL). TPU-first rework:
+SPMD collective-permute pipelining — every pp-rank holds ONE stage's params
+(stacked layer params sharded on pp), and a lax.scan over M + S - 1 ticks
+rotates activations to the next stage with ppermute. Backward flows through
+the scan + ppermute transpose automatically, so jax.grad of the pipelined
+loss trains the pipeline without hand-written send/recv grads. Bubble
+fraction = (S-1)/(M+S-1), as in GPipe.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
+    """Run homogeneous pipeline stages inside shard_map over `axis_name`.
+
+    stage_fn: (params, x) -> y, the per-stage computation (same structure on
+        every rank; each rank's shard of `stage_params` is ITS stage).
+    stage_params: pytree whose leaves are this rank's stage params (already
+        sharded: leading stacked dim split over pp outside, so in here each
+        rank sees its own slice).
+    microbatches: [M, mb, ...] — every rank sees the same microbatch stream
+        (replicated over pp); only stage 0's compute on fresh input matters,
+        later stages consume permuted activations.
+    Returns [M, mb, ...] outputs of the LAST stage (valid on every rank —
+        replicated by a final collect).
+    """
+    s = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    ticks = m + s - 1
+    mb_shape = microbatches.shape[1:]
+
+    fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 ingests microbatch t (if any); others use the rotated buffer
+        mb_idx = jnp.clip(t, 0, m - 1)
+        fresh = jax.lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
+                                             keepdims=False)
+        x = jnp.where(idx == 0, fresh, buf)
+        y = stage_fn(stage_params, x)
+        # last stage's result for microbatch (t - (s-1)) is ready at tick t
+        out_idx = t - (s - 1)
+        is_valid = (out_idx >= 0)
+        outs = jax.lax.cond(
+            is_valid,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(out_idx, 0, m - 1), 0),
+            lambda o: o, outs)
+        buf_next = jax.lax.ppermute(y, axis_name, fwd_perm)
+        return (buf_next, outs), None
+
+    buf0 = jnp.zeros(mb_shape, microbatches.dtype)
+    buf0 = jax.lax.pvary(buf0, axis_name)
+    outs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
+    outs0 = jax.lax.pvary(outs0, axis_name)
+    mbs = jax.lax.pvary(microbatches, axis_name) \
+        if not _is_varying(microbatches) else microbatches
+    (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
+    # outs holds last-stage results only on the last rank; broadcast via
+    # masked psum (a one-hot "bcast from rank s-1")
+    outs_masked = jnp.where(idx == s - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(outs_masked, axis_name)
+
+
+def _is_varying(x):
+    return True  # inputs inside shard_map are treated varying; pvary is idempotent-safe
+
+
+def make_pipeline_loss(stage_fn, loss_head, mesh, num_microbatches,
+                       axis_name="pp"):
+    """Build loss(params_stacked, batch) running the GPipe schedule under
+    shard_map on `mesh`.
+
+    stage_fn: (stage_params, x) -> y
+    loss_head: (y_last, labels) -> scalar (computed replicated)
+    params_stacked: pytree with leading dim = #stages on every leaf.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def loss_fn(params_stacked, x, labels):
+        def inner(params_local, x, labels):
+            # params_local leaves: [1, ...] — this rank's stage
+            params_stage = jax.tree_util.tree_map(lambda p: p[0], params_local)
+            m = num_microbatches
+            mbs = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+            outs = pipeline_apply(stage_fn, params_stage, mbs, axis_name)
+            y = outs.reshape((x.shape[0],) + outs.shape[2:])
+            ell = loss_head(y, labels)
+            # identical on every pp rank; mean keeps it consistent
+            return jax.lax.pmean(ell, axis_name)
+
+        spec_p = jax.tree_util.tree_map(
+            lambda p: P(axis_name), params_stacked)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(spec_p, P(), P()),
+            out_specs=P(),
+            check_rep=False)(params_stacked, x, labels)
+
+    return loss_fn
