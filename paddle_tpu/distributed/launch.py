@@ -1,0 +1,58 @@
+"""Multi-host launch (ref: python/paddle/distributed/launch.py + fleet/launch.py).
+
+The reference forks one trainer process per GPU and wires NCCL ports via env.
+TPU-first: one process per HOST drives all local chips; multi-host bootstrap
+is jax.distributed.initialize (coordinator address + process id), after which
+jax.devices() spans every host and the same Mesh/pjit code scales out.
+
+Usage:
+  python -m paddle_tpu.distributed.launch \
+      --coordinator=HOST:PORT --num_processes=N --process_id=I train.py ...
+Single-host: `python -m paddle_tpu.distributed.launch train.py` just execs.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def initialize_from_env():
+    """Initialize jax.distributed from PADDLE_* / standard env if present."""
+    import jax
+    coord = (os.environ.get("PADDLE_COORDINATOR")
+             or os.environ.get("COORDINATOR_ADDRESS"))
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               os.environ.get("NUM_PROCESSES", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                             os.environ.get("PROCESS_ID", "0")))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    return nproc, pid
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--coordinator", default=None,
+                        help="coordinator host:port for multi-host")
+    parser.add_argument("--num_processes", type=int, default=1)
+    parser.add_argument("--process_id", type=int, default=0)
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.coordinator and args.num_processes > 1:
+        os.environ["PADDLE_COORDINATOR"] = args.coordinator
+        os.environ["PADDLE_TRAINERS_NUM"] = str(args.num_processes)
+        os.environ["PADDLE_TRAINER_ID"] = str(args.process_id)
+        initialize_from_env()
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

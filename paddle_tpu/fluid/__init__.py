@@ -1,0 +1,77 @@
+"""fluid 1.x compatibility namespace.
+
+Reference-era user code (`import paddle.fluid as fluid`) maps here: the
+Program/Executor APIs, fluid.layers functional set, fluid.dygraph guard —
+all backed by the TPU-native implementations.
+"""
+from __future__ import annotations
+
+from ..core.param_attr import ParamAttr  # noqa: F401
+from ..core.place import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace  # noqa: F401
+from ..core.tensor import Tensor  # noqa: F401
+from ..static import (  # noqa: F401
+    CompiledProgram, Executor, Program, data, default_main_program,
+    default_startup_program, global_scope, name_scope, program_guard,
+    scope_guard,
+)
+from ..static.program import Variable, append_backward  # noqa: F401
+from .. import nn as _nn  # noqa: F401
+from .. import optimizer as _optimizer_mod
+from ..nn import initializer  # noqa: F401
+from .. import regularizer  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import layers  # noqa: F401
+from ..io import DataLoader  # noqa: F401
+from ..core.mode import in_dygraph_mode  # noqa: F401
+
+
+class optimizer:  # fluid.optimizer.* (classes with fluid-era ctor names)
+    SGD = _optimizer_mod.SGD
+    SGDOptimizer = _optimizer_mod.SGD
+    Momentum = _optimizer_mod.Momentum
+    MomentumOptimizer = _optimizer_mod.Momentum
+    Adam = _optimizer_mod.Adam
+    AdamOptimizer = _optimizer_mod.Adam
+    Adamax = _optimizer_mod.Adamax
+    AdamaxOptimizer = _optimizer_mod.Adamax
+    Adagrad = _optimizer_mod.Adagrad
+    AdagradOptimizer = _optimizer_mod.Adagrad
+    RMSProp = _optimizer_mod.RMSProp
+    RMSPropOptimizer = _optimizer_mod.RMSProp
+    Lamb = _optimizer_mod.Lamb
+    LambOptimizer = _optimizer_mod.Lamb
+
+
+def embedding(*a, **kw):
+    from ..static import nn as static_nn
+    return static_nn.embedding(*a, **kw)
+
+
+class io:
+    @staticmethod
+    def save_params(executor, dirname, main_program=None, filename=None):
+        import os
+
+        from ..framework.io import save as fsave
+        from ..static import global_scope
+        from ..static.program import default_main_program
+        os.makedirs(dirname, exist_ok=True)
+        prog = main_program or default_main_program()
+        scope = global_scope()
+        state = {}
+        for v in prog.global_block().vars.values():
+            if v.persistable and scope.find_var(v.name) is not None:
+                from ..core.tensor import Tensor as T
+                state[v.name] = T(scope.find_var(v.name))
+        fsave(state, os.path.join(dirname, filename or "params.pd"))
+
+    @staticmethod
+    def load_params(executor, dirname, main_program=None, filename=None):
+        import os
+
+        from ..framework.io import load as fload
+        from ..static import global_scope
+        state = fload(os.path.join(dirname, filename or "params.pd"))
+        scope = global_scope()
+        for name, t in state.items():
+            scope.set(name, t._value)

@@ -1,0 +1,105 @@
+"""fluid.dygraph compatibility (ref: python/paddle/fluid/dygraph/).
+
+Dygraph is the default mode here, so `guard()` is a no-op context that also
+ensures static mode is off.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core import mode
+from ..core.tensor import Tensor
+from ..nn import (  # noqa: F401  fluid-era layer aliases
+    BatchNorm, Embedding, LayerList, Linear, Sequential,
+)
+from ..nn.layer.layers import Layer  # noqa: F401
+from ..jit import TranslatedLayer  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    was_static = mode.in_static_mode()
+    mode.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            mode.enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    if isinstance(value, Tensor):
+        return value
+    return Tensor(np.asarray(value), dtype=dtype, name=name)
+
+
+def enabled():
+    return mode.in_dygraph_mode()
+
+
+class Conv2D(Layer):
+    """fluid.dygraph.Conv2D (NCHW, act fused — old ctor signature)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        from ..nn import Conv2D as NewConv2D
+        self._conv = NewConv2D(num_channels, num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups or 1,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        from .. import ops
+        out = self._conv(x)
+        return getattr(ops, self._act)(out) if self._act else out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._cfg = dict(pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, pool_padding=pool_padding,
+                         global_pooling=global_pooling, ceil_mode=ceil_mode,
+                         exclusive=exclusive)
+
+    def forward(self, x):
+        from ..static.nn import pool2d
+        return pool2d(x, **{k: v for k, v in self._cfg.items()
+                            if k in ("pool_size", "pool_type", "pool_stride",
+                                     "pool_padding", "global_pooling",
+                                     "ceil_mode", "exclusive")})
+
+
+def save_dygraph(state_dict, model_path):
+    from ..framework.io import save
+    save(state_dict, model_path + ".pdparams")
+
+
+def load_dygraph(model_path):
+    import os
+
+    from ..framework.io import load
+    params = load(model_path + ".pdparams") \
+        if os.path.exists(model_path + ".pdparams") else None
+    opt = load(model_path + ".pdopt") \
+        if os.path.exists(model_path + ".pdopt") else None
+    return params, opt
+
+
+no_grad = None  # populated below
+
+
+def _init():
+    global no_grad
+    from ..core.autograd import _NoGradDecorator
+    no_grad = _NoGradDecorator()
+
+
+_init()

@@ -1,0 +1,90 @@
+"""fluid.layers functional namespace (ref: python/paddle/fluid/layers/).
+
+Static-graph builders come from static.nn; pure tensor ops come from the op
+library (usable in both modes).
+"""
+from __future__ import annotations
+
+from .. import ops as _ops
+from ..ops import *  # noqa: F401,F403
+from ..static.nn import (  # noqa: F401
+    batch_norm, conv2d, dropout, embedding, fc, layer_norm, pool2d,
+)
+from ..ops.control import case, cond, switch_case, while_loop  # noqa: F401
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    from ..static import data as static_data
+    if append_batch_size:
+        shape = [-1] + list(shape)
+    return static_data(name, shape, dtype)
+
+
+def fill_constant(shape, dtype, value, name=None, out=None):
+    return _ops.full(shape, value, dtype)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _ops.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _ops.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _ops.max(input, axis=dim, keepdim=keep_dim)
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return _ops.add(x, y)
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return _ops.subtract(x, y)
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return _ops.multiply(x, y)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return _ops.divide(x, y)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    return _ops.matmul(_ops.flatten(x, x_num_col_dims) if x.ndim > 2 else x, y)
+
+
+def mean(x, name=None):
+    return _ops.mean(x)
+
+
+def accuracy(input, label, k=1, **kw):  # noqa: A002
+    from ..metric import accuracy as acc
+    return acc(input, label, k)
+
+
+def softmax_with_cross_entropy(logits, label, **kw):
+    return _ops.softmax_with_cross_entropy(logits, label, **kw)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):  # noqa: A002
+    return _ops.cross_entropy(input, label, soft_label=soft_label,
+                              ignore_index=ignore_index, reduction="none",
+                              use_softmax=False)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.mode import in_static_mode
+    if in_static_mode():
+        from ..static.nn import _create_param
+        return _create_param(shape, dtype, attr, is_bias, default_initializer)
+    from ..core.param_attr import ParamAttr
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+    attr = ParamAttr._to_attr(attr)
+    init = attr.initializer or default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierUniform())
+    return Parameter(init(shape, dtype), name=attr.name)

@@ -1,0 +1,134 @@
+"""fluid-compat namespace, jit.to_static, inference Predictor, transforms."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestFluidCompat:
+    def test_fluid_static_mnist_style(self):
+        import paddle_tpu.fluid as fluid
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                img = fluid.layers.data("img", [784], "float32")
+                hidden = fluid.layers.fc(img, size=32, activation="relu")
+                logits = fluid.layers.fc(hidden, size=10)
+                prob = paddle.ops.softmax(logits)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (p,) = exe.run(main,
+                           feed={"img": np.random.rand(3, 784).astype(np.float32)},
+                           fetch_list=[prob])
+            np.testing.assert_allclose(p.sum(1), np.ones(3), rtol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_fluid_dygraph_guard(self):
+        import paddle_tpu.fluid as fluid
+        with fluid.dygraph.guard():
+            x = fluid.dygraph.to_variable(np.ones((2, 2), np.float32))
+            lin = fluid.dygraph.Linear(2, 3)
+            out = lin(x)
+            assert out.shape == [2, 3]
+
+    def test_fluid_optimizer_alias(self):
+        import paddle_tpu.fluid as fluid
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1, parameters=[p])
+        (p * p).sum().backward()
+        opt.step()
+        assert float(p.numpy()[0]) < 1.0
+
+
+class TestToStatic:
+    def test_function_to_static(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x, y):
+            calls.append(1)
+            return x * 2 + y
+
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        out1 = f(a, a)
+        out2 = f(a, a)
+        np.testing.assert_allclose(out1.numpy(), np.full((2, 2), 3.0))
+        np.testing.assert_allclose(out2.numpy(), np.full((2, 2), 3.0))
+
+    def test_layer_to_static_params_update(self):
+        lin = nn.Linear(4, 2)
+        w0 = lin.weight.numpy().copy()
+        compiled = paddle.jit.to_static(lin)
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        out1 = compiled(x).numpy()
+        # mutate weights; compiled fn must see new values (no baked constants)
+        lin.weight.set_value(w0 * 2)
+        out2 = compiled(x).numpy()
+        np.testing.assert_allclose(out2 - lin.bias.numpy(),
+                                   2 * (out1 - lin.bias.numpy()), rtol=1e-5)
+
+    def test_to_static_dropout_rng_varies(self):
+        drop = nn.Dropout(0.5)
+        layer = paddle.jit.to_static(drop)
+        x = paddle.to_tensor(np.ones((4, 64), np.float32))
+        o1 = layer(x).numpy()
+        o2 = layer(x).numpy()
+        assert not np.allclose(o1, o2)
+
+
+class TestInference:
+    def test_predictor(self):
+        from paddle_tpu.inference import Predictor
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        pred = Predictor(net)
+        x = np.random.rand(3, 4).astype(np.float32)
+        out = pred.run([x])
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+        tr = T.Compose([T.Resize(16), T.CenterCrop(8), T.ToTensor(),
+                        T.Normalize(0.5, 0.5)])
+        img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+        out = tr(img)
+        assert out.shape == (3, 8, 8)
+        assert out.dtype == np.float32
+
+    def test_datasets(self):
+        from paddle_tpu.vision.datasets import MNIST, Cifar10
+        m = MNIST(mode="test")
+        img, label = m[0]
+        assert img.shape == (1, 28, 28) and 0 <= int(label) < 10
+        c = Cifar10(mode="test")
+        img, label = c[0]
+        assert img.shape == (3, 32, 32)
+
+    def test_mnist_learnable(self):
+        """Synthetic MNIST is class-conditional: LeNet should fit quickly."""
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import MNIST
+        from paddle_tpu.vision.models import LeNet
+        import paddle_tpu.optimizer as opt
+        paddle.seed(5)
+        ds = MNIST(mode="train")
+        loader = DataLoader(ds, batch_size=64, shuffle=True)
+        net = LeNet()
+        ce = nn.CrossEntropyLoss()
+        o = opt.Adam(1e-3, parameters=net.parameters())
+        losses = []
+        for i, (x, y) in enumerate(loader):
+            loss = ce(net(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+            if i >= 30:
+                break
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7
