@@ -57,6 +57,16 @@ from . import parallel  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 
+# the ops star-import bound submodule names (linalg, loss, ...) onto this
+# namespace; import the real top-level modules explicitly so they win
+import importlib as _importlib  # noqa: E402
+
+linalg = _importlib.import_module(".linalg", __name__)
+tensor = _importlib.import_module(".tensor", __name__)
+from . import distribution  # noqa: E402,F401
+from . import fluid  # noqa: E402,F401
+from . import models  # noqa: E402,F401
+
 __version__ = version.full_version
 
 

@@ -1,0 +1,9 @@
+"""paddle.linalg namespace (ref: python/paddle/tensor/linalg.py exports)."""
+from __future__ import annotations
+
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, corrcoef, cov, cross, det, eigh, eigvalsh,
+    inverse, lstsq, matrix_power, matrix_rank, multi_dot, mv, norm, pinv, qr,
+    slogdet, solve, svd, triangular_solve,
+)
+from .ops.linalg import inverse as inv  # noqa: F401

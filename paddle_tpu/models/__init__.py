@@ -8,3 +8,4 @@ from __future__ import annotations
 
 from .bert import Bert, BertConfig, Ernie, ErnieConfig  # noqa: F401
 from .gpt2 import GPT2, GPT2Config  # noqa: F401
+from .transformer import TransformerConfig, TransformerModel  # noqa: F401

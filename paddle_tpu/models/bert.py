@@ -139,6 +139,41 @@ class Ernie(Bert):
         super().__init__(cfg or ErnieConfig(**kw))
 
 
+def create_mlm_batch(ids, vocab_size, mask_token, mask_prob=0.15,
+                     mode="token", span_max=3, seed=None, pad_id=0):
+    """Host-side MLM masking (ref: BERT data pipeline; ERNIE's phrase/entity
+    masking — `mode='span'` masks contiguous spans the way ERNIE masks
+    entities). Returns (masked_ids, labels) with labels==-100 where unmasked.
+    """
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    ids = np.asarray(ids)
+    masked = ids.copy()
+    labels = np.full_like(ids, -100)
+    b, s = ids.shape
+    for i in range(b):
+        valid = np.flatnonzero(ids[i] != pad_id)
+        n_mask = max(1, int(len(valid) * mask_prob))
+        if mode == "span":
+            chosen = []
+            while len(chosen) < n_mask and len(valid):
+                start = rng.choice(valid)
+                span = rng.randint(1, span_max + 1)
+                chosen.extend(range(start, min(start + span, s)))
+            chosen = np.unique(np.asarray(chosen[:n_mask], dtype=np.int64))
+        else:
+            chosen = rng.choice(valid, size=min(n_mask, len(valid)),
+                                replace=False)
+        labels[i, chosen] = ids[i, chosen]
+        roll = rng.rand(len(chosen))
+        for j, pos in enumerate(chosen):
+            if roll[j] < 0.8:
+                masked[i, pos] = mask_token
+            elif roll[j] < 0.9:
+                masked[i, pos] = rng.randint(0, vocab_size)
+    return masked, labels
+
+
 def build_train_step(cfg: BertConfig, remat=False):
     """Pure (params, batch, key) -> loss for pjit/fleet (same pattern as
     gpt2.build_train_step)."""

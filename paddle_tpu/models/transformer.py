@@ -1,0 +1,102 @@
+"""Seq2seq Transformer for machine translation.
+
+Reference config: the WMT-style transformer built from fluid transformer ops
+(python/paddle/fluid/layers + nn.Transformer). Encoder-decoder with shared
+source/target embeddings optional, sinusoidal positions, greedy decode.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn, ops
+from ..core.tensor import Tensor
+
+
+@dataclass
+class TransformerConfig:
+    src_vocab_size: int = 30000
+    tgt_vocab_size: int = 30000
+    d_model: int = 512
+    nhead: int = 8
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    dim_feedforward: int = 2048
+    dropout: float = 0.1
+    max_length: int = 256
+    bos_id: int = 0
+    eos_id: int = 1
+
+    @classmethod
+    def tiny(cls):
+        return cls(src_vocab_size=512, tgt_vocab_size=512, d_model=64,
+                   nhead=4, num_encoder_layers=2, num_decoder_layers=2,
+                   dim_feedforward=128, max_length=64)
+
+
+def sinusoid_position_encoding(max_len, d_model):
+    pos = np.arange(max_len)[:, None]
+    i = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000, 2 * (i // 2) / d_model)
+    enc = np.zeros((max_len, d_model), np.float32)
+    enc[:, 0::2] = np.sin(angle[:, 0::2])
+    enc[:, 1::2] = np.cos(angle[:, 1::2])
+    return enc
+
+
+class TransformerModel(nn.Layer):
+    def __init__(self, cfg: TransformerConfig = None, **kw):
+        super().__init__()
+        cfg = cfg or TransformerConfig(**kw)
+        self.cfg = cfg
+        self.src_embed = nn.Embedding(cfg.src_vocab_size, cfg.d_model)
+        self.tgt_embed = nn.Embedding(cfg.tgt_vocab_size, cfg.d_model)
+        self.register_buffer(
+            "pos_enc", Tensor(sinusoid_position_encoding(cfg.max_length,
+                                                         cfg.d_model)),
+            persistable=False)
+        self.transformer = nn.Transformer(
+            d_model=cfg.d_model, nhead=cfg.nhead,
+            num_encoder_layers=cfg.num_encoder_layers,
+            num_decoder_layers=cfg.num_decoder_layers,
+            dim_feedforward=cfg.dim_feedforward, dropout=cfg.dropout,
+            activation="gelu")
+        self.generator = nn.Linear(cfg.d_model, cfg.tgt_vocab_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.scale = math.sqrt(cfg.d_model)
+
+    def _embed(self, table, ids):
+        s = ids.shape[1]
+        return self.dropout(table(ids) * self.scale + self.pos_enc[:s])
+
+    def forward(self, src_ids, tgt_ids, src_pad_mask=None):
+        src = self._embed(self.src_embed, src_ids)
+        tgt = self._embed(self.tgt_embed, tgt_ids)
+        tgt_mask = self.transformer.generate_square_subsequent_mask(
+            tgt_ids.shape[1])
+        src_mask = None
+        if src_pad_mask is not None:
+            m = ops.unsqueeze(src_pad_mask.astype("float32"), [1, 2])
+            src_mask = (1.0 - m) * -1e30
+        out = self.transformer(src, tgt, src_mask=src_mask, tgt_mask=tgt_mask)
+        return self.generator(out)
+
+    def loss(self, src_ids, tgt_in, tgt_out, label_smoothing=0.1):
+        logits = self(src_ids, tgt_in)
+        return ops.cross_entropy(
+            ops.reshape(logits, [-1, self.cfg.tgt_vocab_size]),
+            ops.reshape(tgt_out, [-1]),
+            label_smoothing=label_smoothing)
+
+    def greedy_decode(self, src_ids, max_len=32):
+        """Greedy generation (host loop; inside each step the forward jits)."""
+        import jax.numpy as jnp
+        b = src_ids.shape[0]
+        tgt = Tensor(np.full((b, 1), self.cfg.bos_id, np.int32))
+        for _ in range(max_len - 1):
+            logits = self(src_ids, tgt)
+            nxt = ops.argmax(logits[:, -1], axis=-1).astype("int32")
+            tgt = ops.concat([tgt, ops.unsqueeze(nxt, 1)], axis=1)
+        return tgt

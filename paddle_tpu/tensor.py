@@ -1,0 +1,6 @@
+"""paddle.tensor namespace — functional tensor API re-export
+(ref: python/paddle/tensor/__init__.py)."""
+from __future__ import annotations
+
+from .ops import *  # noqa: F401,F403
+from .core.tensor import Tensor, to_tensor  # noqa: F401
