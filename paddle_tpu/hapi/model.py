@@ -23,14 +23,92 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._use_jit = False
+        self._jit_state = None  # (compiled_fn, opt_state) once built
 
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=True):
+        """jit=True (default): train_batch runs as ONE fused XLA computation
+        (forward + backward + optimizer update), the TPU perf path. Falls back
+        to eager per-op execution when the loss/model isn't traceable."""
         self._optimizer = optimizer
         self._loss = loss
+        self._use_jit = jit
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
         return self
+
+    # ---- fused jitted step ----------------------------------------------
+    def _build_jit_step(self):
+        import jax
+
+        from ..core import rng as rng_mod
+        from ..core.autograd import no_grad
+
+        net, loss_layer, optimizer = self.network, self._loss, self._optimizer
+
+        def pure_step(params, buffers, opt_state, raw_inputs, raw_labels,
+                      key, lr):
+            saved_p, saved_b = net.functional_state()
+            rng_saved = (rng_mod._default_generator._key,
+                         rng_mod._default_generator._count)
+            rng_mod._default_generator._key = key
+            rng_mod._default_generator._count = 0
+            try:
+                def loss_of(p):
+                    net.load_functional_state(p, buffers)
+                    with no_grad():
+                        out = net(*[Tensor(x) for x in raw_inputs])
+                        loss = loss_layer(out, *[Tensor(l) for l in raw_labels])
+                    loss_t = loss if isinstance(loss, Tensor) else loss[0]
+                    out_raw = jax.tree_util.tree_map(
+                        lambda t: t._value if isinstance(t, Tensor) else t,
+                        out, is_leaf=lambda t: isinstance(t, Tensor))
+                    _, new_bufs = net.functional_state()
+                    return loss_t._value, (out_raw, new_bufs)
+
+                (loss_v, (out_raw, new_bufs)), grads = \
+                    jax.value_and_grad(loss_of, has_aux=True)(params)
+                clip = optimizer._grad_clip
+                if clip is not None and hasattr(clip, "clip_tree"):
+                    grads = clip.clip_tree(grads)
+                new_params, new_opt = optimizer.functional_update(
+                    params, grads, opt_state, lr=lr)
+                return loss_v, out_raw, new_params, new_bufs, new_opt
+            finally:
+                net.load_functional_state(saved_p, saved_b)
+                (rng_mod._default_generator._key,
+                 rng_mod._default_generator._count) = rng_saved
+
+        return jax.jit(pure_step, donate_argnums=(0, 2))
+
+    def _jit_train_batch(self, inputs, labels):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import rng as rng_mod
+        if self._jit_state is None:
+            params, _ = self.network.functional_state()
+            opt_state = self._optimizer.functional_init(params)
+            self._jit_state = [self._build_jit_step(), opt_state]
+        step_fn, opt_state = self._jit_state
+        params, buffers = self.network.functional_state()
+        raw_in = [i._value if isinstance(i, Tensor) else jnp.asarray(np.asarray(i))
+                  for i in inputs]
+        raw_lb = [l._value if isinstance(l, Tensor) else jnp.asarray(np.asarray(l))
+                  for l in (labels or [])]
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        loss_v, out_raw, new_params, new_bufs, new_opt = step_fn(
+            params, buffers, opt_state, raw_in, raw_lb,
+            rng_mod.next_key(), lr)
+        self.network.load_functional_state(new_params, new_bufs)
+        self._jit_state[1] = new_opt
+        self._optimizer._step_count += 1
+        out_t = jax.tree_util.tree_map(Tensor, out_raw)
+        metrics = self._compute_metrics(out_t, labels)
+        lv = float(np.asarray(loss_v))
+        return ([lv], metrics) if metrics else [lv]
 
     # ---- core steps ------------------------------------------------------
     def train_batch(self, inputs, labels=None):
@@ -38,6 +116,11 @@ class Model:
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is None or isinstance(labels, (list, tuple)) \
             else [labels]
+        if self._use_jit and labels is not None:
+            try:
+                return self._jit_train_batch(inputs, labels)
+            except Exception:
+                self._use_jit = False  # fall back to eager permanently
         out = self.network(*[_as_tensor(i) for i in inputs])
         loss = self._loss(out, *[_as_tensor(l) for l in labels]) \
             if labels is not None else out
