@@ -1,7 +1,7 @@
 """paddle.vision (ref: python/paddle/vision/)."""
 from __future__ import annotations
 
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import LeNet, MobileNetV1, MobileNetV2, ResNet, VGG  # noqa: F401
 from .models import (  # noqa: F401
     mobilenet_v1, mobilenet_v2, resnet18, resnet34, resnet50, resnet101,

@@ -1,0 +1,129 @@
+"""Vision ops: boxes, NMS, RoI align, deformable-conv-lite.
+
+Reference: python/paddle/vision/ops.py + detection ops in
+paddle/fluid/operators/detection/. NMS is inherently sequential — implemented
+with a fixed-iteration lax.while over score order (static shapes, TPU-safe).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._registry import defop
+
+
+@defop()
+def box_area(boxes):
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+@defop()
+def box_iou(boxes1, boxes2):
+    a1 = (boxes1[:, 2] - boxes1[:, 0]) * (boxes1[:, 3] - boxes1[:, 1])
+    a2 = (boxes2[:, 2] - boxes2[:, 0]) * (boxes2[:, 3] - boxes2[:, 1])
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter, 1e-9)
+
+
+@defop(nondiff=True)
+def nms(boxes, scores=None, iou_threshold=0.3, top_k=None):
+    """Returns indices of kept boxes (padded with -1 to len(boxes))."""
+    n = boxes.shape[0]
+    if scores is None:
+        scores = jnp.arange(n, 0, -1).astype(jnp.float32)
+    order = jnp.argsort(-scores)
+    iou = box_iou.__raw_fn__(boxes, boxes)
+    iou_sorted = iou[order][:, order]
+
+    def body(i, keep):
+        # suppress j>i overlapping a kept i
+        sup = (iou_sorted[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep0 = jnp.ones(n, bool)
+    keep = jax.lax.fori_loop(0, n, body, keep0)
+    kept_sorted_idx = jnp.where(keep, order, -1)
+    # compact: kept first, -1 padding after
+    key = jnp.where(keep, jnp.arange(n), n + jnp.arange(n))
+    perm = jnp.argsort(key)
+    out = kept_sorted_idx[perm]
+    if top_k is not None:
+        out = out[:top_k]
+    return out.astype(jnp.int32)
+
+
+@defop()
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=1, aligned=True):
+    """RoI Align via bilinear grid sampling (NCHW; boxes [K, 4] in image
+    coords, all on batch item 0 unless boxes_num maps them)."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    n, c, h, w = x.shape
+    k = boxes.shape[0]
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    bw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-4)
+    bh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-4)
+    ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (bh[:, None] / oh)
+    xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (bw[:, None] / ow)
+
+    # map rois to batch items
+    if boxes_num is not None:
+        bn = jnp.asarray(boxes_num)
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn, total_repeat_length=k) \
+            if hasattr(jnp, "repeat") else jnp.zeros(k, jnp.int32)
+    else:
+        batch_idx = jnp.zeros(k, jnp.int32)
+
+    def sample_one(bi, ys_i, xs_i):
+        img = x[bi]  # [C, H, W]
+        yy = jnp.clip(ys_i, 0, h - 1)
+        xx = jnp.clip(xs_i, 0, w - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        # gather 4 corners: [C, oh, ow]
+        g = lambda yi, xi: img[:, yi][:, :, xi]  # noqa: E731
+        va = g(y0, x0)
+        vb = g(y1i, x0)
+        vc = g(y0, x1i)
+        vd = g(y1i, x1i)
+        return (va * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                + vb * wy[None, :, None] * (1 - wx)[None, None, :]
+                + vc * (1 - wy)[None, :, None] * wx[None, None, :]
+                + vd * wy[None, :, None] * wx[None, None, :])
+
+    return jax.vmap(sample_one)(batch_idx, ys, xs)
+
+
+@defop()
+def yolo_box_decode(pred, anchors, downsample_ratio=32, class_num=80,
+                    conf_thresh=0.01):
+    """Decode YOLO head predictions to boxes (simplified yolo_box op)."""
+    b, _, h, w = pred.shape
+    na = len(anchors) // 2
+    pred = pred.reshape(b, na, 5 + class_num, h, w)
+    gx = jnp.arange(w)[None, None, None, :]
+    gy = jnp.arange(h)[None, None, :, None]
+    ax = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+    ay = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+    cx = (jax.nn.sigmoid(pred[:, :, 0]) + gx) / w
+    cy = (jax.nn.sigmoid(pred[:, :, 1]) + gy) / h
+    bw = jnp.exp(pred[:, :, 2]) * ax / (w * downsample_ratio)
+    bh = jnp.exp(pred[:, :, 3]) * ay / (h * downsample_ratio)
+    conf = jax.nn.sigmoid(pred[:, :, 4])
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
+                      axis=-1)
+    return boxes.reshape(b, -1, 4), conf.reshape(b, -1)
