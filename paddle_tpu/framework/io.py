@@ -60,3 +60,51 @@ def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
         obj = pickle.load(f)
     return _from_saveable(obj, return_numpy)
+
+
+class AsyncSaver:
+    """Failure-safe async checkpointing (SURVEY §2.36): snapshot to host
+    memory synchronously (cheap device→host copy), write to disk on a
+    background thread, atomic rename so a crash mid-write never corrupts the
+    previous checkpoint."""
+
+    def __init__(self):
+        import threading
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def save(self, obj, path):
+        import threading
+        payload = _to_saveable(obj)  # device→host happens here, synchronously
+        self.wait()
+
+        def _write():
+            tmp = path + ".tmp"
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f, protocol=4)
+            os.replace(tmp, path)
+
+        with self._lock:
+            self._thread = threading.Thread(target=_write, daemon=True)
+            self._thread.start()
+
+    def wait(self):
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join()
+
+
+_async_saver = AsyncSaver()
+
+
+def async_save(obj, path):
+    """paddle.framework.io.async_save — non-blocking checkpoint write."""
+    _async_saver.save(obj, path)
+
+
+def wait_save():
+    _async_saver.wait()
