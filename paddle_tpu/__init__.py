@@ -115,9 +115,12 @@ def is_grad_enabled():
 
 
 def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
-    total = sum(int(__import__("numpy").prod(p.shape)) for p in net.parameters())
-    trainable = sum(int(__import__("numpy").prod(p.shape))
-                    for p in net.parameters() if p.trainable)
+    import builtins
+
+    import numpy as _np
+    total = builtins.sum(int(_np.prod(p.shape)) for p in net.parameters())
+    trainable = builtins.sum(int(_np.prod(p.shape))
+                             for p in net.parameters() if p.trainable)
     info = {"total_params": total, "trainable_params": trainable}
     print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
     return info

@@ -24,11 +24,14 @@ class Optimizer:
         self._lr = learning_rate
         self._parameter_list = list(parameters) if parameters is not None else None
         self._param_groups = None
+        self._group_of = {}  # id(param) -> group dict (per-group lr/wd)
         if self._parameter_list and isinstance(self._parameter_list[0], dict):
             self._param_groups = self._parameter_list
             flat = []
             for g in self._param_groups:
-                flat.extend(g["params"])
+                for p in g["params"]:
+                    flat.append(p)
+                    self._group_of[id(p)] = g
             self._parameter_list = flat
         self._weight_decay = weight_decay
         self._grad_clip = grad_clip
@@ -109,13 +112,20 @@ class Optimizer:
             if slots is None:
                 slots = self.init_slots(p._value)
                 self._slots[id(p)] = slots
+            group = self._group_of.get(id(p))
             p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) \
                 if isinstance(p, Parameter) and hasattr(p, "optimize_attr") else lr
+            if group is not None and "learning_rate" in group:
+                p_lr = lr * group["learning_rate"]
+            wd = self._decay_coeff()
+            if group is not None and "weight_decay" in group:
+                gw = group["weight_decay"]
+                wd = float(gw.coeff) if hasattr(gw, "coeff") else float(gw)
             new_p, new_slots = self.rule(p._value, g, slots, p_lr,
                                          self._step_count)
-            if self._decoupled() and self._decay_coeff() > 0.0 and \
+            if self._decoupled() and wd > 0.0 and \
                     getattr(p, "no_weight_decay", False) is False:
-                new_p = new_p - p_lr * self._decay_coeff() * p._value
+                new_p = new_p - p_lr * wd * p._value
             p._value = new_p
             self._slots[id(p)] = new_slots
 
