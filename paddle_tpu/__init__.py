@@ -63,6 +63,7 @@ import importlib as _importlib  # noqa: E402
 
 linalg = _importlib.import_module(".linalg", __name__)
 tensor = _importlib.import_module(".tensor", __name__)
+autograd = _importlib.import_module(".autograd", __name__)
 from . import distribution  # noqa: E402,F401
 from . import fluid  # noqa: E402,F401
 from . import models  # noqa: E402,F401

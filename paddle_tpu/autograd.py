@@ -1,0 +1,87 @@
+"""paddle.autograd namespace (ref: python/paddle/autograd/ — PyLayer,
+backward, no_grad)."""
+from __future__ import annotations
+
+from .core.autograd import Node, backward, grad, no_grad  # noqa: F401
+from .core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("call PyLayer subclasses via .apply()")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom op with user-defined backward (ref: paddle.autograd.PyLayer).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.exp(x)
+            ctx.save_for_backward(y)
+            return y
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .core.autograd import grad_enabled, no_grad
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_list = [o if isinstance(o, Tensor) else Tensor(o) for o in out_list]
+
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient]
+        diff_ids = {id(a) for a in diff_inputs}
+        if grad_enabled() and diff_inputs:
+            tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+            def vjp_fn(cts):
+                cts_t = cts if isinstance(cts, tuple) else (cts,)
+                with no_grad():
+                    gin = cls.backward(ctx, *[Tensor(c) for c in cts_t])
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                raw = [g._value if isinstance(g, Tensor) else g for g in gin]
+                # backward returns one grad per tensor input, in order
+                raw = list(raw) + [None] * (len(tensor_args) - len(raw))
+                return [g for a, g in zip(tensor_args, raw)
+                        if id(a) in diff_ids]
+
+            node = Node(vjp_fn, diff_inputs, out_list, cls.__name__, multi)
+            for o in out_list:
+                o._node = node
+                o.stop_gradient = False
+        return tuple(out_list) if multi else out_list[0]
+
+
+# paddle 2.x location alias
+class LegacyPyLayer(PyLayer, metaclass=PyLayerMeta):
+    pass

@@ -13,6 +13,7 @@ from .program import (  # noqa: F401
 )
 from .executor import Executor, scope_guard  # noqa: F401
 from . import nn  # noqa: F401
+from .io import load_inference_model, save_inference_model  # noqa: F401
 
 
 class CompiledProgram:

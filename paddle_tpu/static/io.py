@@ -1,0 +1,48 @@
+"""Static-graph inference model save/load.
+
+Reference: python/paddle/fluid/io.py save_inference_model/load_inference_model
+(serializes the pruned ProgramDesc + params). TPU-first: we serialize the
+scope's parameter arrays plus a spec of feed/fetch names; at load time the
+caller re-binds them against a rebuilt program (programs are python-defined
+here, not a portable protobuf — the deployable artifact is params + jitted
+callable via paddle_tpu.jit.save / inference.Predictor).
+"""
+from __future__ import annotations
+
+import os
+
+from ..core.tensor import Tensor
+from ..framework.io import load as fload
+from ..framework.io import save as fsave
+from .executor import _global_scope
+from .program import Variable, default_main_program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    program = program or default_main_program()
+    scope = _global_scope
+    state = {}
+    for v in program.global_block().vars.values():
+        if v.persistable and scope.find_var(v.name) is not None:
+            state[v.name] = Tensor(scope.find_var(v.name))
+    spec = {
+        "feed_names": [v.name if isinstance(v, Variable) else str(v)
+                       for v in feed_vars],
+        "fetch_names": [v.name if isinstance(v, Variable) else str(v)
+                        for v in fetch_vars],
+    }
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    fsave({"params": state, "spec": spec}, path_prefix + ".pdmodel")
+    return path_prefix + ".pdmodel"
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    payload = fload(path_prefix + ".pdmodel")
+    scope = _global_scope
+    for name, t in payload["params"].items():
+        scope.set(name, t._value)
+    spec = payload["spec"]
+    return spec["feed_names"], spec["fetch_names"]

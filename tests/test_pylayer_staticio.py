@@ -1,0 +1,92 @@
+"""PyLayer custom backward + static inference model save/load."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+class TestPyLayer:
+    def test_custom_exp(self):
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.exp(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor()
+                return dy * y
+
+        x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        x.stop_gradient = False
+        y = Exp.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.exp([0.0, 1.0]),
+                                   rtol=1e-5)
+
+    def test_custom_scaled_grad(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 100.0  # deliberately wrong scale to prove custom
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        x.stop_gradient = False
+        Double.apply(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [100.0])
+
+    def test_multi_input_output(self):
+        class AddMul(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a + b, a * b
+
+            @staticmethod
+            def backward(ctx, da, dm):
+                a, b = ctx.saved_tensor()
+                return da + dm * b, da + dm * a
+
+        a = paddle.to_tensor(np.array([2.0], np.float32))
+        b = paddle.to_tensor(np.array([3.0], np.float32))
+        a.stop_gradient = b.stop_gradient = False
+        s, m = AddMul.apply(a, b)
+        (s + m).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [4.0])  # 1 + 3
+        np.testing.assert_allclose(b.grad.numpy(), [3.0])  # 1 + 2
+
+    def test_direct_call_forbidden(self):
+        class L(PyLayer):
+            pass
+        with pytest.raises(RuntimeError):
+            L()
+
+
+class TestStaticInferenceIO:
+    def test_save_load_inference_model(self, tmp_path):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 4], "float32")
+                out = static.nn.fc(x, size=2)
+            exe = static.Executor()
+            exe.run(startup)
+            xd = np.random.rand(2, 4).astype(np.float32)
+            (ref,) = exe.run(main, feed={"x": xd}, fetch_list=[out])
+            prefix = str(tmp_path / "model")
+            static.save_inference_model(prefix, [x], [out], exe, program=main)
+            feeds, fetches = static.load_inference_model(prefix, exe)
+            assert feeds == ["x"] and fetches == [out.name]
+            (again,) = exe.run(main, feed={"x": xd}, fetch_list=[out])
+            np.testing.assert_allclose(again, ref, rtol=1e-6)
+        finally:
+            paddle.disable_static()
