@@ -52,11 +52,19 @@ class GPT2Config:
 
 
 class GPT2Block(nn.Layer):
+    """Pre-LN decoder block. Fused QKV: one [E, 3E] GEMM (vs 3 separate) —
+    bigger MXU tiles, fewer HBM round-trips; the `qkv` name matches the
+    column-parallel TP sharding rule."""
+
     def __init__(self, cfg: GPT2Config):
         super().__init__()
         h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.attn_dropout = cfg.dropout
         self.ln_1 = nn.LayerNorm(h, epsilon=cfg.layer_norm_epsilon)
-        self.attn = nn.MultiHeadAttention(h, cfg.num_heads, cfg.dropout)
+        self.qkv_proj = nn.Linear(h, 3 * h)
+        self.out_proj = nn.Linear(h, h)
         self.ln_2 = nn.LayerNorm(h, epsilon=cfg.layer_norm_epsilon)
         self.fc1 = nn.Linear(h, cfg.intermediate_size)
         self.fc2 = nn.Linear(cfg.intermediate_size, h)
@@ -64,19 +72,16 @@ class GPT2Block(nn.Layer):
 
     def forward(self, x, attn_mask=None):
         a = self.ln_1(x)
-        q = self.attn.q_proj(a)
-        k = self.attn.k_proj(a)
-        v = self.attn.v_proj(a)
         b, s = a.shape[0], a.shape[1]
-        nh, hd = self.attn.num_heads, self.attn.head_dim
-        q = ops.transpose(ops.reshape(q, [b, s, nh, hd]), [0, 2, 1, 3])
-        k = ops.transpose(ops.reshape(k, [b, s, nh, hd]), [0, 2, 1, 3])
-        v = ops.transpose(ops.reshape(v, [b, s, nh, hd]), [0, 2, 1, 3])
+        nh, hd = self.num_heads, self.head_dim
+        qkv = ops.reshape(self.qkv_proj(a), [b, s, 3, nh, hd])
+        qkv = ops.transpose(qkv, [2, 0, 3, 1, 4])  # [3, B, H, S, D]
+        q, k, v = qkv[0], qkv[1], qkv[2]
         o, _ = ops.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=True,
-            dropout_p=self.attn.dropout if self.training else 0.0)
+            dropout_p=self.attn_dropout if self.training else 0.0)
         o = ops.reshape(ops.transpose(o, [0, 2, 1, 3]), [b, s, nh * hd])
-        x = x + self.dropout(self.attn.out_proj(o))
+        x = x + self.dropout(self.out_proj(o))
         m = self.ln_2(x)
         m = self.fc2(ops.gelu(self.fc1(m), approximate=True))
         return x + self.dropout(m)

@@ -53,7 +53,7 @@ def test_gpt2_hybrid_dp_mp_sp_trains():
         losses.append(float(loss))
     assert losses[-1] < losses[0]
     # TP params actually sharded on mp
-    qproj = [n for n in params if "q_proj.weight" in n][0]
+    qproj = [n for n in params if "qkv_proj.weight" in n][0]
     assert "mp" in str(params[qproj].sharding.spec)
 
 
