@@ -1,12 +1,15 @@
 """Flash attention — Pallas TPU kernel.
 
 Replaces the reference's fused_attention CUDA op (north-star: "fused_attention
-→ Pallas flash-attn"). Blockwise online-softmax: each grid step owns one
-128-aligned Q block in VMEM, streams K/V blocks, and accumulates on the MXU in
-f32. O(S) memory instead of the O(S²) score matrix.
+→ Pallas flash-attn"). Blockwise online-softmax: each grid step owns one Q
+block in VMEM, streams K/V blocks from VMEM, and accumulates on the MXU in
+f32 (inputs stay bf16 — the MXU multiplies bf16 natively and accumulates f32
+via preferred_element_type; casting inputs to f32 would quarter the MXU rate
+and double VMEM traffic). O(S) memory instead of the O(S²) score matrix.
 
-Forward is the Pallas kernel; backward (custom_vjp) recomputes attention
-blockwise with einsums that XLA fuses — standard flash-attn training recipe.
+Forward emits the per-row LSE so the backward (also Pallas) can recompute
+probabilities blockwise without a second softmax pass — the standard
+flash-attention training recipe (dq kernel + dkv kernel, delta = rowsum(dO·O)).
 """
 from __future__ import annotations
 
@@ -23,35 +26,54 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_TPU_PALLAS = False
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, sk):
+def _compiler_params(semantics):
+    if not _HAS_TPU_PALLAS:
+        return {}
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:
+        return {}
+    try:
+        return {"compiler_params": cls(dimension_semantics=semantics)}
+    except Exception:
+        return {}
+
+
+LSE_LANES = 8  # lse/delta rows are broadcast over 8 sublanes to satisfy
+               # the TPU (8, 128)-tile layout for non-vector shapes
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, sk):
     # q_ref: [bq, d]; k_ref/v_ref: [sk, d]; o_ref: [bq, d]
-    bq = q_ref.shape[0]
-    d = q_ref.shape[1]
+    # lse_ref: [bq, LSE_LANES] (row value broadcast across lanes)
+    bq, d = q_ref.shape
     qi = pl.program_id(1)  # q block index
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[:]  # keep input dtype — bf16 feeds the MXU at full rate
 
     nk = sk // block_k
     if causal:
-        # only blocks up to and including the diagonal contribute
+        # only k-blocks up to and including the diagonal contribute
         nk_eff = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
     else:
         nk_eff = nk
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                           (bq, block_k), 1)
+                                                          (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -59,7 +81,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, sk):
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -67,14 +89,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, sk):
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _divisor_block(size, block):
+    """Largest block <= `block` that divides `size` — 128-aligned when
+    possible (TPU lane width); sub-128 blocks only appear in interpret-mode
+    tests with tiny shapes."""
+    b = min(block, size)
+    if b >= 128 and size % 128 == 0:
+        b -= b % 128
+        while size % b:
+            b -= 128
+    else:
+        while size % b:
+            b -= 1
+    return b
+
+
+def _block_sizes(sq, sk, block_q, block_k):
+    bq = _divisor_block(sq, block_q)
+    bk = _divisor_block(sk, block_k)
+    # keep the f32 score block under ~2MB of VMEM (only binds when a caller
+    # passes blocks larger than the 512 defaults)
+    while bq > 128 and bq * bk * 4 > 2 * 1024 * 1024:
+        bq = _divisor_block(sq, bq // 2)
+    return bq, bk
+
+
+def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
@@ -84,20 +132,169 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     mem_kwargs = {}
     if _HAS_TPU_PALLAS and not interpret:
         mem_kwargs = {"memory_space": pltpu.VMEM}
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, sq, LSE_LANES), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0), **mem_kwargs),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0), **mem_kwargs),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0), **mem_kwargs),
         ],
-        out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0),
-                               **mem_kwargs),
+        out_specs=(
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0), **mem_kwargs),
+            pl.BlockSpec((None, bq, LSE_LANES), lambda i, j: (i, j, 0),
+                         **mem_kwargs),
+        ),
         interpret=interpret,
+        **_compiler_params(("parallel", "arbitrary")),
     )(q3, k3, v3)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, block_k, sk):
+    # grid over q blocks: dq_i = scale * sum_j (p_ij*(dp_ij - delta_i)) @ k_j
+    bq, d = q_ref.shape
+    qi = pl.program_id(1)
+    q = q_ref[:]
+    do = do_ref[:]
+    lse = lse_ref[:, 0:1]
+    delta = delta_ref[:, 0:1]
+
+    nk = sk // block_k
+    if causal:
+        nk_eff = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
+    else:
+        nk_eff = nk
+
+    def body(j, acc):
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(0, nk_eff,
+                            body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[:] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, sq):
+    # grid over k blocks: dv_j = sum_i p^T @ dO_i ; dk_j = scale * sum_i ds^T @ q_i
+    bk, d = k_ref.shape
+    ki = pl.program_id(1)
+    k = k_ref[:]
+    v = v_ref[:]
+
+    nq = sq // block_q
+    if causal:
+        # q blocks strictly before the diagonal see nothing of this k block
+        first_q = (ki * bk) // block_q
+    else:
+        first_q = 0
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
+        delta = delta_ref[pl.ds(i * block_q, block_q), 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(first_q, nq, body, (z, z))
+    dk_ref[:] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
+               interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # [B,H,Sq]
+    q3, k3, v3 = (x.reshape(b * h, x.shape[2], d) for x in (q, k, v))
+    do3 = g.reshape(b * h, sq, d)
+    lse3 = lse  # already [b*h, sq, LSE_LANES]
+    delta3 = jnp.broadcast_to(delta.reshape(b * h, sq, 1),
+                              (b * h, sq, LSE_LANES))
+    mem_kwargs = {}
+    if _HAS_TPU_PALLAS and not interpret:
+        mem_kwargs = {"memory_space": pltpu.VMEM}
+
+    row_spec = pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0), **mem_kwargs)
+    full_spec = lambda s: pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0),
+                                       **mem_kwargs)
+    vec_blk = pl.BlockSpec((None, bq, LSE_LANES), lambda i, j: (i, j, 0),
+                           **mem_kwargs)
+    vec_full = pl.BlockSpec((None, sq, LSE_LANES), lambda i, j: (i, 0, 0),
+                            **mem_kwargs)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=bk, sk=sk),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // bq),
+        in_specs=[row_spec, full_spec(sk), full_spec(sk), row_spec,
+                  vec_blk, vec_blk],
+        out_specs=row_spec,
+        interpret=interpret,
+        **_compiler_params(("parallel", "arbitrary")),
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    kcol_spec = pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0),
+                             **mem_kwargs)
+    qfull_spec = pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0),
+                              **mem_kwargs)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, sq=sq),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        grid=(b * h, sk // bk),
+        in_specs=[qfull_spec, kcol_spec, kcol_spec, qfull_spec,
+                  vec_full, vec_full],
+        out_specs=(kcol_spec, kcol_spec),
+        interpret=interpret,
+        **_compiler_params(("parallel", "arbitrary")),
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _reference_attention(q, k, v, scale, causal):
@@ -115,30 +312,28 @@ def _reference_attention(q, k, v, scale, causal):
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=False):
-    """q,k,v: [B,H,S,D]. S must be a multiple of the block size."""
+    """q,k,v: [B,H,S,D]. S must be a multiple of 128."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # recompute-based backward: O(S^2) scores per (b,h) but no saved
-    # activations; XLA fuses the chain. A fully blockwise pallas backward is a
-    # later optimization.
-    q, k, v = res
+    q, k, v, out, lse = res
     if scale is None:
         scale = q.shape[-1] ** -0.5
-
-    def f(q, k, v):
-        return _reference_attention(q, k, v, scale, causal)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                      interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -148,6 +148,8 @@ class TestFlashAttention:
                                        rtol=2e-4, atol=2e-5)
 
     def test_backward_matches_reference(self):
+        # covers all three grads: dq (_bwd_dq_kernel) and dk/dv
+        # (_bwd_dkv_kernel)
         import jax
         import jax.numpy as jnp
         from paddle_tpu.ops.pallas.flash_attention import (
@@ -156,9 +158,34 @@ class TestFlashAttention:
         q = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
         k = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
         v = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
-        g1 = jax.grad(lambda q: flash_attention(q, k, v, True, None, 64, 64,
-                                                True).sum())(q)
-        g2 = jax.grad(lambda q: _reference_attention(q, k, v, d ** -0.5,
-                                                     True).sum())(q)
-        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=2e-3,
-                                   atol=2e-4)
+        for causal in (False, True):
+            g1 = jax.grad(
+                lambda q, k, v: flash_attention(q, k, v, causal, None, 64, 64,
+                                                True).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+            g2 = jax.grad(
+                lambda q, k, v: _reference_attention(q, k, v, d ** -0.5,
+                                                     causal).sum(),
+                argnums=(0, 1, 2))(q, k, v)
+            for a, b_ in zip(g1, g2):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                           rtol=2e-3, atol=2e-4)
+
+    def test_default_blocks_nondivisible_seq(self):
+        # S=384: a multiple of 128 that is NOT a multiple of the 512 default
+        # block — _block_sizes must clamp to a divisor, not drop rows/keys
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _block_sizes, _reference_attention, flash_attention)
+        assert _block_sizes(640, 640, 512, 512) == (128, 128)
+        assert _block_sizes(1024, 1024, 512, 512) == (512, 512)
+        assert _block_sizes(384, 384, 512, 512) == (384, 384)
+        b, h, s, d = 1, 2, 384, 32
+        q = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
+        k = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
+        v = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
+        out = flash_attention(q, k, v, True, None, 512, 512, True)
+        ref = _reference_attention(q, k, v, d ** -0.5, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
