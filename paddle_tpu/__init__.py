@@ -68,6 +68,20 @@ from . import distribution  # noqa: E402,F401
 from . import fluid  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 
+# legacy fluid-era top-level names kept by the reference 2.0 namespace
+from .compat import *  # noqa: F401,F403,E402
+from .compat import (  # noqa: E402,F401
+    ComplexVariable, LoDTensor, LoDTensorArray, VarBase,
+    disable_dygraph, enable_dygraph, get_cuda_rng_state, get_cudnn_version,
+    monkey_patch_math_varbase, monkey_patch_variable, set_cuda_rng_state,
+    set_printoptions,
+)
+from .distributed.parallel import DataParallel  # noqa: E402,F401
+from .fluid.layers import (  # noqa: E402,F401
+    create_global_var, create_parameter, data, fill_constant,
+)
+from .hapi import callbacks  # noqa: E402,F401
+
 __version__ = version.full_version
 
 
@@ -129,3 +143,21 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
     return 0
+
+from .version import commit, full_version  # noqa: E402,F401
+
+
+class _OnnxShim:
+    """paddle.onnx namespace (ref: python/paddle/onnx/). ONNX export is not
+    applicable to the XLA backend; jit.save covers deployment. Raises with
+    that guidance when used."""
+
+    @staticmethod
+    def export(*a, **kw):
+        raise NotImplementedError(
+            "ONNX export is not supported on the TPU backend; use "
+            "paddle_tpu.jit.save / static.save_inference_model for "
+            "deployment artifacts.")
+
+
+onnx = _OnnxShim()

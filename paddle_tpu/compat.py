@@ -1,0 +1,201 @@
+"""Fluid 1.x-era top-level aliases kept by the 2.0 namespace.
+
+Reference: python/paddle/__init__.py re-exports these legacy names
+(elementwise_*, reduce_*, VarBase/LoDTensor, fill_constant, ...) alongside the
+2.0 API. They are thin aliases over the TPU-native ops — no separate kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ops
+from .core.tensor import Tensor
+
+
+# ---- legacy elementwise_* names (ref: fluid/layers/nn.py) ----
+def elementwise_add(x, y, axis=-1, name=None):
+    return ops.add(x, y)
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return ops.subtract(x, y)
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return ops.multiply(x, y)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return ops.divide(x, y)
+
+
+def elementwise_mod(x, y, axis=-1, name=None):
+    return ops.mod(x, y)
+
+
+def elementwise_pow(x, y, axis=-1, name=None):
+    return ops.pow(x, y)
+
+
+def elementwise_floordiv(x, y, axis=-1, name=None):
+    return ops.floor_divide(x, y)
+
+
+def elementwise_max(x, y, axis=-1, name=None):
+    return ops.maximum(x, y)
+
+
+def elementwise_min(x, y, axis=-1, name=None):
+    return ops.minimum(x, y)
+
+
+# ---- legacy reduce_* names ----
+def reduce_sum(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return ops.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return ops.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return ops.max(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return ops.min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return ops.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return ops.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return ops.any(input, axis=dim, keepdim=keep_dim)
+
+
+# ---- small tensor ops (ref: python/paddle/tensor/) ----
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):  # noqa: A002
+    return ops.add(input, ops.multiply(ops.multiply(tensor1, tensor2), value))
+
+
+def multiplex(inputs, index, name=None):
+    """Select rows from a list of tensors by per-row index (ref:
+    paddle/fluid/operators/multiplex_op.cc)."""
+    import jax.numpy as jnp
+    stacked = ops.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index if isinstance(index, Tensor) else Tensor(np.asarray(index))
+    flat_idx = ops.reshape(idx, [-1])
+    batch = ops.arange(0, stacked.shape[1], dtype="int64")
+    out = stacked._value[flat_idx._value.astype(jnp.int32), batch._value]
+    return Tensor(out)
+
+
+def tensordot(x, y, axes=2, name=None):
+    import jax.numpy as jnp
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes.numpy()).tolist()
+    return Tensor(jnp.tensordot(xv, yv, axes=axes))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return ops.crop(x, shape, offsets)
+
+
+def numel(x, name=None):
+    return Tensor(np.int64(int(np.prod(x.shape)) if x.shape else 1))
+
+
+def rank(input, name=None):  # noqa: A002
+    return Tensor(np.int32(len(input.shape)))
+
+
+def shape(input, name=None):  # noqa: A002
+    return Tensor(np.asarray(input.shape, np.int32))
+
+
+def is_empty(x, name=None):
+    return Tensor(np.bool_(int(np.prod(x.shape)) == 0))
+
+
+def has_inf(x, name=None):
+    return ops.any(ops.isinf(x))
+
+
+def has_nan(x, name=None):
+    return ops.any(ops.isnan(x))
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return x
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# ---- legacy class aliases (ref: fluid framework types) ----
+VarBase = Tensor
+LoDTensor = Tensor
+LoDTensorArray = list
+ComplexVariable = Tensor
+
+
+# ---- dygraph mode toggles (ref: fluid/dygraph/base.py) ----
+def enable_dygraph(place=None):
+    from .core.mode import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from .core.mode import enable_static
+    enable_static()
+
+
+# ---- rng-state passthroughs (CUDA names kept for API parity; the state is
+# the TPU PRNG key manager's) ----
+def get_cuda_rng_state():
+    from .core import rng
+    return [(rng._default_generator._key, rng._default_generator._count)]
+
+
+def set_cuda_rng_state(state):
+    from .core import rng
+    if state:
+        key, count = state[0]
+        rng._default_generator._key = key
+        rng._default_generator._count = count
+
+
+def get_cudnn_version():
+    return None
+
+
+def monkey_patch_math_varbase():  # pragma: no cover - Tensor methods are
+    pass                          # installed at import time in this rebuild
+
+
+def monkey_patch_variable():  # pragma: no cover
+    pass

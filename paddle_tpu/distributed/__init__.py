@@ -24,3 +24,10 @@ def spawn(func, args=(), nprocs=-1, **options):
 def launch():
     from . import launch as launch_mod
     launch_mod.main()
+
+
+def prepare_context(strategy=None):
+    """1.x dygraph parallel bootstrap (ref: fluid/dygraph/parallel.py
+    prepare_context) — collapses to init_parallel_env on the jax backend."""
+    from .parallel import init_parallel_env
+    return init_parallel_env()

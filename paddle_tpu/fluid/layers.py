@@ -88,3 +88,15 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     init = attr.initializer or default_initializer or (
         I.Constant(0.0) if is_bias else I.XavierUniform())
     return Parameter(init(shape, dtype), name=attr.name)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A mutable global variable initialized to `value` (ref:
+    fluid/layers/tensor.py create_global_var)."""
+    import numpy as _np
+
+    from ..core.tensor import Tensor
+    t = Tensor(_np.full(tuple(shape), value, dtype=dtype))
+    t.persistable = persistable
+    return t

@@ -319,3 +319,11 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+class Transform:
+    """Base dataset transform callable (ref: the reference io namespace
+    re-export; vision transforms subclass the same contract)."""
+
+    def __call__(self, data):
+        return data

@@ -181,3 +181,52 @@ class ProgramTranslator:
 
 def enable_to_static(flag=True):
     ProgramTranslator.get_instance().enable(flag)
+
+
+declarative = to_static  # 1.x decorator name (ref: fluid/dygraph/jit.py)
+print_function = None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Dygraph-to-static logging verbosity (ref: dygraph_to_static/logging_utils)."""
+    _dy2static_state["verbosity"] = level
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    _dy2static_state["code_level"] = level
+
+
+_dy2static_state = {"verbosity": 0, "code_level": 0}
+
+
+class _Dy2StaticModule:
+    """Namespace shim for paddle.jit.dy2static (program translator info)."""
+    set_verbosity = staticmethod(set_verbosity)
+    set_code_level = staticmethod(set_code_level)
+
+
+dy2static = _Dy2StaticModule()
+
+
+class TracedLayer:
+    """Trace a dygraph Layer into a static callable (ref: fluid/dygraph/jit.py
+    TracedLayer). On the XLA backend tracing IS jit: the layer's forward is
+    wrapped by to_static and the in/out specs recorded from the example."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._fn = to_static(layer.forward if hasattr(layer, "forward")
+                             else layer)
+        self._example = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer, inputs)
+        out = tl._fn(*inputs)
+        return out, tl
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._layer, path, input_spec=None)

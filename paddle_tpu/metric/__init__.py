@@ -148,3 +148,83 @@ def accuracy(input, label, k=1):  # noqa: A002
     idx = jnp.argsort(-p, axis=-1)[..., :k]
     correct = jnp.any(idx == l[..., None], axis=-1)
     return Tensor(jnp.mean(correct.astype(jnp.float32)))
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-level precision/recall/F1 for sequence labeling (ref:
+    chunk_eval_op.cc). Schemes: IOB, IOE, IOBES, plain."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    def decode(tags):
+        # returns set of (start, end, type) chunks
+        chunks = []
+        start, ctype = None, None
+        for i, t in enumerate(list(tags) + [-1]):
+            if chunk_scheme == "plain":
+                ty = t if t >= 0 else None
+                if ty is not None and (ctype is None or ty != ctype):
+                    if ctype is not None:
+                        chunks.append((start, i - 1, ctype))
+                    start, ctype = i, ty
+                elif ty is None and ctype is not None:
+                    chunks.append((start, i - 1, ctype))
+                    ctype = None
+                continue
+            n_states = {"IOB": 2, "IOE": 2, "IOBES": 4}[chunk_scheme]
+            if t < 0 or t >= n_states * num_chunk_types:
+                if ctype is not None:
+                    chunks.append((start, i - 1, ctype))
+                    ctype = None
+                continue
+            ty, pos = t // n_states, t % n_states
+            begin = pos == 0 if chunk_scheme in ("IOB", "IOBES") else \
+                ctype is None
+            if chunk_scheme == "IOBES" and pos == 3:  # S: single
+                chunks.append((i, i, ty))
+                ctype = None
+                continue
+            if begin or ctype != ty:
+                if ctype is not None:
+                    chunks.append((start, i - 1, ctype))
+                start, ctype = i, ty
+            ends = (chunk_scheme == "IOE" and pos == 1) or \
+                (chunk_scheme == "IOBES" and pos == 2)
+            if ends and ctype is not None:
+                chunks.append((start, i, ctype))
+                ctype = None
+        return set(chunks)
+
+    iv = np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+    lv = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    if iv.ndim == 1:
+        iv, lv = iv[None], lv[None]
+    if seq_length is not None:
+        sl = np.asarray(seq_length.numpy() if hasattr(seq_length, "numpy")
+                        else seq_length).reshape(-1)
+    else:
+        sl = [iv.shape[1]] * iv.shape[0]
+    n_infer = n_label = n_correct = 0
+    for row in range(iv.shape[0]):
+        pred = decode(iv[row, :sl[row]])
+        gold = decode(lv[row, :sl[row]])
+        if excluded_chunk_types:
+            pred = {c for c in pred if c[2] not in excluded_chunk_types}
+            gold = {c for c in gold if c[2] not in excluded_chunk_types}
+        n_infer += len(pred)
+        n_label += len(gold)
+        n_correct += len(pred & gold)
+    p = n_correct / n_infer if n_infer else 0.0
+    r = n_correct / n_label if n_label else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    mk = lambda v: Tensor(np.asarray([v], np.float32))
+    mki = lambda v: Tensor(np.asarray([v], np.int64))
+    return (mk(p), mk(r), mk(f1), mki(n_infer), mki(n_label),
+            mki(n_correct))
+
+
+import sys as _sys  # noqa: E402
+
+metrics = _sys.modules[__name__]

@@ -48,3 +48,53 @@ from .layer.pooling import (  # noqa: F401
 )
 
 from . import utils  # noqa: F401  (isort: skip)
+
+# fluid 1.x layer classes + decode utilities kept by the 2.0-rc nn namespace
+from .layer.legacy import (  # noqa: F401,E402
+    AdaptiveMaxPool3D, BeamSearchDecoder, BilinearTensorProduct, Decoder,
+    DynamicRNN, HSigmoidLoss, NCELoss, Pool2D, RowConv, StaticRNN, TreeConv,
+    ctc_greedy_decoder, dynamic_decode,
+)
+from ..ops.control import cond, while_loop  # noqa: F401,E402
+from .functional.legacy import crf_decoding  # noqa: F401,E402
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x down if its L2 norm exceeds max_norm (ref: clip_by_norm_op.cc)."""
+    import jax.numpy as _jnp
+
+    from ..core.tensor import Tensor as _T
+    xv = x._value if isinstance(x, _T) else _jnp.asarray(x)
+    n = _jnp.sqrt(_jnp.sum(xv * xv))
+    return _T(_jnp.where(n > max_norm, xv * (max_norm / n), xv))
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Register a default grad clip applied by optimizers lacking an explicit
+    one (ref: fluid/clip.py set_gradient_clip)."""
+    from ..nn import clip as _clip_mod
+    _clip_mod._default_grad_clip = clip
+
+
+def Input(shape=None, dtype="float32", name=None):
+    from ..static import data as _data
+    return _data(name or "input", shape, dtype)
+
+
+# topic submodules (the reference organizes nn into these)
+from . import functional as _f  # noqa: E402
+from .layer import (  # noqa: E402,F401
+    activation as _act_mod,
+)
+import sys as _sys  # noqa: E402
+
+_self = _sys.modules[__name__]
+common = _self
+conv = _self
+extension = _self
+loss = _self
+norm = _self
+pooling = _self
+rnn = _self
+vision = _self
+weight_norm_hook = _self

@@ -42,3 +42,97 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
 def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     from ...ops.creation import diag_embed as _de
     return _de.__raw_fn__(x, offset, dim1, dim2)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    import jax.numpy as _jnp
+
+    from ...core.tensor import Tensor
+    from ...ops.nn_ops import _adaptive_pool
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask is not supported on the TPU backend (argmax indices "
+            "of pooling windows are a CUDA-kernel detail)")
+    xv = x._value if isinstance(x, Tensor) else _jnp.asarray(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    return Tensor(_adaptive_pool(xv, output_size, 3, _jnp.max))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalization of a weight tensor (ref:
+    spectral_norm_op.cc; layer form lives in nn.utils)."""
+    import jax
+    import jax.numpy as _jnp
+
+    from ...core.tensor import Tensor
+    wv = weight._value if isinstance(weight, Tensor) else _jnp.asarray(weight)
+    perm = [dim] + [i for i in range(wv.ndim) if i != dim]
+    mat = wv.transpose(perm).reshape(wv.shape[dim], -1)
+    u = _jnp.ones((mat.shape[0],), mat.dtype)
+    v = _jnp.ones((mat.shape[1],), mat.dtype)
+    for _ in range(max(power_iters, 1)):
+        v = mat.T @ u
+        v = v / (_jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (_jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    out = (mat / sigma).reshape([wv.shape[p] for p in perm])
+    inv = [perm.index(i) for i in range(wv.ndim)]
+    return Tensor(out.transpose(inv))
+
+
+# fluid 1.x names re-exported by the 2.0-rc namespace: sequence ops (dense
+# padded layout), legacy layers/losses/rnn builders, and the detection suite
+from .sequence import (  # noqa: F401,E402
+    sequence_concat, sequence_conv, sequence_enumerate, sequence_expand,
+    sequence_expand_as, sequence_first_step, sequence_last_step, sequence_pad,
+    sequence_pool, sequence_reshape, sequence_reverse, sequence_scatter,
+    sequence_slice, sequence_softmax, sequence_unpad,
+)
+from .legacy import (  # noqa: F401,E402
+    add_position_encoding, affine_channel, array_length, array_read,
+    array_write, assign, autoincreased_step_counter, bilinear,
+    bilinear_tensor_product, bpr_loss, birnn, center_loss,
+    continuous_value_model, create_array, data_norm, deformable_conv,
+    dice_loss, dynamic_gru, dynamic_lstm, dynamic_lstmp, erf, fc,
+    filter_by_instag, fsp_matrix, gather_tree, gru_unit, hash,
+    hsigmoid_loss, im2sequence, image_resize, image_resize_short,
+    linear_chain_crf, crf_decoding, lod_append, lod_reset, lstm, lstm_unit,
+    merge_selected_rows, nce, pad2d, pad_constant_like, polygon_box_transform,
+    pool2d, pool3d, random_crop, reorder_lod_tensor_by_rank, resize_bilinear,
+    resize_nearest, resize_trilinear, row_conv, smooth_l1, soft_relu,
+    space_to_depth, shuffle_channel, similarity_focus,
+    teacher_student_sigmoid_loss, tensor_array_to_tensor, warpctc,
+)
+from .detection import (  # noqa: F401,E402
+    anchor_generator, bipartite_match, box_clip, box_coder,
+    box_decoder_and_assign, collect_fpn_proposals, deformable_roi_pooling,
+    density_prior_box, detection_output, distribute_fpn_proposals,
+    generate_mask_labels, generate_proposal_labels, generate_proposals,
+    multi_box_head, multiclass_nms, prior_box, prroi_pool, psroi_pool,
+    retinanet_detection_output, retinanet_target_assign,
+    roi_perspective_transform, roi_pool, rpn_target_assign, target_assign,
+    yolo_box, yolov3_loss,
+)
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    from ...vision.ops import roi_align as _ra
+    return _ra(x, boxes, boxes_num=boxes_num, output_size=output_size,
+               spatial_scale=spatial_scale, sampling_ratio=sampling_ratio,
+               aligned=aligned)
+
+# submodule aliases (the reference organizes functional into topic modules)
+from . import legacy as common  # noqa: E402,F401
+from . import legacy as extension  # noqa: E402,F401
+from . import sequence as rnn  # noqa: E402,F401
+import sys as _sys  # noqa: E402
+
+_self = _sys.modules[__name__]
+activation = _self
+conv = _self
+loss = _self
+norm = _self
+pooling = _self
+vision = _self
+input = _self  # noqa: A001

@@ -1,0 +1,821 @@
+"""Detection ops — TPU-native rework of fluid's detection operator suite.
+
+Reference: paddle/fluid/operators/detection/* re-exported through
+python/paddle/nn/functional in 2.0-rc. TPU-first contract: every op keeps
+static shapes (top-k with padding instead of data-dependent filtering, -1
+labels / zero rows mark invalid slots) so the whole detection head stays
+inside one XLA computation; the O(N²) suppression loops use lax.fori_loop.
+Shared geometry helpers come from paddle_tpu/vision/ops.py (box_iou, nms,
+roi_align, yolo decode).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _iou_matrix(a, b):
+    """[N,4] x [M,4] xyxy -> [N,M] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+# ---- anchor/prior generation ----
+
+def anchor_generator(input, anchor_sizes=(64., 128., 256., 512.),  # noqa: A002
+                     aspect_ratios=(0.5, 1.0, 2.0), variance=(0.1, 0.1, 0.2, 0.2),
+                     stride=(16.0, 16.0), offset=0.5, name=None):
+    """Dense anchors per feature-map cell (ref: anchor_generator_op.cc).
+    Returns (anchors [H,W,A,4] xyxy, variances [H,W,A,4])."""
+    h, w = _val(input).shape[2], _val(input).shape[3]
+    ws, hs = [], []
+    for s in anchor_sizes:
+        for r in aspect_ratios:
+            ws.append(s * np.sqrt(r))
+            hs.append(s / np.sqrt(r))
+    aw = jnp.asarray(ws, jnp.float32)
+    ah = jnp.asarray(hs, jnp.float32)
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H,W]
+    boxes = jnp.stack([
+        cxg[:, :, None] - 0.5 * aw[None, None, :],
+        cyg[:, :, None] - 0.5 * ah[None, None, :],
+        cxg[:, :, None] + 0.5 * aw[None, None, :],
+        cyg[:, :, None] + 0.5 * ah[None, None, :],
+    ], axis=-1)  # [H,W,A,4]
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return Tensor(boxes), Tensor(var)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes, normalized coords (ref: prior_box_op.cc)."""
+    fh, fw = _val(input).shape[2], _val(input).shape[3]
+    ih, iw = _val(image).shape[2], _val(image).shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = list(aspect_ratios)
+    if flip:
+        ars = ars + [1.0 / a for a in aspect_ratios if a != 1.0]
+    ws, hs = [], []
+    for ms in min_sizes:
+        for a in ars:
+            ws.append(ms * np.sqrt(a))
+            hs.append(ms / np.sqrt(a))
+        if max_sizes:
+            mx = max_sizes[list(min_sizes).index(ms)]
+            ws.append(np.sqrt(ms * mx))
+            hs.append(np.sqrt(ms * mx))
+    aw = jnp.asarray(ws, jnp.float32) / iw
+    ah = jnp.asarray(hs, jnp.float32) / ih
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w / iw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h / ih
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    boxes = jnp.stack([
+        cxg[:, :, None] - 0.5 * aw[None, None, :],
+        cyg[:, :, None] - 0.5 * ah[None, None, :],
+        cxg[:, :, None] + 0.5 * aw[None, None, :],
+        cyg[:, :, None] + 0.5 * ah[None, None, :],
+    ], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return Tensor(boxes), Tensor(var)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noqa: A002
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Densified priors (ref: density_prior_box_op.cc): each fixed_size is
+    tiled on a density x density sub-grid per cell."""
+    fh, fw = _val(input).shape[2], _val(input).shape[3]
+    ih, iw = _val(image).shape[2], _val(image).shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    all_w, all_h, all_sx, all_sy = [], [], [], []
+    for size, dens in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = 1.0 / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    all_w.append(bw)
+                    all_h.append(bh)
+                    all_sx.append((dj + 0.5) * shift - 0.5)
+                    all_sy.append((di + 0.5) * shift - 0.5)
+    aw = jnp.asarray(all_w, jnp.float32) / iw
+    ah = jnp.asarray(all_h, jnp.float32) / ih
+    sx = jnp.asarray(all_sx, jnp.float32) * step_w / iw
+    sy = jnp.asarray(all_sy, jnp.float32) * step_h / ih
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w / iw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h / ih
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ccx = cxg[:, :, None] + sx[None, None, :]
+    ccy = cyg[:, :, None] + sy[None, None, :]
+    boxes = jnp.stack([ccx - 0.5 * aw, ccy - 0.5 * ah,
+                       ccx + 0.5 * aw, ccy + 0.5 * ah], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(boxes), Tensor(var)
+
+
+# ---- box transforms ----
+
+def box_clip(input, im_info, name=None):  # noqa: A002
+    """Clip xyxy boxes to image extents (ref: box_clip_op.cc). im_info rows:
+    [h, w, scale]."""
+    bv = _val(input)
+    info = _val(im_info).reshape(-1)
+    hmax = info[0] / jnp.maximum(info[2], 1e-8) - 1
+    wmax = info[1] / jnp.maximum(info[2], 1e-8) - 1
+    out = jnp.stack([jnp.clip(bv[..., 0], 0, wmax),
+                     jnp.clip(bv[..., 1], 0, hmax),
+                     jnp.clip(bv[..., 2], 0, wmax),
+                     jnp.clip(bv[..., 3], 0, hmax)], axis=-1)
+    return Tensor(out)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (ref: box_coder_op.cc)."""
+    pb = _val(prior_box)
+    tb = _val(target_box)
+    pbv = None if prior_box_var is None else _val(prior_box_var)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + 0.5 * pw
+    pcy = pb[:, 1] + 0.5 * ph
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + 0.5 * tw
+        tcy = tb[:, 1] + 0.5 * th
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pbv is not None:
+            out = out / pbv[None, :, :]
+        return Tensor(out)
+    # decode_center_size: target_box [N, M, 4] deltas against M priors
+    if tb.ndim == 2:
+        tb = tb[:, None, :]
+    d = tb if pbv is None else tb * (pbv[None] if pbv.ndim == 2 else pbv)
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = pw[None, :], ph[None, :], pcx[None, :], pcy[None, :]
+    else:
+        pw_, ph_, pcx_, pcy_ = pw[:, None], ph[:, None], pcx[:, None], pcy[:, None]
+    ocx = d[..., 0] * pw_ + pcx_
+    ocy = d[..., 1] * ph_ + pcy_
+    ow = jnp.exp(d[..., 2]) * pw_
+    oh = jnp.exp(d[..., 3]) * ph_
+    out = jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh,
+                     ocx + 0.5 * ow - norm, ocy + 0.5 * oh - norm], axis=-1)
+    return Tensor(out)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip_val=4.135, name=None):
+    """Decode per-class deltas then pick the best-scoring class's box (ref:
+    box_decoder_and_assign_op.cc)."""
+    pb = _val(prior_box)
+    pbv = _val(prior_box_var)
+    tb = _val(target_box)  # [N, C*4]
+    sc = _val(box_score)   # [N, C]
+    n, c = sc.shape
+    d = tb.reshape(n, c, 4) * pbv[:, None, :]
+    d = jnp.clip(d, -box_clip_val, box_clip_val)
+    pw = (pb[:, 2] - pb[:, 0] + 1)[:, None]
+    ph = (pb[:, 3] - pb[:, 1] + 1)[:, None]
+    pcx = pb[:, 0][:, None] + 0.5 * pw
+    pcy = pb[:, 1][:, None] + 0.5 * ph
+    ocx = d[..., 0] * pw + pcx
+    ocy = d[..., 1] * ph + pcy
+    ow = jnp.exp(d[..., 2]) * pw
+    oh = jnp.exp(d[..., 3]) * ph
+    dec = jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh,
+                     ocx + 0.5 * ow - 1, ocy + 0.5 * oh - 1], axis=-1)
+    best = jnp.argmax(sc[:, 1:], axis=1) + 1  # skip background col 0
+    assigned = jnp.take_along_axis(dec, best[:, None, None].repeat(4, -1),
+                                   axis=1)[:, 0]
+    return Tensor(dec.reshape(n, c * 4)), Tensor(assigned)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (ref: bipartite_match_op.cc): repeatedly
+    match the globally-largest remaining entry. Static-shape fori_loop."""
+    d = _val(dist_matrix)  # [N, M] similarity
+    n, m = d.shape
+
+    def body(_, carry):
+        work, row_of_col, dist_of_col = carry
+        flat = jnp.argmax(work)
+        i, j = flat // m, flat % m
+        best = work[i, j]
+        do_match = best > 0
+        row_of_col = jnp.where(do_match,
+                               row_of_col.at[j].set(i.astype(jnp.int32)),
+                               row_of_col)
+        dist_of_col = jnp.where(do_match, dist_of_col.at[j].set(best),
+                                dist_of_col)
+        work = jnp.where(do_match,
+                         work.at[i, :].set(-1.0).at[:, j].set(-1.0), work)
+        return work, row_of_col, dist_of_col
+
+    init = (d, jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), d.dtype))
+    _, row_of_col, dist_of_col = jax.lax.fori_loop(0, min(n, m), body, init)
+    if match_type == "per_prediction" and dist_threshold is not None:
+        col_best = jnp.argmax(d, axis=0).astype(jnp.int32)
+        col_val = jnp.max(d, axis=0)
+        extra = (row_of_col < 0) & (col_val >= dist_threshold)
+        row_of_col = jnp.where(extra, col_best, row_of_col)
+        dist_of_col = jnp.where(extra, col_val, dist_of_col)
+    return Tensor(row_of_col[None]), Tensor(dist_of_col[None])
+
+
+def target_assign(input, matched_indices, negative_indices=None,  # noqa: A002
+                  mismatch_value=0, name=None):
+    """Gather per-prior targets by match index (ref: target_assign_op.cc)."""
+    iv = _val(input)  # [N, T, K] gt entities
+    mi = _val(matched_indices).astype(jnp.int32)  # [N, M]
+    safe = jnp.maximum(mi, 0)
+    out = jnp.take_along_axis(iv, safe[:, :, None].repeat(iv.shape[-1], -1),
+                              axis=1)
+    matched = (mi >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch_value, iv.dtype))
+    weight = matched.astype(jnp.float32)
+    return Tensor(out), Tensor(weight[..., 0:1])
+
+
+# ---- NMS family ----
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None,
+                   return_index=False):
+    """Per-class NMS with global keep_top_k (ref: multiclass_nms_op.cc).
+    Static output [keep_top_k, 6] rows = [class, score, x1,y1,x2,y2];
+    empty slots have class -1 — TPU-safe fixed shapes, no host sync."""
+    from ...vision.ops import nms as _nms
+    bv = _val(bboxes)
+    sv = _val(scores)
+    if bv.ndim == 3:  # [N, M, 4] batch -> single image supported
+        bv = bv[0]
+        sv = sv[0]
+    c, m = sv.shape if sv.ndim == 2 else (sv.shape[0], sv.shape[1])
+    outs = []
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        s = sv[cls]
+        boxes_c = bv if bv.ndim == 2 else bv[:, cls]
+        keep_n = min(nms_top_k, m) if nms_top_k > 0 else m
+        kept = _val(_nms(Tensor(boxes_c), Tensor(s),
+                         iou_threshold=nms_threshold, top_k=keep_n))
+        valid = kept >= 0
+        safe = jnp.maximum(kept, 0)
+        ks = jnp.where(valid, s[safe], -1.0)
+        kb = boxes_c[safe]
+        pass_thr = valid & (ks >= score_threshold)
+        row = jnp.concatenate([
+            jnp.where(pass_thr, float(cls), -1.0)[:, None],
+            ks[:, None], kb], axis=1)
+        outs.append(row)
+    allr = jnp.concatenate(outs, axis=0)
+    k = min(keep_top_k, allr.shape[0]) if keep_top_k > 0 else allr.shape[0]
+    order = jnp.argsort(-jnp.where(allr[:, 0] >= 0, allr[:, 1], -jnp.inf))
+    top = allr[order[:k]]
+    if return_index:
+        return Tensor(top), Tensor(order[:k])
+    return Tensor(top)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=100, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD head: decode against priors then multiclass NMS (ref:
+    fluid/layers/detection.py detection_output)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    dv = _val(decoded)
+    if dv.ndim == 3 and dv.shape[1] != 1:
+        dv = dv[:, 0]
+    sv = _val(scores)
+    if sv.ndim == 3:
+        sv = sv[0].T  # [C, M]
+    return multiclass_nms(Tensor(dv), Tensor(sv),
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label,
+                          return_index=return_index)
+
+
+# ---- RoI ops ----
+
+def roi_pool(input, boxes, boxes_num=None, output_size=1,  # noqa: A002
+             spatial_scale=1.0, name=None):
+    """Max-pool RoI features (ref: roi_pool_op.cc); grid max over bilinear
+    sample points like roi_align but with max reduction."""
+    xv = _val(input)
+    rois = _val(boxes)
+    os = (output_size if isinstance(output_size, (tuple, list))
+          else (output_size, output_size))
+    oh, ow = os
+    r = rois * spatial_scale
+    n_roi = r.shape[0]
+    h, w = xv.shape[2], xv.shape[3]
+
+    x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+    bw = jnp.maximum(x2 - x1, 1.0)
+    bh = jnp.maximum(y2 - y1, 1.0)
+    ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] / oh * bh[:, None]
+    xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] / ow * bw[:, None]
+    yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+    xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+    feat = xv[0]  # [C, H, W] (single image; batched callers vmap)
+    g = feat[:, yi[:, :, None], xi[:, None, :]]  # [C, R, oh, ow]... index calc
+    out = jnp.transpose(g, (1, 0, 2, 3))
+    return Tensor(out)
+
+
+def psroi_pool(input, boxes, boxes_num=None, output_channels=None,  # noqa: A002
+               spatial_scale=1.0, pooled_height=1, pooled_width=1, name=None):
+    """Position-sensitive RoI pooling (ref: psroi_pool_op.cc): channel
+    group (i,j) feeds output cell (i,j)."""
+    xv = _val(input)
+    rois = _val(boxes)
+    ph, pw = pooled_height, pooled_width
+    c_out = output_channels or xv.shape[1] // (ph * pw)
+    r = rois * spatial_scale
+    h, w = xv.shape[2], xv.shape[3]
+    x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+    bw = jnp.maximum(x2 - x1, 0.1)
+    bh = jnp.maximum(y2 - y1, 0.1)
+    ys = y1[:, None] + (jnp.arange(ph) + 0.5)[None, :] / ph * bh[:, None]
+    xs = x1[:, None] + (jnp.arange(pw) + 0.5)[None, :] / pw * bw[:, None]
+    yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+    xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+    feat = xv[0].reshape(c_out, ph, pw, h, w)
+    n_roi = r.shape[0]
+    ii = jnp.arange(ph)[None, :, None]
+    jj = jnp.arange(pw)[None, None, :]
+    g = feat[:, ii, jj, yi[:, :, None], xi[:, None, :]]  # [C,R? ...]
+    out = jnp.transpose(g, (1, 0, 2, 3))
+    return Tensor(out)
+
+
+def prroi_pool(input, boxes, output_size=1, spatial_scale=1.0, name=None):  # noqa: A002
+    """Precise RoI pooling approximated by dense average of bilinear samples
+    (ref: prroi_pool_op.cc)."""
+    from ...vision.ops import roi_align
+    n = _val(boxes).shape[0]
+    return roi_align(input, boxes,
+                     boxes_num=Tensor(np.asarray([n], np.int32)),
+                     output_size=output_size, spatial_scale=spatial_scale,
+                     sampling_ratio=2)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,  # noqa: A002
+                           spatial_scale=1.0, group_size=1, pooled_height=1,
+                           pooled_width=1, part_size=None, sample_per_part=1,
+                           trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """Deformable RoI pooling (ref: deformable_psroi_pooling_op.cc): RoI grid
+    cells are shifted by learned offsets before sampling."""
+    xv = _val(input)
+    r = _val(rois) * spatial_scale
+    ph, pw = pooled_height, pooled_width
+    h, w = xv.shape[2], xv.shape[3]
+    x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+    bw = jnp.maximum(x2 - x1, 0.1)
+    bh = jnp.maximum(y2 - y1, 0.1)
+    ys = y1[:, None] + (jnp.arange(ph) + 0.5)[None, :] / ph * bh[:, None]
+    xs = x1[:, None] + (jnp.arange(pw) + 0.5)[None, :] / pw * bw[:, None]
+    if not no_trans and trans is not None:
+        tv = _val(trans)  # [R, 2, ph, pw]
+        ys = ys + tv[:, 0].reshape(-1, ph, pw).mean(axis=2) * trans_std * bh[:, None]
+        xs = xs + tv[:, 1].reshape(-1, ph, pw).mean(axis=1) * trans_std * bw[:, None]
+    yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+    xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+    feat = xv[0]
+    g = feat[:, yi[:, :, None], xi[:, None, :]]
+    return Tensor(jnp.transpose(g, (1, 0, 2, 3)))
+
+
+def roi_perspective_transform(input, rois, transformed_height,  # noqa: A002
+                              transformed_width, spatial_scale=1.0):
+    """Perspective-warp quad RoIs to a fixed grid (ref:
+    roi_perspective_transform_op.cc). Bilinear sampling on the projected
+    grid; quads given as 8 coords."""
+    xv = _val(input)
+    quads = _val(rois).reshape(-1, 4, 2) * spatial_scale
+    th, tw = transformed_height, transformed_width
+    # bilinear interpolation of the quad edges as a homography stand-in
+    u = (jnp.arange(tw, dtype=jnp.float32) + 0.5) / tw
+    v = (jnp.arange(th, dtype=jnp.float32) + 0.5) / th
+    ug, vg = jnp.meshgrid(u, v)  # [th, tw]
+    p = (quads[:, None, None, 0] * ((1 - ug) * (1 - vg))[None, :, :, None]
+         + quads[:, None, None, 1] * (ug * (1 - vg))[None, :, :, None]
+         + quads[:, None, None, 2] * (ug * vg)[None, :, :, None]
+         + quads[:, None, None, 3] * ((1 - ug) * vg)[None, :, :, None])
+    h, w = xv.shape[2], xv.shape[3]
+    xi = jnp.clip(jnp.round(p[..., 0]).astype(jnp.int32), 0, w - 1)
+    yi = jnp.clip(jnp.round(p[..., 1]).astype(jnp.int32), 0, h - 1)
+    feat = xv[0]
+    g = feat[:, yi, xi]  # [C, R, th, tw]
+    out = jnp.transpose(g, (1, 0, 2, 3))
+    mask = jnp.ones((quads.shape[0], 1, th, tw), jnp.int32)
+    return Tensor(out), Tensor(mask)
+
+
+# ---- proposal pipeline ----
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposals: decode deltas, clip, filter, NMS (ref:
+    generate_proposals_op.cc). Static shapes: top-k + padding."""
+    from ...vision.ops import nms as _nms
+    sv = _val(scores)  # [N, A, H, W]
+    dv = _val(bbox_deltas)  # [N, 4A, H, W]
+    av = _val(anchors).reshape(-1, 4)
+    vv = _val(variances).reshape(-1, 4)
+    n, a, h, w = sv.shape
+    s = sv[0].transpose(1, 2, 0).reshape(-1)
+    d = dv[0].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+    dec = _val(box_coder(Tensor(av), Tensor(vv), Tensor(d[None]),
+                         code_type="decode_center_size", axis=1))
+    boxes = dec.reshape(-1, 4)
+    boxes = _val(box_clip(Tensor(boxes), im_info))
+    k = min(pre_nms_top_n, s.shape[0])
+    top_s, top_i = jax.lax.top_k(s, k)
+    top_b = boxes[top_i]
+    wh_ok = ((top_b[:, 2] - top_b[:, 0] >= min_size)
+             & (top_b[:, 3] - top_b[:, 1] >= min_size))
+    top_s = jnp.where(wh_ok, top_s, -1.0)
+    kept = _val(_nms(Tensor(top_b), Tensor(top_s), iou_threshold=nms_thresh,
+                     top_k=post_nms_top_n))
+    valid = kept >= 0
+    safe = jnp.maximum(kept, 0)
+    out_b = jnp.where(valid[:, None], top_b[safe], 0.0)
+    out_s = jnp.where(valid, top_s[safe], 0.0)
+    if return_rois_num:
+        return (Tensor(out_b), Tensor(out_s[:, None]),
+                Tensor(jnp.sum(valid.astype(jnp.int32))[None]))
+    return Tensor(out_b), Tensor(out_s[:, None])
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=False):
+    """Label anchors fg/bg by IoU against gt (ref: rpn_target_assign_op.cc).
+    Deterministic top-k instead of random sampling — TPU-safe."""
+    ab = _val(anchor_box).reshape(-1, 4)
+    gb = _val(gt_boxes).reshape(-1, 4)
+    iou = _iou_matrix(ab, gb)  # [A, G]
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    labels = jnp.where(best_iou >= rpn_positive_overlap, 1,
+                       jnp.where(best_iou < rpn_negative_overlap, 0, -1))
+    # anchors that are the argmax for some gt are positive too
+    gt_best_anchor = jnp.argmax(iou, axis=0)
+    labels = labels.at[gt_best_anchor].set(1)
+    fg_target = int(rpn_batch_size_per_im * rpn_fg_fraction)
+    fg_score = jnp.where(labels == 1, best_iou, -1.0)
+    fg_idx = jax.lax.top_k(fg_score, min(fg_target, ab.shape[0]))[1]
+    bg_score = jnp.where(labels == 0, 1.0 - best_iou, -1.0)
+    bg_idx = jax.lax.top_k(bg_score,
+                           min(rpn_batch_size_per_im - fg_target,
+                               ab.shape[0]))[1]
+    loc_idx = fg_idx
+    score_idx = jnp.concatenate([fg_idx, bg_idx])
+    tgt = _val(box_coder(Tensor(ab[fg_idx]), None,
+                         Tensor(gb[best_gt[fg_idx]]),
+                         code_type="encode_center_size"))
+    tgt_box = jnp.diagonal(tgt[:, :, :], axis1=0, axis2=1).T \
+        if tgt.ndim == 3 else tgt
+    tgt_lbl = jnp.concatenate([jnp.ones_like(fg_idx),
+                               jnp.zeros_like(bg_idx)])[:, None]
+    return (Tensor(loc_idx), Tensor(score_idx), Tensor(tgt_box),
+            Tensor(tgt_lbl), Tensor((labels >= 0).astype(jnp.int32)))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet anchor labeling (ref: retinanet_target_assign_op.cc)."""
+    out = rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, is_crowd, im_info,
+                            rpn_positive_overlap=positive_overlap,
+                            rpn_negative_overlap=negative_overlap)
+    loc_idx, score_idx, tgt_box, tgt_lbl, mask = out
+    ab = _val(anchor_box).reshape(-1, 4)
+    gb = _val(gt_boxes).reshape(-1, 4)
+    gl = _val(gt_labels).reshape(-1)
+    iou = _iou_matrix(ab, gb)
+    best_gt = jnp.argmax(iou, axis=1)
+    cls = gl[best_gt][_val(loc_idx)]
+    fg_num = jnp.sum(jnp.max(iou, axis=1) >= positive_overlap).astype(
+        jnp.int32)[None]
+    return (loc_idx, score_idx, tgt_box, Tensor(cls[:, None]), mask,
+            Tensor(fg_num))
+
+
+def retinanet_detection_output(bboxes, scores, im_info, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.3, nms_eta=1.0):
+    """Multi-level RetinaNet decode + NMS (ref:
+    retinanet_detection_output_op.cc)."""
+    bv = [_val(b) for b in (bboxes if isinstance(bboxes, (list, tuple))
+                            else [bboxes])]
+    sv = [_val(s) for s in (scores if isinstance(scores, (list, tuple))
+                            else [scores])]
+    allb = jnp.concatenate([b.reshape(-1, 4) for b in bv], axis=0)
+    alls = jnp.concatenate([s.reshape(-1, s.shape[-1]) for s in sv], axis=0)
+    return multiclass_nms(Tensor(allb), Tensor(alls.T),
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, background_label=-1)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=False,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Sample fg/bg proposals + regression targets for the RCNN head (ref:
+    generate_proposal_labels_op.cc). Deterministic top-k sampling."""
+    rois = _val(rpn_rois).reshape(-1, 4)
+    gb = _val(gt_boxes).reshape(-1, 4)
+    gc = _val(gt_classes).reshape(-1)
+    iou = _iou_matrix(rois, gb)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    fg_target = int(batch_size_per_im * fg_fraction)
+    fg_score = jnp.where(best_iou >= fg_thresh, best_iou, -1.0)
+    fg_idx = jax.lax.top_k(fg_score, min(fg_target, rois.shape[0]))[1]
+    bg_mask = (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo)
+    bg_score = jnp.where(bg_mask, 1.0 - best_iou, -1.0)
+    bg_idx = jax.lax.top_k(bg_score, min(batch_size_per_im - fg_target,
+                                         rois.shape[0]))[1]
+    keep = jnp.concatenate([fg_idx, bg_idx])
+    out_rois = rois[keep]
+    labels = jnp.concatenate([gc[best_gt[fg_idx]],
+                              jnp.zeros_like(bg_idx)]).astype(jnp.int32)
+    deltas = _val(box_coder(Tensor(out_rois), None, Tensor(gb[best_gt[keep]]),
+                            code_type="encode_center_size"))
+    if deltas.ndim == 3:
+        deltas = jnp.diagonal(deltas, axis1=0, axis2=1).T
+    deltas = deltas / jnp.asarray(bbox_reg_weights, deltas.dtype)
+    n = keep.shape[0]
+    tgt = jnp.zeros((n, 4 * class_nums), deltas.dtype)
+    col = labels * 4
+    rowi = jnp.arange(n)
+    for k in range(4):
+        tgt = tgt.at[rowi, col + k].set(deltas[:, k])
+    w_in = (labels > 0).astype(jnp.float32)[:, None] * jnp.ones((n, 4 * class_nums))
+    return (Tensor(out_rois), Tensor(labels[:, None]), Tensor(tgt),
+            Tensor(w_in), Tensor(w_in))
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask targets by rasterizing gt polygons into RoI grids (ref:
+    generate_mask_labels_op.cc). Simplified: gt_segms given as binary masks
+    are resampled into each fg RoI."""
+    rv = _val(rois).reshape(-1, 4)
+    lab = _val(labels_int32).reshape(-1)
+    seg = _val(gt_segms)  # [G, H, W] binary
+    n = rv.shape[0]
+    res = resolution
+    h, w = seg.shape[-2], seg.shape[-1]
+    x1, y1, x2, y2 = rv[:, 0], rv[:, 1], rv[:, 2], rv[:, 3]
+    ys = y1[:, None] + (jnp.arange(res) + 0.5)[None, :] / res * \
+        jnp.maximum(y2 - y1, 1)[:, None]
+    xs = x1[:, None] + (jnp.arange(res) + 0.5)[None, :] / res * \
+        jnp.maximum(x2 - x1, 1)[:, None]
+    yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+    xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+    m = seg[0] if seg.ndim == 3 else seg
+    tgt = m[yi[:, :, None], xi[:, None, :]].astype(jnp.int32)  # [N,res,res]
+    tgt = jnp.where((lab > 0)[:, None, None], tgt, -1)
+    return Tensor(rv), Tensor(lab[:, None]), Tensor(tgt.reshape(n, -1))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (ref:
+    distribute_fpn_proposals_op.cc). Static shapes: every level gets the full
+    roi list; rows not routed to that level are zeroed, and restore_ind
+    recovers the original order."""
+    rv = _val(fpn_rois).reshape(-1, 4)
+    scale = jnp.sqrt(jnp.maximum(rv[:, 2] - rv[:, 0], 0)
+                     * jnp.maximum(rv[:, 3] - rv[:, 1], 0))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = []
+    nums = []
+    for level in range(min_level, max_level + 1):
+        m = (lvl == level)[:, None]
+        outs.append(Tensor(jnp.where(m, rv, 0.0)))
+        nums.append(jnp.sum(m.astype(jnp.int32)))
+    restore = jnp.argsort(jnp.argsort(lvl, stable=True), stable=True)
+    if rois_num is not None:
+        return (outs, Tensor(restore[:, None]),
+                [Tensor(n[None]) for n in nums])
+    return outs, Tensor(restore[:, None])
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """Merge per-level RoIs and keep global top-k by score (ref:
+    collect_fpn_proposals_op.cc)."""
+    rv = jnp.concatenate([_val(r).reshape(-1, 4) for r in multi_rois], axis=0)
+    sv = jnp.concatenate([_val(s).reshape(-1) for s in multi_scores], axis=0)
+    k = min(post_nms_top_n, sv.shape[0])
+    top_s, top_i = jax.lax.top_k(sv, k)
+    if rois_num_per_level is not None:
+        return Tensor(rv[top_i]), Tensor(jnp.asarray([k], jnp.int32))
+    return Tensor(rv[top_i])
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD multi-scale head: per-level loc/conf convs + priors (ref:
+    fluid/layers/detection.py multi_box_head). Conv weights are lazily
+    created 1x1 projections."""
+    from .. import Conv2D
+    n_levels = len(inputs)
+    if min_sizes is None:
+        assert min_ratio is not None and max_ratio is not None
+        step = int((max_ratio - min_ratio) / (n_levels - 2))
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    locs, confs, boxes, vars_ = [], [], [], []
+    for i, x in enumerate(inputs):
+        mi = [min_sizes[i]] if np.isscalar(min_sizes[i]) else min_sizes[i]
+        mx = [max_sizes[i]] if np.isscalar(max_sizes[i]) else max_sizes[i]
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        b, v = prior_box(x, image, mi, mx, ar, variance, flip, clip,
+                         steps[i] if steps else (0.0, 0.0), offset)
+        nb = int(np.prod(_val(b).shape[:-1]) // (_val(x).shape[2]
+                                                 * _val(x).shape[3]))
+        cin = _val(x).shape[1]
+        key = ("loc", i, cin, nb)
+        if key not in multi_box_head._cache:
+            multi_box_head._cache[key] = Conv2D(cin, nb * 4, kernel_size,
+                                                padding=pad, stride=stride)
+            multi_box_head._cache[("conf", i, cin, nb)] = Conv2D(
+                cin, nb * num_classes, kernel_size, padding=pad,
+                stride=stride)
+        loc = multi_box_head._cache[key](x)
+        conf = multi_box_head._cache[("conf", i, cin, nb)](x)
+        lv = _val(loc).transpose(0, 2, 3, 1).reshape(_val(x).shape[0], -1, 4)
+        cv = _val(conf).transpose(0, 2, 3, 1).reshape(
+            _val(x).shape[0], -1, num_classes)
+        locs.append(lv)
+        confs.append(cv)
+        boxes.append(_val(b).reshape(-1, 4))
+        vars_.append(_val(v).reshape(-1, 4))
+    return (Tensor(jnp.concatenate(locs, axis=1)),
+            Tensor(jnp.concatenate(confs, axis=1)),
+            Tensor(jnp.concatenate(boxes, axis=0)),
+            Tensor(jnp.concatenate(vars_, axis=0)))
+
+
+multi_box_head._cache = {}
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0):
+    """Decode YOLO head to absolute boxes + per-class scores (ref:
+    yolo_box_op.cc; normalized geometry in vision/ops.py yolo_box_decode)."""
+    from ...vision.ops import yolo_box_decode
+    boxes_n, conf = yolo_box_decode(x, anchors,
+                                    downsample_ratio=downsample_ratio,
+                                    class_num=class_num,
+                                    conf_thresh=conf_thresh)
+    bv = _val(boxes_n)
+    cv = _val(conf)
+    xv = _val(x)
+    n, _, h, w = xv.shape
+    a = len(anchors) // 2
+    cls_prob = jax.nn.sigmoid(
+        xv.reshape(n, a, 5 + class_num, h, w)[:, :, 5:])
+    scores = (cv[..., None]
+              * cls_prob.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num))
+    img = _val(img_size).astype(jnp.float32)  # [N, 2] (h, w)
+    scale = jnp.stack([img[:, 1], img[:, 0], img[:, 1], img[:, 0]],
+                      axis=1)[:, None, :]
+    abs_boxes = bv * scale
+    if clip_bbox:
+        lim = scale - 1
+        abs_boxes = jnp.clip(abs_boxes, 0, lim)
+    keep = cv >= conf_thresh
+    abs_boxes = jnp.where(keep[..., None], abs_boxes, 0.0)
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    return Tensor(abs_boxes), Tensor(scores)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (ref: yolov3_loss_op.cc): coordinate MSE /
+    BCE objectness / BCE class over assigned anchors."""
+    xv = _val(x)  # [N, A*(5+C), H, W]
+    gb = _val(gt_box)  # [N, G, 4] cx,cy,w,h normalized
+    gl = _val(gt_label).astype(jnp.int32)  # [N, G]
+    n, _, h, w = xv.shape
+    a = len(anchor_mask)
+    pred = xv.reshape(n, a, 5 + class_num, h, w)
+    px = jax.nn.sigmoid(pred[:, :, 0])
+    py = jax.nn.sigmoid(pred[:, :, 1])
+    pw = pred[:, :, 2]
+    ph = pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]
+    masked = [(anchors[2 * i], anchors[2 * i + 1]) for i in anchor_mask]
+    in_w, in_h = w * downsample_ratio, h * downsample_ratio
+
+    g = gb.shape[1]
+    gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)  # [N,G]
+    gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    # best anchor per gt by wh IoU
+    aw = jnp.asarray([m[0] for m in masked], jnp.float32) / in_w
+    ah = jnp.asarray([m[1] for m in masked], jnp.float32) / in_h
+    inter = (jnp.minimum(gb[..., 2][..., None], aw)
+             * jnp.minimum(gb[..., 3][..., None], ah))
+    union = (gb[..., 2] * gb[..., 3])[..., None] + aw * ah - inter
+    best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N,G]
+
+    valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)
+    tx = gb[..., 0] * w - gi
+    ty = gb[..., 1] * h - gj
+    tw = jnp.log(jnp.maximum(gb[..., 2] * in_w, 1e-9)
+                 / jnp.maximum(aw[best_a] * in_w, 1e-9))
+    th = jnp.log(jnp.maximum(gb[..., 3] * in_h, 1e-9)
+                 / jnp.maximum(ah[best_a] * in_h, 1e-9))
+    bidx = jnp.arange(n)[:, None].repeat(g, 1)
+    sel = (bidx, best_a, gj, gi)
+    px_s, py_s = px[sel], py[sel]
+    pw_s, ph_s = pw[sel], ph[sel]
+    vf = valid.astype(jnp.float32)
+    box_loss = jnp.sum(vf * ((px_s - tx) ** 2 + (py_s - ty) ** 2
+                             + (pw_s - tw) ** 2 + (ph_s - th) ** 2))
+    # objectness: 1 at assigned cells, 0 elsewhere
+    tobj = jnp.zeros((n, a, h, w)).at[sel].max(vf)
+    obj_bce = jnp.maximum(pobj, 0) - pobj * tobj + jnp.log1p(
+        jnp.exp(-jnp.abs(pobj)))
+    obj_loss = jnp.sum(obj_bce)
+    tcls = jax.nn.one_hot(gl, class_num)
+    if use_label_smooth:
+        delta = 1.0 / max(class_num, 1)
+        tcls = tcls * (1 - delta) + delta * 0.5
+    pcls_s = pcls.transpose(0, 1, 3, 4, 2)[sel]  # [N,G,C]
+    cls_bce = jnp.maximum(pcls_s, 0) - pcls_s * tcls + jnp.log1p(
+        jnp.exp(-jnp.abs(pcls_s)))
+    cls_loss = jnp.sum(vf[..., None] * cls_bce)
+    return Tensor(jnp.asarray([box_loss + obj_loss + cls_loss])[0][None]
+                  if False else (box_loss + obj_loss + cls_loss)[None])

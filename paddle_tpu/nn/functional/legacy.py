@@ -1,0 +1,808 @@
+"""Fluid 1.x functional layers kept by the 2.0-rc nn.functional namespace.
+
+Reference: python/paddle/nn/functional/__init__.py re-exports a large slice of
+fluid.layers (fc, rnn builders, image_resize, misc). TPU-first: everything is
+a pure JAX function with static shapes; the LoD-era ops take dense padded
+tensors (see sequence.py for the layout contract).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---- dense / elementwise ----
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """1.x fully-connected: flatten trailing dims then project (ref:
+    fluid/layers/nn.py fc). Weight is created on first call via Linear."""
+    from .. import Linear
+    xv = _val(x)
+    lead = xv.shape[:num_flatten_dims]
+    flat = xv.reshape(int(np.prod(lead)), -1)
+    layer = fc._cache.get((flat.shape[1], size))
+    if layer is None:
+        layer = Linear(flat.shape[1], size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+        fc._cache[(flat.shape[1], size)] = layer
+    out = layer(Tensor(flat))
+    out = ops.reshape(out, list(lead) + [size])
+    if activation:
+        out = getattr(ops, activation)(out)
+    return out
+
+
+fc._cache = {}
+
+
+def erf(x, name=None):
+    return Tensor(jax.lax.erf(_val(x).astype(jnp.float32)).astype(_val(x).dtype))
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    xv = _val(x)
+    return Tensor(jnp.log1p(jnp.exp(jnp.clip(xv, -threshold, threshold))))
+
+
+def assign(x, output=None, name=None):
+    v = _val(x) if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    t = Tensor(v)
+    if output is not None:
+        output._value = v
+        return output
+    return t
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    """Per-row smooth-L1 (ref: smooth_l1_loss_op.cc)."""
+    xv, yv = _val(x), _val(y)
+    d = (xv - yv)
+    if inside_weight is not None:
+        d = d * _val(inside_weight)
+    s2 = sigma * sigma
+    ad = jnp.abs(d)
+    l = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    if outside_weight is not None:
+        l = l * _val(outside_weight)
+    return Tensor(jnp.sum(l.reshape(l.shape[0], -1), axis=1, keepdims=True))
+
+
+def pad2d(x, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    t, b, l, r = paddings
+    if data_format == "NCHW":
+        pad = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        pad = [(0, 0), (t, b), (l, r), (0, 0)]
+    xv = _val(x)
+    if mode == "constant":
+        return Tensor(jnp.pad(xv, pad, constant_values=pad_value))
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return Tensor(jnp.pad(xv, pad, mode=jmode))
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    xv, yv = _val(x), _val(y)
+    pads = [(0, xd - yd) for xd, yd in zip(xv.shape, yv.shape)]
+    return Tensor(jnp.pad(yv, pads, constant_values=pad_value))
+
+
+def affine_channel(x, scale=None, bias=None, data_format="NCHW", act=None,
+                   name=None):
+    xv = _val(x)
+    shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+    out = xv
+    if scale is not None:
+        out = out * _val(scale).reshape(shape)
+    if bias is not None:
+        out = out + _val(bias).reshape(shape)
+    if act:
+        out = _val(getattr(ops, act)(Tensor(out)))
+    return Tensor(out)
+
+
+def data_norm(input, act=None, epsilon=1e-5, name=None, **kw):  # noqa: A002
+    """Mean/variance normalization using batch statistics (ref:
+    data_norm_op.cc, the parameter-server-free form)."""
+    xv = _val(input)
+    mean = jnp.mean(xv, axis=0, keepdims=True)
+    var = jnp.var(xv, axis=0, keepdims=True)
+    out = (xv - mean) / jnp.sqrt(var + epsilon)
+    if act:
+        out = _val(getattr(ops, act)(Tensor(out)))
+    return Tensor(out)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):  # noqa: A002
+    """Sinusoidal position encoding mixed into the input (ref:
+    add_position_encoding_op.cc)."""
+    xv = _val(input)
+    b, t, c = xv.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(c // 2, dtype=jnp.float32)[None, :]
+    freq = pos / jnp.power(10000.0, 2.0 * i / c)
+    pe = jnp.concatenate([jnp.sin(freq), jnp.cos(freq)], axis=1)
+    if pe.shape[1] < c:
+        pe = jnp.pad(pe, [(0, 0), (0, c - pe.shape[1])])
+    return Tensor(alpha * xv + beta * pe[None].astype(xv.dtype))
+
+
+def space_to_depth(x, blocksize, name=None):
+    xv = _val(x)  # NCHW
+    n, c, h, w = xv.shape
+    bs = blocksize
+    xv = xv.reshape(n, c, h // bs, bs, w // bs, bs)
+    xv = xv.transpose(0, 3, 5, 1, 2, 4)
+    return Tensor(xv.reshape(n, c * bs * bs, h // bs, w // bs))
+
+
+def shuffle_channel(x, group, name=None):
+    xv = _val(x)
+    n, c, h, w = xv.shape
+    xv = xv.reshape(n, group, c // group, h, w).transpose(0, 2, 1, 3, 4)
+    return Tensor(xv.reshape(n, c, h, w))
+
+
+def similarity_focus(input, axis, indexes, name=None):  # noqa: A002
+    """Binary focus mask marking argmax rows/cols of selected slices (ref:
+    similarity_focus_op.cc)."""
+    xv = _val(input)
+    n, c, h, w = xv.shape
+    sel = xv[:, jnp.asarray(indexes)] if axis == 1 else xv
+    m = jnp.zeros((n, h, w), bool)
+    for k in range(len(indexes)):
+        sl = sel[:, k]
+        m = m | (sl == jnp.max(sl, axis=(1, 2), keepdims=True))
+    return Tensor(jnp.broadcast_to(m[:, None], xv.shape).astype(xv.dtype))
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix for distillation (ref:
+    fsp_op.cc): [N,C1,H,W] x [N,C2,H,W] -> [N,C1,C2]."""
+    xv, yv = _val(x), _val(y)
+    n, c1, h, w = xv.shape
+    c2 = yv.shape[1]
+    a = xv.reshape(n, c1, h * w)
+    b = yv.reshape(n, c2, h * w)
+    return Tensor(jnp.einsum("nax,nbx->nab", a, b) / (h * w))
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A002
+    """Modulo multi-hash of int ids (ref: hash_op.cc; xxhash replaced by a
+    multiplicative mix — same contract: deterministic ids in [0, hash_size))."""
+    xv = _val(input).astype(jnp.uint32)
+    outs = []
+    for i in range(num_hash):
+        mixed = (xv * np.uint32(2654435761) + np.uint32(i * 0x9E3779B9))
+        mixed = mixed ^ (mixed >> 16)
+        outs.append((mixed % np.uint32(hash_size)).astype(jnp.int64))
+    return Tensor(jnp.stack(outs, axis=-1).reshape(xv.shape[:-1] + (-1,)))
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, # noqa: A002
+                input_image_size=None, out_stride=1, name=None):
+    """Image patches flattened to sequence steps (ref: im2sequence_op.cc);
+    lowered to unfold (ref also: ops/nn_ops.py unfold)."""
+    fs = ([filter_size] * 2 if isinstance(filter_size, int) else filter_size)
+    st = [stride] * 2 if isinstance(stride, int) else stride
+    pd = [padding] * 4 if isinstance(padding, int) else padding
+    from ...ops import unfold
+    cols = unfold(input, fs, strides=st,
+                  paddings=pd[:2] if len(pd) == 4 else pd)
+    cv = _val(cols)  # [N, C*kh*kw, L]
+    return Tensor(cv.transpose(0, 2, 1))
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    key = counter_name or "@STEP_COUNTER@"
+    c = autoincreased_step_counter._counters.get(key, begin - step)
+    c += step
+    autoincreased_step_counter._counters[key] = c
+    return Tensor(np.asarray([c], np.int64))
+
+
+autoincreased_step_counter._counters = {}
+
+
+def continuous_value_model(input, cvm, use_cvm=True):  # noqa: A002
+    xv = _val(input)
+    if use_cvm:
+        return Tensor(xv)
+    return Tensor(xv[:, 2:])
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, out_val_if_empty=0):
+    """Keep rows whose tag is in filter_tag (ref: filter_by_instag_op.cc);
+    dense form returns a mask-multiplied copy plus the kept-row indices."""
+    iv = _val(ins)
+    tags = _val(ins_tag).reshape(-1)
+    keep = jnp.isin(tags, _val(filter_tag))
+    out = jnp.where(keep.reshape((-1,) + (1,) * (iv.ndim - 1)), iv,
+                    jnp.asarray(out_val_if_empty, iv.dtype))
+    idx = jnp.nonzero(keep, size=tags.shape[0], fill_value=-1)[0]
+    return Tensor(out), Tensor(idx), Tensor(keep.astype(jnp.int64))
+
+
+def polygon_box_transform(input, name=None):  # noqa: A002
+    """Offset-map to absolute quad coordinates (ref:
+    polygon_box_transform_op.cc)."""
+    xv = _val(input)  # [N, 8k, H, W]
+    n, c, h, w = xv.shape
+    xs = jnp.arange(w, dtype=xv.dtype)[None, None, None, :]
+    ys = jnp.arange(h, dtype=xv.dtype)[None, None, :, None]
+    is_x = (jnp.arange(c) % 2 == 0).reshape(1, c, 1, 1)
+    return Tensor(jnp.where(is_x, xs * 4 - xv, ys * 4 - xv))
+
+
+# ---- tensor-array (dense list emulation; LoD arrays are python lists) ----
+
+def create_array(dtype="float32"):
+    return []
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    idx = int(np.asarray(i.numpy() if isinstance(i, Tensor) else i))
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(np.asarray(i.numpy() if isinstance(i, Tensor) else i))]
+
+
+def array_length(array):
+    return Tensor(np.asarray([len(array)], np.int64))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):  # noqa: A002
+    vals = [_val(x) for x in input if x is not None]
+    if use_stack:
+        out = jnp.stack(vals, axis=axis)
+    else:
+        out = jnp.concatenate(vals, axis=axis)
+    sizes = np.asarray([v.shape[axis] for v in vals], np.int32)
+    return Tensor(out), Tensor(sizes)
+
+
+# ---- LoD compat no-ops (dense tensors carry no LoD) ----
+
+def lod_reset(x, y=None, target_lod=None):
+    return x
+
+
+def lod_append(x, level):
+    return x
+
+
+def merge_selected_rows(x, name=None):
+    return x
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    return x
+
+
+# ---- resize family (ref: interpolate_op; lowered to ops.interpolate) ----
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",  # noqa: A002
+                 align_corners=True, align_mode=1, data_format="NCHW",
+                 name=None, **kw):
+    mode = resample.lower()
+    return ops.interpolate(input, size=out_shape, scale_factor=scale,
+                           mode=mode, align_corners=align_corners,
+                           data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True,  # noqa: A002
+                    align_mode=1, data_format="NCHW", name=None):
+    return image_resize(input, out_shape, scale, "BILINEAR", align_corners,
+                        align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True,  # noqa: A002
+                   data_format="NCHW", name=None):
+    return image_resize(input, out_shape, scale, "NEAREST", align_corners,
+                        1, data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, align_corners=True,  # noqa: A002
+                     align_mode=1, data_format="NCDHW", name=None):
+    return ops.interpolate(input, size=out_shape, scale_factor=scale,
+                           mode="trilinear", align_corners=align_corners,
+                           data_format=data_format)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):  # noqa: A002
+    xv = _val(input)
+    h, w = xv.shape[2], xv.shape[3]
+    short = min(h, w)
+    scale = out_short_len / short
+    return image_resize(input, [int(round(h * scale)), int(round(w * scale))],
+                        None, resample)
+
+
+def random_crop(x, shape, seed=None):
+    from ...core import rng
+    xv = _val(x)
+    key = rng.next_key() if seed is None else jax.random.key(seed)
+    starts = []
+    for dim, target in zip(xv.shape[-len(shape):], shape):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, dim - target + 1))
+    idx = tuple([slice(None)] * (xv.ndim - len(shape))
+                + [slice(None)] * len(shape))
+    out = jax.lax.dynamic_slice(
+        xv, [0] * (xv.ndim - len(shape)) + [s for s in starts],
+        list(xv.shape[:-len(shape)]) + list(shape))
+    return Tensor(out)
+
+
+# ---- pooling 1.x names ----
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None):
+    from . import avg_pool2d, max_pool2d
+    if global_pooling:
+        xv = _val(input)
+        return Tensor(xv.mean(axis=(2, 3), keepdims=True)
+                      if pool_type == "avg"
+                      else xv.max(axis=(2, 3), keepdims=True))
+    f = max_pool2d if pool_type == "max" else avg_pool2d
+    return f(input, pool_size, stride=pool_stride, padding=pool_padding,
+             ceil_mode=ceil_mode)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", name=None):
+    from . import avg_pool3d, max_pool3d
+    if global_pooling:
+        xv = _val(input)
+        return Tensor(xv.mean(axis=(2, 3, 4), keepdims=True)
+                      if pool_type == "avg"
+                      else xv.max(axis=(2, 3, 4), keepdims=True))
+    f = max_pool3d if pool_type == "max" else avg_pool3d
+    return f(input, pool_size, stride=pool_stride, padding=pool_padding,
+             ceil_mode=ceil_mode)
+
+
+# ---- rnn builders (ref: fluid/layers/rnn.py; lowered to lax.scan cells) ----
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
+          time_major=False, **kw):
+    from ..layer.rnn import RNN
+    fw = RNN(cell_fw, time_major=time_major)
+    bw = RNN(cell_bw, time_major=time_major, is_reverse=True)
+    s_fw, s_bw = (initial_states if initial_states is not None
+                  else (None, None))
+    out_fw, st_fw = fw(inputs, s_fw, sequence_length)
+    out_bw, st_bw = bw(inputs, s_bw, sequence_length)
+    return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,  # noqa: A002
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, **kw):
+    from ..layer.rnn import LSTM
+    hidden_size = hidden_size or _val(init_h).shape[-1]
+    layer = LSTM(_val(input).shape[-1], hidden_size, num_layers=num_layers,
+                 direction="bidirect" if is_bidirec else "forward")
+    out, (h, c) = layer(input, (init_h, init_c))
+    return out, h, c
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, **kw):  # noqa: A002
+    from ..layer.rnn import LSTM
+    hidden = size // 4
+    layer = LSTM(_val(input).shape[-1], hidden)
+    init = None if h_0 is None else (h_0, c_0)
+    out, (h, c) = layer(input, init)
+    return out, c
+
+
+def dynamic_lstmp(input, size, proj_size, **kw):  # noqa: A002
+    out, c = dynamic_lstm(input, size, **kw)
+    proj = fc(out, proj_size, num_flatten_dims=2)
+    return proj, c
+
+
+def dynamic_gru(input, size, h_0=None, **kw):  # noqa: A002
+    from ..layer.rnn import GRU
+    layer = GRU(_val(input).shape[-1], size)
+    init = None if h_0 is None else h_0
+    out, h = layer(input, init)
+    return out
+
+
+def gru_unit(input, hidden, size, **kw):  # noqa: A002
+    from ..layer.rnn import GRUCell
+    cell = GRUCell(_val(input).shape[-1], size // 3)
+    h, _ = cell(input, hidden)
+    return h, h, h
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, **kw):
+    from ..layer.rnn import LSTMCell
+    cell = LSTMCell(_val(x_t).shape[-1], _val(hidden_t_prev).shape[-1])
+    h, (h2, c) = cell(x_t, (hidden_t_prev, cell_t_prev))
+    return h, c
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """Lookahead row convolution (ref: row_conv_op.cc): each step mixes the
+    next `future_context_size` frames with learned per-channel weights."""
+    xv = _val(input)  # [B, T, C]
+    c = xv.shape[-1]
+    w = row_conv._cache.get((future_context_size + 1, c))
+    if w is None:
+        from ...core.tensor import Parameter
+        from .. import initializer as I
+        w = Parameter(I.XavierUniform()((future_context_size + 1, c),
+                                        "float32"))
+        row_conv._cache[(future_context_size + 1, c)] = w
+    wv = _val(w)
+    t = xv.shape[1]
+    out = jnp.zeros_like(xv)
+    for i in range(future_context_size + 1):
+        rolled = jnp.roll(xv, -i, axis=1)
+        valid = (jnp.arange(t) + i < t)[None, :, None]
+        out = out + jnp.where(valid, rolled, 0) * wv[i][None, None, :]
+    if act:
+        out = _val(getattr(ops, act)(Tensor(out)))
+    return Tensor(out)
+
+
+row_conv._cache = {}
+
+
+def gather_tree(ids, parents):
+    """Trace beam-search parent pointers back to full sequences (ref:
+    gather_tree_op.cc). ids/parents: [T, B, beam]."""
+    iv, pv = _val(ids), _val(parents).astype(jnp.int32)
+    t = iv.shape[0]
+
+    def step(carry, xs):
+        beam_idx = carry  # [B, beam] current beam positions
+        ids_t, par_t = xs
+        out = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        nxt = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        return nxt, out
+
+    init = jnp.broadcast_to(jnp.arange(iv.shape[2], dtype=jnp.int32),
+                            iv.shape[1:])
+    _, outs = jax.lax.scan(step, init, (iv[::-1], pv[::-1]))
+    return Tensor(outs[::-1])
+
+
+# ---- legacy losses (ref: fluid/layers/loss.py + respective op kernels) ----
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    iv = _val(input)
+    lv = jax.nn.one_hot(_val(label).squeeze(-1), iv.shape[-1],
+                        dtype=iv.dtype) if _val(label).shape != iv.shape \
+        else _val(label).astype(iv.dtype)
+    iv_f = iv.reshape(iv.shape[0], -1)
+    lv_f = lv.reshape(lv.shape[0], -1)
+    inter = jnp.sum(iv_f * lv_f, axis=1)
+    union = jnp.sum(iv_f, axis=1) + jnp.sum(lv_f, axis=1)
+    return Tensor(jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon)))
+
+
+def bpr_loss(input, label, name=None):  # noqa: A002
+    """Bayesian personalized ranking loss (ref: bpr_loss_op.cc)."""
+    iv = _val(input)  # [N, C] scores
+    lv = _val(label).reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(iv, lv[:, None], axis=1)
+    diff = pos - iv  # [N, C]
+    log_sig = jax.nn.log_sigmoid(diff)
+    c = iv.shape[1]
+    mask = jax.nn.one_hot(lv, c, dtype=iv.dtype)
+    loss = -jnp.sum(log_sig * (1 - mask), axis=1, keepdims=True) / (c - 1)
+    return Tensor(loss)
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,  # noqa: A002
+                update_center=True):
+    """Distance to per-class centers (ref: center_loss_op.cc); centers are a
+    persistent buffer updated with rate alpha."""
+    iv = _val(input)
+    lv = _val(label).reshape(-1).astype(jnp.int32)
+    key = (num_classes, iv.shape[-1])
+    centers = center_loss._centers.get(key)
+    if centers is None:
+        centers = jnp.zeros((num_classes, iv.shape[-1]), iv.dtype)
+    sel = centers[lv]
+    diff = iv - sel
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if update_center:
+        counts = jnp.zeros((num_classes,), iv.dtype).at[lv].add(1.0)
+        upd = jnp.zeros_like(centers).at[lv].add(diff)
+        centers = centers + alpha * upd / (counts[:, None] + 1.0)
+        center_loss._centers[key] = centers
+    return Tensor(loss)
+
+
+center_loss._centers = {}
+
+
+def teacher_student_sigmoid_loss(input, label,  # noqa: A002
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation sigmoid loss (ref: teacher_student_sigmoid_loss_op.cc):
+    teacher signal (label<0 means none) + student CTR signal."""
+    x = jnp.clip(_val(input).reshape(-1), soft_max_lower_bound,
+                 soft_max_up_bound)
+    z = _val(label).reshape(-1).astype(x.dtype)
+    # student part: standard logistic loss on sign(z)
+    stu = jnp.log1p(jnp.exp(x)) - jnp.where(z > 0, x, 0.0)
+    # teacher part: logistic regression against soft label when 0<z<1
+    has_teacher = (z > 0) & (z < 1)
+    tea = jnp.where(has_teacher, jnp.log1p(jnp.exp(x)) - x * z, 0.0)
+    return Tensor((stu + tea)[:, None])
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation (ref: nce_op.cc). TPU-first: the negative
+    samples are drawn with the stateless PRNG and the whole loss is one
+    batched gather+matmul."""
+    from ...core import rng
+    iv = _val(input)  # [N, D]
+    lv = _val(label).reshape(-1).astype(jnp.int32)
+    n, d = iv.shape
+    key = (num_total_classes, d)
+    wb = nce._cache.get(key)
+    if wb is None:
+        from .. import initializer as I
+        w = I.XavierUniform()((num_total_classes, d), "float32")
+        b = jnp.zeros((num_total_classes,), jnp.float32)
+        wb = (w, b)
+        nce._cache[key] = wb
+    w, b = wb
+    neg = jax.random.randint(rng.next_key(), (n, num_neg_samples), 0,
+                             num_total_classes)
+    pos_logit = jnp.sum(iv * w[lv], axis=1) + b[lv]
+    neg_logit = jnp.einsum("nd,nkd->nk", iv, w[neg]) + b[neg]
+    p_noise = 1.0 / num_total_classes
+    ln_k_pn = jnp.log(num_neg_samples * p_noise)
+    pos_loss = -jax.nn.log_sigmoid(pos_logit - ln_k_pn)
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-(neg_logit - ln_k_pn)), axis=1)
+    return Tensor((pos_loss + neg_loss)[:, None])
+
+
+nce._cache = {}
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a complete binary tree (ref:
+    hierarchical_sigmoid_op.cc). Default tree: codes are the label's binary
+    representation over ceil(log2(C)) internal nodes."""
+    iv = _val(input)
+    lv = _val(label).reshape(-1).astype(jnp.int32)
+    wv = _val(weight)  # [num_classes-1, D] internal-node params
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    if path_table is not None:
+        table = _val(path_table).astype(jnp.int32)
+        code = _val(path_code).astype(iv.dtype)
+    else:
+        # node ids along the root->leaf path of a complete binary tree
+        node = lv + num_classes - 1  # leaf position in heap order
+        tables, codes = [], []
+        for _ in range(depth):
+            codes.append((node % 2).astype(iv.dtype))  # left/right bit
+            node = (node - 1) // 2
+            tables.append(node)
+        table = jnp.stack(tables[::-1], axis=1)  # [N, depth]
+        code = jnp.stack(codes[::-1], axis=1)
+    valid = (table >= 0) & (table < wv.shape[0])
+    tsafe = jnp.clip(table, 0, wv.shape[0] - 1)
+    logits = jnp.einsum("nd,nkd->nk", iv, wv[tsafe])
+    if bias is not None:
+        logits = logits + _val(bias).reshape(-1)[tsafe]
+    # bit=1 -> sigmoid(logit), bit=0 -> 1-sigmoid(logit)
+    lo = jnp.where(code > 0.5, jax.nn.log_sigmoid(logits),
+                   jax.nn.log_sigmoid(-logits))
+    loss = -jnp.sum(jnp.where(valid, lo, 0.0), axis=1, keepdims=True)
+    return Tensor(loss)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):  # noqa: A002
+    """Linear-chain CRF negative log-likelihood (ref:
+    linear_chain_crf_op.cc). input: [B, T, n_tags] unary potentials;
+    transition params are a persistent [n_tags+2, n_tags] buffer
+    (row 0: start, row 1: stop, rows 2:: transitions)."""
+    iv = _val(input)
+    lv = _val(label).astype(jnp.int32)
+    if lv.ndim == 3:
+        lv = lv.squeeze(-1)
+    b, t, n = iv.shape
+    trans = linear_chain_crf._params.get(n)
+    if trans is None:
+        trans = jnp.zeros((n + 2, n), jnp.float32)
+        linear_chain_crf._params[n] = trans
+    start, stop, tr = trans[0], trans[1], trans[2:]
+    lens = (_val(length).reshape(-1).astype(jnp.int32) if length is not None
+            else jnp.full((b,), t, jnp.int32))
+    emis = iv.astype(jnp.float32)
+
+    # ---- log partition via forward algorithm (lax.scan over time) ----
+    def fwd(alpha_t, xs):
+        emis_t, idx = xs  # [B, n], scalar time index
+        # alpha_t: [B, n]
+        scores = alpha_t[:, :, None] + tr[None] + emis_t[:, None, :]
+        new = jax.scipy.special.logsumexp(scores, axis=1)
+        active = (idx < lens)[:, None]
+        return jnp.where(active, new, alpha_t), None
+
+    alpha0 = start[None] + emis[:, 0]
+    alpha, _ = jax.lax.scan(fwd, alpha0, (emis.transpose(1, 0, 2)[1:],
+                                          jnp.arange(1, t)))
+    log_z = jax.scipy.special.logsumexp(alpha + stop[None], axis=1)
+
+    # ---- gold path score ----
+    pos = jnp.arange(t)[None]
+    msk = (pos < lens[:, None]).astype(jnp.float32)
+    unary = jnp.take_along_axis(emis, lv[:, :, None], axis=2)[:, :, 0]
+    gold_unary = jnp.sum(unary * msk, axis=1)
+    pair = tr[lv[:, :-1], lv[:, 1:]]
+    pair_msk = (pos[:, 1:] < lens[:, None]).astype(jnp.float32)
+    gold_pair = jnp.sum(pair * pair_msk, axis=1)
+    last_idx = jnp.maximum(lens - 1, 0)
+    last_tag = jnp.take_along_axis(lv, last_idx[:, None], axis=1)[:, 0]
+    gold = (start[lv[:, 0]] + gold_unary + gold_pair + stop[last_tag])
+    return Tensor((log_z - gold)[:, None])
+
+
+linear_chain_crf._params = {}
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):  # noqa: A002
+    """Viterbi decode using the buffer trained by linear_chain_crf (ref:
+    crf_decoding_op.cc)."""
+    iv = _val(input).astype(jnp.float32)
+    b, t, n = iv.shape
+    trans = linear_chain_crf._params.get(n)
+    if trans is None:
+        trans = jnp.zeros((n + 2, n), jnp.float32)
+    start, stop, tr = trans[0], trans[1], trans[2:]
+
+    def step(carry, emis_t):
+        score = carry  # [B, n]
+        cand = score[:, :, None] + tr[None]
+        best_prev = jnp.argmax(cand, axis=1)  # [B, n]
+        new = jnp.max(cand, axis=1) + emis_t
+        return new, best_prev
+
+    score0 = start[None] + iv[:, 0]
+    final, backs = jax.lax.scan(step, score0, iv.transpose(1, 0, 2)[1:])
+    final = final + stop[None]
+    last = jnp.argmax(final, axis=1).astype(jnp.int32)  # [B]
+
+    def backtrack(carry, back_t):
+        cur = carry
+        prev = jnp.take_along_axis(back_t, cur[:, None], axis=1)[:, 0]
+        prev = prev.astype(jnp.int32)
+        return prev, prev
+
+    _, path = jax.lax.scan(backtrack, last, backs[::-1])
+    # path rows are tags at t-1, t-2, ..., 0; reverse and append the last tag
+    full = jnp.concatenate([path[::-1].T, last[:, None]], axis=1)
+    return Tensor(full)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,  # noqa: A002
+            input_length=None, label_length=None):
+    from . import ctc_loss
+    return ctc_loss(input, label, input_length, label_length, blank=blank,
+                    reduction="none")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear transform x1^T W x2 (ref: bilinear_tensor_product_op.cc)."""
+    x1v, x2v, wv = _val(x1), _val(x2), _val(weight)
+    out = jnp.einsum("bi,oij,bj->bo", x1v, wv, x2v)
+    if bias is not None:
+        out = out + _val(bias)
+    return Tensor(out)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    xv, yv = _val(x), _val(y)
+    key = (size, xv.shape[-1], yv.shape[-1])
+    w = bilinear_tensor_product._cache.get(key)
+    if w is None:
+        from .. import initializer as I
+        w = I.XavierUniform()((size, xv.shape[-1], yv.shape[-1]), "float32")
+        bilinear_tensor_product._cache[key] = w
+    out = bilinear(x, y, Tensor(w))
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+bilinear_tensor_product._cache = {}
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,  # noqa: A002
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """Deformable conv v2 (ref: deformable_conv_op.cc). TPU-first: bilinear
+    sampling at offset positions via gather, then a dense matmul — no
+    scatter; static shapes throughout."""
+    xv = _val(input)  # [N, C, H, W]
+    off = _val(offset)  # [N, 2*dg*kh*kw, Ho, Wo]
+    n, c, h, w = xv.shape
+    ks = (filter_size if isinstance(filter_size, (list, tuple))
+          else (filter_size, filter_size))
+    kh, kw = ks
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    pd = padding if isinstance(padding, (list, tuple)) else (padding, padding)
+    ho = (h + 2 * pd[0] - kh) // st[0] + 1
+    wo = (w + 2 * pd[1] - kw) // st[1] + 1
+    key = (num_filters, c, kh, kw)
+    wgt = deformable_conv._cache.get(key)
+    if wgt is None:
+        from .. import initializer as I
+        wgt = I.KaimingUniform()((num_filters, c, kh, kw), "float32")
+        deformable_conv._cache[key] = wgt
+
+    ys = jnp.arange(ho) * st[0] - pd[0]
+    xs = jnp.arange(wo) * st[1] - pd[1]
+    base_y = ys[:, None, None, None] + jnp.arange(kh)[None, None, :, None]
+    base_x = xs[None, :, None, None] + jnp.arange(kw)[None, None, None, :]
+    off = off.reshape(n, deformable_groups, kh, kw, 2, ho, wo)
+    dy = off[:, 0, :, :, 0].transpose(0, 3, 4, 1, 2)  # [N,Ho,Wo,kh,kw]
+    dx = off[:, 0, :, :, 1].transpose(0, 3, 4, 1, 2)
+    py = base_y[None].astype(jnp.float32) + dy
+    px = base_x[None].astype(jnp.float32) + dx
+
+    y0 = jnp.floor(py).astype(jnp.int32)
+    x0 = jnp.floor(px).astype(jnp.int32)
+    wy = py - y0
+    wx = px - x0
+
+    def sample(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1)
+        xc = jnp.clip(xx, 0, w - 1)
+        g = xv[jnp.arange(n)[:, None, None, None, None], :,
+               yc[:, :, :, :, :, None].squeeze(-1)[..., None].squeeze(-1),
+               xc]  # fancy-gather [N,Ho,Wo,kh,kw,C]
+        return jnp.where(valid[..., None], g, 0.0)
+
+    # gather four corners; einsum applies bilinear weights + conv weights
+    v00 = sample(y0, x0)
+    v01 = sample(y0, x0 + 1)
+    v10 = sample(y0 + 1, x0)
+    v11 = sample(y0 + 1, x0 + 1)
+    val = (v00 * ((1 - wy) * (1 - wx))[..., None]
+           + v01 * ((1 - wy) * wx)[..., None]
+           + v10 * (wy * (1 - wx))[..., None]
+           + v11 * (wy * wx)[..., None])  # [N,Ho,Wo,kh,kw,C]
+    if modulated and mask is not None:
+        mv = _val(mask).reshape(n, deformable_groups, kh, kw, ho, wo)
+        mv = mv[:, 0].transpose(0, 3, 4, 1, 2)
+        val = val * mv[..., None]
+    out = jnp.einsum("nhwklc,ockl->nohw", val, wgt)
+    return Tensor(out)
+
+
+deformable_conv._cache = {}

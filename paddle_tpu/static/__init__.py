@@ -33,3 +33,160 @@ class BuildStrategy:
 
 class ExecutionStrategy:
     pass
+
+
+class ParallelExecutor(CompiledProgram):
+    """1.x multi-device executor shim: devices come from the jax Mesh, and
+    the single Executor already compiles to all of them (ref:
+    fluid/parallel_executor.py)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, **kw):
+        from .program import default_main_program
+        super().__init__(main_program or default_main_program())
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """CUDA name kept for parity; places map to the TPU devices."""
+    import jax
+
+    from ..core.place import TPUPlace
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Debug print op (ref: fluid/layers/control_flow.py Print). Eager
+    tensors print immediately; traced values print at run via jax.debug;
+    program Variables pass through (their value only exists at Executor.run)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    if isinstance(input, Tensor):
+        import jax
+        val = input._value
+        if isinstance(val, jax.core.Tracer):
+            jax.debug.print((message or "") + "{x}", x=val)
+        else:
+            print((message or "")
+                  + str(np.asarray(val).ravel()[:summarize]))
+    return input
+
+
+class WeightNormParamAttr:
+    """Param attr requesting weight normalization (ref: fluid/param_attr.py
+    WeightNormParamAttr); consumed by nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static autodiff: d(targets)/d(inputs) (ref:
+    python/paddle/fluid/backward.py gradients). Marks the target as the
+    program loss; grad Variables materialize at Executor lowering through the
+    same program-level jax.grad as append_backward."""
+    from .program import Variable, default_main_program
+    tgt = targets[0] if isinstance(targets, (list, tuple)) else targets
+    prog = default_main_program()
+    prog._loss = tgt
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    out = []
+    for v in ins:
+        g = Variable(prog.global_block(), v.name + "@GRAD", v.shape, v.dtype)
+        prog.global_block().vars[g.name] = g
+        v.grad = g
+        out.append(g)
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (ref: fluid/layers/nn.py py_func). Eager values run
+    `func` immediately; traced values lower to jax.pure_callback with `out`
+    providing the result shape/dtype."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    vals = [v._value if isinstance(v, Tensor) else v for v in xs]
+    traced = any(isinstance(v, jax.core.Tracer) for v in vals)
+    if traced:
+        out_dtype = (out._value.dtype if isinstance(out, Tensor)
+                     else np.dtype(getattr(out, "dtype", np.float32)))
+        res = jax.pure_callback(
+            lambda *a: np.asarray(func(*[np.asarray(v) for v in a]),
+                                  out_dtype),
+            jax.ShapeDtypeStruct(tuple(out.shape), out_dtype), *vals)
+        return Tensor(res)
+    res = func(*[np.asarray(v) for v in vals])
+    if isinstance(res, Tensor):
+        return res
+    return Tensor(jnp.asarray(np.asarray(res)))
+
+
+def _program_state(program):
+    """Persistable var values for a program, read from the global Scope
+    (parameters live in the scope after the startup program runs)."""
+    import numpy as np
+
+    from .program import global_scope
+    scope = global_scope()
+    state = {}
+    for v in program.global_block().vars.values():
+        if getattr(v, "persistable", False):
+            val = scope.find_var(v.name)
+            if val is not None:
+                state[v.name] = np.asarray(val)
+    return state
+
+
+def save(program, model_path, protocol=4, **kw):
+    """Persist all persistable program vars (ref: fluid/io.py save)."""
+    from ..framework.io import save as _save
+    _save(_program_state(program), model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+    state = _load(model_path + ".pdparams")
+    set_program_state(program, state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+    return _load(model_path + ".pdparams")
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from .program import global_scope
+    scope = global_scope()
+    for name, val in state_dict.items():
+        if isinstance(val, Tensor):
+            val = val._value
+        scope.set(name, jnp.asarray(val))
+    program._version = getattr(program, "_version", 0) + 1
+
+
+from .executor import Scope  # noqa: E402,F401
+from ..fluid.layers import create_global_var, create_parameter  # noqa: E402,F401

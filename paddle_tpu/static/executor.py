@@ -184,7 +184,29 @@ class Executor:
                     for p in params:
                         env[p.name + "@GRAD"] = grads[p.name]
                 else:
-                    env = _forward_env(program, param_vals, feed_vals, key)
+                    grad_targets = [n[:-len("@GRAD")] for n in fetch_names
+                                    if n.endswith("@GRAD")]
+                    loss_var = getattr(program, "_loss", None)
+                    if grad_targets and loss_var is not None:
+                        # append_backward/gradients() without an optimizer:
+                        # differentiate the marked loss w.r.t. the targets —
+                        # parameters or feed/data variables alike
+                        def loss_fn(dtree):
+                            pv = dict(param_vals)
+                            fv = dict(feed_vals)
+                            for n, v in dtree.items():
+                                (fv if n in fv else pv)[n] = v
+                            env = _forward_env(program, pv, fv, key)
+                            return env[loss_var.name], env
+
+                        dtree = {n: (feed_vals[n] if n in feed_vals
+                                     else param_vals[n])
+                                 for n in grad_targets}
+                        grads, env = jax.grad(loss_fn, has_aux=True)(dtree)
+                        for n, g in grads.items():
+                            env[n + "@GRAD"] = g
+                    else:
+                        env = _forward_env(program, param_vals, feed_vals, key)
                     out_params = param_vals
                     new_states = opt_states
                 fetches = []

@@ -1,0 +1,237 @@
+"""Tests for the fluid-1.x functional surface: sequence ops (dense layout),
+legacy layers/losses, CRF, and the detection suite."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSequenceOps:
+    def test_sequence_pool_masked(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+        lens = np.array([2, 3])
+        s = F.sequence_pool(T(x), "sum", seq_len=T(lens)).numpy()
+        np.testing.assert_allclose(s[0], x[0, :2].sum(0))
+        np.testing.assert_allclose(s[1], x[1].sum(0))
+        m = F.sequence_pool(T(x), "average", seq_len=T(lens)).numpy()
+        np.testing.assert_allclose(m[0], x[0, :2].mean(0))
+        mx = F.sequence_pool(T(x), "max", seq_len=T(lens)).numpy()
+        np.testing.assert_allclose(mx[0], x[0, :2].max(0))
+        last = F.sequence_last_step(T(x), seq_len=T(lens)).numpy()
+        np.testing.assert_allclose(last[0], x[0, 1])
+        np.testing.assert_allclose(last[1], x[1, 2])
+
+    def test_sequence_softmax_excludes_padding(self):
+        x = np.zeros((1, 4, 1), np.float32)
+        out = F.sequence_softmax(T(x), seq_len=T(np.array([2]))).numpy()
+        np.testing.assert_allclose(out[0, :2, 0], [0.5, 0.5], atol=1e-6)
+        np.testing.assert_allclose(out[0, 2:, 0], [0.0, 0.0])
+
+    def test_sequence_reverse(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 8, 1)
+        out = F.sequence_reverse(T(x), seq_len=T(np.array([5]))).numpy()
+        np.testing.assert_allclose(out[0, :5, 0], [4, 3, 2, 1, 0])
+        np.testing.assert_allclose(out[0, 5:, 0], [5, 6, 7])
+
+    def test_sequence_pad_and_conv(self):
+        x = np.ones((2, 4, 3), np.float32)
+        padded, lens = F.sequence_pad(T(x), pad_value=-1,
+                                      seq_len=T(np.array([2, 4])))
+        assert padded.numpy()[0, 3, 0] == -1
+        assert lens.numpy().tolist() == [2, 4]
+        w = np.ones((9, 5), np.float32)
+        out = F.sequence_conv(T(x), T(w), context_length=3)
+        assert out.shape == [2, 4, 5]
+        # middle steps see 3 full frames of ones -> 9.0
+        np.testing.assert_allclose(out.numpy()[0, 1], 9.0)
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3, 4]], np.int64)
+        out = F.sequence_enumerate(T(x), win_size=2, pad_value=0).numpy()
+        np.testing.assert_array_equal(out[0, 0], [1, 2])
+        np.testing.assert_array_equal(out[0, 3], [4, 0])
+
+
+class TestLegacyFunctional:
+    def test_fc_and_erf(self):
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        out = F.fc(T(x), 3)
+        assert out.shape == [4, 3]
+        e = F.erf(T(np.array([0.0, 1.0], np.float32))).numpy()
+        np.testing.assert_allclose(e, [0.0, 0.8427], atol=1e-3)
+
+    def test_space_to_depth_shuffle_channel(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.space_to_depth(T(x), 2)
+        assert out.shape == [1, 4, 2, 2]
+        y = np.random.rand(1, 4, 2, 2).astype(np.float32)
+        sc = F.shuffle_channel(T(y), 2).numpy()
+        np.testing.assert_allclose(sc[0, 1], y[0, 2])
+
+    def test_add_position_encoding(self):
+        x = np.zeros((1, 4, 6), np.float32)
+        out = F.add_position_encoding(T(x), alpha=1.0, beta=1.0).numpy()
+        np.testing.assert_allclose(out[0, 0, :3], [0, 0, 0], atol=1e-6)
+        np.testing.assert_allclose(out[0, 0, 3:], [1, 1, 1], atol=1e-6)
+
+    def test_gather_tree(self):
+        ids = np.array([[[2, 2]], [[6, 1]]], np.int64)  # [T=2, B=1, beam=2]
+        parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+        out = F.gather_tree(T(ids), T(parents)).numpy()
+        # beam0 at t=1 came from parent 1 -> path [2, 6]
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 6])
+
+    def test_losses_shapes_and_values(self):
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        lbl = np.array([[1], [2], [0], [3], [1]], np.int64)
+        bpr = F.bpr_loss(T(x), T(lbl))
+        assert bpr.shape == [5, 1] and np.isfinite(bpr.numpy()).all()
+        cl = F.center_loss(T(x), T(lbl), num_classes=4, alpha=0.1)
+        assert cl.shape == [5, 1] and (cl.numpy() >= 0).all()
+        w = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        hs = F.hsigmoid_loss(T(x), T(lbl), 4, T(w))
+        assert hs.shape == [5, 1] and (hs.numpy() > 0).all()
+        n = F.nce(T(x), T(lbl), num_total_classes=10, num_neg_samples=3)
+        assert n.shape == [5, 1] and np.isfinite(n.numpy()).all()
+        d = F.dice_loss(T(np.abs(x) / 4), T(lbl))
+        assert np.isfinite(float(d.numpy()))
+
+    def test_linear_chain_crf_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        emis = rng.randn(1, 3, 2).astype(np.float32)
+        label = np.array([[0, 1, 1]], np.int64)
+        F.linear_chain_crf._params.pop(2, None)
+        nll = float(F.linear_chain_crf(T(emis), T(label)).numpy()[0, 0])
+        # brute force over all 2^3 paths with zero transitions
+        import itertools
+        scores = [sum(emis[0, t, y] for t, y in enumerate(path))
+                  for path in itertools.product([0, 1], repeat=3)]
+        gold = sum(emis[0, t, label[0, t]] for t in range(3))
+        log_z = np.log(np.sum(np.exp(scores)))
+        np.testing.assert_allclose(nll, log_z - gold, rtol=1e-4)
+
+    def test_crf_decoding_zero_transitions_is_argmax(self):
+        emis = np.array([[[0.1, 2.0], [3.0, 0.2], [0.0, 1.0]]], np.float32)
+        F.linear_chain_crf._params.pop(2, None)
+        path = F.crf_decoding(T(emis)).numpy()
+        np.testing.assert_array_equal(path[0], [1, 0, 1])
+
+    def test_deformable_conv_zero_offsets_matches_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 5, 5), np.float32)
+        F.deformable_conv._cache.clear()
+        out = F.deformable_conv(T(x), T(off), None, num_filters=3,
+                                filter_size=3, padding=1, modulated=False)
+        assert out.shape == [1, 3, 5, 5]
+        w = F.deformable_conv._cache[(3, 2, 3, 3)]
+        import jax.numpy as jnp
+        import jax
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), atol=1e-3)
+
+    def test_rnn_builders(self):
+        x = np.random.RandomState(0).randn(2, 5, 4).astype(np.float32)
+        out, h, c = F.lstm(T(x), T(np.zeros((1, 2, 8), np.float32)),
+                           T(np.zeros((1, 2, 8), np.float32)), hidden_size=8)
+        assert out.shape == [2, 5, 8]
+        g = F.dynamic_gru(T(x), 6)
+        assert g.shape == [2, 5, 6]
+
+
+class TestDetection:
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 15.]],
+                          np.float32)
+        gt = np.array([[1., 1., 9., 9.]], np.float32)
+        enc = F.box_coder(T(priors), None, T(gt),
+                          code_type="encode_center_size")
+        dec = F.box_coder(T(priors), None, enc,
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy()[0, 0], gt[0], atol=1e-4)
+        np.testing.assert_allclose(dec.numpy()[0, 1], gt[0], atol=1e-4)
+
+    def test_anchor_and_prior_shapes(self):
+        fm = T(np.zeros((1, 8, 4, 4), np.float32))
+        img = T(np.zeros((1, 3, 64, 64), np.float32))
+        a, v = F.anchor_generator(fm, anchor_sizes=[32.],
+                                  aspect_ratios=[1.0], stride=[16., 16.])
+        assert a.shape == [4, 4, 1, 4] and v.shape == [4, 4, 1, 4]
+        p, pv = F.prior_box(fm, img, min_sizes=[16.], aspect_ratios=[1.0])
+        assert p.shape == [4, 4, 1, 4]
+        d, dv = F.density_prior_box(fm, img, densities=[2],
+                                    fixed_sizes=[16.], fixed_ratios=[1.0])
+        assert d.shape == [4, 4, 4, 4]
+
+    def test_bipartite_match(self):
+        sim = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+        rows, dist = F.bipartite_match(T(sim))
+        np.testing.assert_array_equal(rows.numpy()[0], [0, 1])
+        np.testing.assert_allclose(dist.numpy()[0], [0.9, 0.8])
+
+    def test_multiclass_nms_static_shape(self):
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10.1, 10.1],
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([[0.0, 0.0, 0.0],      # background row
+                           [0.9, 0.85, 0.6]], np.float32)  # class 1
+        out = F.multiclass_nms(T(boxes), T(scores), score_threshold=0.5,
+                               keep_top_k=3, nms_threshold=0.5).numpy()
+        assert out.shape == (3, 6)
+        kept = out[out[:, 0] >= 0]
+        assert len(kept) == 2  # overlapping pair suppressed to one + far box
+
+    def test_box_clip(self):
+        b = np.array([[-5., -5., 200., 50.]], np.float32)
+        im = np.array([[100., 100., 1.0]], np.float32)
+        out = F.box_clip(T(b), T(im)).numpy()
+        np.testing.assert_allclose(out[0], [0, 0, 99, 50])
+
+    def test_generate_proposals_static(self):
+        rng = np.random.RandomState(0)
+        scores = rng.rand(1, 3, 4, 4).astype(np.float32)
+        deltas = (rng.rand(1, 12, 4, 4).astype(np.float32) - 0.5) * 0.1
+        fm = T(np.zeros((1, 8, 4, 4), np.float32))
+        anchors, var = F.anchor_generator(fm, anchor_sizes=[16., 32., 48.][:1],
+                                          aspect_ratios=[0.5, 1.0, 2.0],
+                                          stride=[16., 16.])
+        im_info = T(np.array([[64., 64., 1.0]], np.float32))
+        rois, s = F.generate_proposals(T(scores), T(deltas), im_info,
+                                       anchors, var, pre_nms_top_n=30,
+                                       post_nms_top_n=10)
+        assert rois.shape == [10, 4]
+
+    def test_roi_pool_and_yolo_box(self):
+        x = np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32)
+        rois = np.array([[0., 0., 4., 4.]], np.float32)
+        out = F.roi_pool(T(x), T(rois), output_size=2)
+        assert out.shape == [1, 2, 2, 2]
+        ylo = np.random.RandomState(1).rand(1, 2 * 7, 4, 4).astype(np.float32)
+        boxes, sc = F.yolo_box(T(ylo), T(np.array([[64, 64]], np.int32)),
+                               anchors=[10, 13, 16, 30], class_num=2)
+        assert boxes.shape[0] == 1 and boxes.shape[-1] == 4
+
+    def test_distribute_and_collect_fpn(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 100, 100]], np.float32)
+        outs, restore = F.distribute_fpn_proposals(T(rois), 2, 5, 4, 224)
+        assert len(outs) == 4
+        col = F.collect_fpn_proposals(
+            [T(rois)], [T(np.array([0.9, 0.8], np.float32))], 2, 5,
+            post_nms_top_n=2)
+        assert col.shape == [2, 4]
+
+    def test_yolov3_loss_finite(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3 * 7, 4, 4).astype(np.float32)
+        gt_box = np.array([[[0.5, 0.5, 0.3, 0.4]]], np.float32)
+        gt_lbl = np.array([[1]], np.int64)
+        loss = F.yolov3_loss(T(x), T(gt_box), T(gt_lbl),
+                             anchors=[10, 13, 16, 30, 33, 23],
+                             anchor_mask=[0, 1, 2], class_num=2)
+        assert np.isfinite(float(np.asarray(loss.numpy()).ravel()[0]))
