@@ -16,3 +16,58 @@ from .base import (  # noqa: F401
     distributed_optimizer, distributed_model,
 )
 from .meta import apply_strategy, build_hybrid_train_step  # noqa: F401
+
+
+class UtilBase:
+    """Fleet util helpers (ref: python/paddle/distributed/fleet/base/
+    util_factory.py): small collective conveniences over the jax backend."""
+
+    def all_reduce(self, input, mode="sum"):  # noqa: A002
+        from ..collective import ReduceOp, all_reduce as _ar
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN, "avg": ReduceOp.AVG}[mode]
+        return _ar(input, op=op)
+
+    def barrier(self):
+        from ..collective import barrier as _b
+        _b()
+
+    def all_gather(self, input):  # noqa: A002
+        from ..collective import all_gather as _ag
+        out = []
+        _ag(out, input)
+        return out
+
+
+class Role:
+    """ref: fleet/base/role_maker.py role enum."""
+    WORKER = 1
+    SERVER = 2
+
+
+class MultiSlotDataGenerator:
+    """Slot-format data generator contract (ref: fleet/data_generator/).
+    Subclasses implement generate_sample(line) yielding (slot, values)
+    pairs; run() streams stdin to stdout in the slot text protocol."""
+
+    def set_batch(self, batch_size):
+        self._batch = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            g = self.generate_sample(line)
+            for rec in (g() if callable(g) else g):
+                parts = []
+                for _, vals in rec:
+                    parts.append(str(len(vals)))
+                    parts += [str(v) for v in vals]
+                sys.stdout.write(" ".join(parts) + "\n")
+
+
+import sys as _sys  # noqa: E402
+
+metrics = _sys.modules[__name__]

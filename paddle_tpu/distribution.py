@@ -151,3 +151,63 @@ class Bernoulli(Distribution):
 
 def kl_divergence(p, q):
     return p.kl_divergence(q)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (ref:
+    python/paddle/distribution.py MultivariateNormalDiag)."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(jnp.asarray(loc))
+        self.scale = scale if isinstance(scale, Tensor) \
+            else Tensor(jnp.asarray(scale))
+
+    def sample(self, shape=()):
+        from .core import rng
+        lv = self.loc._value
+        d = self._diag()
+        eps = jax.random.normal(rng.next_key(),
+                                tuple(shape) + lv.shape, lv.dtype)
+        return Tensor(lv + d * eps)
+
+    def _diag(self):
+        """Per-dimension stddevs. `scale` is a diagonal vector (possibly
+        batched, same shape as loc); a full matrix form (loc.ndim+1 dims with
+        square trailing axes) has its diagonal extracted."""
+        sv = self.scale._value
+        lv = self.loc._value
+        if sv.ndim == lv.ndim + 1 and sv.shape[-1] == sv.shape[-2]:
+            return jnp.diagonal(sv, axis1=-2, axis2=-1)
+        return sv
+
+    def log_prob(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        d = self._diag()
+        z = (v - self.loc._value) / d
+        return Tensor(-0.5 * jnp.sum(z * z, -1)
+                      - jnp.sum(jnp.log(d), -1)
+                      - 0.5 * d.shape[-1] * jnp.log(2 * jnp.pi))
+
+    def entropy(self):
+        d = self._diag()
+        k = d.shape[-1]
+        return Tensor(0.5 * k * (1 + jnp.log(2 * jnp.pi))
+                      + jnp.sum(jnp.log(d), -1))
+
+    def kl_divergence(self, other):
+        d0, d1 = self._diag(), other._diag()
+        m0, m1 = self.loc._value, other.loc._value
+        return Tensor(jnp.sum(jnp.log(d1) - jnp.log(d0)
+                              + (d0 ** 2 + (m0 - m1) ** 2) / (2 * d1 ** 2)
+                              - 0.5, -1))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):  # noqa: A002
+    """Sample a column index per row from a probability matrix (ref:
+    sampling_id_op.cc)."""
+    from .core import dtype as dtype_mod
+    from .core import rng
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    key = rng.next_key() if seed == 0 else jax.random.key(seed)
+    idx = jax.random.categorical(key, jnp.log(jnp.maximum(xv, 1e-30)), -1)
+    return Tensor(idx.astype(dtype_mod.convert_dtype(dtype)))

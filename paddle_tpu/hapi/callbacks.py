@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import time
 
+import numpy as np
+
 
 class Callback:
     def set_model(self, model):
@@ -128,3 +130,86 @@ class LRScheduler(Callback):
         opt = getattr(self.model, "_optimizer", None)
         if opt is not None and hasattr(opt._lr, "step"):
             opt._lr.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a monitored metric plateaus (ref:
+    python/paddle/hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if hasattr(cur, "__len__") else cur)
+        better = (self._best is None
+                  or (self.mode == "max" and cur > self._best + self.min_delta)
+                  or (self.mode != "max" and cur < self._best - self.min_delta))
+        if better:
+            self._best = cur
+            self._wait = 0
+            return
+        if self._cool > 0:
+            self._cool -= 1
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = max(float(opt.get_lr()) * self.factor, self.min_lr)
+                opt.set_lr(lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {lr:.2e}")
+            self._wait = 0
+            self._cool = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (ref: python/paddle/hapi/callbacks.py
+    VisualDL). The visualdl package isn't available in this environment, so
+    scalars append to a jsonl file under log_dir — same information, greppable."""
+
+    def __init__(self, log_dir="vdl_log"):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        rec = {"tag": tag, "step": self._step}
+        if not isinstance(logs, dict):
+            logs = {"value": logs} if logs is not None else {}
+        for k, v in logs.items():
+            try:
+                rec[k] = float(np.ravel(np.asarray(v, dtype=np.float64))[0])
+            except (TypeError, ValueError):
+                continue
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train":
+            return
+        self._step += 1
+        if self._step % 10 == 0:
+            self._write("train", logs)
+
+    def on_end(self, mode, logs=None):
+        self._write(mode, logs)

@@ -44,3 +44,5 @@ class LookAhead:
     def minimize(self, loss):
         loss.backward()
         self.step()
+
+from ..io import native_loader as reader  # noqa: E402,F401

@@ -185,3 +185,43 @@ def calculate_gain(nonlinearity, param=None):
              "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
              "selu": 3.0 / 4}
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for conv_transpose (ref:
+    python/paddle/fluid/initializer.py BilinearInitializer)."""
+
+    def __call__(self, shape, dtype="float32"):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        weight = np.zeros(shape, dtype="float32")
+        c_out, c_in, h, w = shape
+        f = np.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape[2:]))):
+            x = i % w
+            y = (i // w) % h
+            val = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[:, :, y, x] = val
+        return jnp.asarray(weight, self._dt(dtype))
+
+
+_global_initializer = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializer for subsequently-created params (ref:
+    fluid/initializer.py set_global_initializer)."""
+    _global_initializer["weight"] = weight_init
+    _global_initializer["bias"] = bias_init
+
+
+import sys as _sys  # noqa: E402
+
+_self = _sys.modules[__name__]
+assign = _self
+constant = _self
+kaiming = _self
+normal = _self
+uniform = _self
+xavier = _self

@@ -40,7 +40,10 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype
-        init = attr.initializer or default_initializer
+        from ..initializer import _global_initializer
+        init = (attr.initializer
+                or _global_initializer["bias" if is_bias else "weight"]
+                or default_initializer)
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(shape, dtype)

@@ -71,3 +71,110 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     path = jnp.concatenate([jnp.flip(path_rev, 0),
                             last[None]], axis=0)
     return Tensor(scores), Tensor(jnp.moveaxis(path, 0, 1))
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (ref: python/paddle/text/datasets/imikolov.py);
+    synthetic corpus in the zero-egress environment."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        n = 5000 if mode == "train" else 500
+        rng = np.random.RandomState(11)
+        vocab = 2000
+        self.window_size = window_size
+        corpus = rng.zipf(1.5, n + window_size) % vocab
+        self.samples = [corpus[i:i + window_size].astype(np.int64)
+                        for i in range(n)]
+
+    def __getitem__(self, idx):
+        s = self.samples[idx]
+        return tuple(s[:-1]) + (s[-1:],)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """MovieLens ratings (ref: python/paddle/text/datasets/movielens.py);
+    synthetic (user, gender, age, job, movie, category, title, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        rng = np.random.RandomState(rand_seed)
+        n = 4000 if mode == "train" else 400
+        self.rows = [(
+            rng.randint(1, 6041), rng.randint(0, 2), rng.randint(0, 7),
+            rng.randint(0, 21), rng.randint(1, 3953),
+            rng.randint(0, 19, 3).astype(np.int64),
+            rng.randint(1, 5000, 4).astype(np.int64),
+            np.float32(rng.randint(1, 6)),
+        ) for _ in range(n)]
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (ref: python/paddle/text/datasets/
+    uci_housing.py); synthetic 13-feature rows."""
+
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(3)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + rng.randn(n) * 0.1).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx:idx + 1]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL dataset (ref: python/paddle/text/datasets/conll05.py);
+    synthetic (word, predicate, ctx windows, mark, label) id rows."""
+
+    WORD_DICT_LEN = 44068
+    LABEL_DICT_LEN = 59
+    PRED_DICT_LEN = 3162
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train"):
+        rng = np.random.RandomState(5)
+        n = 1000 if mode == "train" else 100
+        self.rows = []
+        for _ in range(n):
+            ln = rng.randint(5, 30)
+            words = rng.randint(0, self.WORD_DICT_LEN, ln).astype(np.int64)
+            pred = np.full(ln, rng.randint(0, self.PRED_DICT_LEN),
+                           np.int64)
+            mark = (rng.rand(ln) < 0.1).astype(np.int64)
+            label = rng.randint(0, self.LABEL_DICT_LEN, ln).astype(np.int64)
+            ctx = [np.roll(words, s) for s in (-2, -1, 0, 1, 2)]
+            self.rows.append(tuple([words] + ctx + [pred, mark, label]))
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class WMT16(WMT14):
+    """WMT16 en-de (ref: python/paddle/text/datasets/wmt16.py); same synthetic
+    contract as WMT14 with a BPE-sized vocab."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en"):
+        super().__init__(data_file, mode, dict_size=src_dict_size)
+
+
+import sys as _sys  # noqa: E402
+
+datasets = _sys.modules[__name__]  # paddle.text.datasets alias

@@ -30,3 +30,44 @@ def deprecated(since=None, update_to=None, reason=None):
     def deco(fn):
         return fn
     return deco
+
+
+def download(url, path=None, md5sum=None, **kw):
+    """ref: python/paddle/utils/download.py — no network egress here; callers
+    must point datasets at local files."""
+    raise RuntimeError(
+        "network downloads are unavailable in this environment; pass "
+        "data_file= pointing at a local copy instead")
+
+
+def dump_config(config, path=None):
+    import json
+    s = json.dumps(config, indent=2, default=str)
+    if path:
+        with open(path, "w") as f:
+            f.write(s)
+    return s
+
+
+def require_version(min_version, max_version=None):
+    from ..version import full_version
+
+    def _tup(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+    cur = _tup(full_version)
+    if _tup(min_version) > cur:
+        raise Exception(
+            f"paddle_tpu>={min_version} required, found {full_version}")
+    if max_version and _tup(max_version) < cur:
+        raise Exception(
+            f"paddle_tpu<={max_version} required, found {full_version}")
+
+
+def load_op_library(lib_path):
+    """Custom-op loading (ref: utils/op_version.py era API). Native TPU ops
+    are Pallas kernels; C runtime extensions load via ctypes."""
+    import ctypes
+    return ctypes.CDLL(lib_path)
+
+
+from ..core import unique_name  # noqa: E402,F401

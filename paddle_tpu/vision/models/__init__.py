@@ -7,3 +7,6 @@ from .resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+
+from . import mobilenet as mobilenetv1  # noqa: E402,F401
+from . import mobilenet as mobilenetv2  # noqa: E402,F401

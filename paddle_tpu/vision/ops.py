@@ -127,3 +127,20 @@ def yolo_box_decode(pred, anchors, downsample_ratio=32, class_num=80,
     boxes = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
                       axis=-1)
     return boxes.reshape(b, -1, 4), conf.reshape(b, -1)
+
+
+# reference public names (ref: python/paddle/vision/ops.py __all__)
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0):
+    from ..nn.functional.detection import yolo_box as _yb
+    return _yb(x, img_size, anchors, class_num, conf_thresh,
+               downsample_ratio, clip_bbox, name, scale_x_y)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh=0.7, downsample_ratio=32, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    from ..nn.functional.detection import yolov3_loss
+    return yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                       ignore_thresh, downsample_ratio, gt_score,
+                       use_label_smooth, name, scale_x_y)

@@ -179,3 +179,132 @@ def hflip(img):
 
 def vflip(img):
     return np.asarray(img)[::-1].copy()
+
+import sys as _sys  # noqa: E402
+
+_self = _sys.modules[__name__]
+functional = _self
+transforms = _self
+
+
+# functional transforms (ref: python/paddle/vision/transforms/functional.py);
+# images are numpy HWC (or CHW for tensors) — no PIL dependency
+def _hwc(img):
+    import numpy as np
+    a = np.asarray(img)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[2] not in (1, 3)
+    return (a.transpose(1, 2, 0), True) if chw else (a, False)
+
+
+def _restore(a, was_chw):
+    return a.transpose(2, 0, 1) if was_chw else a
+
+
+def crop(img, top, left, height, width):
+    a, chw = _hwc(img)
+    return _restore(a[top:top + height, left:left + width], chw)
+
+
+def center_crop(img, output_size):
+    a, chw = _hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    th, tw = output_size
+    i = max((a.shape[0] - th) // 2, 0)
+    j = max((a.shape[1] - tw) // 2, 0)
+    return _restore(a[i:i + th, j:j + tw], chw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    import numpy as np
+    a, chw = _hwc(img)
+    if isinstance(padding, int):
+        padding = (padding,) * 4
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    pads = [(t, b), (l, r)] + [(0, 0)] * (a.ndim - 2)
+    if padding_mode == "constant":
+        out = np.pad(a, pads, constant_values=fill)
+    else:
+        out = np.pad(a, pads, mode={"edge": "edge", "reflect": "reflect",
+                                    "symmetric": "symmetric"}[padding_mode])
+    return _restore(out, chw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    import numpy as np
+    a, chw = _hwc(img)
+    k = int(round(angle / 90.0)) % 4
+    if abs(angle - 90.0 * round(angle / 90.0)) < 1e-6:
+        out = np.rot90(a, k)  # right-angle fast path, no resampling
+    else:
+        # nearest-neighbour rotation about the image center
+        h, w = a.shape[:2]
+        cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+            else (center[1], center[0])
+        rad = np.deg2rad(angle)
+        ys, xs = np.mgrid[0:h, 0:w]
+        sy = cy + (ys - cy) * np.cos(rad) - (xs - cx) * np.sin(rad)
+        sx = cx + (ys - cy) * np.sin(rad) + (xs - cx) * np.cos(rad)
+        yi = np.clip(np.round(sy).astype(int), 0, h - 1)
+        xi = np.clip(np.round(sx).astype(int), 0, w - 1)
+        valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
+        out = a[yi, xi]
+        out[~valid] = fill
+    return _restore(out, chw)
+
+
+def to_grayscale(img, num_output_channels=1):
+    import numpy as np
+    a, chw = _hwc(img)
+    gray = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+            + 0.114 * a[..., 2]).astype(a.dtype)
+    out = np.stack([gray] * num_output_channels, axis=-1)
+    return _restore(out, chw)
+
+
+def adjust_brightness(img, brightness_factor):
+    import numpy as np
+    a, chw = _hwc(img)
+    hi = 255 if a.dtype == np.uint8 else 1.0
+    return _restore(np.clip(a * brightness_factor, 0, hi).astype(a.dtype),
+                    chw)
+
+
+def adjust_contrast(img, contrast_factor):
+    import numpy as np
+    a, chw = _hwc(img)
+    hi = 255 if a.dtype == np.uint8 else 1.0
+    mean = a.mean()
+    out = np.clip((a - mean) * contrast_factor + mean, 0, hi).astype(a.dtype)
+    return _restore(out, chw)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via RGB<->HSV."""
+    import numpy as np
+    a, chw = _hwc(img)
+    scale = 255.0 if a.dtype == np.uint8 else 1.0
+    x = a.astype(np.float32) / scale
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    rgb = np.select(
+        [i[..., None] == k for k in range(6)],
+        [np.stack(c, -1) for c in
+         [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]])
+    out = (rgb * scale).astype(a.dtype)
+    return _restore(out, chw)
