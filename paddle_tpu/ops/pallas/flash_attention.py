@@ -172,25 +172,34 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     def body(j, acc):
         k = k_ref[pl.ds(j * block_k, block_k), :]
         v = v_ref[pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
+                           qi * bq, j * block_k)
         return acc + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     acc = jax.lax.fori_loop(0, nk_eff,
                             body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[:] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0):
+    """Shared backward tile math: recompute probabilities from the stored LSE
+    and form ds = p * (dO·v^T - delta). Used by all three backward kernels so
+    masking/lse/dtype fixes land in exactly one place. Returns (p, ds) with
+    p in the dO dtype and ds in the k dtype (MXU-ready)."""
+    bq, bk = q.shape[0], k.shape[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta)).astype(k.dtype)
+    return p.astype(do.dtype), ds
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -214,23 +223,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[pl.ds(i * block_q, block_q), :]
         lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
         delta = delta_ref[pl.ds(i * block_q, block_q), 0:1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
+                           i * block_q, ki * bk)
         dv_acc = dv_acc + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
         dk_acc = dk_acc + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
@@ -238,6 +237,92 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_acc, dv_acc = jax.lax.fori_loop(first_q, nq, body, (z, z))
     dk_ref[:] = (dk_acc * scale).astype(dk_ref.dtype)
     dv_ref[:] = dv_acc.astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_acc, *, scale, causal,
+                      block_q, sq, nk):
+    """One-pass backward: grid over k-blocks (sequential per (b,h) row), q
+    streamed inside. Computes p = exp(s - lse) ONCE per (i,j) tile and feeds
+    all three grads: dv_j += p^T dO_i, dk_j += ds^T q_i, and dq_i accumulated
+    across j in a VMEM scratch flushed on the last k-block. Versus separate
+    dq/dkv kernels this halves the exp work and drops two of seven dots."""
+    bk, d = k_ref.shape
+    ki = pl.program_id(1)
+    k = k_ref[:]
+    v = v_ref[:]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    nq = sq // block_q
+    first_q = (ki * bk) // block_q if causal else 0
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
+        delta = delta_ref[pl.ds(i * block_q, block_q), 0:1]
+        p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
+                           i * block_q, ki * bk)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_tile = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_acc[pl.ds(i * block_q, block_q), :] += dq_tile
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(first_q, nq, body, (z, z))
+    dk_ref[:] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv_acc.astype(dv_ref.dtype)
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        dq_ref[:] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, o, lse, g, scale, causal, block_q, block_k,
+                     interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    q3, k3, v3 = (x.reshape(b * h, x.shape[2], d) for x in (q, k, v))
+    do3 = g.reshape(b * h, sq, d)
+    delta3 = jnp.broadcast_to(delta.reshape(b * h, sq, 1),
+                              (b * h, sq, LSE_LANES))
+    mem_kwargs = {}
+    if _HAS_TPU_PALLAS and not interpret:
+        mem_kwargs = {"memory_space": pltpu.VMEM}
+    scratch = [pltpu.VMEM((sq, d), jnp.float32)]
+
+    qfull = pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0), **mem_kwargs)
+    kcol = pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0), **mem_kwargs)
+    vec_full = pl.BlockSpec((None, sq, LSE_LANES), lambda i, j: (i, 0, 0),
+                            **mem_kwargs)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=bq, sq=sq, nk=sk // bk),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        grid=(b * h, sk // bk),
+        in_specs=[qfull, kcol, kcol, qfull, vec_full, vec_full],
+        out_specs=(qfull, kcol, kcol),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **_compiler_params(("parallel", "arbitrary")),
+    )(q3, k3, v3, do3, lse, delta3)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
@@ -332,6 +417,15 @@ def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    # Fused single-pass backward VMEM residency per (b,h) grid row:
+    # dq_acc scratch (sq*d f32) + q, dO inputs and dq output window
+    # (sq*d bf16 each) + lse/delta (~sq*8 f32 each) + double-buffered
+    # k/v/dk/dv column blocks. Budget the sq-proportional part (~10 bytes
+    # per sq*d element) at 8MB of the ~16MB core; larger shapes take the
+    # two-kernel path whose dkv pass pins only q/dO (no f32 accumulator).
+    if _HAS_TPU_PALLAS and q.shape[2] * q.shape[3] * 10 <= 8 * 1024 * 1024:
+        return _flash_bwd_fused(q, k, v, out, lse, g, scale, causal, block_q,
+                                block_k, interpret)
     return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
                       interpret)
 
