@@ -199,3 +199,10 @@ def monkey_patch_math_varbase():  # pragma: no cover - Tensor methods are
 
 def monkey_patch_variable():  # pragma: no cover
     pass
+
+
+# star-import from here must export only the legacy alias names — not rebind
+# paddle_tpu.np / paddle_tpu.ops / paddle_tpu.Tensor at top level (ADVICE r1)
+__all__ = [_n for _n in list(globals())
+           if not _n.startswith("_")
+           and _n not in ("np", "ops", "Tensor", "annotations")]

@@ -68,6 +68,4 @@ class MultiSlotDataGenerator:
                 sys.stdout.write(" ".join(parts) + "\n")
 
 
-import sys as _sys  # noqa: E402
-
-metrics = _sys.modules[__name__]
+from . import metrics  # noqa: E402,F401

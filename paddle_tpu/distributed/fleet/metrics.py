@@ -1,0 +1,97 @@
+"""Fleet distributed metrics.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py — each function
+all-reduces a host-side metric accumulator across workers (MPI in the
+reference) then finishes the statistic locally. TPU-first: the cross-worker
+reduce goes through jax's multi-host collective when a distributed world is
+initialized (`jax.distributed` / process_count > 1); single-process it is the
+identity, which matches the reference run on one worker.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _all_reduce_np(arr, mode="sum"):
+    """All-reduce a host numpy array across processes (multi-host), identity
+    on a single process. Uses jax's cross-process collective over the global
+    device set so no MPI dependency is needed. A multi-process reduce that
+    fails raises — silently returning the local value would report per-worker
+    statistics as global ones."""
+    if mode not in ("sum", "max", "min"):
+        raise ValueError(f"unsupported reduce mode {mode!r}")
+    arr = np.asarray(arr, np.float64)
+    try:
+        import jax
+        n_proc = jax.process_count()
+    except Exception:
+        return arr  # jax backend not initialized — single-process eager use
+    if n_proc <= 1:
+        return arr
+    import jax.numpy as jnp
+    from jax.experimental.multihost_utils import process_allgather
+    gathered = np.asarray(process_allgather(jnp.asarray(arr)))
+    return {"sum": gathered.sum, "max": gathered.max,
+            "min": gathered.min}[mode](axis=0)
+
+
+def _to_np(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def sum(input, scope=None):  # noqa: A001,A002
+    return _all_reduce_np(_to_np(input), "sum")
+
+
+def max(input, scope=None):  # noqa: A001,A002
+    return _all_reduce_np(_to_np(input), "max")
+
+
+def min(input, scope=None):  # noqa: A001,A002
+    return _all_reduce_np(_to_np(input), "min")
+
+
+def auc(stat_pos, stat_neg, scope=None):
+    """ROC AUC from the per-bucket pos/neg counters produced by the auc op
+    (ref formula: trapezoid sweep from the top bucket down)."""
+    global_pos = _all_reduce_np(_to_np(stat_pos), "sum").reshape(1, -1)
+    global_neg = _all_reduce_np(_to_np(stat_neg), "sum").reshape(1, -1)
+    num_bucket = global_pos.shape[1]
+    area = pos = neg = 0.0
+    total_ins_num = 0.0
+    for i in range(num_bucket):
+        index = num_bucket - 1 - i
+        new_pos = pos + global_pos[0][index]
+        total_ins_num += global_pos[0][index]
+        new_neg = neg + global_neg[0][index]
+        total_ins_num += global_neg[0][index]
+        area += (new_neg - neg) * (pos + new_pos) / 2
+        pos, neg = new_pos, new_neg
+    if pos * neg == 0 or total_ins_num == 0:
+        return 0.5
+    return float(area / (pos * neg))
+
+
+def mae(abserr, total_ins_num, scope=None):
+    # reference contract (metric.py mae): only the error accumulator is
+    # all-reduced; total_ins_num is the caller-supplied GLOBAL instance count
+    err = _all_reduce_np(_to_np(abserr), "sum")
+    return float(err.sum() / total_ins_num)
+
+
+def rmse(sqrerr, total_ins_num, scope=None):
+    err = _all_reduce_np(_to_np(sqrerr), "sum")
+    return float((err.sum() / total_ins_num) ** 0.5)
+
+
+def mse(sqrerr, total_ins_num, scope=None):
+    err = _all_reduce_np(_to_np(sqrerr), "sum")
+    return float(err.sum() / total_ins_num)
+
+
+def acc(correct, total, scope=None):
+    c = _all_reduce_np(_to_np(correct), "sum")
+    t = _all_reduce_np(_to_np(total), "sum")
+    return float(c.sum() / t.sum())

@@ -98,7 +98,8 @@ from .legacy import (  # noqa: F401,E402
     dice_loss, dynamic_gru, dynamic_lstm, dynamic_lstmp, erf, fc,
     filter_by_instag, fsp_matrix, gather_tree, gru_unit, hash,
     hsigmoid_loss, im2sequence, image_resize, image_resize_short,
-    linear_chain_crf, crf_decoding, lod_append, lod_reset, lstm, lstm_unit,
+    legacy_param_store, linear_chain_crf, crf_decoding, lod_append,
+    lod_reset, lstm, lstm_unit,
     merge_selected_rows, nce, pad2d, pad_constant_like, polygon_box_transform,
     pool2d, pool3d, random_crop, reorder_lod_tensor_by_rank, resize_bilinear,
     resize_nearest, resize_trilinear, row_conv, smooth_l1, soft_relu,

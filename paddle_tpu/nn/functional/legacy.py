@@ -19,29 +19,138 @@ def _val(x):
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+def _is_concrete(v):
+    """True when v is a real array (not a jax tracer) — safe to store in a
+    python-side buffer without capturing a leaked tracer."""
+    import jax.core
+    return not isinstance(v, jax.core.Tracer)
+
+
+class LegacyParamStore:
+    """Name-keyed registry backing the fluid-1.x eager functional shims.
+
+    In the reference these APIs create program parameters with unique
+    auto-generated names (python/paddle/fluid/layer_helper.py
+    create_parameter); re-calling with ``ParamAttr(name=...)`` reuses the
+    named parameter. The eager rebuild mirrors that contract:
+
+    - an UNNAMED call creates fresh parameters every time (two same-shape
+      calls are fully independent — nothing is shared by shape);
+    - a NAMED call (``name=`` or ``ParamAttr(name=...)``) creates the
+      parameter once in this store and reuses it, so it can be handed to an
+      optimizer via ``legacy_param_store().parameters()`` / trained.
+
+    Buffers (e.g. center_loss centers, CRF transitions) live here too so
+    they persist across calls without module-global shape-keyed dicts.
+    """
+
+    def __init__(self):
+        self._params = {}   # name -> Parameter
+        self._layers = {}   # name -> nn.Layer
+        self._buffers = {}  # name -> jnp array
+
+    def parameter(self, name, shape, dtype="float32", initializer=None):
+        p = self._params.get(name)
+        if p is not None:
+            if tuple(p.shape) != tuple(shape):
+                raise ValueError(
+                    f"legacy parameter {name!r} exists with shape "
+                    f"{tuple(p.shape)}, requested {tuple(shape)}")
+            return p
+        from ...core.tensor import Parameter
+        from .. import initializer as I
+        init = initializer or I.XavierUniform()
+        p = Parameter(init(tuple(shape), dtype))
+        self._params[name] = p
+        return p
+
+    def layer(self, name, factory):
+        lyr = self._layers.get(name)
+        if lyr is None:
+            lyr = factory()
+            self._layers[name] = lyr
+        return lyr
+
+    def buffer(self, name, default_fn):
+        b = self._buffers.get(name)
+        if b is None:
+            b = default_fn()
+            if _is_concrete(b):  # don't capture a tracer created under jit
+                self._buffers[name] = b
+        return b
+
+    def set_buffer(self, name, value):
+        if _is_concrete(value):  # never store a traced value (jit-safety)
+            self._buffers[name] = value
+
+    def parameters(self):
+        out = list(self._params.values())
+        for lyr in self._layers.values():
+            out.extend(lyr.parameters())
+        return out
+
+    def state_dict(self):
+        sd = {}
+        for k, p in self._params.items():
+            sd[k] = p
+        for lname, lyr in self._layers.items():
+            for k, v in lyr.state_dict().items():
+                sd[f"{lname}.{k}"] = v
+        for k, b in self._buffers.items():
+            sd[f"buffer/{k}"] = Tensor(b)
+        return sd
+
+    def clear(self):
+        self._params.clear()
+        self._layers.clear()
+        self._buffers.clear()
+
+
+_store = LegacyParamStore()
+
+
+def legacy_param_store():
+    """The process-wide store of parameters created by named fluid-1.x shim
+    calls (``fc(name=...)`` etc.). Pass ``legacy_param_store().parameters()``
+    to an optimizer to train them."""
+    return _store
+
+
+def _attr_name(name, attr):
+    if name:
+        return name
+    return getattr(attr, "name", None) if attr is not None else None
+
+
 # ---- dense / elementwise ----
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     """1.x fully-connected: flatten trailing dims then project (ref:
-    fluid/layers/nn.py fc). Weight is created on first call via Linear."""
+    fluid/layers/nn.py fc). Unnamed calls create fresh weights each time
+    (reference static-graph semantics: one new program parameter per call);
+    pass ``name=`` to create-once/reuse via the LegacyParamStore."""
     from .. import Linear
     xv = _val(x)
     lead = xv.shape[:num_flatten_dims]
     flat = xv.reshape(int(np.prod(lead)), -1)
-    layer = fc._cache.get((flat.shape[1], size))
-    if layer is None:
-        layer = Linear(flat.shape[1], size, weight_attr=weight_attr,
-                       bias_attr=bias_attr)
-        fc._cache[(flat.shape[1], size)] = layer
+
+    def factory():
+        return Linear(flat.shape[1], size, weight_attr=weight_attr,
+                      bias_attr=bias_attr)
+
+    pname = _attr_name(name, weight_attr)
+    layer = _store.layer(f"fc/{pname}", factory) if pname else factory()
+    got = tuple(layer.weight.shape)
+    if got != (flat.shape[1], size):
+        raise ValueError(
+            f"fc name {pname!r} exists with weight shape {got}, but this "
+            f"call needs {(flat.shape[1], size)} — use a different name")
     out = layer(Tensor(flat))
     out = ops.reshape(out, list(lead) + [size])
     if activation:
         out = getattr(ops, activation)(out)
     return out
-
-
-fc._cache = {}
 
 
 def erf(x, name=None):
@@ -441,31 +550,43 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, **kw):
     return h, c
 
 
+def _traced(core, name, *args):
+    """Run a pure jnp core through the op tape so Tensor/Parameter args
+    (incl. store-registered named weights) receive gradients."""
+    from ...ops._registry import apply_op
+    return apply_op(core, name, args, {}, False, False)
+
+
 def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
     """Lookahead row convolution (ref: row_conv_op.cc): each step mixes the
     next `future_context_size` frames with learned per-channel weights."""
-    xv = _val(input)  # [B, T, C]
-    c = xv.shape[-1]
-    w = row_conv._cache.get((future_context_size + 1, c))
-    if w is None:
+    c = _val(input).shape[-1]
+    shape = (future_context_size + 1, c)
+    pname = _attr_name(None, param_attr)
+    if pname:
+        w = _store.parameter(f"row_conv/{pname}", shape)
+    else:
         from ...core.tensor import Parameter
         from .. import initializer as I
-        w = Parameter(I.XavierUniform()((future_context_size + 1, c),
-                                        "float32"))
-        row_conv._cache[(future_context_size + 1, c)] = w
-    wv = _val(w)
-    t = xv.shape[1]
-    out = jnp.zeros_like(xv)
-    for i in range(future_context_size + 1):
-        rolled = jnp.roll(xv, -i, axis=1)
-        valid = (jnp.arange(t) + i < t)[None, :, None]
-        out = out + jnp.where(valid, rolled, 0) * wv[i][None, None, :]
+        w = Parameter(I.XavierUniform()(shape, "float32"))
+
+    def core(xv, wv):
+        t = xv.shape[1]
+        out = jnp.zeros_like(xv)
+        for i in range(future_context_size + 1):
+            rolled = jnp.roll(xv, -i, axis=1)
+            valid = (jnp.arange(t) + i < t)[None, :, None]
+            out = out + jnp.where(valid, rolled, 0) * wv[i][None, None, :]
+        return out
+
+    out = _traced(core, "row_conv", _as_tensor(input), w)
     if act:
-        out = _val(getattr(ops, act)(Tensor(out)))
-    return Tensor(out)
+        out = getattr(ops, act)(out)
+    return out
 
 
-row_conv._cache = {}
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(_val(x))
 
 
 def gather_tree(ids, parents):
@@ -515,15 +636,18 @@ def bpr_loss(input, label, name=None):  # noqa: A002
 
 
 def center_loss(input, label, num_classes, alpha, param_attr=None,  # noqa: A002
-                update_center=True):
+                update_center=True, name=None):
     """Distance to per-class centers (ref: center_loss_op.cc); centers are a
-    persistent buffer updated with rate alpha."""
+    persistent name-keyed buffer updated with rate alpha. The write-back is
+    eager-only: under jit the updated centers would be tracers, so the store
+    is left untouched (jit-safe) — train centers eagerly or keep them in
+    your own train state for a fully-jitted loop."""
     iv = _val(input)
     lv = _val(label).reshape(-1).astype(jnp.int32)
-    key = (num_classes, iv.shape[-1])
-    centers = center_loss._centers.get(key)
-    if centers is None:
-        centers = jnp.zeros((num_classes, iv.shape[-1]), iv.dtype)
+    bname = _attr_name(name, param_attr) or \
+        f"center_loss_{num_classes}_{iv.shape[-1]}"
+    centers = _store.buffer(
+        bname, lambda: jnp.zeros((num_classes, iv.shape[-1]), iv.dtype))
     sel = centers[lv]
     diff = iv - sel
     loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
@@ -531,11 +655,8 @@ def center_loss(input, label, num_classes, alpha, param_attr=None,  # noqa: A002
         counts = jnp.zeros((num_classes,), iv.dtype).at[lv].add(1.0)
         upd = jnp.zeros_like(centers).at[lv].add(diff)
         centers = centers + alpha * upd / (counts[:, None] + 1.0)
-        center_loss._centers[key] = centers
+        _store.set_buffer(bname, centers)  # no-op when centers is a tracer
     return Tensor(loss)
-
-
-center_loss._centers = {}
 
 
 def teacher_student_sigmoid_loss(input, label,  # noqa: A002
@@ -562,29 +683,31 @@ def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
     batched gather+matmul."""
     from ...core import rng
     iv = _val(input)  # [N, D]
-    lv = _val(label).reshape(-1).astype(jnp.int32)
     n, d = iv.shape
-    key = (num_total_classes, d)
-    wb = nce._cache.get(key)
-    if wb is None:
-        from .. import initializer as I
-        w = I.XavierUniform()((num_total_classes, d), "float32")
-        b = jnp.zeros((num_total_classes,), jnp.float32)
-        wb = (w, b)
-        nce._cache[key] = wb
-    w, b = wb
+    from .. import initializer as I
+    pname = _attr_name(name, param_attr)
+    if pname:
+        w = _store.parameter(f"nce/{pname}.w", (num_total_classes, d))
+        b = _store.parameter(f"nce/{pname}.b", (num_total_classes,),
+                             initializer=I.Constant(0.0))
+    else:
+        w = Tensor(I.XavierUniform()((num_total_classes, d), "float32"))
+        b = Tensor(jnp.zeros((num_total_classes,), jnp.float32))
     neg = jax.random.randint(rng.next_key(), (n, num_neg_samples), 0,
                              num_total_classes)
-    pos_logit = jnp.sum(iv * w[lv], axis=1) + b[lv]
-    neg_logit = jnp.einsum("nd,nkd->nk", iv, w[neg]) + b[neg]
-    p_noise = 1.0 / num_total_classes
-    ln_k_pn = jnp.log(num_neg_samples * p_noise)
-    pos_loss = -jax.nn.log_sigmoid(pos_logit - ln_k_pn)
-    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-(neg_logit - ln_k_pn)), axis=1)
-    return Tensor((pos_loss + neg_loss)[:, None])
+    lv = _val(label).reshape(-1).astype(jnp.int32)
 
+    def core(iv, w, b):
+        pos_logit = jnp.sum(iv * w[lv], axis=1) + b[lv]
+        neg_logit = jnp.einsum("nd,nkd->nk", iv, w[neg]) + b[neg]
+        p_noise = 1.0 / num_total_classes
+        ln_k_pn = jnp.log(num_neg_samples * p_noise)
+        pos_loss = -jax.nn.log_sigmoid(pos_logit - ln_k_pn)
+        neg_loss = -jnp.sum(jax.nn.log_sigmoid(-(neg_logit - ln_k_pn)),
+                            axis=1)
+        return (pos_loss + neg_loss)[:, None]
 
-nce._cache = {}
+    return _traced(core, "nce", _as_tensor(input), w, b)
 
 
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
@@ -595,7 +718,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
     representation over ceil(log2(C)) internal nodes."""
     iv = _val(input)
     lv = _val(label).reshape(-1).astype(jnp.int32)
-    wv = _val(weight)  # [num_classes-1, D] internal-node params
+    n_nodes = _val(weight).shape[0]
     depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
     if path_table is not None:
         table = _val(path_table).astype(jnp.int32)
@@ -610,16 +733,22 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
             tables.append(node)
         table = jnp.stack(tables[::-1], axis=1)  # [N, depth]
         code = jnp.stack(codes[::-1], axis=1)
-    valid = (table >= 0) & (table < wv.shape[0])
-    tsafe = jnp.clip(table, 0, wv.shape[0] - 1)
-    logits = jnp.einsum("nd,nkd->nk", iv, wv[tsafe])
+    valid = (table >= 0) & (table < n_nodes)
+    tsafe = jnp.clip(table, 0, n_nodes - 1)
+
+    def core(iv, wv, *maybe_bias):
+        logits = jnp.einsum("nd,nkd->nk", iv, wv[tsafe])
+        if maybe_bias:
+            logits = logits + maybe_bias[0].reshape(-1)[tsafe]
+        # bit=1 -> sigmoid(logit), bit=0 -> 1-sigmoid(logit)
+        lo = jnp.where(code > 0.5, jax.nn.log_sigmoid(logits),
+                       jax.nn.log_sigmoid(-logits))
+        return -jnp.sum(jnp.where(valid, lo, 0.0), axis=1, keepdims=True)
+
+    args = [_as_tensor(input), _as_tensor(weight)]
     if bias is not None:
-        logits = logits + _val(bias).reshape(-1)[tsafe]
-    # bit=1 -> sigmoid(logit), bit=0 -> 1-sigmoid(logit)
-    lo = jnp.where(code > 0.5, jax.nn.log_sigmoid(logits),
-                   jax.nn.log_sigmoid(-logits))
-    loss = -jnp.sum(jnp.where(valid, lo, 0.0), axis=1, keepdims=True)
-    return Tensor(loss)
+        args.append(_as_tensor(bias))
+    return _traced(core, "hsigmoid_loss", *args)
 
 
 def linear_chain_crf(input, label, param_attr=None, length=None):  # noqa: A002
@@ -632,10 +761,8 @@ def linear_chain_crf(input, label, param_attr=None, length=None):  # noqa: A002
     if lv.ndim == 3:
         lv = lv.squeeze(-1)
     b, t, n = iv.shape
-    trans = linear_chain_crf._params.get(n)
-    if trans is None:
-        trans = jnp.zeros((n + 2, n), jnp.float32)
-        linear_chain_crf._params[n] = trans
+    trans = _store.buffer(f"crf_transition_{n}",
+                          lambda: jnp.zeros((n + 2, n), jnp.float32))
     start, stop, tr = trans[0], trans[1], trans[2:]
     lens = (_val(length).reshape(-1).astype(jnp.int32) if length is not None
             else jnp.full((b,), t, jnp.int32))
@@ -669,17 +796,13 @@ def linear_chain_crf(input, label, param_attr=None, length=None):  # noqa: A002
     return Tensor((log_z - gold)[:, None])
 
 
-linear_chain_crf._params = {}
-
-
 def crf_decoding(input, param_attr=None, label=None, length=None):  # noqa: A002
     """Viterbi decode using the buffer trained by linear_chain_crf (ref:
     crf_decoding_op.cc)."""
     iv = _val(input).astype(jnp.float32)
     b, t, n = iv.shape
-    trans = linear_chain_crf._params.get(n)
-    if trans is None:
-        trans = jnp.zeros((n + 2, n), jnp.float32)
+    trans = _store.buffer(f"crf_transition_{n}",
+                          lambda: jnp.zeros((n + 2, n), jnp.float32))
     start, stop, tr = trans[0], trans[1], trans[2:]
 
     def step(carry, emis_t):
@@ -715,29 +838,32 @@ def warpctc(input, label, blank=0, norm_by_times=False,  # noqa: A002
 
 def bilinear(x1, x2, weight, bias=None, name=None):
     """Bilinear transform x1^T W x2 (ref: bilinear_tensor_product_op.cc)."""
-    x1v, x2v, wv = _val(x1), _val(x2), _val(weight)
-    out = jnp.einsum("bi,oij,bj->bo", x1v, wv, x2v)
     if bias is not None:
-        out = out + _val(bias)
-    return Tensor(out)
+        def core(x1v, x2v, wv, bv):
+            return jnp.einsum("bi,oij,bj->bo", x1v, wv, x2v) + bv
+        return _traced(core, "bilinear", _as_tensor(x1), _as_tensor(x2),
+                       _as_tensor(weight), _as_tensor(bias))
+
+    def core(x1v, x2v, wv):
+        return jnp.einsum("bi,oij,bj->bo", x1v, wv, x2v)
+    return _traced(core, "bilinear", _as_tensor(x1), _as_tensor(x2),
+                   _as_tensor(weight))
 
 
 def bilinear_tensor_product(x, y, size, act=None, name=None,
                             param_attr=None, bias_attr=None):
     xv, yv = _val(x), _val(y)
-    key = (size, xv.shape[-1], yv.shape[-1])
-    w = bilinear_tensor_product._cache.get(key)
-    if w is None:
+    shape = (size, xv.shape[-1], yv.shape[-1])
+    pname = _attr_name(name, param_attr)
+    if pname:
+        w = _store.parameter(f"bilinear_tensor_product/{pname}", shape)
+    else:
         from .. import initializer as I
-        w = I.XavierUniform()((size, xv.shape[-1], yv.shape[-1]), "float32")
-        bilinear_tensor_product._cache[key] = w
-    out = bilinear(x, y, Tensor(w))
+        w = Tensor(I.XavierUniform()(shape, "float32"))
+    out = bilinear(x, y, w)
     if act:
         out = getattr(ops, act)(out)
     return out
-
-
-bilinear_tensor_product._cache = {}
 
 
 def deformable_conv(input, offset, mask, num_filters, filter_size,  # noqa: A002
@@ -757,52 +883,58 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,  # noqa: A002
     pd = padding if isinstance(padding, (list, tuple)) else (padding, padding)
     ho = (h + 2 * pd[0] - kh) // st[0] + 1
     wo = (w + 2 * pd[1] - kw) // st[1] + 1
-    key = (num_filters, c, kh, kw)
-    wgt = deformable_conv._cache.get(key)
-    if wgt is None:
-        from .. import initializer as I
-        wgt = I.KaimingUniform()((num_filters, c, kh, kw), "float32")
-        deformable_conv._cache[key] = wgt
+    from .. import initializer as I
+    pname = _attr_name(name, param_attr)
+    if pname:
+        wgt = _store.parameter(f"deformable_conv/{pname}",
+                               (num_filters, c, kh, kw),
+                               initializer=I.KaimingUniform())
+    else:
+        wgt = Tensor(I.KaimingUniform()((num_filters, c, kh, kw), "float32"))
 
-    ys = jnp.arange(ho) * st[0] - pd[0]
-    xs = jnp.arange(wo) * st[1] - pd[1]
-    base_y = ys[:, None, None, None] + jnp.arange(kh)[None, None, :, None]
-    base_x = xs[None, :, None, None] + jnp.arange(kw)[None, None, None, :]
-    off = off.reshape(n, deformable_groups, kh, kw, 2, ho, wo)
-    dy = off[:, 0, :, :, 0].transpose(0, 3, 4, 1, 2)  # [N,Ho,Wo,kh,kw]
-    dx = off[:, 0, :, :, 1].transpose(0, 3, 4, 1, 2)
-    py = base_y[None].astype(jnp.float32) + dy
-    px = base_x[None].astype(jnp.float32) + dx
+    use_mask = modulated and mask is not None
 
-    y0 = jnp.floor(py).astype(jnp.int32)
-    x0 = jnp.floor(px).astype(jnp.int32)
-    wy = py - y0
-    wx = px - x0
+    def core(xv, off, wgt, *maybe_mask):
+        ys = jnp.arange(ho) * st[0] - pd[0]
+        xs = jnp.arange(wo) * st[1] - pd[1]
+        base_y = ys[:, None, None, None] + jnp.arange(kh)[None, None, :, None]
+        base_x = xs[None, :, None, None] + jnp.arange(kw)[None, None, None, :]
+        off_r = off.reshape(n, deformable_groups, kh, kw, 2, ho, wo)
+        dy = off_r[:, 0, :, :, 0].transpose(0, 3, 4, 1, 2)  # [N,Ho,Wo,kh,kw]
+        dx = off_r[:, 0, :, :, 1].transpose(0, 3, 4, 1, 2)
+        py = base_y[None].astype(jnp.float32) + dy
+        px = base_x[None].astype(jnp.float32) + dx
 
-    def sample(yy, xx):
-        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
-        yc = jnp.clip(yy, 0, h - 1)
-        xc = jnp.clip(xx, 0, w - 1)
-        g = xv[jnp.arange(n)[:, None, None, None, None], :,
-               yc[:, :, :, :, :, None].squeeze(-1)[..., None].squeeze(-1),
-               xc]  # fancy-gather [N,Ho,Wo,kh,kw,C]
-        return jnp.where(valid[..., None], g, 0.0)
+        y0 = jnp.floor(py).astype(jnp.int32)
+        x0 = jnp.floor(px).astype(jnp.int32)
+        wy = py - y0
+        wx = px - x0
 
-    # gather four corners; einsum applies bilinear weights + conv weights
-    v00 = sample(y0, x0)
-    v01 = sample(y0, x0 + 1)
-    v10 = sample(y0 + 1, x0)
-    v11 = sample(y0 + 1, x0 + 1)
-    val = (v00 * ((1 - wy) * (1 - wx))[..., None]
-           + v01 * ((1 - wy) * wx)[..., None]
-           + v10 * (wy * (1 - wx))[..., None]
-           + v11 * (wy * wx)[..., None])  # [N,Ho,Wo,kh,kw,C]
-    if modulated and mask is not None:
-        mv = _val(mask).reshape(n, deformable_groups, kh, kw, ho, wo)
-        mv = mv[:, 0].transpose(0, 3, 4, 1, 2)
-        val = val * mv[..., None]
-    out = jnp.einsum("nhwklc,ockl->nohw", val, wgt)
-    return Tensor(out)
+        def sample(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            g = xv[jnp.arange(n)[:, None, None, None, None], :,
+                   yc[:, :, :, :, :, None].squeeze(-1)[..., None].squeeze(-1),
+                   xc]  # fancy-gather [N,Ho,Wo,kh,kw,C]
+            return jnp.where(valid[..., None], g, 0.0)
 
+        # gather four corners; einsum applies bilinear weights + conv weights
+        v00 = sample(y0, x0)
+        v01 = sample(y0, x0 + 1)
+        v10 = sample(y0 + 1, x0)
+        v11 = sample(y0 + 1, x0 + 1)
+        val = (v00 * ((1 - wy) * (1 - wx))[..., None]
+               + v01 * ((1 - wy) * wx)[..., None]
+               + v10 * (wy * (1 - wx))[..., None]
+               + v11 * (wy * wx)[..., None])  # [N,Ho,Wo,kh,kw,C]
+        if maybe_mask:
+            mv = maybe_mask[0].reshape(n, deformable_groups, kh, kw, ho, wo)
+            mv = mv[:, 0].transpose(0, 3, 4, 1, 2)
+            val = val * mv[..., None]
+        return jnp.einsum("nhwklc,ockl->nohw", val, wgt)
 
-deformable_conv._cache = {}
+    args = [_as_tensor(input), _as_tensor(offset), wgt]
+    if use_mask:
+        args.append(_as_tensor(mask))
+    return _traced(core, "deformable_conv", *args)

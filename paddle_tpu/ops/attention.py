@@ -44,14 +44,15 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  return_weights=False, key=None):
     """q,k,v: [B, H, S, D] (head-major). Dispatches to flash attention when
     profitable; the weights output is only materialized when requested."""
+    # The Pallas kernels stream K/V (fwd, dq) and Q/dO (dkv) blockwise over
+    # an arbitrary grid dim with online-softmax state in VMEM scratch, so
+    # per-step residency is a few blocks regardless of sequence length —
+    # no VMEM-driven length cap. (The fused one-pass backward, which does
+    # pin full Q/dO, self-gates on sq in _fa_bwd.)
     use_flash = (_on_tpu() and attn_mask is None and dropout_p == 0.0
                  and not return_weights and q.shape[-2] >= 128
                  and q.shape[-1] in (32, 64, 128, 256)
-                 and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
-                 # fwd keeps full K/V and bwd (dkv kernel) full Q/dO for one
-                 # (b,h) in VMEM; past 32k rows that residency (with
-                 # double-buffering) stops fitting
-                 and k.shape[-2] <= 32768 and q.shape[-2] <= 32768)
+                 and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0)
     if use_flash:
         try:
             from .pallas.flash_attention import flash_attention

@@ -48,50 +48,57 @@ LSE_LANES = 8  # lse/delta rows are broadcast over 8 sublanes to satisfy
                # the TPU (8, 128)-tile layout for non-vector shapes
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k, sk):
-    # q_ref: [bq, d]; k_ref/v_ref: [sk, d]; o_ref: [bq, d]
-    # lse_ref: [bq, LSE_LANES] (row value broadcast across lanes)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, nk):
+    # Streaming layout: grid = (b*h, nq, nk), K/V blocks arrive one per grid
+    # step on the innermost ("arbitrary") dim — nothing larger than a block
+    # is ever resident in VMEM, so sequence length is unbounded. Online
+    # softmax state (acc, m, l) is carried in VMEM scratch across k steps.
+    # q_ref: [bq, d]; k_ref/v_ref: [bk, d]; lse_ref: [bq, LSE_LANES].
     bq, d = q_ref.shape
-    qi = pl.program_id(1)  # q block index
-    q = q_ref[:]  # keep input dtype — bf16 feeds the MXU at full rate
+    bk = k_ref.shape[0]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    nk = sk // block_k
-    if causal:
-        # only k-blocks up to and including the diagonal contribute
-        nk_eff = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
-    else:
-        nk_eff = nk
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :]
-        v = v_ref[pl.ds(j * block_k, block_k), :]
+    # causal: tiles strictly above the diagonal contribute nothing
+    run = (ki * bk < (qi + 1) * bq) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[:]  # keep input dtype — bf16 feeds the MXU at full rate
+        k = k_ref[:]
+        v = v_ref[:]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (bq, block_k), 1)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
+    @pl.when(ki == nk - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[:] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[:] = jnp.broadcast_to(m_ref[:, 0:1] + jnp.log(l),
+                                      lse_ref.shape)
 
 
 def _divisor_block(size, block):
@@ -126,9 +133,9 @@ def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
-    grid = (b * h, sq // bq)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=bk, sk=sk)
+    nk = sk // bk
+    grid = (b * h, sq // bq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, nk=nk)
     mem_kwargs = {}
     if _HAS_TPU_PALLAS and not interpret:
         mem_kwargs = {"memory_space": pltpu.VMEM}
@@ -138,49 +145,60 @@ def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
                    jax.ShapeDtypeStruct((b * h, sq, LSE_LANES), jnp.float32)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0), **mem_kwargs),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0), **mem_kwargs),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0), **mem_kwargs),
+            pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0),
+                         **mem_kwargs),
+            pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0),
+                         **mem_kwargs),
+            pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0),
+                         **mem_kwargs),
         ],
         out_specs=(
-            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0), **mem_kwargs),
-            pl.BlockSpec((None, bq, LSE_LANES), lambda i, j: (i, j, 0),
+            pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0),
+                         **mem_kwargs),
+            pl.BlockSpec((None, bq, LSE_LANES), lambda i, j, kk: (i, j, 0),
                          **mem_kwargs),
         ),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, LSE_LANES), jnp.float32),
+                        pltpu.VMEM((bq, LSE_LANES), jnp.float32)],
         interpret=interpret,
-        **_compiler_params(("parallel", "arbitrary")),
+        **_compiler_params(("parallel", "parallel", "arbitrary")),
     )(q3, k3, v3)
     return out.reshape(b, h, sq, d), lse
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, block_k, sk):
-    # grid over q blocks: dq_i = scale * sum_j (p_ij*(dp_ij - delta_i)) @ k_j
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, nk):
+    # Streaming: grid = (b*h, nq, nk); dq_i = scale * sum_j ds_ij @ k_j
+    # accumulated in VMEM scratch across the k steps, flushed on the last.
     bq, d = q_ref.shape
+    bk = k_ref.shape[0]
     qi = pl.program_id(1)
-    q = q_ref[:]
-    do = do_ref[:]
-    lse = lse_ref[:, 0:1]
-    delta = delta_ref[:, 0:1]
+    ki = pl.program_id(2)
 
-    nk = sk // block_k
-    if causal:
-        nk_eff = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
-    else:
-        nk_eff = nk
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    def body(j, acc):
-        k = k_ref[pl.ds(j * block_k, block_k), :]
-        v = v_ref[pl.ds(j * block_k, block_k), :]
+    run = (ki * bk < (qi + 1) * bq) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:, 0:1]
+        delta = delta_ref[:, 0:1]
+        k = k_ref[:]
+        v = v_ref[:]
         p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
-                           qi * bq, j * block_k)
-        return acc + jax.lax.dot_general(
+                           qi * bq, ki * bk)
+        dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    acc = jax.lax.fori_loop(0, nk_eff,
-                            body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[:] = (acc * scale).astype(dq_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _flush():
+        dq_ref[:] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
 def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0):
@@ -203,40 +221,43 @@ def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0):
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, sq):
-    # grid over k blocks: dv_j = sum_i p^T @ dO_i ; dk_j = scale * sum_i ds^T @ q_i
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, nq):
+    # Streaming: grid = (b*h, nk, nq); Q/dO blocks arrive on the innermost
+    # dim; dk_j / dv_j accumulate in VMEM scratch, flushed on the last step.
     bk, d = k_ref.shape
+    bq = q_ref.shape[0]
     ki = pl.program_id(1)
-    k = k_ref[:]
-    v = v_ref[:]
+    qi = pl.program_id(2)
 
-    nq = sq // block_q
-    if causal:
-        # q blocks strictly before the diagonal see nothing of this k block
-        first_q = (ki * bk) // block_q
-    else:
-        first_q = 0
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def body(i, carry):
-        dk_acc, dv_acc = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :]
-        do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
-        delta = delta_ref[pl.ds(i * block_q, block_q), 0:1]
+    # causal: q blocks strictly before the diagonal see nothing of this k blk
+    run = ((qi + 1) * bq > ki * bk) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _compute():
+        k = k_ref[:]
+        v = v_ref[:]
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:, 0:1]
+        delta = delta_ref[:, 0:1]
         p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
-                           i * block_q, ki * bk)
-        dv_acc = dv_acc + jax.lax.dot_general(
+                           qi * bq, ki * bk)
+        dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dk_acc = dk_acc + jax.lax.dot_general(
+        dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
 
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk_acc, dv_acc = jax.lax.fori_loop(first_q, nq, body, (z, z))
-    dk_ref[:] = (dk_acc * scale).astype(dk_ref.dtype)
-    dv_ref[:] = dv_acc.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[:] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -341,41 +362,43 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
     if _HAS_TPU_PALLAS and not interpret:
         mem_kwargs = {"memory_space": pltpu.VMEM}
 
-    row_spec = pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0), **mem_kwargs)
-    full_spec = lambda s: pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0),
-                                       **mem_kwargs)
-    vec_blk = pl.BlockSpec((None, bq, LSE_LANES), lambda i, j: (i, j, 0),
+    nq, nk = sq // bq, sk // bk
+    # dq pass: grid (bh, nq, nk) — q row pinned per j, k/v streamed on kk
+    qrow = pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0),
+                        **mem_kwargs)
+    kstream = pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0),
                            **mem_kwargs)
-    vec_full = pl.BlockSpec((None, sq, LSE_LANES), lambda i, j: (i, 0, 0),
-                            **mem_kwargs)
-
+    vec_row = pl.BlockSpec((None, bq, LSE_LANES), lambda i, j, kk: (i, j, 0),
+                           **mem_kwargs)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=bk, sk=sk),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, nk=nk),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=(b * h, sq // bq),
-        in_specs=[row_spec, full_spec(sk), full_spec(sk), row_spec,
-                  vec_blk, vec_blk],
-        out_specs=row_spec,
+        grid=(b * h, nq, nk),
+        in_specs=[qrow, kstream, kstream, qrow, vec_row, vec_row],
+        out_specs=qrow,
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-        **_compiler_params(("parallel", "arbitrary")),
+        **_compiler_params(("parallel", "parallel", "arbitrary")),
     )(q3, k3, v3, do3, lse3, delta3)
 
-    kcol_spec = pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0),
-                             **mem_kwargs)
-    qfull_spec = pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0),
-                              **mem_kwargs)
+    # dkv pass: grid (bh, nk, nq) — k/v column pinned per j, q/dO streamed
+    kcol = pl.BlockSpec((None, bk, d), lambda i, j, qq: (i, j, 0),
+                        **mem_kwargs)
+    qstream = pl.BlockSpec((None, bq, d), lambda i, j, qq: (i, qq, 0),
+                           **mem_kwargs)
+    vec_stream = pl.BlockSpec((None, bq, LSE_LANES),
+                              lambda i, j, qq: (i, qq, 0), **mem_kwargs)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, sq=sq),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq),
         out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
-        grid=(b * h, sk // bk),
-        in_specs=[qfull_spec, kcol_spec, kcol_spec, qfull_spec,
-                  vec_full, vec_full],
-        out_specs=(kcol_spec, kcol_spec),
+        grid=(b * h, nk, nq),
+        in_specs=[qstream, kcol, kcol, qstream, vec_stream, vec_stream],
+        out_specs=(kcol, kcol),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-        **_compiler_params(("parallel", "arbitrary")),
+        **_compiler_params(("parallel", "parallel", "arbitrary")),
     )(q3, k3, v3, do3, lse3, delta3)
 
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),

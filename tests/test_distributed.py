@@ -225,3 +225,17 @@ class TestCollectivesAPI:
         wrapped = fleet.distributed_optimizer(base, strategy)
         assert isinstance(wrapped, opt.Lamb)
         assert fleet.worker_num() == 1
+
+    def test_fleet_metrics(self):
+        # ADVICE r1: fleet.metrics must expose the reference's metric fns
+        from paddle_tpu.distributed.fleet import metrics as M
+        np.testing.assert_allclose(M.sum(np.array([1.0, 2.0])), [1.0, 2.0])
+        assert M.acc(np.array([3.0]), np.array([4.0])) == 0.75
+        assert M.mae(np.array([2.0]), 4) == 0.5
+        assert M.rmse(np.array([16.0]), 4) == 2.0
+        assert M.mse(np.array([16.0]), 4) == 4.0
+        # perfect separation -> auc 1.0: all pos in top bucket, neg in bottom
+        pos = np.zeros(4); pos[3] = 10
+        neg = np.zeros(4); neg[0] = 10
+        assert M.auc(pos, neg) == 1.0
+        assert M.auc(np.zeros(4), np.zeros(4)) == 0.5

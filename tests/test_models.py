@@ -171,6 +171,32 @@ class TestFlashAttention:
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                            rtol=2e-3, atol=2e-4)
 
+    def test_two_kernel_backward_matches_reference(self):
+        # ADVICE r1: the streaming dq/dkv two-kernel path (production path
+        # for long sequences) must be covered directly — _fa_bwd would pick
+        # the fused kernel at this size, so call _flash_bwd itself.
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _flash_bwd, _flash_fwd_lse, _reference_attention)
+        np.random.seed(3)
+        b, h, s, d = 1, 2, 256, 32
+        scale = d ** -0.5
+        q = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
+        k = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
+        v = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
+        g = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
+        for causal in (False, True):
+            out, lse = _flash_fwd_lse(q, k, v, scale, causal, 64, 64, True)
+            dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal,
+                                    64, 64, True)
+            ref = jax.vjp(
+                lambda q, k, v: _reference_attention(q, k, v, scale, causal),
+                q, k, v)[1](g)
+            for a, b_ in zip((dq, dk, dv), ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                           rtol=2e-3, atol=2e-4)
+
     def test_default_blocks_nondivisible_seq(self):
         # S=384: a multiple of 128 that is NOT a multiple of the 512 default
         # block — _block_sizes must clamp to a divisor, not drop rows/keys
