@@ -6,9 +6,9 @@ collective.py; fleet/ holds the DistributedStrategy machinery.
 from __future__ import annotations
 
 from .collective import (  # noqa: F401
-    ParallelEnv, all_gather, all_reduce, barrier, broadcast, get_rank,
-    get_world_size, init_parallel_env, reduce, ReduceOp, scatter, split,
-    reduce_scatter, alltoall, wait,
+    Group, ParallelEnv, all_gather, all_reduce, barrier, broadcast, get_group,
+    get_rank, get_world_size, init_parallel_env, new_group, reduce, ReduceOp,
+    scatter, split, reduce_scatter, alltoall, wait,
 )
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401

@@ -26,6 +26,89 @@ class ReduceOp:
     AVG = "avg"
 
 
+class Group:
+    """A process group = a subset of the global ranks (ref: collective.py
+    Group over an NCCL sub-communicator). TPU-first lowering: inside a
+    traced region the group's collectives pass ``axis_index_groups`` to the
+    XLA collective, which partitions the mesh axis into independent ICI
+    rings — the hardware analogue of a sub-communicator, with no extra
+    process bootstrap."""
+
+    def __init__(self, ranks, gid):
+        world = get_world_size()
+        self.ranks = sorted(int(r) for r in ranks)
+        if any(r < 0 or r >= world for r in self.ranks):
+            raise ValueError(f"ranks {ranks} outside world of size {world}")
+        self.id = gid
+        self.nranks = len(self.ranks)
+        # axis_index_groups must partition the axis: non-members reduce
+        # among themselves (their result is unused — SPMD runs everywhere).
+        # AllReduce accepts uneven groups; gather-style collectives need
+        # EQUAL-sized groups, so the remainder is chunked to the group size
+        # when it divides evenly (uniform partition), else those collectives
+        # reject the group loudly.
+        rest = [r for r in range(world) if r not in self.ranks]
+        self.axis_index_groups = [self.ranks] + ([rest] if rest else [])
+        n = self.nranks
+        if len(rest) % n == 0:
+            self.uniform_axis_index_groups = [self.ranks] + [
+                rest[i:i + n] for i in range(0, len(rest), n)]
+        else:
+            self.uniform_axis_index_groups = None
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_group_registry = {}
+_WORLD_GROUP_ID = 0
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Create a process group over `ranks` (global device indices).
+    All collectives accept it via `group=`; inside shard_map/pjit regions
+    it lowers to axis_index_groups on the XLA collective."""
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    gid = len(_group_registry) + 1
+    g = Group(ranks, gid)
+    _group_registry[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == _WORLD_GROUP_ID:
+        return Group(range(get_world_size()), _WORLD_GROUP_ID)
+    return _group_registry.get(gid)
+
+
+def _group_kwargs(group, uniform=False):
+    """axis_index_groups for a collective. `uniform=True` for gather-style
+    collectives (all_gather/all_to_all/psum_scatter), which require
+    equal-sized replica groups — raises instead of silently mis-lowering."""
+    if group is None:
+        return {}
+    if not uniform:
+        return {"axis_index_groups": group.axis_index_groups}
+    if group.uniform_axis_index_groups is None:
+        raise ValueError(
+            f"group of {group.nranks} ranks cannot partition a world of "
+            f"{get_world_size()} into equal-sized replica groups — "
+            f"gather-style collectives need len(world) % len(group) == 0")
+    return {"axis_index_groups": group.uniform_axis_index_groups}
+
+
 class ParallelEnv:
     def __init__(self):
         self.rank = get_rank()
@@ -55,7 +138,10 @@ def is_initialized():
 
 
 def get_rank(group=None):
-    return jax.process_index()
+    r = jax.process_index()
+    if group is not None:
+        return group.get_group_rank(r)
+    return r
 
 
 def get_world_size(group=None):
@@ -94,23 +180,20 @@ def _unwrap(t):
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce (ref: c_allreduce_sum_op). With a single
     participating shard per value this is identity-safe; inside shard_map /
-    pjit regions XLA emits the ICI all-reduce."""
+    pjit regions XLA emits the ICI all-reduce — restricted to `group`'s
+    ranks via axis_index_groups when a group is passed."""
     x = _unwrap(tensor)
-    axis_or_axes = None
-    try:
-        # inside shard_map: psum over all mesh axes present
-        from jax.core import get_axis_env_size  # noqa: F401
-    except Exception:
-        pass
     reducer = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
                ReduceOp.MIN: jax.lax.pmin,
                ReduceOp.AVG: jax.lax.pmean}.get(op, jax.lax.psum)
     mesh = _mesh_1d()
-    axis = mesh.axis_names
+    # axis_index_groups applies along ONE axis; the world group spans all
+    axis = mesh.axis_names if group is None else mesh.axis_names[0]
+    kw = _group_kwargs(group)  # AllReduce accepts uneven replica groups
     try:
-        out = reducer(x, axis)  # traced context with named axes
-    except (NameError, Exception):
-        out = x  # single logical copy: reduce over 1 participant is identity
+        out = reducer(x, axis, **kw)
+    except NameError:  # eager (no axis context): 1 participant == identity
+        out = x
     if isinstance(tensor, Tensor):
         tensor._value = out
         return tensor
@@ -119,12 +202,14 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     x = _unwrap(tensor)
+    n = group.nranks if group is not None else get_world_size()
+    kw = _group_kwargs(group, uniform=True)
     try:
         mesh = _mesh_1d()
-        out = jax.lax.all_gather(x, mesh.axis_names[0])
-        parts = [out[i] for i in range(out.shape[0])]
-    except Exception:
-        parts = [x] * get_world_size()
+        out = jax.lax.all_gather(x, mesh.axis_names[0], **kw)
+        parts = [out[i] for i in range(n)]
+    except NameError:  # eager: every "rank" holds the same replica
+        parts = [x] * n
     if tensor_list is not None:
         tensor_list.clear()
         tensor_list.extend(Tensor(p) for p in parts)
@@ -133,7 +218,22 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    return tensor  # value already replicated across the mesh
+    """Replicate src's value across the group (ref: c_broadcast_op). In a
+    traced region: gather the group and select src's slot; eager the value
+    is already replicated."""
+    x = _unwrap(tensor)
+    kw = _group_kwargs(group, uniform=True)
+    try:
+        mesh = _mesh_1d()
+        gathered = jax.lax.all_gather(x, mesh.axis_names[0], **kw)
+        slot = group.get_group_rank(src) if group is not None else src
+        out = gathered[slot]
+    except NameError:
+        out = x  # already replicated outside traced regions
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return Tensor(out)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -142,22 +242,41 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list:
-        rank = get_rank()
-        tensor._value = _unwrap(tensor_list[rank])
+        rank = get_rank(group)
+        tensor._value = _unwrap(tensor_list[max(rank, 0)])
     return tensor
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    stacked = jnp.stack([_unwrap(t) for t in tensor_list])
-    summed = jnp.sum(stacked, axis=0)
-    tensor._value = summed[get_rank() % summed.shape[0]] \
-        if summed.ndim > tensor._value.ndim else summed
+    x = jnp.concatenate([_unwrap(t) for t in tensor_list], axis=0) \
+        if isinstance(tensor_list, (list, tuple)) else _unwrap(tensor_list)
+    kw = _group_kwargs(group, uniform=True)
+    try:
+        mesh = _mesh_1d()
+        out = jax.lax.psum_scatter(x, mesh.axis_names[0],
+                                   scatter_dimension=0, tiled=True, **kw)
+    except NameError:
+        stacked = jnp.stack([_unwrap(t) for t in tensor_list])
+        summed = jnp.sum(stacked, axis=0)
+        out = summed[get_rank() % summed.shape[0]] \
+            if summed.ndim > tensor._value.ndim else summed
+    tensor._value = out
     return tensor
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
-    outs = [Tensor(_unwrap(t)) for t in in_tensor_list]
+    """Exchange the i-th input with rank i (ref: c_alltoall). In a traced
+    region lowers to XLA all_to_all over the mesh axis (ICI all-to-all)."""
+    kw = _group_kwargs(group, uniform=True)
+    try:
+        mesh = _mesh_1d()
+        x = jnp.stack([_unwrap(t) for t in in_tensor_list])  # [n, ...]
+        out = jax.lax.all_to_all(x, mesh.axis_names[0], split_axis=0,
+                                 concat_axis=0, tiled=False, **kw)
+        outs = [Tensor(out[i]) for i in range(out.shape[0])]
+    except NameError:
+        outs = [Tensor(_unwrap(t)) for t in in_tensor_list]
     if out_tensor_list is not None:
         out_tensor_list.clear()
         out_tensor_list.extend(outs)

@@ -28,6 +28,8 @@ class DistributedStrategy:
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
         self.lamb = False
         self.lamb_configs = {"lamb_weight_decay": 0.01}
         self.lars = False

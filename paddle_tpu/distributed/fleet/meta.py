@@ -63,12 +63,21 @@ def apply_strategy(strategy, loss_fn):
     return fn
 
 
-def build_hybrid_train_step(strategy, loss_fn, optimizer, mesh=None):
+def build_hybrid_train_step(strategy, loss_fn, optimizer, mesh=None,
+                            stage_fn=None, loss_head=None):
     """Build the full pjit'ed train step per strategy.
 
     loss_fn: pure (params, batch, key) -> scalar loss.
     Returns (step_fn, mesh): step_fn(params, opt_state, batch, key) ->
     (loss, new_params, new_opt_state); all collectives XLA-inserted.
+
+    strategy.pipeline (pp_degree > 1) additionally needs `stage_fn`
+    ((stage_params, x) -> y, the homogeneous per-stage computation) and
+    `loss_head` ((y, labels) -> scalar); the loss is then built by
+    parallel/pipeline.py's GPipe schedule over the pp axis and `loss_fn`
+    may be None.
+    localsgd / dgc build an explicit-dp step (shard_map over dp) because
+    both need per-worker gradients before the collective.
     """
     hybrid = strategy.hybrid_configs
     if mesh is None:
@@ -78,6 +87,26 @@ def build_hybrid_train_step(strategy, loss_fn, optimizer, mesh=None):
                          pp=hybrid.get("pp_degree", 1),
                          sp=hybrid.get("sp_degree", 1))
         set_mesh(mesh)
+
+    if strategy.pipeline and mesh.shape.get("pp", 1) > 1:
+        # ref: pipeline_optimizer.py — graph-partitioned GPipe. Here the
+        # stage computation is user-supplied and the schedule comes from
+        # parallel/pipeline.py (ppermute microbatch rotation).
+        if stage_fn is None or loss_head is None:
+            raise ValueError(
+                "strategy.pipeline with pp_degree>1 needs stage_fn and "
+                "loss_head (the reference partitions the program graph by "
+                "device annotation; the TPU rebuild takes the per-stage fn)")
+        from ...parallel.pipeline import make_pipeline_loss
+        m = strategy.pipeline_configs.get("accumulate_steps", 1)
+        pl_loss = make_pipeline_loss(stage_fn, loss_head, mesh, m, "pp")
+
+        def loss_fn(params, batch, key):  # noqa: F811
+            labels = batch.get("labels", batch.get("y"))
+            return pl_loss(params, batch["x"], labels)
+
+    if strategy.localsgd or strategy.dgc:
+        return _build_explicit_dp_step(strategy, loss_fn, optimizer, mesh)
 
     wrapped_loss = apply_strategy(strategy, loss_fn)
     k_steps = strategy.gradient_merge_configs.get("k_steps", 1) \
@@ -110,29 +139,184 @@ def build_hybrid_train_step(strategy, loss_fn, optimizer, mesh=None):
                                                             opt_state)
         return loss, new_params, new_state
 
-    # shardings: ZeRO shards params+opt state over dp; else replicate params
-    if strategy.sharding:
-        def spec_for(v):
-            # shard the largest dim that divides dp degree
-            dp = mesh.shape["dp"]
-            for i, s in enumerate(v.shape):
-                if s % dp == 0 and s >= dp:
-                    return P(*([None] * i + ["dp"] + [None] * (v.ndim - i - 1)))
-            return P()
-        param_sharding_fn = lambda v: NamedSharding(mesh, spec_for(v))  # noqa: E731
+    # ZeRO shardings (ref: sharding_optimizer.py stages):
+    #   stage 1: optimizer state sharded over dp, params/grads replicated
+    #   stage 2: + gradient reduce-scatter — with dp-sharded slots XLA's
+    #            SPMD partitioner emits the reduce-scatter into the update
+    #            itself, so stages 1/2 share the slot-sharding lowering
+    #   stage 3: + parameters sharded over dp
+    def _zero_spec(v):
+        # shard the largest dim that divides dp degree
+        dp = mesh.shape["dp"]
+        for i, s in enumerate(v.shape):
+            if s % dp == 0 and s >= dp:
+                return P(*([None] * i + ["dp"] + [None] * (v.ndim - i - 1)))
+        return P()
+
+    zero_stage = strategy.sharding_configs.get("stage", 2) \
+        if strategy.sharding else 0
+    if zero_stage >= 3:
+        param_sharding_fn = lambda v: NamedSharding(mesh, _zero_spec(v))  # noqa: E731
+    elif strategy.pipeline and mesh.shape.get("pp", 1) > 1:
+        pp = mesh.shape["pp"]
+        param_sharding_fn = lambda v: NamedSharding(  # noqa: E731
+            mesh, P("pp", *([None] * (v.ndim - 1)))
+            if v.ndim and v.shape[0] == pp else P())
     else:
         param_sharding_fn = lambda v: NamedSharding(mesh, P())  # noqa: E731
+    slot_sharding_fn = (lambda v: NamedSharding(mesh, _zero_spec(v))) \
+        if zero_stage >= 1 else None
 
-    def compile_for(params, batch):
+    def compile_for(params, batch, opt_state=None):
         p_sh = jax.tree_util.tree_map(param_sharding_fn, params)
         b_sh = jax.tree_util.tree_map(
             lambda x: NamedSharding(mesh, P("dp", *([None] * (x.ndim - 1)))),
             batch)
+        s_sh = None
+        if opt_state is not None and slot_sharding_fn is not None:
+            s_sh = jax.tree_util.tree_map(slot_sharding_fn, opt_state)
+        # pin outputs to the stage contract — otherwise XLA may propagate
+        # the slot sharding onto the (donated) replicated params
+        out_sh = None if s_sh is None else (None, p_sh, s_sh)
         return jax.jit(step,
-                       in_shardings=(p_sh, None, b_sh, None),
-                       out_shardings=None,
+                       in_shardings=(p_sh, s_sh, b_sh, None),
+                       out_shardings=out_sh,
                        donate_argnums=(0, 1))
 
     step.compile_for = compile_for
+    step.mesh = mesh
+    return step, mesh
+
+
+def _build_explicit_dp_step(strategy, loss_fn, optimizer, mesh):
+    """localsgd / dgc lowering — both need each dp worker's own gradient
+    before the collective, so the step body runs under shard_map over dp.
+
+    localsgd (ref: localsgd_optimizer.py): params carry a leading dp axis
+    (one divergent copy per worker); workers update locally from LOCAL
+    grads and every k_steps psum-average the copies.
+    dgc (ref: dgc_optimizer.py): error-feedback top-k sparsification — the
+    allreduce moves only the top (1-sparsity) gradient entries; the residual
+    stays in a per-worker error buffer folded into the next step.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    wrapped_loss = apply_strategy(strategy, loss_fn)
+    dp = mesh.shape["dp"]
+    use_localsgd = strategy.localsgd
+    use_dgc = strategy.dgc
+    k_steps = strategy.localsgd_configs.get("k_steps", 1)
+    sparsity = strategy.dgc_configs.get("sparsity", [0.999])[-1] \
+        if use_dgc else 0.0
+
+    # per-worker (divergent) state carries a leading dp axis, sharded P("dp")
+    # into shard_map so each worker owns one slice of size 1:
+    #   localsgd -> params + optimizer slots diverge between averaging steps
+    #   dgc      -> the error-feedback residual is inherently per-worker
+    stack_pi = use_localsgd        # params + inner slots
+    stack_err = use_dgc
+
+    def _stack(tree):
+        return jax.tree_util.tree_map(
+            lambda v: jnp.broadcast_to(v[None], (dp,) + v.shape), tree)
+
+    def _local(tree):   # [1, ...] worker slice -> [...]
+        return jax.tree_util.tree_map(lambda v: v[0], tree)
+
+    def _relocal(tree):  # [...] -> [1, ...] for the P("dp") out concat
+        return jax.tree_util.tree_map(lambda v: v[None], tree)
+
+    def _compress(g, e):
+        # error feedback: add residual, keep top-k magnitude entries
+        g = g + e
+        flat = g.reshape(-1)
+        kk = max(1, int(flat.size * (1.0 - sparsity)))
+        thresh = jax.lax.top_k(jnp.abs(flat), kk)[0][-1]
+        g_send = jnp.where(jnp.abs(g) >= thresh, g, 0.0)
+        return g_send, g - g_send
+
+    def local_step(params, inner_state, err, step_ct, batch, key):
+        p_local = _local(params) if stack_pi else params
+        s_local = _local(inner_state) if stack_pi else inner_state
+        e_local = _local(err) if stack_err else err
+        loss, grads = jax.value_and_grad(wrapped_loss)(p_local, batch, key)
+        if use_dgc:
+            flat_g, tdef = jax.tree_util.tree_flatten(grads)
+            flat_e = jax.tree_util.tree_leaves(e_local)
+            pairs = [_compress(g, e) for g, e in zip(flat_g, flat_e)]
+            grads = jax.tree_util.tree_unflatten(tdef, [p[0] for p in pairs])
+            e_local = jax.tree_util.tree_unflatten(tdef,
+                                                   [p[1] for p in pairs])
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, "dp") / dp, grads)
+        elif not use_localsgd:
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "dp"), grads)
+        # localsgd: NO grad sync — the collective is the periodic param avg
+        new_p, new_s = optimizer.functional_update(p_local, grads, s_local)
+        if use_localsgd:
+            do_avg = (step_ct % k_steps) == (k_steps - 1)
+            new_p = jax.lax.cond(
+                do_avg,
+                lambda p: jax.tree_util.tree_map(
+                    lambda v: jax.lax.pmean(v, "dp"), p),
+                lambda p: p, new_p)
+        if stack_pi:
+            new_p, new_s = _relocal(new_p), _relocal(new_s)
+        if stack_err:
+            e_local = _relocal(e_local)
+        return jax.lax.pmean(loss, "dp"), new_p, new_s, e_local
+
+    def step(params, opt_state, batch, key):
+        inner = opt_state["inner"]
+        err = opt_state["dgc_err"]
+        ct = opt_state["step"]
+        rep = P()
+        pi_spec = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda v: P("dp", *([None] * (v.ndim - 1))) if stack_pi else rep,
+            tree)
+        err_spec = jax.tree_util.tree_map(
+            lambda v: P("dp", *([None] * (v.ndim - 1))) if stack_err else rep,
+            err)
+        b_spec = jax.tree_util.tree_map(
+            lambda x: P("dp", *([None] * (x.ndim - 1))), batch)
+        loss, new_p, new_s, new_err = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(pi_spec(params), pi_spec(inner), err_spec, rep,
+                      b_spec, rep),
+            out_specs=(rep, pi_spec(params), pi_spec(inner), err_spec),
+            check_rep=False)(params, inner, err, ct, batch, key)
+        return loss, new_p, {"inner": new_s, "dgc_err": new_err,
+                             "step": ct + 1}
+
+    def init_opt_state(params):
+        """Build (params_for_step, opt_state): step counter + dgc error
+        buffers; localsgd stacks params/slots to one copy per dp worker."""
+        inner = optimizer.functional_init(params)
+        if use_dgc:  # per-worker residuals: [dp, ...] per param leaf
+            err = jax.tree_util.tree_map(
+                lambda v: jnp.zeros((dp,) + v.shape, v.dtype), params)
+        else:        # unused placeholder, keeps the opt_state pytree static
+            err = jax.tree_util.tree_map(
+                lambda v: jnp.zeros((), v.dtype), params)
+        p = params
+        if stack_pi:
+            p, inner = _stack(params), _stack(inner)
+        return p, {"inner": inner, "dgc_err": err,
+                   "step": jnp.zeros((), jnp.int32)}
+
+    def compile_for(params, batch, opt_state=None):
+        p_sh = jax.tree_util.tree_map(
+            lambda v: NamedSharding(
+                mesh, P("dp", *([None] * (v.ndim - 1))) if stack_pi else P()),
+            params)
+        b_sh = jax.tree_util.tree_map(
+            lambda x: NamedSharding(mesh, P("dp", *([None] * (x.ndim - 1)))),
+            batch)
+        return jax.jit(step, in_shardings=(p_sh, None, b_sh, None),
+                       out_shardings=None, donate_argnums=(0, 1))
+
+    step.compile_for = compile_for
+    step.init_opt_state = init_opt_state
     step.mesh = mesh
     return step, mesh

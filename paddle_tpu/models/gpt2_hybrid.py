@@ -1,0 +1,255 @@
+"""GPT-2 with full 4-D hybrid parallelism: dp × pp × mp × sp on ONE mesh.
+
+The north-star configuration (BASELINE.json: "ERNIE/BERT-large pretraining
+under Fleet collective mode on v5e-256") needs data, pipeline, tensor and
+sequence parallelism composed in a single train step. Reference lineage:
+fleet meta_optimizers (sharding/pipeline/hybrid_parallel_optimizer) rewrite
+the program graph with NCCL send/recv + allreduce; here the whole step is one
+shard_map over the (dp, pp, mp, sp) mesh and XLA emits the ICI collectives:
+
+  dp — batch split; gradient reduction comes out of shard_map's transpose
+       (replicated params -> psum cotangent), no hand-written allreduce.
+  pp — GPipe microbatch rotation via ppermute (parallel/pipeline.py).
+  mp — Megatron tensor parallel: column-split QKV/fc1, row-split out/fc2
+       with one psum per half-block. QKV is stored [E, H, 3, d] so the mp
+       split on H keeps each rank's q/k/v for its own heads contiguous.
+  sp — ring attention over the sequence shards (parallel/ring_attention.py,
+       Pallas flash kernels inside each ring step when shapes allow).
+
+Params are a flat dict of jnp arrays; per-stage leaves are stacked
+[pp, L/pp, ...] so the pp axis shards stages and a lax.scan walks the
+layers inside a stage. `reference_loss` computes the identical math without
+any mesh for the single-device parity assertion.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def init_hybrid_gpt2_params(key, vocab_size, hidden, num_heads, num_layers,
+                            pp, max_position, intermediate=None,
+                            dtype=jnp.float32):
+    """Flat param dict; stage leaves stacked [pp, L/pp, ...]."""
+    assert num_layers % pp == 0, (num_layers, pp)
+    lps = num_layers // pp
+    e = hidden
+    h = num_heads
+    d = e // h
+    f = intermediate or 4 * e
+    ks = jax.random.split(key, 8)
+
+    def nrm(k, shape, std=0.02):
+        return (jax.random.normal(k, shape) * std).astype(dtype)
+
+    return {
+        "wte": nrm(ks[0], (vocab_size, e)),
+        "wpe": nrm(ks[1], (max_position, e)),
+        "ln_f.w": jnp.ones((e,), dtype),
+        "ln_f.b": jnp.zeros((e,), dtype),
+        "blk.ln1.w": jnp.ones((pp, lps, e), dtype),
+        "blk.ln1.b": jnp.zeros((pp, lps, e), dtype),
+        # [E, H, 3, d]: mp splits H, so each rank holds q/k/v of its heads
+        "blk.wqkv": nrm(ks[2], (pp, lps, e, h, 3, d)),
+        "blk.bqkv": jnp.zeros((pp, lps, h, 3, d), dtype),
+        "blk.wo": nrm(ks[3], (pp, lps, h, d, e)),
+        "blk.bo": jnp.zeros((pp, lps, e), dtype),
+        "blk.ln2.w": jnp.ones((pp, lps, e), dtype),
+        "blk.ln2.b": jnp.zeros((pp, lps, e), dtype),
+        "blk.w1": nrm(ks[4], (pp, lps, e, f)),
+        "blk.b1": jnp.zeros((pp, lps, f), dtype),
+        "blk.w2": nrm(ks[5], (pp, lps, f, e)),
+        "blk.b2": jnp.zeros((pp, lps, e), dtype),
+    }
+
+
+def hybrid_param_specs(params):
+    """PartitionSpec per leaf: stage dim -> pp, TP dim -> mp, rest replicated.
+    (Used both as shard_map in_specs and jit in_shardings.)"""
+    specs = {
+        "wte": P(),
+        "wpe": P(),
+        "ln_f.w": P(),
+        "ln_f.b": P(),
+        "blk.ln1.w": P("pp"),
+        "blk.ln1.b": P("pp"),
+        "blk.wqkv": P("pp", None, None, "mp"),
+        "blk.bqkv": P("pp", None, "mp"),
+        "blk.wo": P("pp", None, "mp"),
+        "blk.bo": P("pp"),
+        "blk.ln2.w": P("pp"),
+        "blk.ln2.b": P("pp"),
+        "blk.w1": P("pp", None, None, "mp"),
+        "blk.b1": P("pp", None, "mp"),
+        "blk.w2": P("pp", None, "mp"),
+        "blk.b2": P("pp"),
+    }
+    assert set(specs) == set(params)
+    return specs
+
+
+def _ln(x, w, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * w + b
+
+
+def _stage_fn(stage, x, *, sp_axis, mp_axis, ring_impl):
+    """One pipeline stage: scan over its L/pp layers. `stage` leaves are this
+    rank's slice: [L/pp, ...] (TP dims already local)."""
+    from ..parallel.ring_attention import ring_attention
+
+    def layer(h, wl):
+        a = _ln(h, wl["blk.ln1.w"], wl["blk.ln1.b"])
+        qkv = jnp.einsum("bse,ehtd->bshtd", a, wl["blk.wqkv"]) \
+            + wl["blk.bqkv"]
+        q = jnp.moveaxis(qkv[:, :, :, 0], 1, 2)  # [mb, H_loc, S_l, d]
+        k = jnp.moveaxis(qkv[:, :, :, 1], 1, 2)
+        v = jnp.moveaxis(qkv[:, :, :, 2], 1, 2)
+        if sp_axis is not None:
+            o = ring_attention(q, k, v, axis_name=sp_axis, causal=True,
+                               impl=ring_impl)
+        else:  # no sp axis: plain causal attention
+            s = q.shape[2]
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask, logits, -1e30)
+            o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
+        att = jnp.einsum("bhsd,hde->bse", o, wl["blk.wo"])
+        if mp_axis is not None:
+            att = jax.lax.psum(att, mp_axis)
+        h = h + att + wl["blk.bo"]
+        m = _ln(h, wl["blk.ln2.w"], wl["blk.ln2.b"])
+        m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", m, wl["blk.w1"])
+                        + wl["blk.b1"], approximate=True)
+        m = jnp.einsum("bsf,fe->bse", m, wl["blk.w2"])
+        if mp_axis is not None:
+            m = jax.lax.psum(m, mp_axis)
+        return h + m + wl["blk.b2"], None
+
+    blk = {k: v for k, v in stage.items() if k.startswith("blk.")}
+    out, _ = jax.lax.scan(layer, x, blk)
+    return out
+
+
+def build_hybrid_gpt2_loss(mesh, num_microbatches=2, ring_impl=None):
+    """Pure loss_fn(params, batch) running dp×pp×mp×sp on `mesh`.
+
+    batch: {"input_ids": [B, S] int32, "labels": [B, S] int32} — B sharded
+    over dp, S over sp. Differentiable end-to-end: grads of replicated
+    leaves psum automatically via the shard_map transpose.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    from ..parallel.pipeline import pipeline_apply
+
+    axes = dict(mesh.shape)
+    use_pp = axes.get("pp", 1) > 1
+    sp_axis = "sp" if axes.get("sp", 1) > 1 else None
+    mp_axis = "mp" if axes.get("mp", 1) > 1 else None
+
+    def inner(params, ids, labels):
+        sp_idx = jax.lax.axis_index("sp") if sp_axis else 0
+        s_l = ids.shape[1]
+        pos = sp_idx * s_l + jnp.arange(s_l)
+        x = params["wte"][ids] + params["wpe"][pos][None]
+        stage_fn = functools.partial(_stage_fn, sp_axis=sp_axis,
+                                     mp_axis=mp_axis, ring_impl=ring_impl)
+        stage = {k: (v[0] if k.startswith("blk.") else v)
+                 for k, v in params.items()}  # local pp slice: [1, L/pp,...]
+        if use_pp:
+            m = num_microbatches
+            mbs = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+            outs = pipeline_apply(stage_fn, stage, mbs, "pp")
+            y = outs.reshape((x.shape[0],) + outs.shape[2:])
+        else:
+            y = stage_fn(stage, x)
+        y = _ln(y, params["ln_f.w"], params["ln_f.b"])
+        logits = jnp.einsum("bse,ve->bsv", y, params["wte"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        loss = jnp.mean(nll)
+        for ax in ("dp", "sp"):
+            if axes.get(ax, 1) > 1:
+                loss = jax.lax.pmean(loss, ax)
+        if use_pp:
+            loss = jax.lax.pmean(loss, "pp")
+        if mp_axis:
+            loss = jax.lax.pmean(loss, mp_axis)
+        return loss
+
+    def loss_fn(params, batch):
+        specs = hybrid_param_specs(params)
+        data_spec = P("dp", "sp")
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=P(),
+            check_rep=False)(params, batch["input_ids"], batch["labels"])
+
+    return loss_fn
+
+
+def reference_loss(params, batch):
+    """Same math, no mesh — the parity oracle for dryrun_multichip."""
+    ids, labels = batch["input_ids"], batch["labels"]
+    s = ids.shape[1]
+    x = params["wte"][ids] + params["wpe"][jnp.arange(s)][None]
+    pp, lps = params["blk.w1"].shape[:2]
+    for pi in range(pp):
+        for li in range(lps):
+            wl = {k: v[pi, li] for k, v in params.items()
+                  if k.startswith("blk.")}
+            a = _ln(x, wl["blk.ln1.w"], wl["blk.ln1.b"])
+            qkv = jnp.einsum("bse,ehtd->bshtd", a, wl["blk.wqkv"]) \
+                + wl["blk.bqkv"]
+            q = jnp.moveaxis(qkv[:, :, :, 0], 1, 2)
+            k = jnp.moveaxis(qkv[:, :, :, 1], 1, 2)
+            v = jnp.moveaxis(qkv[:, :, :, 2], 1, 2)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask, logits, -1e30)
+            o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
+            att = jnp.einsum("bhsd,hde->bse", o, wl["blk.wo"])
+            x = x + att + wl["blk.bo"]
+            m = _ln(x, wl["blk.ln2.w"], wl["blk.ln2.b"])
+            m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", m, wl["blk.w1"])
+                            + wl["blk.b1"], approximate=True)
+            x = x + jnp.einsum("bsf,fe->bse", m, wl["blk.w2"]) + wl["blk.b2"]
+    x = _ln(x, params["ln_f.w"], params["ln_f.b"])
+    logits = jnp.einsum("bse,ve->bsv", x, params["wte"])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def hybrid_shardings(mesh, params, optimizer_state=None, zero_dp=True):
+    """NamedShardings for jit: params per hybrid_param_specs; optimizer
+    slots additionally ZeRO-sharded over dp on replicated leaves (stage-1
+    style: the big replicated tensors' moments live dp-sharded)."""
+    specs = hybrid_param_specs(params)
+    p_sh = {k: NamedSharding(mesh, specs[k]) for k in params}
+
+    def slot_spec(name, v):
+        base = specs[name]
+        if zero_dp and base == P():
+            dp = mesh.shape["dp"]
+            for i, s in enumerate(v.shape):
+                if s % dp == 0 and s >= dp:
+                    return NamedSharding(
+                        mesh,
+                        P(*([None] * i + ["dp"]
+                            + [None] * (v.ndim - i - 1))))
+        return NamedSharding(mesh, base)
+
+    if optimizer_state is None:
+        return p_sh, None
+    slots = {name: {k: slot_spec(name, params[name])
+                    for k in optimizer_state["slots"][name]}
+             for name in optimizer_state["slots"]}
+    os_sh = {"slots": slots, "t": NamedSharding(mesh, P())}
+    return p_sh, os_sh

@@ -1,0 +1,91 @@
+"""4-D hybrid GPT-2: dp×pp×mp×sp ALL > 1 on one mesh (VERDICT r1 #2).
+
+Needs 16 virtual devices; tests/conftest.py materializes 8 by default, so
+this file spawns no mesh when fewer than 16 exist — __graft_entry__'s
+dryrun bumps jax_num_cpu_devices to 16 when it controls the platform. To
+still exercise the full composition in CI we run a subprocess with its own
+device count.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+_SCRIPT = r"""
+import jax
+jax.config.update("jax_num_cpu_devices", 16)
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu.models.gpt2_hybrid import (
+    build_hybrid_gpt2_loss, hybrid_shardings, init_hybrid_gpt2_params,
+    reference_loss)
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu import optimizer as opt_mod
+
+mesh = make_mesh(dp=2, mp=2, pp=2, sp=2)
+assert all(mesh.shape[a] > 1 for a in ("dp", "pp", "mp", "sp"))
+params = init_hybrid_gpt2_params(
+    jax.random.key(0), vocab_size=128, hidden=32, num_heads=4, num_layers=4,
+    pp=2, max_position=64)
+rng = np.random.RandomState(0)
+batch = {"input_ids": jnp.asarray(rng.randint(0, 128, (8, 64), np.int32)),
+         "labels": jnp.asarray(rng.randint(0, 128, (8, 64), np.int32))}
+
+loss_fn = build_hybrid_gpt2_loss(mesh, num_microbatches=2)
+ref = float(jax.jit(reference_loss)(params, batch))
+hyb = float(jax.jit(loss_fn)(params, batch))
+assert abs(ref - hyb) < 1e-3 * max(1.0, abs(ref)), (ref, hyb)
+print("PARITY_OK", ref, hyb)
+
+# full train step with ZeRO slot sharding over dp
+optimizer = opt_mod.AdamW(learning_rate=1e-3, weight_decay=0.0)
+opt_state = optimizer.functional_init(params)
+p_sh, os_sh = hybrid_shardings(mesh, params, opt_state)
+wte_m = opt_state["slots"]["wte"]
+
+def step(params, opt_state, batch):
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    new_p, new_s = optimizer.functional_update(params, grads, opt_state)
+    return loss, new_p, new_s
+
+jitted = jax.jit(step, in_shardings=(p_sh, os_sh, None),
+                 out_shardings=(None, p_sh, os_sh))
+params = jax.device_put(params, p_sh)
+opt_state = jax.device_put(opt_state, os_sh)
+l0 = None
+for i in range(4):
+    loss, params, opt_state = jitted(params, opt_state, batch)
+    if l0 is None:
+        l0 = float(loss)
+# ZeRO: the wte moment slots live dp-sharded
+slot = list(opt_state["slots"]["wte"].values())[0]
+assert "dp" in str(slot.sharding.spec), slot.sharding
+assert float(loss) < l0, (l0, float(loss))
+print("TRAIN_OK", l0, float(loss))
+
+# grads parity: hybrid grads == reference grads on a replicated leaf
+g_h = jax.grad(loss_fn)(jax.device_get(params), batch)
+g_r = jax.grad(reference_loss)(jax.device_get(params), batch)
+d = float(jnp.max(jnp.abs(g_h["wte"] - g_r["wte"])))
+scale = float(jnp.max(jnp.abs(g_r["wte"]))) + 1e-9
+assert d / scale < 5e-3, (d, scale)
+print("GRAD_OK", d, scale)
+"""
+
+
+def test_4d_hybrid_parity_and_training():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    r = subprocess.run([sys.executable, "-c", _SCRIPT], env=env,
+                       capture_output=True, text=True, timeout=900,
+                       cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert "PARITY_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
+    assert "TRAIN_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
+    assert "GRAD_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
