@@ -11,6 +11,7 @@
 //
 // Build: cc -O3 -shared -fPIC native_runtime.cpp -o libpaddle_tpu_native.so
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -59,6 +60,28 @@ int ptq_push(void* handle, const uint8_t* data, size_t nbytes) {
     return 0;
 }
 
+// Push with a 1-byte frame tag prepended — saves the caller assembling a
+// tag+payload copy in Python (the memcpy out of shared memory happens here,
+// with the GIL already released by ctypes).
+int ptq_push_tagged(void* handle, uint8_t tag, const uint8_t* data,
+                    size_t nbytes) {
+    auto* q = static_cast<ByteQueue*>(handle);
+    std::unique_lock<std::mutex> lk(q->mu);
+    q->not_full.wait(lk, [&] {
+        return q->closed || (q->items.size() < q->capacity_items &&
+                             q->bytes + nbytes + 1 <= q->capacity_bytes) ||
+               q->items.empty();
+    });
+    if (q->closed) return -1;
+    std::vector<uint8_t> item(nbytes + 1);
+    item[0] = tag;
+    std::memcpy(item.data() + 1, data, nbytes);
+    q->items.emplace_back(std::move(item));
+    q->bytes += nbytes + 1;
+    q->not_empty.notify_one();
+    return 0;
+}
+
 // Returns size of the popped item (>=0), -1 when closed+drained.
 // The item is copied into out (caller sizes it via ptq_peek_size).
 int64_t ptq_peek_size(void* handle) {
@@ -77,6 +100,27 @@ int64_t ptq_pop(void* handle, uint8_t* out, size_t out_cap) {
     auto& front = q->items.front();
     size_t n = front.size();
     if (n > out_cap) return -2;  // caller must re-size via ptq_peek_size
+    std::memcpy(out, front.data(), n);
+    q->bytes -= n;
+    q->items.pop_front();
+    q->not_full.notify_one();
+    return (int64_t)n;
+}
+
+// Timed variant: waits up to timeout_ms for an item. Returns item size
+// (>=0) on success, -1 closed+drained, -2 out too small, -3 timed out.
+int64_t ptq_pop_timed(void* handle, uint8_t* out, size_t out_cap,
+                      int64_t timeout_ms) {
+    auto* q = static_cast<ByteQueue*>(handle);
+    std::unique_lock<std::mutex> lk(q->mu);
+    bool ok = q->not_empty.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms),
+        [&] { return q->closed || !q->items.empty(); });
+    if (!ok) return -3;
+    if (q->items.empty()) return -1;
+    auto& front = q->items.front();
+    size_t n = front.size();
+    if (n > out_cap) return -2;
     std::memcpy(out, front.data(), n);
     q->bytes -= n;
     q->items.pop_front();

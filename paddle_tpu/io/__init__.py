@@ -249,6 +249,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -284,11 +287,36 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return
-        yield from self._prefetch_iter()
+        yield from self._multiprocess_iter()
+
+    def _multiprocess_iter(self):
+        """Worker processes do __getitem__ + collate (ref:
+        fluid/dataloader/dataloader_iter.py); batches travel through shared
+        memory into the C++ byte-queue. Falls back to the single-process
+        thread prefetcher if process spawn fails (e.g. sandboxed)."""
+        from .worker import MultiprocessLoaderIter
+        try:
+            if self._iterable_mode:
+                it = MultiprocessLoaderIter(
+                    self.dataset, self.collate_fn, None, self.num_workers,
+                    self.prefetch_factor, self.timeout, self.worker_init_fn,
+                    self.use_shared_memory,
+                    iterable_batch_size=self.batch_size,
+                    iterable_drop_last=self.drop_last)
+            else:
+                it = MultiprocessLoaderIter(
+                    self.dataset, self.collate_fn,
+                    list(self.batch_sampler), self.num_workers,
+                    self.prefetch_factor, self.timeout, self.worker_init_fn,
+                    self.use_shared_memory)
+        except Exception:
+            yield from self._prefetch_iter()
+            return
+        yield from it
 
     def _prefetch_iter(self):
-        """Background prefetch: native C++ ring buffer when available,
-        otherwise a Python thread."""
+        """Single-process background prefetch: native C++ ring buffer when
+        available, otherwise a Python thread."""
         try:
             from .native_loader import NativePrefetcher
             prefetcher = NativePrefetcher(self._iter_batches(),
@@ -318,7 +346,8 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    from .worker import get_worker_info as _gwi
+    return _gwi()
 
 
 class Transform:

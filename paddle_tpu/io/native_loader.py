@@ -55,6 +55,14 @@ def get_lib():
         lib.ptq_pop.argtypes = [ctypes.c_void_p,
                                 ctypes.POINTER(ctypes.c_uint8),
                                 ctypes.c_size_t]
+        lib.ptq_pop_timed.restype = ctypes.c_int64
+        lib.ptq_pop_timed.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_size_t, ctypes.c_int64]
+        lib.ptq_push_tagged.restype = ctypes.c_int
+        lib.ptq_push_tagged.argtypes = [ctypes.c_void_p, ctypes.c_uint8,
+                                        ctypes.POINTER(ctypes.c_uint8),
+                                        ctypes.c_size_t]
         lib.ptq_size.restype = ctypes.c_int64
         lib.ptq_size.argtypes = [ctypes.c_void_p]
         lib.ptq_close.argtypes = [ctypes.c_void_p]

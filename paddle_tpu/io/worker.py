@@ -1,0 +1,333 @@
+"""Multiprocess DataLoader workers.
+
+Reference: python/paddle/fluid/dataloader/dataloader_iter.py (796 LoC:
+worker processes, shared-memory tensor transport, timeout + error
+propagation, get_worker_info). TPU-first rework: workers run
+`__getitem__` + collate in their own processes (true parallelism for the
+GIL-bound input pipeline), serialize batches to ONE contiguous buffer in
+POSIX shared memory, and a parent feeder thread copies each buffer into the
+C++ bounded byte-queue (csrc/native_runtime.cpp) with the GIL released —
+so batch production, staging and consumption all overlap. Order is restored
+by batch index in the feeder; worker exceptions travel as tracebacks and
+re-raise at the consumer with the original stack text.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import pickle
+import queue as pyqueue
+import threading
+import traceback
+from dataclasses import dataclass
+
+import numpy as np
+
+_TAG_BATCH = b"B"
+_TAG_ERR = b"E"
+_TAG_END = b"X"
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    seed: int
+    dataset: object = None
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a worker process: this worker's (id, num_workers, seed,
+    dataset). In the main process: None. (ref: dataloader_iter.py)"""
+    return _worker_info
+
+
+def _seed_worker(worker_id, base_seed):
+    import random
+    random.seed(base_seed + worker_id)
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
+                 num_workers, base_seed, worker_init_fn, use_shared_memory,
+                 iterable_batch_size, iterable_drop_last):
+    """Target of each worker process. Map-style: pops (batch_idx, indices)
+    tasks. Iterable-style: iterates its own dataset copy (the dataset uses
+    get_worker_info() to shard itself) and emits (-1, batch) results."""
+    global _worker_info
+    _worker_info = WorkerInfo(id=worker_id, num_workers=num_workers,
+                              seed=base_seed + worker_id, dataset=dataset)
+    _seed_worker(worker_id, base_seed)
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(worker_id)
+        except Exception:
+            result_queue.put(("err", -1, traceback.format_exc()))
+            return
+
+    def emit(batch_idx, batch):
+        from .native_loader import _serialize_batch
+        data = _serialize_batch(batch)
+        if use_shared_memory:
+            from multiprocessing import shared_memory
+            shm = shared_memory.SharedMemory(create=True, size=len(data))
+            shm.buf[:len(data)] = data
+            result_queue.put(("shm", batch_idx, shm.name, len(data)))
+            shm.close()  # parent attaches + unlinks
+        else:
+            result_queue.put(("data", batch_idx, data))
+
+    try:
+        if iterable_batch_size is not None:  # iterable mode
+            it = iter(dataset)
+            while True:
+                batch = list(itertools.islice(it, iterable_batch_size))
+                if not batch or (len(batch) < iterable_batch_size
+                                 and iterable_drop_last):
+                    break
+                emit(-1, collate_fn(batch))
+            result_queue.put(("done", worker_id, None))
+            return
+        while True:
+            task = index_queue.get()
+            if task is None:
+                break
+            batch_idx, indices = task
+            try:
+                emit(batch_idx, collate_fn([dataset[i] for i in indices]))
+            except Exception:
+                result_queue.put(("err", batch_idx, traceback.format_exc()))
+    except (KeyboardInterrupt, EOFError):
+        pass
+
+
+class _ByteChannel:
+    """Parent-side staging channel: the C++ bounded byte-queue when the
+    native lib builds, else a plain python queue. Frames are tag + payload."""
+
+    def __init__(self, depth, capacity_mb=1024):
+        import ctypes
+        self._ctypes = ctypes
+        try:
+            from .native_loader import get_lib
+            self._lib = get_lib()
+            self._q = self._lib.ptq_create(depth, capacity_mb << 20)
+            self._py = None
+        except Exception:
+            self._lib = None
+            self._py = pyqueue.Queue(maxsize=depth)
+
+    def push(self, tag, payload):
+        if self._lib is None:
+            self._py.put(tag + payload)
+            return
+        buf = (self._ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        self._lib.ptq_push_tagged(self._q, tag[0], buf, len(payload))
+
+    def push_shm_frame(self, tag, shm_buf, nbytes):
+        """Copy straight out of shared memory into the C++ queue — the
+        memcpy runs inside ptq_push_tagged with the GIL released."""
+        if self._lib is None:
+            self._py.put(tag + bytes(shm_buf[:nbytes]))
+            return
+        buf = (self._ctypes.c_uint8 * nbytes).from_buffer(shm_buf)
+        self._lib.ptq_push_tagged(self._q, tag[0], buf, nbytes)
+
+    def pop(self, timeout=None):
+        """Returns (tag, payload_memoryview) or None on timeout."""
+        if self._lib is None:
+            try:
+                data = self._py.get(timeout=timeout)
+            except pyqueue.Empty:
+                return None
+            return data[:1], memoryview(data)[1:]
+        ms = int((timeout or 3600) * 1000)
+        out_cap = 1 << 16
+        while True:
+            out = (self._ctypes.c_uint8 * out_cap)()
+            r = self._lib.ptq_pop_timed(self._q, out, out_cap, ms)
+            if r == -3:
+                return None
+            if r == -1:
+                return _TAG_END, memoryview(b"")
+            if r == -2:
+                n = self._lib.ptq_peek_size(self._q)
+                if n < 0:
+                    return _TAG_END, memoryview(b"")
+                out_cap = int(n)
+                continue
+            data = memoryview(out)[:int(r)]
+            return bytes(data[:1]), data[1:]
+
+    def close(self):
+        if self._lib is not None:
+            self._lib.ptq_close(self._q)
+
+    def destroy(self):
+        if self._lib is not None:
+            self._lib.ptq_destroy(self._q)
+
+
+def _mp_context():
+    import multiprocessing as mp
+    method = os.environ.get("PADDLE_TPU_MP_START")
+    if method:
+        return mp.get_context(method)
+    # fork is fast and fine for numpy datasets; spawn-safe code paths are
+    # kept (everything pickled is module-level)
+    try:
+        return mp.get_context("fork")
+    except ValueError:  # pragma: no cover - non-posix
+        return mp.get_context("spawn")
+
+
+class MultiprocessLoaderIter:
+    """One epoch's iterator over worker processes (map or iterable style)."""
+
+    def __init__(self, dataset, collate_fn, batches, num_workers,
+                 prefetch_factor=2, timeout=0, worker_init_fn=None,
+                 use_shared_memory=True, iterable_batch_size=None,
+                 iterable_drop_last=False, base_seed=None):
+        ctx = _mp_context()
+        self.timeout = timeout or None
+        self.num_workers = num_workers
+        self._iterable = iterable_batch_size is not None
+        self._batches = list(batches) if batches is not None else None
+        self._result_queue = ctx.Queue()
+        self._index_queue = ctx.Queue() if not self._iterable else None
+        depth = max(2, num_workers * prefetch_factor)
+        self._chan = _ByteChannel(depth)
+        self._shutdown = False
+        base_seed = np.random.randint(1 << 30) if base_seed is None \
+            else base_seed
+
+        self._workers = []
+        for wid in range(num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, collate_fn, self._index_queue,
+                      self._result_queue, wid, num_workers, base_seed,
+                      worker_init_fn, use_shared_memory,
+                      iterable_batch_size, iterable_drop_last),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+
+        if not self._iterable:
+            self._n_batches = len(self._batches)
+            for task in enumerate(self._batches):
+                self._index_queue.put(task)
+            for _ in range(num_workers):
+                self._index_queue.put(None)
+        self._feeder = threading.Thread(target=self._feed, daemon=True)
+        self._feeder.start()
+
+    # -- feeder thread: result_queue -> (reorder) -> byte channel ---------
+    def _feed(self):
+        try:
+            if self._iterable:
+                done = 0
+                while done < self.num_workers:
+                    msg = self._get_result()
+                    if msg is None:
+                        return  # timeout error already pushed
+                    kind, idx, a, b = msg
+                    if kind == "done":
+                        done += 1
+                        continue
+                    self._push_result(kind, a, b)
+                self._chan.push(_TAG_END, b"")
+                return
+            received = 0
+            reorder = {}
+            next_out = 0
+            while received < self._n_batches:
+                msg = self._get_result()
+                if msg is None:
+                    return
+                kind, idx, a, b = msg
+                received += 1
+                reorder[idx] = (kind, a, b)
+                while next_out in reorder:
+                    self._push_result(*reorder.pop(next_out))
+                    next_out += 1
+            self._chan.push(_TAG_END, b"")
+        except Exception:
+            try:
+                self._chan.push(_TAG_ERR, pickle.dumps(
+                    traceback.format_exc()))
+            except Exception:
+                pass
+        finally:
+            self._chan.close()
+
+    def _get_result(self):
+        try:
+            msg = self._result_queue.get(timeout=self.timeout)
+        except pyqueue.Empty:
+            self._chan.push(_TAG_ERR, pickle.dumps(
+                f"DataLoader timed out after {self.timeout}s waiting for a "
+                f"worker batch ({sum(w.is_alive() for w in self._workers)}"
+                f"/{self.num_workers} workers alive)"))
+            self._chan.close()
+            return None
+        if len(msg) == 3:
+            msg = (*msg, None)
+        return msg
+
+    def _push_result(self, kind, a, b):
+        if kind == "err":
+            self._chan.push(_TAG_ERR, pickle.dumps(a))
+        elif kind == "shm":
+            from multiprocessing import shared_memory
+            shm = shared_memory.SharedMemory(name=a)
+            try:
+                self._chan.push_shm_frame(_TAG_BATCH, shm.buf, b)
+            finally:
+                shm.close()
+                shm.unlink()
+        else:  # inline bytes
+            self._chan.push(_TAG_BATCH, a)
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .native_loader import _deserialize_batch
+        if self._shutdown:
+            raise StopIteration
+        got = self._chan.pop(timeout=self.timeout)
+        if got is None:
+            self._shutdown_workers()
+            raise RuntimeError(
+                f"DataLoader timed out after {self.timeout}s")
+        tag, payload = got
+        if tag == _TAG_END:
+            self._shutdown_workers()
+            raise StopIteration
+        if tag == _TAG_ERR:
+            self._shutdown_workers()
+            raise RuntimeError(
+                "DataLoader worker failed:\n" + pickle.loads(bytes(payload)))
+        return _deserialize_batch(payload)
+
+    def _shutdown_workers(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for w in self._workers:
+            w.join(timeout=5)
+        for w in self._workers:
+            if w.is_alive():  # pragma: no cover - stuck worker
+                w.terminate()
+        self._chan.destroy()
+
+    def __del__(self):  # pragma: no cover - gc path
+        try:
+            self._shutdown_workers()
+        except Exception:
+            pass

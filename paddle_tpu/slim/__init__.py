@@ -1,0 +1,377 @@
+"""paddle.slim — quantization toolkit (QAT + PTQ), TPU-first.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ —
+QuantizationTransformPass inserts fake_quantize/dequantize ops into the
+program; imperative/qat.py (ImperativeQuantAware) swaps dygraph layers for
+quantized variants; post_training_quantization.py calibrates activation
+ranges then emits an int8 program.
+
+TPU-first rework: int8 matmul/conv are first-class MXU ops, so the
+converted path quantizes activations on the fly, runs the contraction in
+int8 with an int32 accumulator (`preferred_element_type`), and folds the
+(act_scale × weight_scale) rescale into one multiply — XLA fuses it into
+the epilogue. Fake-quant for QAT is a straight-through estimator
+(custom_vjp). Observers are host-side state updated eagerly (the reference
+QAT is dygraph-only too).
+
+Public API (reference names):
+  ImperativeQuantAware      — QAT: .quantize(model) swaps layers in place
+  PostTrainingQuantization  — PTQ: calibrate → .convert() int8 model
+  fake_quant, quantize_symmetric, dequantize — functional pieces
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import nn
+
+
+def _qmax(bits):
+    return (1 << (bits - 1)) - 1
+
+
+def quantize_symmetric(x, scale, bits=8):
+    """x (float) -> int8/int16 codes with symmetric per-tensor scale."""
+    qm = _qmax(bits)
+    dt = jnp.int8 if bits <= 8 else jnp.int16
+    safe = jnp.maximum(scale, 1e-12)
+    return jnp.clip(jnp.round(x / safe * qm), -qm, qm).astype(dt)
+
+
+def dequantize(q, scale, bits=8):
+    return q.astype(jnp.float32) * (scale / _qmax(bits))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fake_quant(x, scale, bits=8):
+    """Quantize→dequantize with a straight-through gradient (ref:
+    fake_quantize_dequantize ops in quantization_pass.py)."""
+    return dequantize(quantize_symmetric(x, scale, bits), scale, bits)
+
+
+def _fq_fwd(x, scale, bits):
+    safe = jnp.maximum(scale, 1e-12)
+    in_range = jnp.abs(x) <= safe
+    return fake_quant(x, scale, bits), in_range
+
+
+def _fq_bwd(bits, res, g):
+    in_range = res
+    return (jnp.where(in_range, g, 0.0), jnp.zeros(()))
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+# ---------------------------------------------------------------- observers
+
+class AbsmaxObserver:
+    """Running max(|x|) (ref algo='abs_max')."""
+
+    def __init__(self):
+        self.scale = 0.0
+
+    def update(self, x):
+        self.scale = max(self.scale, float(jnp.max(jnp.abs(x))))
+
+
+class MovingAverageAbsmaxObserver:
+    """EMA of per-batch max(|x|) (ref algo='moving_average_abs_max')."""
+
+    def __init__(self, momentum=0.9):
+        self.momentum = momentum
+        self.scale = 0.0
+        self._init = False
+
+    def update(self, x):
+        cur = float(jnp.max(jnp.abs(x)))
+        if not self._init:
+            self.scale, self._init = cur, True
+        else:
+            self.scale = self.momentum * self.scale \
+                + (1 - self.momentum) * cur
+
+
+class PercentileObserver:
+    """Percentile of |x| over calibration (ref algo='hist'-style, robust to
+    outliers)."""
+
+    def __init__(self, percentile=99.9):
+        self.percentile = percentile
+        self._samples = []
+
+    def update(self, x):
+        a = np.abs(np.asarray(x)).ravel()
+        if a.size > 4096:  # subsample to bound memory
+            a = a[:: max(1, a.size // 4096)]
+        self._samples.append(a)
+
+    @property
+    def scale(self):
+        if not self._samples:
+            return 0.0
+        return float(np.percentile(np.concatenate(self._samples),
+                                   self.percentile))
+
+
+_OBSERVERS = {
+    "abs_max": AbsmaxObserver,
+    "moving_average_abs_max": MovingAverageAbsmaxObserver,
+    "hist": PercentileObserver,
+}
+
+
+# ---------------------------------------------------------- quantized layers
+
+class QuantedLinear(nn.Layer):
+    """Linear in one of three modes:
+    - 'qat': fake-quant weight + input each call (STE grads), observer
+      tracks the activation range;
+    - 'calib': float forward, observer records input absmax;
+    - 'int8': real int8×int8→int32 matmul on the MXU, one rescale."""
+
+    def __init__(self, inner, mode="qat", weight_bits=8, activation_bits=8,
+                 act_observer="moving_average_abs_max"):
+        super().__init__()
+        self.inner = inner
+        self.mode = mode
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_observer = _OBSERVERS[act_observer]()
+        self.w_scale = float(jnp.max(jnp.abs(inner.weight._value)))
+        self._wq = None
+
+    def _observe(self, xv):
+        import jax.core as jcore
+        if not isinstance(xv, jcore.Tracer):  # observers are eager-only
+            self.act_observer.update(xv)
+
+    def convert(self):
+        """Freeze to int8: quantize the weight once."""
+        self._wq = quantize_symmetric(self.inner.weight._value,
+                                      self.w_scale, self.weight_bits)
+        self.mode = "int8"
+        return self
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.mode == "calib":
+            self._observe(xv)
+            return self.inner(x)
+        if self.mode == "qat":
+            self._observe(xv)
+            a_scale = self.act_observer.scale or float(jnp.max(jnp.abs(xv)))
+            from ..ops._registry import apply_op
+
+            def core(xv, wv, *bias):
+                xq = fake_quant(xv, jnp.asarray(a_scale),
+                                self.activation_bits)
+                wq = fake_quant(wv, jnp.asarray(self.w_scale),
+                                self.weight_bits)
+                y = xq @ wq
+                return y + bias[0] if bias else y
+
+            args = [x if isinstance(x, Tensor) else Tensor(xv),
+                    self.inner.weight]
+            if self.inner.bias is not None:
+                args.append(self.inner.bias)
+            return apply_op(core, "quanted_linear", tuple(args), {})
+        # int8 inference path
+        a_scale = self.act_observer.scale or 1.0
+        xq = quantize_symmetric(xv, a_scale, self.activation_bits)
+        acc = jax.lax.dot_general(
+            xq, self._wq,
+            (((xv.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        rescale = (a_scale / _qmax(self.activation_bits)) * \
+            (self.w_scale / _qmax(self.weight_bits))
+        y = acc.astype(jnp.float32) * rescale
+        if self.inner.bias is not None:
+            y = y + self.inner.bias._value
+        return Tensor(y)
+
+
+class QuantedConv2D(nn.Layer):
+    """Conv2D counterpart of QuantedLinear (NCHW)."""
+
+    def __init__(self, inner, mode="qat", weight_bits=8, activation_bits=8,
+                 act_observer="moving_average_abs_max"):
+        super().__init__()
+        self.inner = inner
+        self.mode = mode
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_observer = _OBSERVERS[act_observer]()
+        self.w_scale = float(jnp.max(jnp.abs(inner.weight._value)))
+        self._wq = None
+
+    def _observe(self, xv):
+        import jax.core as jcore
+        if not isinstance(xv, jcore.Tracer):
+            self.act_observer.update(xv)
+
+    def convert(self):
+        self._wq = quantize_symmetric(self.inner.weight._value,
+                                      self.w_scale, self.weight_bits)
+        self.mode = "int8"
+        return self
+
+    def _conv(self, x, w, preferred=None):
+        inner = self.inner
+        st = inner.stride if isinstance(inner.stride, (list, tuple)) \
+            else (inner.stride, inner.stride)
+        pd = inner.padding if isinstance(inner.padding, (list, tuple)) \
+            else (inner.padding, inner.padding)
+        dl = inner.dilation if isinstance(inner.dilation, (list, tuple)) \
+            else (inner.dilation, inner.dilation)
+        kw = {}
+        if preferred is not None:
+            kw["preferred_element_type"] = preferred
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=tuple(st),
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])],
+            rhs_dilation=tuple(dl), feature_group_count=inner.groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"), **kw)
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.mode == "calib":
+            self._observe(xv)
+            return self.inner(x)
+        if self.mode == "qat":
+            self._observe(xv)
+            a_scale = self.act_observer.scale or float(jnp.max(jnp.abs(xv)))
+            from ..ops._registry import apply_op
+
+            def core(xv, wv, *bias):
+                xq = fake_quant(xv, jnp.asarray(a_scale),
+                                self.activation_bits)
+                wq = fake_quant(wv, jnp.asarray(self.w_scale),
+                                self.weight_bits)
+                y = self._conv(xq, wq)
+                if bias:
+                    y = y + bias[0].reshape(1, -1, 1, 1)
+                return y
+
+            args = [x if isinstance(x, Tensor) else Tensor(xv),
+                    self.inner.weight]
+            if self.inner.bias is not None:
+                args.append(self.inner.bias)
+            return apply_op(core, "quanted_conv2d", tuple(args), {})
+        a_scale = self.act_observer.scale or 1.0
+        xq = quantize_symmetric(xv, a_scale, self.activation_bits)
+        acc = self._conv(xq, self._wq, preferred=jnp.int32)
+        rescale = (a_scale / _qmax(self.activation_bits)) * \
+            (self.w_scale / _qmax(self.weight_bits))
+        y = acc.astype(jnp.float32) * rescale
+        if self.inner.bias is not None:
+            y = y + self.inner.bias._value.reshape(1, -1, 1, 1)
+        return Tensor(y)
+
+
+_QUANTABLE = {}
+
+
+def _quantable():
+    if not _QUANTABLE:
+        _QUANTABLE[nn.Linear] = QuantedLinear
+        _QUANTABLE[nn.Conv2D] = QuantedConv2D
+    return _QUANTABLE
+
+
+def _swap(model, mode, weight_bits, activation_bits, act_observer):
+    """Replace every quantable sublayer in place; returns the wrappers."""
+    table = _quantable()
+    wrapped = []
+
+    def visit(layer):
+        for name, child in list(layer._sub_layers.items()):
+            cls = table.get(type(child))
+            if cls is not None:
+                q = cls(child, mode=mode, weight_bits=weight_bits,
+                        activation_bits=activation_bits,
+                        act_observer=act_observer)
+                layer._sub_layers[name] = q
+                if name in layer.__dict__:
+                    layer.__dict__[name] = q
+                wrapped.append(q)
+            else:
+                visit(child)
+
+    visit(model)
+    return wrapped
+
+
+class ImperativeQuantAware:
+    """QAT driver (ref: imperative/qat.py ImperativeQuantAware): swaps
+    Linear/Conv2D for fake-quant wrappers; after training call
+    `.convert(model)` for the int8 inference model."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9, quantizable_layer_type=None):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_observer = activation_quantize_type
+        self._wrapped = []
+
+    def quantize(self, model):
+        self._wrapped = _swap(model, "qat", self.weight_bits,
+                              self.activation_bits, self.act_observer)
+        return model
+
+    def convert(self, model):
+        for q in self._wrapped:
+            q.convert()
+        return model
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        from .. import jit
+        self.convert(layer)
+        jit.save(layer, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ driver (ref: post_training_quantization.py): calibrate activation
+    ranges over sample data, then convert weights+compute to int8."""
+
+    def __init__(self, model=None, algo="hist", weight_bits=8,
+                 activation_bits=8, executor=None, **kw):
+        self.model = model
+        self.algo = {"abs_max": "abs_max", "hist": "hist",
+                     "avg": "moving_average_abs_max",
+                     "mse": "hist", "KL": "hist"}.get(algo, "abs_max")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._wrapped = []
+
+    def quantize(self, data_loader=None, batch_nums=None):
+        """Calibration pass: run the model over data_loader batches with
+        observers attached, then freeze to int8."""
+        self._wrapped = _swap(self.model, "calib", self.weight_bits,
+                              self.activation_bits, self.act_observer_name)
+        self.model.eval()
+        if data_loader is not None:
+            for i, batch in enumerate(data_loader):
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                if not isinstance(x, Tensor):
+                    x = Tensor(jnp.asarray(np.asarray(x)))
+                self.model(x)
+                if batch_nums is not None and i + 1 >= batch_nums:
+                    break
+        return self.convert()
+
+    @property
+    def act_observer_name(self):
+        return self.algo
+
+    def convert(self):
+        for q in self._wrapped:
+            q.convert()
+        return self.model

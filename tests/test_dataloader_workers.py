@@ -1,0 +1,162 @@
+"""Multiprocess DataLoader workers (VERDICT r1 #4).
+
+Covers: ~Nx speedup on a CPU-bound __getitem__, deterministic batch order,
+worker exception propagation with the original traceback, timeout, shared
+memory transport, get_worker_info inside workers, iterable-dataset sharding.
+Dataset classes live at module top level so the spawn start method works too.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset, IterableDataset
+
+
+class SlowDataset(Dataset):
+    """CPU-bound __getitem__ — holds the GIL, so threads can't parallelize
+    it but worker processes can."""
+
+    def __init__(self, n=64, delay=0.02):
+        self.n = n
+        self.delay = delay
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < self.delay:
+            pass  # busy-wait: holds the GIL (sleep would release it)
+        return np.full((4,), i, np.float32)
+
+
+class FailingDataset(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        if i == 11:
+            raise ValueError("boom at index 11")
+        return np.zeros((2,), np.float32)
+
+
+class HangingDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i >= 4:
+            time.sleep(60)
+        return np.zeros((2,), np.float32)
+
+
+class InfoDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        from paddle_tpu.io import get_worker_info
+        info = get_worker_info()
+        return np.array([i, -1 if info is None else info.id,
+                         -1 if info is None else info.num_workers],
+                        np.int64)
+
+
+class ShardedIterable(IterableDataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __iter__(self):
+        from paddle_tpu.io import get_worker_info
+        info = get_worker_info()
+        wid = 0 if info is None else info.id
+        nw = 1 if info is None else info.num_workers
+        for i in range(wid, self.n, nw):
+            yield np.array([i], np.int64)
+
+
+class TestMultiprocessDataLoader:
+    def test_order_and_values(self):
+        ds = SlowDataset(n=32, delay=0.0)
+        loader = DataLoader(ds, batch_size=4, num_workers=2)
+        batches = [np.asarray(b.numpy() if hasattr(b, "numpy") else b)
+                   for b in loader]
+        assert len(batches) == 8
+        flat = np.concatenate([b[:, 0] for b in batches])
+        np.testing.assert_array_equal(flat, np.arange(32))
+
+    def test_speedup_with_workers(self):
+        # VERDICT done-criterion: slow __getitem__, num_workers=4 ~4x faster
+        ds = SlowDataset(n=64, delay=0.02)  # 1.28s of pure GIL-bound work
+
+        t0 = time.perf_counter()
+        n0 = sum(1 for _ in DataLoader(ds, batch_size=8, num_workers=0))
+        serial = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        n4 = sum(1 for _ in DataLoader(ds, batch_size=8, num_workers=4))
+        parallel = time.perf_counter() - t0
+
+        assert n0 == n4 == 8
+        # demand >2x to stay robust on loaded CI machines (ideal ~4x)
+        assert parallel < serial / 2.0, (serial, parallel)
+
+    def test_worker_error_propagates_with_traceback(self):
+        loader = DataLoader(FailingDataset(), batch_size=4, num_workers=2)
+        with pytest.raises(RuntimeError) as ei:
+            list(loader)
+        assert "boom at index 11" in str(ei.value)
+        assert "ValueError" in str(ei.value)
+
+    def test_timeout(self):
+        loader = DataLoader(HangingDataset(), batch_size=4, num_workers=2,
+                            timeout=2)
+        with pytest.raises(RuntimeError, match="timed out"):
+            list(loader)
+
+    def test_get_worker_info_inside_worker(self):
+        loader = DataLoader(InfoDataset(), batch_size=2, num_workers=2)
+        rows = np.concatenate(
+            [np.asarray(b.numpy() if hasattr(b, "numpy") else b)
+             for b in loader])
+        rows = rows.astype(np.int64)
+        assert set(rows[:, 1]) <= {0, 1}       # worker ids
+        assert (rows[:, 2] == 2).all()          # num_workers visible
+        from paddle_tpu.io import get_worker_info
+        assert get_worker_info() is None        # main process
+
+    def test_worker_init_fn_runs(self):
+        calls = []
+
+        def init(worker_id):
+            import os
+            os.environ["PADDLE_TPU_TEST_WID"] = str(worker_id)
+
+        loader = DataLoader(SlowDataset(n=8, delay=0.0), batch_size=4,
+                            num_workers=2, worker_init_fn=init)
+        assert len(list(loader)) == 2
+
+    def test_iterable_dataset_sharding(self):
+        loader = DataLoader(ShardedIterable(n=32), batch_size=4,
+                            num_workers=2)
+        seen = sorted(
+            int(x) for b in loader
+            for x in np.asarray(b.numpy() if hasattr(b, "numpy")
+                                else b).ravel())
+        assert seen == list(range(32))  # each item exactly once
+
+    def test_shared_memory_roundtrip_dict_batches(self):
+        class _D(Dataset):  # local class: fork start method covers this
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {"x": np.full((3,), i, np.float32), "y": int(i)}
+
+        loader = DataLoader(_D(), batch_size=4, num_workers=2)
+        out = list(loader)
+        assert len(out) == 2
+        xs = np.asarray(out[0]["x"].numpy() if hasattr(out[0]["x"], "numpy")
+                        else out[0]["x"])
+        np.testing.assert_allclose(xs[:, 0], [0, 1, 2, 3])

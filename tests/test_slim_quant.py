@@ -1,0 +1,121 @@
+"""Quantization subsystem (VERDICT r1 #5): PTQ, QAT, int8 inference path.
+
+Ref: fluid/contrib/slim/quantization — quantization_pass.py fake-quant
+semantics, post_training_quantization.py calibration, imperative/qat.py.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.slim import (
+    ImperativeQuantAware, PostTrainingQuantization, QuantedConv2D,
+    QuantedLinear, dequantize, fake_quant, quantize_symmetric)
+
+
+class TestFunctional:
+    def test_quant_dequant_roundtrip(self):
+        x = np.linspace(-2, 2, 64).astype(np.float32)
+        q = quantize_symmetric(jnp.asarray(x), 2.0, bits=8)
+        assert q.dtype == jnp.int8
+        back = np.asarray(dequantize(q, 2.0, bits=8))
+        np.testing.assert_allclose(back, x, atol=2.0 / 127 + 1e-6)
+
+    def test_fake_quant_ste_gradient(self):
+        import jax
+        g = jax.grad(lambda x: fake_quant(x, jnp.asarray(1.0), 8).sum())(
+            jnp.asarray([0.5, -0.3, 5.0]))  # 5.0 is clipped -> zero grad
+        np.testing.assert_allclose(np.asarray(g), [1.0, 1.0, 0.0])
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+class TestPTQ:
+    def _data(self, n=64):
+        rng = np.random.RandomState(0)
+        x = rng.randn(n, 16).astype(np.float32)
+        y = (x[:, :4] > 0).argmax(axis=1).astype(np.int64)
+        return x, y
+
+    def test_ptq_mlp_close_to_fp32(self):
+        x, y = self._data()
+        model = _MLP()
+        # train fp32 briefly so outputs are meaningful
+        sgd = opt.Adam(learning_rate=0.01, parameters=model.parameters())
+        for _ in range(30):
+            loss = paddle.nn.functional.cross_entropy(
+                model(Tensor(jnp.asarray(x))), Tensor(jnp.asarray(y)))
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+        ref = np.asarray(model(Tensor(jnp.asarray(x))).numpy())
+
+        ptq = PostTrainingQuantization(model=model, algo="abs_max")
+        ptq.quantize(data_loader=[(x[i:i + 16],) for i in range(0, 64, 16)])
+        # layers really swapped + frozen to int8
+        assert isinstance(model.fc1, QuantedLinear)
+        assert model.fc1.mode == "int8"
+        assert model.fc1._wq.dtype == jnp.int8
+        out = np.asarray(model(Tensor(jnp.asarray(x))).numpy())
+        # int8 outputs track fp32 closely; argmax agreement is the metric
+        agree = (out.argmax(1) == ref.argmax(1)).mean()
+        assert agree >= 0.95, agree
+
+    def test_ptq_lenet_conv_int8(self):
+        from paddle_tpu.vision.models import LeNet
+        model = LeNet()
+        model.eval()
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(8, 1, 28, 28).astype(np.float32)
+        ref = np.asarray(model(Tensor(jnp.asarray(imgs))).numpy())
+        ptq = PostTrainingQuantization(model=model, algo="abs_max")
+        ptq.quantize(data_loader=[(imgs,)], batch_nums=1)
+        convs = [m for _, m in model.named_sublayers()
+                 if isinstance(m, QuantedConv2D)]
+        assert convs and all(c._wq.dtype == jnp.int8 for c in convs)
+        out = np.asarray(model(Tensor(jnp.asarray(imgs))).numpy())
+        assert (out.argmax(1) == ref.argmax(1)).mean() >= 0.9
+        # scale-aware error bound: int8 logits within a few quant steps
+        assert np.max(np.abs(out - ref)) / (np.max(np.abs(ref)) + 1e-9) < 0.2
+
+
+class TestQAT:
+    def test_qat_trains_and_converts(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(64, 16).astype(np.float32)
+        y = (x[:, :4] > 0).argmax(axis=1).astype(np.int64)
+        model = _MLP()
+        qat = ImperativeQuantAware()
+        qat.quantize(model)
+        assert isinstance(model.fc1, QuantedLinear)
+        assert model.fc1.mode == "qat"
+        sgd = opt.Adam(learning_rate=0.01, parameters=model.parameters())
+        losses = []
+        for _ in range(40):
+            loss = paddle.nn.functional.cross_entropy(
+                model(Tensor(jnp.asarray(x))), Tensor(jnp.asarray(y)))
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+        # observer collected activation ranges during training
+        assert model.fc1.act_observer.scale > 0
+        qat_out = np.asarray(model(Tensor(jnp.asarray(x))).numpy())
+        qat.convert(model)
+        assert model.fc1.mode == "int8"
+        int8_out = np.asarray(model(Tensor(jnp.asarray(x))).numpy())
+        # converted int8 model matches the fake-quant model it trained as
+        assert (int8_out.argmax(1) == qat_out.argmax(1)).mean() >= 0.95
