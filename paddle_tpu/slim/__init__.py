@@ -2,17 +2,31 @@
 
 Reference: python/paddle/fluid/contrib/slim/quantization/ —
 QuantizationTransformPass inserts fake_quantize/dequantize ops into the
-program; imperative/qat.py (ImperativeQuantAware) swaps dygraph layers for
-quantized variants; post_training_quantization.py calibrates activation
-ranges then emits an int8 program.
+program (per-tensor `abs_max` and per-channel `channel_wise_abs_max`,
+quantization_pass.py:329); imperative/qat.py (ImperativeQuantAware) swaps
+dygraph layers for quantized variants; post_training_quantization.py
+calibrates activation ranges then emits an int8 program.
 
 TPU-first rework: int8 matmul/conv are first-class MXU ops, so the
 converted path quantizes activations on the fly, runs the contraction in
 int8 with an int32 accumulator (`preferred_element_type`), and folds the
 (act_scale × weight_scale) rescale into one multiply — XLA fuses it into
 the epilogue. Fake-quant for QAT is a straight-through estimator
-(custom_vjp). Observers are host-side state updated eagerly (the reference
-QAT is dygraph-only too).
+(custom_vjp). Two observer designs, matching the two execution modes:
+
+- QAT activation ranges live in a registered *buffer* updated with traced
+  jnp ops (EMA of per-batch absmax). Buffers flow through
+  `Layer.functional_state()`, so the update works identically in eager
+  mode and inside `@to_static`/hapi's jitted train step — the jit wrapper
+  returns new buffer values and writes them back (jit/__init__.py pure()).
+- PTQ calibration is eager-only by contract (like the reference's
+  sample-generator loop), so the 'hist'/percentile observer may keep
+  host-side sample lists.
+
+Weight scales during QAT are recomputed from the *current* weights inside
+the traced computation every forward (reference fake_quantize_abs_max also
+re-reads the weight each pass), so weights drifting outside their initial
+range are never silently clipped.
 
 Public API (reference names):
   ImperativeQuantAware      — QAT: .quantize(model) swaps layers in place
@@ -36,7 +50,8 @@ def _qmax(bits):
 
 
 def quantize_symmetric(x, scale, bits=8):
-    """x (float) -> int8/int16 codes with symmetric per-tensor scale."""
+    """x (float) -> int8/int16 codes with symmetric scale. `scale` may be a
+    scalar (per-tensor) or an array broadcastable against x (per-channel)."""
     qm = _qmax(bits)
     dt = jnp.int8 if bits <= 8 else jnp.int16
     safe = jnp.maximum(scale, 1e-12)
@@ -50,22 +65,40 @@ def dequantize(q, scale, bits=8):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def fake_quant(x, scale, bits=8):
     """Quantize→dequantize with a straight-through gradient (ref:
-    fake_quantize_dequantize ops in quantization_pass.py)."""
+    fake_quantize_dequantize ops in quantization_pass.py). Per-tensor or
+    per-channel depending on scale's shape."""
     return dequantize(quantize_symmetric(x, scale, bits), scale, bits)
 
 
 def _fq_fwd(x, scale, bits):
     safe = jnp.maximum(scale, 1e-12)
     in_range = jnp.abs(x) <= safe
-    return fake_quant(x, scale, bits), in_range
+    return fake_quant(x, scale, bits), (in_range, scale)
 
 
 def _fq_bwd(bits, res, g):
-    in_range = res
-    return (jnp.where(in_range, g, 0.0), jnp.zeros(()))
+    in_range, scale = res
+    return (jnp.where(in_range, g, 0.0), jnp.zeros(jnp.shape(scale)))
 
 
 fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def channel_axes(weight_ndim, kind):
+    """Reduction axes for channel_wise_abs_max. Paddle quantizes conv weights
+    per output channel (OIHW axis 0) and Linear/matmul weights per output
+    feature (last axis) — quantization_pass.py:329."""
+    if kind == "conv":
+        return tuple(range(1, weight_ndim))
+    return tuple(range(weight_ndim - 1))
+
+
+def weight_scale_of(w, quantize_type, kind):
+    """Current-weight scale, traced (works on tracers under jit)."""
+    if quantize_type == "channel_wise_abs_max":
+        return jnp.max(jnp.abs(w), axis=channel_axes(w.ndim, kind),
+                       keepdims=True)
+    return jnp.max(jnp.abs(w))
 
 
 # ---------------------------------------------------------------- observers
@@ -128,100 +161,170 @@ _OBSERVERS = {
 
 # ---------------------------------------------------------- quantized layers
 
-class QuantedLinear(nn.Layer):
-    """Linear in one of three modes:
-    - 'qat': fake-quant weight + input each call (STE grads), observer
-      tracks the activation range;
-    - 'calib': float forward, observer records input absmax;
-    - 'int8': real int8×int8→int32 matmul on the MXU, one rescale."""
+class _QuantedBase(nn.Layer):
+    """Shared machinery for QuantedLinear/QuantedConv2D.
+
+    Modes:
+    - 'qat': fake-quant weight (scale recomputed from current weights,
+      in-trace) + input (EMA buffer scale) each call, STE grads;
+    - 'calib': float forward, host observer records input range (eager);
+    - 'int8': real int8×int8→int32 contraction on the MXU, one rescale.
+    """
+
+    _kind = "linear"
 
     def __init__(self, inner, mode="qat", weight_bits=8, activation_bits=8,
-                 act_observer="moving_average_abs_max"):
+                 act_observer="moving_average_abs_max",
+                 weight_quantize_type="abs_max", moving_rate=0.9):
         super().__init__()
         self.inner = inner
         self.mode = mode
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.moving_rate = moving_rate
+        self.act_quantize_type = act_observer
         self.act_observer = _OBSERVERS[act_observer]()
-        self.w_scale = float(jnp.max(jnp.abs(inner.weight._value)))
+        # traced per-batch activation range stat; 0.0 == uninitialized.
+        # abs_max -> running max (never decreases); moving_average_abs_max/
+        # hist -> EMA (hist's percentile host observer is calib-only, QAT
+        # falls back to EMA like the reference's MovingAverageAbsMaxScale).
+        self.register_buffer("act_scale", Tensor(jnp.zeros((), jnp.float32)))
         self._wq = None
+        self._w_scale_frozen = None
+        self._a_scale_frozen = None
 
-    def _observe(self, xv):
+    # -- activation range tracking ------------------------------------
+    def _track_act(self, xv):
+        """Absmax-stat update as traced ops on the act_scale buffer — runs
+        under jit (buffer round-trips through functional_state) and eagerly."""
+        cur = jnp.max(jnp.abs(xv)).astype(jnp.float32)
+        old = self.act_scale._value
+        if self.act_quantize_type == "abs_max":
+            new = jnp.maximum(old, cur)
+        else:
+            new = jnp.where(
+                old > 0,
+                self.moving_rate * old + (1 - self.moving_rate) * cur,
+                cur)
+        self.act_scale._value = new
+        return new
+
+    def _act_scale_for_eval(self, xv):
+        """Frozen stat for eval-mode QAT forwards (no observer pollution —
+        ref MovingAverageAbsMaxScale only updates when training)."""
+        buf = self.act_scale._value
+        return jnp.where(buf > 0, buf, jnp.max(jnp.abs(xv)))
+
+    def _observe_host(self, xv):
         import jax.core as jcore
-        if not isinstance(xv, jcore.Tracer):  # observers are eager-only
+        if not isinstance(xv, jcore.Tracer):  # calib path is eager-only
             self.act_observer.update(xv)
 
+    def _calib_scale(self):
+        """Best activation scale available at convert time."""
+        host = self.act_observer.scale
+        buf = float(self.act_scale._value)
+        return host or buf or 1.0
+
+    # -- contraction (subclass hook) ----------------------------------
+    def _contract(self, x, w, preferred=None):
+        raise NotImplementedError
+
+    def _add_bias(self, y, bias):
+        raise NotImplementedError
+
+    def _per_channel_acc_scale(self, w_scale):
+        """Reshape the per-channel weight scale to broadcast against the
+        contraction output."""
+        raise NotImplementedError
+
+    # -- lifecycle ----------------------------------------------------
     def convert(self):
-        """Freeze to int8: quantize the weight once."""
-        self._wq = quantize_symmetric(self.inner.weight._value,
-                                      self.w_scale, self.weight_bits)
+        """Freeze to int8: quantize the weight once with the final scale."""
+        w = self.inner.weight._value
+        ws = weight_scale_of(w, self.weight_quantize_type, self._kind)
+        self._w_scale_frozen = jnp.asarray(ws)
+        self._wq = quantize_symmetric(w, self._w_scale_frozen,
+                                      self.weight_bits)
+        self._a_scale_frozen = self._calib_scale()
         self.mode = "int8"
         return self
+
+    # back-compat: round-2 tests/code read `.w_scale` as the per-tensor float
+    @property
+    def w_scale(self):
+        if self._w_scale_frozen is not None:
+            return float(jnp.max(self._w_scale_frozen))
+        return float(jnp.max(jnp.abs(self.inner.weight._value)))
 
     def forward(self, x):
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
         if self.mode == "calib":
-            self._observe(xv)
+            self._observe_host(xv)
+            self._track_act(xv)
             return self.inner(x)
         if self.mode == "qat":
-            self._observe(xv)
-            a_scale = self.act_observer.scale or float(jnp.max(jnp.abs(xv)))
+            a_scale = self._track_act(xv) if self.training \
+                else self._act_scale_for_eval(xv)
             from ..ops._registry import apply_op
+            wq_type, kind, bits_w, bits_a = (self.weight_quantize_type,
+                                             self._kind, self.weight_bits,
+                                             self.activation_bits)
 
             def core(xv, wv, *bias):
-                xq = fake_quant(xv, jnp.asarray(a_scale),
-                                self.activation_bits)
-                wq = fake_quant(wv, jnp.asarray(self.w_scale),
-                                self.weight_bits)
-                y = xq @ wq
-                return y + bias[0] if bias else y
+                xq = fake_quant(xv, a_scale, bits_a)
+                # live scale from the *current* weight, so drifting weights
+                # are never clipped by a stale construction-time range
+                ws = weight_scale_of(jax.lax.stop_gradient(wv), wq_type, kind)
+                wq = fake_quant(wv, ws, bits_w)
+                y = self._contract(xq, wq)
+                return self._add_bias(y, bias[0]) if bias else y
 
             args = [x if isinstance(x, Tensor) else Tensor(xv),
                     self.inner.weight]
             if self.inner.bias is not None:
                 args.append(self.inner.bias)
-            return apply_op(core, "quanted_linear", tuple(args), {})
+            return apply_op(core, f"quanted_{self._kind}", tuple(args), {})
         # int8 inference path
-        a_scale = self.act_observer.scale or 1.0
+        a_scale = self._a_scale_frozen or self._calib_scale()
         xq = quantize_symmetric(xv, a_scale, self.activation_bits)
-        acc = jax.lax.dot_general(
-            xq, self._wq,
-            (((xv.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        rescale = (a_scale / _qmax(self.activation_bits)) * \
-            (self.w_scale / _qmax(self.weight_bits))
-        y = acc.astype(jnp.float32) * rescale
+        acc = self._contract(xq, self._wq, preferred=jnp.int32)
+        w_rescale = self._per_channel_acc_scale(
+            self._w_scale_frozen / _qmax(self.weight_bits))
+        y = acc.astype(jnp.float32) * \
+            ((a_scale / _qmax(self.activation_bits)) * w_rescale)
         if self.inner.bias is not None:
-            y = y + self.inner.bias._value
+            y = self._add_bias(y, self.inner.bias)
         return Tensor(y)
 
 
-class QuantedConv2D(nn.Layer):
-    """Conv2D counterpart of QuantedLinear (NCHW)."""
+class QuantedLinear(_QuantedBase):
+    """Linear with per-tensor or per-output-feature (channel_wise_abs_max)
+    weight quantization. Weight layout [in, out]; channel scale shape
+    [1, out] broadcasts over both the weight and the [..., out] output."""
 
-    def __init__(self, inner, mode="qat", weight_bits=8, activation_bits=8,
-                 act_observer="moving_average_abs_max"):
-        super().__init__()
-        self.inner = inner
-        self.mode = mode
-        self.weight_bits = weight_bits
-        self.activation_bits = activation_bits
-        self.act_observer = _OBSERVERS[act_observer]()
-        self.w_scale = float(jnp.max(jnp.abs(inner.weight._value)))
-        self._wq = None
+    _kind = "linear"
 
-    def _observe(self, xv):
-        import jax.core as jcore
-        if not isinstance(xv, jcore.Tracer):
-            self.act_observer.update(xv)
+    def _contract(self, x, w, preferred=None):
+        kw = {"preferred_element_type": preferred} if preferred else {}
+        return jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (0,)), ((), ())), **kw)
 
-    def convert(self):
-        self._wq = quantize_symmetric(self.inner.weight._value,
-                                      self.w_scale, self.weight_bits)
-        self.mode = "int8"
-        return self
+    def _add_bias(self, y, bias):
+        b = bias._value if isinstance(bias, Tensor) else bias
+        return y + b
 
-    def _conv(self, x, w, preferred=None):
+    def _per_channel_acc_scale(self, ws):
+        return ws.reshape(-1) if ws.ndim else ws
+
+
+class QuantedConv2D(_QuantedBase):
+    """Conv2D counterpart (NCHW, OIHW weights; channel scale over axis O)."""
+
+    _kind = "conv"
+
+    def _contract(self, x, w, preferred=None):
         inner = self.inner
         st = inner.stride if isinstance(inner.stride, (list, tuple)) \
             else (inner.stride, inner.stride)
@@ -238,40 +341,13 @@ class QuantedConv2D(nn.Layer):
             rhs_dilation=tuple(dl), feature_group_count=inner.groups,
             dimension_numbers=("NCHW", "OIHW", "NCHW"), **kw)
 
-    def forward(self, x):
-        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-        if self.mode == "calib":
-            self._observe(xv)
-            return self.inner(x)
-        if self.mode == "qat":
-            self._observe(xv)
-            a_scale = self.act_observer.scale or float(jnp.max(jnp.abs(xv)))
-            from ..ops._registry import apply_op
+    def _add_bias(self, y, bias):
+        b = bias._value if isinstance(bias, Tensor) else bias
+        return y + b.reshape(1, -1, 1, 1)
 
-            def core(xv, wv, *bias):
-                xq = fake_quant(xv, jnp.asarray(a_scale),
-                                self.activation_bits)
-                wq = fake_quant(wv, jnp.asarray(self.w_scale),
-                                self.weight_bits)
-                y = self._conv(xq, wq)
-                if bias:
-                    y = y + bias[0].reshape(1, -1, 1, 1)
-                return y
-
-            args = [x if isinstance(x, Tensor) else Tensor(xv),
-                    self.inner.weight]
-            if self.inner.bias is not None:
-                args.append(self.inner.bias)
-            return apply_op(core, "quanted_conv2d", tuple(args), {})
-        a_scale = self.act_observer.scale or 1.0
-        xq = quantize_symmetric(xv, a_scale, self.activation_bits)
-        acc = self._conv(xq, self._wq, preferred=jnp.int32)
-        rescale = (a_scale / _qmax(self.activation_bits)) * \
-            (self.w_scale / _qmax(self.weight_bits))
-        y = acc.astype(jnp.float32) * rescale
-        if self.inner.bias is not None:
-            y = y + self.inner.bias._value.reshape(1, -1, 1, 1)
-        return Tensor(y)
+    def _per_channel_acc_scale(self, ws):
+        # [O,1,1,1] -> [1,O,1,1] to broadcast against NCHW accumulators
+        return ws.reshape(1, -1, 1, 1) if ws.ndim else ws
 
 
 _QUANTABLE = {}
@@ -284,7 +360,8 @@ def _quantable():
     return _QUANTABLE
 
 
-def _swap(model, mode, weight_bits, activation_bits, act_observer):
+def _swap(model, mode, weight_bits, activation_bits, act_observer,
+          weight_quantize_type="abs_max", moving_rate=0.9):
     """Replace every quantable sublayer in place; returns the wrappers."""
     table = _quantable()
     wrapped = []
@@ -295,7 +372,9 @@ def _swap(model, mode, weight_bits, activation_bits, act_observer):
             if cls is not None:
                 q = cls(child, mode=mode, weight_bits=weight_bits,
                         activation_bits=activation_bits,
-                        act_observer=act_observer)
+                        act_observer=act_observer,
+                        weight_quantize_type=weight_quantize_type,
+                        moving_rate=moving_rate)
                 layer._sub_layers[name] = q
                 if name in layer.__dict__:
                     layer.__dict__[name] = q
@@ -316,14 +395,20 @@ class ImperativeQuantAware:
                  weight_quantize_type="abs_max",
                  activation_quantize_type="moving_average_abs_max",
                  moving_rate=0.9, quantizable_layer_type=None):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unsupported weight_quantize_type {weight_quantize_type!r}")
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.act_observer = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.moving_rate = moving_rate
         self._wrapped = []
 
     def quantize(self, model):
         self._wrapped = _swap(model, "qat", self.weight_bits,
-                              self.activation_bits, self.act_observer)
+                              self.activation_bits, self.act_observer,
+                              self.weight_quantize_type, self.moving_rate)
         return model
 
     def convert(self, model):
@@ -342,20 +427,26 @@ class PostTrainingQuantization:
     ranges over sample data, then convert weights+compute to int8."""
 
     def __init__(self, model=None, algo="hist", weight_bits=8,
-                 activation_bits=8, executor=None, **kw):
+                 activation_bits=8, executor=None,
+                 weight_quantize_type="channel_wise_abs_max", **kw):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unsupported weight_quantize_type {weight_quantize_type!r}")
         self.model = model
         self.algo = {"abs_max": "abs_max", "hist": "hist",
                      "avg": "moving_average_abs_max",
                      "mse": "hist", "KL": "hist"}.get(algo, "abs_max")
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
         self._wrapped = []
 
     def quantize(self, data_loader=None, batch_nums=None):
         """Calibration pass: run the model over data_loader batches with
         observers attached, then freeze to int8."""
         self._wrapped = _swap(self.model, "calib", self.weight_bits,
-                              self.activation_bits, self.act_observer_name)
+                              self.activation_bits, self.act_observer_name,
+                              self.weight_quantize_type)
         self.model.eval()
         if data_loader is not None:
             for i, batch in enumerate(data_loader):
@@ -375,3 +466,7 @@ class PostTrainingQuantization:
         for q in self._wrapped:
             q.convert()
         return self.model
+
+    def save_quantized_model(self, path, input_spec=None, **config):
+        from .. import jit
+        jit.save(self.model, path, input_spec=input_spec)
