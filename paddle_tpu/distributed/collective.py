@@ -147,6 +147,8 @@ def get_rank(group=None):
 def get_world_size(group=None):
     # logical world = all addressable devices (chips), matching the
     # one-process-per-GPU reference model where world_size == #devices
+    if group is not None:
+        return group.nranks
     return jax.device_count()
 
 
@@ -237,11 +239,51 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    """Reduce to `dst` (ref: c_reduce_sum_op): dst ends up with the reduced
+    value, every other rank keeps its ORIGINAL tensor — implemented as
+    all-reduce + per-rank select, the SPMD analogue of a rooted reduce (the
+    wire cost on ICI is the same all-reduce ring)."""
+    x = _unwrap(tensor)
+    reducer = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin,
+               ReduceOp.AVG: jax.lax.pmean}.get(op, jax.lax.psum)
+    mesh = _mesh_1d()
+    axis = mesh.axis_names[0]
+    kw = _group_kwargs(group)
+    try:
+        reduced = reducer(x, axis if group is not None else mesh.axis_names,
+                          **kw)
+        me = jax.lax.axis_index(axis)
+        out = jnp.where(me == dst, reduced, x)
+    except NameError:  # eager, 1 participant: reduce == identity
+        out = x
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return Tensor(out)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list:
+    """Rank i receives tensor_list[i] from src (ref: c_scatter_op). In a
+    traced region each rank selects its slot by axis_index — the values are
+    already device-resident under SPMD, so no wire traffic is needed; eager
+    falls back to host-side indexing."""
+    if not tensor_list:
+        return tensor
+    vals = jnp.stack([_unwrap(t) for t in tensor_list])
+    try:
+        mesh = _mesh_1d()
+        me = jax.lax.axis_index(mesh.axis_names[0])
+        if group is not None:
+            # position within the group; non-members keep their input
+            gr = jnp.asarray(group.ranks)
+            slot = jnp.argmax(gr == me)
+            member = jnp.any(gr == me)
+            picked = jnp.take(vals, slot, axis=0)
+            tensor._value = jnp.where(member, picked, _unwrap(tensor))
+        else:
+            tensor._value = jnp.take(vals, me, axis=0)
+    except NameError:
         rank = get_rank(group)
         tensor._value = _unwrap(tensor_list[max(rank, 0)])
     return tensor
@@ -285,9 +327,16 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 
 def barrier(group=None):
-    for d in jax.devices():
-        pass
-    jax.block_until_ready(jnp.zeros(()))
+    """Device-wide rendezvous (ref: barrier_op): a tiny all-reduce over the
+    global mesh — the result cannot materialize until every device has
+    entered the collective, which IS the barrier on ICI."""
+    mesh = _mesh_1d()
+    axis = mesh.axis_names[0]
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    f = shard_map(lambda x: jax.lax.psum(x, axis), mesh=mesh,
+                  in_specs=P(), out_specs=P(), check_rep=False)
+    jax.block_until_ready(f(jnp.zeros((), jnp.int32)))
 
 
 def wait(tensor, group=None, use_calc_stream=True):

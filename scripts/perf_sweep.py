@@ -1,0 +1,229 @@
+"""Profile-driven perf sweep on the real TPU chip (VERDICT r2 next #1).
+
+Measures, with the same device_get-scalar barrier bench.py uses (the axon
+tunnel's block_until_ready returns early):
+  1. step-time decomposition: fwd / fwd+bwd / full train step
+  2. per-chip batch sweep at seq=1024
+  3. flash-attention block_q/block_k sweep (microbench, B=8 H=12 S=1024 D=64)
+  4. long-sequence (S=16384) flash fwd+bwd — forces the streaming two-kernel
+     backward (sq*d*10 > 8MB) to compile and run on hardware
+Run: timeout 1800 python scripts/perf_sweep.py [--section N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(fn, *args, iters=10, warmup=3):
+    import jax
+    for i in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _sync(out):
+    import jax
+    leaves = jax.tree_util.tree_leaves(out)
+    # fetch one scalar reduced from the first leaf — reliable barrier on axon
+    import jax.numpy as jnp
+    float(jax.device_get(jnp.sum(leaves[0]).astype(jnp.float32)))
+
+
+def section_model(batch_sizes=(8, 16, 24)):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import optimizer as opt_mod
+    from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
+
+    cfg = GPT2Config()
+    cfg.dropout = 0.0
+    loss_fn, init_params, _ = build_train_step(cfg, remat=False)
+    params0 = init_params()
+    n_params = sum(int(np.prod(v.shape)) for v in params0.values())
+
+    def _to_bf16(x):
+        return x.astype(jnp.bfloat16) \
+            if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+    def amp_loss(p32, data, key):
+        pb = jax.tree_util.tree_map(_to_bf16, p32)
+        return loss_fn(pb, data, key).astype(jnp.float32)
+
+    optimizer = opt_mod.AdamW(learning_rate=1e-4, weight_decay=0.01)
+
+    rng = np.random.RandomState(0)
+    for batch in batch_sizes:
+        seq = 1024
+        data = {
+            "input_ids": jnp.asarray(rng.randint(
+                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+            "labels": jnp.asarray(rng.randint(
+                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+        }
+        key = jax.random.key(0)
+        params = init_params()
+        opt_state = optimizer.functional_init(params)
+        inner = 10
+
+        # fwd-only: perturb one param leaf by the carry to defeat CSE
+        @jax.jit
+        def fwd_n(p):
+            k0 = next(iter(p))
+
+            def body(c, _):
+                p2 = dict(p)
+                p2[k0] = p2[k0] + (c * 1e-30).astype(p2[k0].dtype)
+                return amp_loss(p2, data, key).astype(jnp.float32), None
+            c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                None, length=inner)
+            return c
+        fwd_n(params)
+        _sync(fwd_n(params))
+        t0 = time.perf_counter()
+        _sync(fwd_n(params))
+        t_fwd = (time.perf_counter() - t0) / inner
+
+        # full train step chained: params/opt flow through the scan carry
+        def step(carry, _):
+            p, s = carry
+            loss, g = jax.value_and_grad(amp_loss)(p, data, key)
+            np_, ns = optimizer.functional_update(p, g, s)
+            return (np_, ns), loss
+
+        @jax.jit
+        def train_n(p, s):
+            (p, s), losses = jax.lax.scan(step, (p, s), None, length=inner)
+            return p, s, losses[-1]
+
+        params, opt_state, loss = train_n(params, opt_state)
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        params, opt_state, loss = train_n(params, opt_state)
+        float(jax.device_get(loss))
+        t_step = (time.perf_counter() - t0) / inner
+
+        toks = batch * seq
+        mfu = toks / t_step * 6 * n_params / 197e12
+        print(f"batch={batch} seq={seq}: fwd={t_fwd*1e3:.1f}ms "
+              f"step={t_step*1e3:.1f}ms "
+              f"tok/s={toks/t_step:,.0f} MFU={mfu:.3f}", flush=True)
+
+
+def _scan_timer(step_of_carry, carry0, inner=20, reps=3):
+    """Time `inner` data-dependent iterations inside ONE jitted scan — the
+    axon tunnel adds ~8ms dispatch overhead per RPC, so per-call timing
+    cannot resolve sub-10ms kernels. The carry dependency defeats CSE."""
+    import jax
+
+    @jax.jit
+    def many(c0):
+        c, _ = jax.lax.scan(lambda c, _: (step_of_carry(c), None), c0,
+                            None, length=inner)
+        return c
+    c = many(carry0)  # compile + warm
+    _sync(c)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        c = many(carry0)
+        _sync(c)
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def section_flash_blocks():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, h, s, d = 8, 12, 1024, 64
+    kq = jax.random.key(1)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(kq, 1), (b, h, s, d),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(kq, 2), (b, h, s, d),
+                          jnp.bfloat16)
+    flops_f = 2 * 2 * b * h * s * s * d * 0.5  # causal fwd
+
+    for bq, bk in [(512, 512), (1024, 512), (512, 1024), (1024, 1024),
+                   (256, 512), (512, 256), (256, 256), (1024, 256)]:
+        try:
+            def fwd_step(c, bq=bq, bk=bk):
+                qc = q + c * 1e-30  # carry-dependence defeats CSE/hoisting
+                o = flash_attention(qc, k, v, True, None, bq, bk)
+                return o.astype(jnp.float32).mean()
+
+            t_f = _scan_timer(fwd_step, jnp.zeros((), jnp.float32))
+
+            def bwd_step(c, bq=bq, bk=bk):
+                qc = q + c * 1e-30
+                g = jax.grad(lambda qq: flash_attention(
+                    qq, k, v, True, None, bq, bk).astype(
+                        jnp.float32).sum())(qc)
+                return g.astype(jnp.float32).mean()
+
+            t_g = _scan_timer(bwd_step, jnp.zeros((), jnp.float32))
+            print(f"blocks=({bq},{bk}): fwd={t_f*1e3:.2f}ms "
+                  f"({flops_f/t_f/1e12:.0f}TF/s) "
+                  f"fwd+bwd={t_g*1e3:.2f}ms", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"blocks=({bq},{bk}): FAILED {type(e).__name__}: "
+                  f"{str(e)[:100]}", flush=True)
+
+
+def section_longseq():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, h, s, d = 1, 8, 16384, 64  # s*d*10 = 10.5MB > 8MB -> two-kernel bwd
+    kq = jax.random.key(2)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(kq, 1), (b, h, s, d),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(kq, 2), (b, h, s, d),
+                          jnp.bfloat16)
+    def bwd_step(c):
+        qc = q + c * 1e-30
+        gr = jax.grad(lambda qq: flash_attention(
+            qq, k, v, True).astype(jnp.float32).sum())(qc)
+        return gr.astype(jnp.float32).mean()
+
+    t = _scan_timer(bwd_step, jnp.zeros((), jnp.float32), inner=5)
+    # causal flash fwd+bwd ~ 3.5 matmul passes over S^2/2 scores
+    flops = 3.5 * 2 * b * h * s * s * d * 0.5
+    print(f"longseq S={s}: streaming two-kernel bwd fwd+bwd={t*1e3:.1f}ms "
+          f"(~{flops/t/1e12:.1f} TFLOP/s)", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--section", default="all",
+                    choices=["all", "model", "blocks", "longseq"])
+    ap.add_argument("--batches", default="8,16,24")
+    args = ap.parse_args()
+    import jax
+    print(f"backend={jax.default_backend()} devices={jax.devices()}",
+          file=sys.stderr)
+    if args.section in ("all", "blocks"):
+        section_flash_blocks()
+    if args.section in ("all", "longseq"):
+        section_longseq()
+    if args.section in ("all", "model"):
+        section_model(tuple(int(x) for x in args.batches.split(",")))
+
+
+if __name__ == "__main__":
+    main()
