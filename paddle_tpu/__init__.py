@@ -66,7 +66,17 @@ tensor = _importlib.import_module(".tensor", __name__)
 autograd = _importlib.import_module(".autograd", __name__)
 from . import distribution  # noqa: E402,F401
 from . import fluid  # noqa: E402,F401
-from . import models  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # lazy model zoo (PEP 562): deployment processes (inference.Predictor on
+    # a jit.save'd artifact) never pay for — or depend on — the model
+    # classes; `paddle_tpu.models` still works on first touch
+    if name == "models":
+        mod = _importlib.import_module(".models", __name__)
+        globals()["models"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # legacy fluid-era top-level names kept by the reference 2.0 namespace
 from .compat import *  # noqa: F401,F403,E402

@@ -1,7 +1,12 @@
-"""Inference predictor (ref: paddle/fluid/inference/ + paddle.inference API).
+"""Inference predictor (ref: paddle/fluid/inference/api/analysis_predictor.cc
++ paddle.inference python API).
 
-TPU-first: a predictor is a compiled forward with donated input buffers and a
-persistent params pytree on device.
+TPU-first: the deployable artifact is a serialized StableHLO module
+(jit.save's .pdmodel) + an npz params archive (.pdiparams). A Predictor
+deserializes the module in a fresh process — no Python model class, no
+paddle_tpu.models import — and runs it as one AOT XLA computation with the
+params resident on device. The reference's Config knobs that steer CUDA/
+MKLDNN engines map to device placement here; IR optimization is XLA's job.
 """
 from __future__ import annotations
 
@@ -12,69 +17,178 @@ from ..core.tensor import Tensor
 
 
 class Config:
+    """paddle.inference.Config (ref: analysis_config.cc). Accepts either
+    Config(prog_file, params_file) or Config(model_dir) with the default
+    `inference.pdmodel` names, like the reference."""
+
     def __init__(self, model_path=None, params_path=None):
         self.model_path = model_path
         self.params_path = params_path
-        self._use_tpu = True
+        self._device = "tpu"
+        self._memory_optim = True
 
+    # --- file locations ------------------------------------------------
+    def set_prog_file(self, path):
+        self.model_path = path
+
+    def set_params_file(self, path):
+        self.params_path = path
+
+    def prog_file(self):
+        return self.model_path
+
+    def params_file(self):
+        return self.params_path
+
+    def _prefix(self):
+        """Common path prefix of the .pdmodel/.pdiparams pair."""
+        import os
+        p = self.model_path
+        if p is None:
+            raise ValueError("Config has no model path")
+        if os.path.isdir(p):
+            p = os.path.join(p, "inference")
+        if p.endswith(".pdmodel"):
+            p = p[: -len(".pdmodel")]
+        return p
+
+    # --- device / engine knobs ----------------------------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._use_tpu = True
+        self._device = "tpu"  # accelerator path == the TPU backend
 
     def disable_gpu(self):
-        self._use_tpu = False
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
 
     def switch_ir_optim(self, flag=True):
-        pass
+        pass  # XLA always optimizes; no separate IR pass pipeline
 
     def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_use_feed_fetch_ops(self, flag):
         pass
+
+    def disable_glog_info(self):
+        pass
+
+
+class _IOHandle:
+    """Input/output tensor handle (ref: ZeroCopyTensor): copy_from_cpu /
+    copy_to_cpu move host arrays in and out of the predictor slot."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def copy_from_cpu(self, arr):
+        self._array = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = self._array.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._array)
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else None
 
 
 class Predictor:
-    """Wraps a Layer (or pure fn) into a compiled inference callable."""
+    """Compiled inference callable. Two construction paths:
+    - from a live Layer / pure fn (dev convenience), or
+    - from Config via `create_predictor` (deployment: deserialized
+      StableHLO + params, no model class)."""
 
     def __init__(self, model, example_inputs=None):
         from ..nn.layer.layers import Layer
+        self._translated = None
         self._layer = model if isinstance(model, Layer) else None
-        self._fn = None
+        self._in_handles = {}
+        self._out_arrays = []
         if self._layer is not None:
-            self._layer.eval()
-            params, bufs = self._layer.functional_state()
-            self._params, self._bufs = params, bufs
-            layer = self._layer
+            from ..jit import TranslatedLayer
+            if isinstance(model, TranslatedLayer):
+                self._translated = model
+                self._fn = None
+            else:
+                self._layer.eval()
+                params, bufs = self._layer.functional_state()
+                self._params, self._bufs = params, bufs
+                layer = self._layer
 
-            def fwd(params, bufs, *xs):
-                saved = layer.functional_state()
-                layer.load_functional_state(params, bufs)
-                try:
-                    out = layer(*[Tensor(x) for x in xs])
-                finally:
-                    layer.load_functional_state(*saved)
-                return jax.tree_util.tree_map(
-                    lambda t: t._value if isinstance(t, Tensor) else t, out,
-                    is_leaf=lambda t: isinstance(t, Tensor))
-            self._fn = jax.jit(fwd)
+                def fwd(params, bufs, *xs):
+                    saved = layer.functional_state()
+                    layer.load_functional_state(params, bufs)
+                    try:
+                        out = layer(*[Tensor(x) for x in xs])
+                    finally:
+                        layer.load_functional_state(*saved)
+                    return jax.tree_util.tree_map(
+                        lambda t: t._value if isinstance(t, Tensor) else t,
+                        out, is_leaf=lambda t: isinstance(t, Tensor))
+                self._fn = jax.jit(fwd)
         else:
             self._fn = jax.jit(model)
             self._params, self._bufs = {}, {}
 
-    def run(self, inputs):
+    # --- direct call API ----------------------------------------------
+    def run(self, inputs=None):
+        if inputs is None:  # handle-based flow (reference predictor.run())
+            xs = [self._in_handles[n]._array
+                  for n in sorted(self._in_handles)]
+            out = self._run_raw(xs)
+            flat = jax.tree_util.tree_leaves(out)
+            self._out_arrays = [np.asarray(
+                x._value if isinstance(x, Tensor) else x) for x in flat]
+            return True
         xs = [i._value if isinstance(i, Tensor) else np.asarray(i)
-              for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+              for i in (inputs if isinstance(inputs, (list, tuple))
+                        else [inputs])]
+        out = self._run_raw(xs)
+        return jax.tree_util.tree_map(
+            lambda x: x if isinstance(x, Tensor) else Tensor(x), out,
+            is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+
+    def _run_raw(self, xs):
+        if self._translated is not None:
+            return self._translated(*xs)
         if self._layer is not None:
-            out = self._fn(self._params, self._bufs, *xs)
-        else:
-            out = self._fn(*xs)
-        return jax.tree_util.tree_map(Tensor, out)
+            return self._fn(self._params, self._bufs, *xs)
+        return self._fn(*xs)
 
     __call__ = run
 
+    # --- handle API (ref: paddle.inference zero-copy flow) -------------
+    def get_input_names(self):
+        if self._translated is not None:
+            n = len(self._translated._meta.get("in_specs", []))
+            return [f"input_{i}" for i in range(n)]
+        return sorted(self._in_handles) or ["input_0"]
+
+    def get_input_handle(self, name):
+        return self._in_handles.setdefault(name, _IOHandle(name))
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(max(1, len(self._out_arrays)))]
+
+    def get_output_handle(self, name):
+        idx = int(name.rsplit("_", 1)[-1]) if "_" in name else 0
+        h = _IOHandle(name)
+        if idx < len(self._out_arrays):
+            h._array = self._out_arrays[idx]
+        return h
+
 
 def create_predictor(config_or_model, example_inputs=None):
+    """paddle.inference.create_predictor — from a Config, rebuild the
+    predictor out of the serialized artifacts alone (ref:
+    analysis_predictor.cc CreatePaddlePredictor)."""
     if isinstance(config_or_model, Config):
         from ..jit import load as jit_load
-        payload = jit_load(config_or_model.model_path)
-        raise NotImplementedError(
-            "file-based predictor requires jit.save'd layer; "
-            "pass the Layer directly")
+        translated = jit_load(config_or_model._prefix())
+        return Predictor(translated)
     return Predictor(config_or_model, example_inputs)

@@ -1,0 +1,123 @@
+"""Compare attention impls on the real chip: ours vs jax stock pallas flash
+vs plain XLA einsum. B=8 H=12 S=1024 D=64 bf16 causal (GPT-2 small shapes)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _sync(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    float(jax.device_get(jnp.sum(leaves[0]).astype(jnp.float32)))
+
+
+def scan_time(step, c0, inner=20, reps=3):
+    @jax.jit
+    def many(c):
+        c, _ = jax.lax.scan(lambda c, _: (step(c), None), c, None,
+                            length=inner)
+        return c
+    _sync(many(c0))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(many(c0))
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def main():
+    b, h, s, d = 8, 12, 1024, 64
+    kq = jax.random.key(1)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(kq, 1), (b, h, s, d),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(kq, 2), (b, h, s, d),
+                          jnp.bfloat16)
+    flops_f = 2 * 2 * b * h * s * s * d * 0.5
+
+    # ---- ours
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    def ours(c):
+        o = flash_attention(q + c * 1e-30, k, v, True)
+        return o.astype(jnp.float32).mean()
+
+    t = scan_time(ours, jnp.zeros((), jnp.float32))
+    print(f"ours            fwd {t*1e3:.2f}ms {flops_f/t/1e12:.1f}TF/s",
+          flush=True)
+
+    def ours_g(c):
+        g = jax.grad(lambda qq: flash_attention(qq, k, v, True)
+                     .astype(jnp.float32).sum())(q + c * 1e-30)
+        return g.astype(jnp.float32).mean()
+
+    t = scan_time(ours_g, jnp.zeros((), jnp.float32))
+    print(f"ours            f+b {t*1e3:.2f}ms", flush=True)
+
+    # ---- stock pallas flash attention
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as stock_fa, BlockSizes)
+
+        def stock(c):
+            o = stock_fa(q + c * 1e-30, k, v, causal=True,
+                         sm_scale=d ** -0.5)
+            return o.astype(jnp.float32).mean()
+
+        t = scan_time(stock, jnp.zeros((), jnp.float32))
+        print(f"stock pallas    fwd {t*1e3:.2f}ms {flops_f/t/1e12:.1f}TF/s",
+              flush=True)
+
+        def stock_g(c):
+            g = jax.grad(lambda qq: stock_fa(qq, k, v, causal=True,
+                                             sm_scale=d ** -0.5)
+                         .astype(jnp.float32).sum())(q + c * 1e-30)
+            return g.astype(jnp.float32).mean()
+
+        t = scan_time(stock_g, jnp.zeros((), jnp.float32))
+        print(f"stock pallas    f+b {t*1e3:.2f}ms", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"stock pallas FAILED: {type(e).__name__}: {str(e)[:150]}",
+              flush=True)
+
+    # ---- plain XLA
+    def xla(c):
+        qq = (q + c * 1e-30).astype(jnp.bfloat16)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qq, k,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        sc = jnp.where(qpos >= kpos, sc, -1e30)
+        w = jax.nn.softmax(sc, axis=-1).astype(jnp.bfloat16)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        return o.astype(jnp.float32).mean()
+
+    t = scan_time(xla, jnp.zeros((), jnp.float32))
+    print(f"xla einsum      fwd {t*1e3:.2f}ms {flops_f/t/1e12:.1f}TF/s "
+          f"(counting causal-half flops)", flush=True)
+
+    def xla_g(c):
+        g = jax.grad(lambda qq: xla_loss(qq))(q + c * 1e-30)
+        return g.astype(jnp.float32).mean()
+
+    def xla_loss(qq):
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qq.astype(jnp.bfloat16), k,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        sc = jnp.where(qpos >= kpos, sc, -1e30)
+        w = jax.nn.softmax(sc, axis=-1).astype(jnp.bfloat16)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        return o.astype(jnp.float32).sum()
+
+    t = scan_time(xla_g, jnp.zeros((), jnp.float32))
+    print(f"xla einsum      f+b {t*1e3:.2f}ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Raw-op throughput on the chip: big GEMM, attention-shaped batch GEMM,
+exp, softmax. Establishes the hardware envelope the attention kernel lives in."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _sync(out):
+    float(jax.device_get(jnp.sum(jax.tree_util.tree_leaves(out)[0])
+                         .astype(jnp.float32)))
+
+
+def scan_time(step, c0, inner=20, reps=3):
+    @jax.jit
+    def many(c):
+        c, _ = jax.lax.scan(lambda c, _: (step(c), None), c, None,
+                            length=inner)
+        return c
+    _sync(many(c0))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(many(c0))
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def main():
+    key = jax.random.key(0)
+    z = jnp.zeros((), jnp.float32)
+
+    # 1. big square GEMM bf16: the MXU ceiling
+    a = jax.random.normal(key, (4096, 4096), jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (4096, 4096),
+                          jnp.bfloat16)
+
+    def gemm(c):
+        return ((a + c * 1e-30) @ b).astype(jnp.float32).mean()
+
+    t = scan_time(gemm, z)
+    fl = 2 * 4096**3
+    print(f"gemm 4096^3 bf16: {t*1e3:.3f}ms {fl/t/1e12:.0f}TF/s", flush=True)
+
+    # 2. attention-shaped batch GEMM: [96,1024,64]x[96,64,1024]
+    q = jax.random.normal(key, (96, 1024, 64), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 2), (96, 1024, 64),
+                          jnp.bfloat16)
+
+    def bmm(c):
+        s = jnp.einsum("bqd,bkd->bqk", q + c * 1e-30, k,
+                       preferred_element_type=jnp.float32)
+        return s.mean()
+
+    t = scan_time(bmm, z)
+    fl = 2 * 96 * 1024 * 1024 * 64
+    print(f"bmm  96x1024x64x1024 (f32 out): {t*1e3:.3f}ms "
+          f"{fl/t/1e12:.0f}TF/s", flush=True)
+
+    # 2b. same but bf16 out (halves the HBM write)
+    def bmm16(c):
+        s = jnp.einsum("bqd,bkd->bqk", q + c * 1e-30, k)
+        return s.astype(jnp.float32).mean()
+
+    t = scan_time(bmm16, z)
+    print(f"bmm  96x1024x64x1024 (bf16 out): {t*1e3:.3f}ms "
+          f"{fl/t/1e12:.0f}TF/s", flush=True)
+
+    # 3. exp throughput on the score-matrix volume
+    x = jax.random.normal(key, (96, 1024, 1024), jnp.float32)
+
+    def expf(c):
+        return jnp.exp(x + c).mean()
+
+    t = scan_time(expf, z)
+    n = 96 * 1024 * 1024
+    print(f"exp  f32 {n/1e6:.0f}M elems: {t*1e3:.3f}ms "
+          f"{n/t/1e9:.0f}Gexp/s", flush=True)
+
+    xb = x.astype(jnp.bfloat16)
+
+    def expb(c):
+        return jnp.exp(xb + c.astype(jnp.bfloat16)).astype(jnp.float32).mean()
+
+    t = scan_time(expb, z)
+    print(f"exp  bf16: {t*1e3:.3f}ms {n/t/1e9:.0f}Gexp/s", flush=True)
+
+    # 4. full softmax on scores
+    def sm(c):
+        return jax.nn.softmax(x + c, axis=-1).mean()
+
+    t = scan_time(sm, z)
+    print(f"softmax f32 [96,1024,1024]: {t*1e3:.3f}ms", flush=True)
+
+    # 5. HBM bandwidth probe: copy 402MB
+    def cp(c):
+        return (x + c).mean()
+
+    t = scan_time(cp, z)
+    byts = n * 4 * 2
+    print(f"add+reduce f32 402MB: {t*1e3:.3f}ms "
+          f"~{byts/t/1e9:.0f}GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

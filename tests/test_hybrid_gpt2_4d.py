@@ -29,18 +29,28 @@ from paddle_tpu import optimizer as opt_mod
 
 mesh = make_mesh(dp=2, mp=2, pp=2, sp=2)
 assert all(mesh.shape[a] > 1 for a in ("dp", "pp", "mp", "sp"))
+# vocab 129 is NOT divisible by mp=2: exercises Megatron vocab padding +
+# masked softmax stats; d_head=32 and S_local=128 pass _flash_ok so the
+# ring runs the Pallas flash kernels (interpret-mode on CPU)
+VOCAB = 129
+import functools
 params = init_hybrid_gpt2_params(
-    jax.random.key(0), vocab_size=128, hidden=32, num_heads=4, num_layers=4,
-    pp=2, max_position=64)
+    jax.random.key(0), vocab_size=VOCAB, hidden=128, num_heads=4,
+    num_layers=4, pp=2, max_position=256, mp=2)
+assert params["wte"].shape[0] == 130  # padded to a multiple of mp
 rng = np.random.RandomState(0)
-batch = {"input_ids": jnp.asarray(rng.randint(0, 128, (8, 64), np.int32)),
-         "labels": jnp.asarray(rng.randint(0, 128, (8, 64), np.int32))}
+batch = {"input_ids": jnp.asarray(rng.randint(0, VOCAB, (8, 256), np.int32)),
+         "labels": jnp.asarray(rng.randint(0, VOCAB, (8, 256), np.int32))}
 
-loss_fn = build_hybrid_gpt2_loss(mesh, num_microbatches=2)
-ref = float(jax.jit(reference_loss)(params, batch))
+loss_fn = build_hybrid_gpt2_loss(mesh, num_microbatches=2, vocab_size=VOCAB)
+ref_fn = functools.partial(reference_loss, vocab_size=VOCAB)
+ref = float(jax.jit(ref_fn)(params, batch))
 hyb = float(jax.jit(loss_fn)(params, batch))
 assert abs(ref - hyb) < 1e-3 * max(1.0, abs(ref)), (ref, hyb)
+from paddle_tpu.parallel.ring_attention import last_impl_used
+assert last_impl_used() == "flash", last_impl_used()
 print("PARITY_OK", ref, hyb)
+print("RING_IMPL", last_impl_used())
 
 # full train step with ZeRO slot sharding over dp
 optimizer = opt_mod.AdamW(learning_rate=1e-3, weight_decay=0.0)
@@ -62,15 +72,18 @@ for i in range(4):
     loss, params, opt_state = jitted(params, opt_state, batch)
     if l0 is None:
         l0 = float(loss)
-# ZeRO: the wte moment slots live dp-sharded
+# wte is vocab-parallel now: its slots follow the mp sharding; ZeRO-over-dp
+# applies to the remaining big replicated leaves (wpe)
 slot = list(opt_state["slots"]["wte"].values())[0]
-assert "dp" in str(slot.sharding.spec), slot.sharding
+assert "mp" in str(slot.sharding.spec), slot.sharding
+wpe_slot = list(opt_state["slots"]["wpe"].values())[0]
+assert "dp" in str(wpe_slot.sharding.spec), wpe_slot.sharding
 assert float(loss) < l0, (l0, float(loss))
 print("TRAIN_OK", l0, float(loss))
 
-# grads parity: hybrid grads == reference grads on a replicated leaf
+# grads parity: hybrid grads == reference grads on the embedding
 g_h = jax.grad(loss_fn)(jax.device_get(params), batch)
-g_r = jax.grad(reference_loss)(jax.device_get(params), batch)
+g_r = jax.grad(ref_fn)(jax.device_get(params), batch)
 d = float(jnp.max(jnp.abs(g_h["wte"] - g_r["wte"])))
 scale = float(jnp.max(jnp.abs(g_r["wte"]))) + 1e-9
 assert d / scale < 5e-3, (d, scale)
@@ -87,5 +100,6 @@ def test_4d_hybrid_parity_and_training():
                        capture_output=True, text=True, timeout=900,
                        cwd=os.path.dirname(os.path.dirname(__file__)))
     assert "PARITY_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
+    assert "RING_IMPL flash" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
     assert "TRAIN_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
     assert "GRAD_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]

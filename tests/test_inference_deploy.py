@@ -1,0 +1,141 @@
+"""Inference deployment format (VERDICT r2 next #3, carried from r1):
+jit.save serializes the traced forward as StableHLO (jax.export) +
+params npz; jit.load / create_predictor(Config) rebuild a runnable
+Predictor in a FRESH PROCESS with no model-class import.
+
+Ref: python/paddle/fluid/io.py:1198 save_inference_model,
+paddle/fluid/inference/api/analysis_predictor.cc.
+"""
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static import InputSpec
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 16)
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _save_net(tmp_path):
+    paddle.seed(11)
+    net = _Net()
+    net.eval()
+    prefix = str(tmp_path / "deploy" / "inference")
+    import os
+    os.makedirs(str(tmp_path / "deploy"), exist_ok=True)
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 4], "float32")])
+    x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    ref = np.asarray(net(Tensor(jnp.asarray(x))).numpy())
+    return prefix, x, ref
+
+
+class TestJitSaveLoad:
+    def test_artifacts_exist_and_model_is_stablehlo(self, tmp_path):
+        prefix, x, ref = _save_net(tmp_path)
+        import os
+        assert os.path.exists(prefix + ".pdmodel")
+        assert os.path.exists(prefix + ".pdiparams")
+        with open(prefix + ".pdmodel", "rb") as f:
+            assert f.read(8) == b"PTPUEXP1"
+        # params archive is plain npz, no pickles
+        with open(prefix + ".pdiparams", "rb") as f:
+            npz = np.load(f, allow_pickle=False)
+            assert any(k.startswith("p:") for k in npz.files)
+
+    def test_load_runs_without_model_class(self, tmp_path):
+        prefix, x, ref = _save_net(tmp_path)
+        loaded = paddle.jit.load(prefix)
+        out = np.asarray(loaded(Tensor(jnp.asarray(x))).numpy())
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        # batch-polymorphic: a different batch size runs too
+        x2 = np.random.RandomState(1).randn(9, 4).astype(np.float32)
+        out2 = loaded(Tensor(jnp.asarray(x2)))
+        assert tuple(out2.shape) == (9, 3)
+
+    def test_save_requires_input_spec(self, tmp_path):
+        with pytest.raises(ValueError, match="input_spec"):
+            paddle.jit.save(_Net(), str(tmp_path / "m"))
+
+    def test_cross_process_predictor_no_model_import(self, tmp_path):
+        """The deployment contract: a fresh process with ONLY the artifact
+        files must rebuild and run the model — no test module, no
+        paddle_tpu.models import."""
+        prefix, x, ref = _save_net(tmp_path)
+        np.save(str(tmp_path / "x.npy"), x)
+        script = textwrap.dedent(f"""
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import sys
+            import numpy as np
+            from paddle_tpu.inference import Config, create_predictor
+            cfg = Config({str(prefix)!r} + ".pdmodel",
+                         {str(prefix)!r} + ".pdiparams")
+            pred = create_predictor(cfg)
+            x = np.load({str(tmp_path / "x.npy")!r})
+            out = pred.run([x])
+            # the model class lives in the test module: must not be loaded
+            assert not any("test_inference_deploy" in m for m in sys.modules), \\
+                "model-class module leaked into the fresh process"
+            assert "paddle_tpu.models" not in sys.modules
+            np.save({str(tmp_path / "out.npy")!r}, np.asarray(out.numpy()))
+            print("CROSS_PROCESS_OK")
+        """)
+        env = {"PYTHONPATH": ".", "PATH": "/usr/bin:/bin",
+               "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+               "HOME": "/root"}
+        r = subprocess.run([sys.executable, "-c", script], text=True,
+                           capture_output=True, timeout=240, env=env,
+                           cwd="/root/repo")
+        assert "CROSS_PROCESS_OK" in r.stdout, (r.stdout, r.stderr[-2000:])
+        out = np.load(str(tmp_path / "out.npy"))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_handle_based_predictor_flow(self, tmp_path):
+        """The reference's zero-copy handle flow: copy_from_cpu -> run() ->
+        copy_to_cpu."""
+        prefix, x, ref = _save_net(tmp_path)
+        from paddle_tpu.inference import Config, create_predictor
+        pred = create_predictor(Config(prefix + ".pdmodel",
+                                       prefix + ".pdiparams"))
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        assert pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestQuantizedDeploy:
+    def test_save_quantized_model_roundtrip(self, tmp_path):
+        """slim.save_quantized_model rides the same artifact path: the int8
+        weights are baked into the StableHLO module as constants."""
+        from paddle_tpu.slim import ImperativeQuantAware
+        paddle.seed(3)
+        net = _Net()
+        qat = ImperativeQuantAware()
+        qat.quantize(net)
+        x = np.random.RandomState(2).randn(6, 4).astype(np.float32)
+        net(Tensor(jnp.asarray(x)))  # collect activation ranges
+        prefix = str(tmp_path / "quant")
+        qat.save_quantized_model(net, prefix,
+                                 input_spec=[InputSpec([None, 4],
+                                                       "float32")])
+        ref = np.asarray(net(Tensor(jnp.asarray(x))).numpy())
+        loaded = paddle.jit.load(prefix)
+        out = np.asarray(loaded(Tensor(jnp.asarray(x))).numpy())
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)

@@ -120,11 +120,18 @@ class TestCheckpoint:
         assert o2._step_count == 3
 
     def test_jit_save_load(self, tmp_path):
+        from paddle_tpu.static import InputSpec
         net = nn.Linear(3, 2)
         path = str(tmp_path / "jit_model")
-        paddle.jit.save(net, path)
-        payload = paddle.jit.load(path)
-        assert "state_dict" in payload
+        paddle.jit.save(net, path, input_spec=[InputSpec([None, 3])])
+        loaded = paddle.jit.load(path)
+        x = np.random.randn(4, 3).astype(np.float32)
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        np.testing.assert_allclose(
+            np.asarray(loaded(Tensor(jnp.asarray(x))).numpy()),
+            np.asarray(net(Tensor(jnp.asarray(x))).numpy()),
+            rtol=1e-5, atol=1e-5)
 
 
 class TestHapiModel:
