@@ -45,4 +45,4 @@ class LookAhead:
         loss.backward()
         self.step()
 
-from ..io import native_loader as reader  # noqa: E402,F401
+from .. import reader  # noqa: E402,F401  (the real decorator module)

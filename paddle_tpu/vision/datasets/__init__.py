@@ -17,6 +17,11 @@ from ...io import Dataset
 
 
 class MNIST(Dataset):
+    """IDX-format reader (ref: python/paddle/vision/datasets/mnist.py —
+    the same >IIII magic/count/rows/cols header + raw uint8 parse)."""
+
+    _SYN_SEEDS = (0, 1)  # (train, test) synthetic-fallback seeds
+
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=True, backend=None):
         self.mode = mode
@@ -33,7 +38,9 @@ class MNIST(Dataset):
             # synthetic fallback: class-conditional patterns so models can
             # actually fit (loss decreases) in tests/benchmarks
             n = 6000 if mode == "train" else 1000
-            rng = np.random.RandomState(0 if mode == "train" else 1)
+            seeds = type(self)._SYN_SEEDS
+            rng = np.random.RandomState(
+                seeds[0] if mode == "train" else seeds[1])
             self.labels = rng.randint(0, 10, n).astype(np.int64)
             base = rng.rand(10, 28, 28) * 255
             noise = rng.rand(n, 28, 28) * 64
@@ -54,28 +61,77 @@ class MNIST(Dataset):
 
 
 class FashionMNIST(MNIST):
-    pass
+    """Fashion-MNIST (ref: python/paddle/vision/datasets — same IDX wire
+    format as MNIST, different archive contents). Reads real
+    train/t10k-images-idx3-ubyte.gz pairs via the shared IDX parser; the
+    synthetic fallback draws from its own seeds so MNIST and FashionMNIST
+    produce distinct data in tests."""
+
+    _SYN_SEEDS = (40, 41)
 
 
 class Cifar10(Dataset):
+    """CIFAR-10 from the published cifar-10-python.tar.gz layout (ref:
+    python/paddle/vision/datasets/cifar.py:140): walk the archive members,
+    unpickle every data_batch_* (train) or test_batch (test), and
+    concatenate. A bare single-batch pickle file still loads (legacy)."""
+
+    NUM_CLASSES = 10
+    _TRAIN_FLAG = "data_batch"
+    _TEST_FLAG = "test_batch"
+    _LABEL_KEYS = (b"labels", b"fine_labels")
+    _SYN_SEEDS = (2, 3)
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
         self.mode = mode
         self.transform = transform
-        self.num_classes = 10
+        self.num_classes = type(self).NUM_CLASSES
         if data_file and os.path.exists(data_file):
-            with open(data_file, "rb") as f:
-                d = pickle.load(f, encoding="bytes")
-            self.images = d[b"data"].reshape(-1, 3, 32, 32)
-            self.labels = np.asarray(d[b"labels"], np.int64)
+            self._load_file(data_file, mode)
         else:
             n = 5000 if mode == "train" else 1000
-            rng = np.random.RandomState(2 if mode == "train" else 3)
+            seeds = type(self)._SYN_SEEDS
+            rng = np.random.RandomState(
+                seeds[0] if mode == "train" else seeds[1])
             self.labels = rng.randint(0, self.num_classes, n).astype(np.int64)
             base = rng.rand(self.num_classes, 3, 32, 32) * 255
             noise = rng.rand(n, 3, 32, 32) * 64
             self.images = np.clip(base[self.labels] * 0.75 + noise, 0,
                                   255).astype(np.uint8)
+
+    def _pick_labels(self, d):
+        for k in type(self)._LABEL_KEYS:
+            if k in d:
+                return d[k]
+        raise KeyError(f"no label key in batch (have {list(d)})")
+
+    def _load_file(self, data_file, mode):
+        import tarfile
+        flag = type(self)._TRAIN_FLAG if mode == "train" \
+            else type(self)._TEST_FLAG
+        if tarfile.is_tarfile(data_file):
+            imgs, labels = [], []
+            with tarfile.open(data_file, mode="r:*") as tf:
+                names = sorted(n for n in tf.getnames()
+                               if flag in os.path.basename(n))
+                if not names:
+                    raise ValueError(
+                        f"no '{flag}' members in {data_file} for "
+                        f"mode={mode!r}")
+                for name in names:
+                    d = pickle.load(tf.extractfile(name), encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"], np.uint8)
+                                .reshape(-1, 3, 32, 32))
+                    labels.append(np.asarray(self._pick_labels(d), np.int64))
+            self.images = np.concatenate(imgs, axis=0)
+            self.labels = np.concatenate(labels, axis=0)
+        else:  # legacy single-batch pickle
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            self.images = np.asarray(d[b"data"], np.uint8) \
+                .reshape(-1, 3, 32, 32)
+            self.labels = np.asarray(self._pick_labels(d), np.int64)
 
     def __getitem__(self, idx):
         img = self.images[idx]
@@ -91,9 +147,14 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
-    def __init__(self, *a, **kw):
-        super().__init__(*a, **kw)
-        self.num_classes = 100
+    """CIFAR-100: cifar-100-python.tar.gz holds single 'train'/'test'
+    members with b'fine_labels' (ref: cifar.py CIFAR100 flags)."""
+
+    NUM_CLASSES = 100
+    _TRAIN_FLAG = "train"
+    _TEST_FLAG = "test"
+    _LABEL_KEYS = (b"fine_labels", b"labels")
+    _SYN_SEEDS = (4, 5)
 
 
 class ImageFolder(Dataset):
