@@ -14,11 +14,102 @@ from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 
 
-def spawn(func, args=(), nprocs=-1, **options):
-    """Single-host TPU runtime: jax owns all local chips in one process, so
-    spawn degenerates to a direct call (ref: python/paddle/distributed/spawn.py
-    forks one process per GPU)."""
-    func(*args)
+class MultiprocessContext:
+    """Join handle for spawned workers (ref: spawn.py MultiprocessContext):
+    join() waits for all, and re-raises the first worker failure with its
+    traceback."""
+
+    def __init__(self, processes, error_queues):
+        self.processes = processes
+        self.error_queues = error_queues
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        failures = []
+        for rank, (p, q) in enumerate(zip(self.processes,
+                                          self.error_queues)):
+            if p.exitcode not in (0, None):
+                tb = q.get() if not q.empty() else "<no traceback captured>"
+                failures.append((rank, p.exitcode, tb))
+        if failures:
+            rank, code, tb = failures[0]
+            raise RuntimeError(
+                f"spawned worker {rank} exited with code {code}:\n{tb}")
+        return True
+
+
+def _spawn_worker(func, args, rank, nprocs, error_queue, env):
+    import os
+    import sys
+    import traceback
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["FLAGS_selected_gpus"] = str(rank)
+    try:
+        if env.get("JAX_PLATFORMS"):
+            # belt-and-braces: a site hook may have imported jax and pinned
+            # a platform before the env var was read — override via config
+            import jax
+            jax.config.update("jax_platforms", env["JAX_PLATFORMS"])
+        func(*args)
+    except KeyboardInterrupt:
+        pass
+    except Exception:  # noqa: BLE001
+        error_queue.put(traceback.format_exc())
+        sys.exit(1)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Fork one worker process per rank and run `func(*args)` in each
+    (ref: python/paddle/distributed/spawn.py:238 — per-device process
+    start, join, error collection).
+
+    TPU-first shape: on a TPU host ONE process drives all local chips
+    through the mesh, so intra-host scaling never needs spawn — spawn
+    exists for the reference's process-per-rank pattern (CPU workers,
+    PS-lite trainers, multi-host tests). Workers default to the CPU
+    platform (each owning its own XLA backend); multi-host TPU bootstrap
+    goes through distributed.launch -> jax.distributed instead. Workers
+    see their rank via PADDLE_TRAINER_ID (get_rank() honors it)."""
+    import multiprocessing as mp
+
+    if nprocs <= 0:
+        import jax
+        nprocs = max(1, jax.device_count())
+    import os
+
+    ctx = mp.get_context("spawn")
+    env = {"JAX_PLATFORMS": options.pop("backend", "cpu"),
+           "PALLAS_AXON_POOL_IPS": ""}
+    procs, queues = [], []
+    # children must see the platform env at INTERPRETER start (site hooks
+    # import jax before any user code runs), so export it around start()
+    saved = {k: os.environ.get(k) for k in
+             (*env, "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM")}
+    try:
+        for rank in range(nprocs):
+            os.environ.update(env)
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+            q = ctx.SimpleQueue()
+            p = ctx.Process(target=_spawn_worker,
+                            args=(func, args, rank, nprocs, q, env),
+                            daemon=daemon)
+            p.start()
+            procs.append(p)
+            queues.append(q)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    context = MultiprocessContext(procs, queues)
+    if join:
+        context.join()
+    return context
 
 
 def launch():

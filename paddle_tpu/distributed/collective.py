@@ -138,7 +138,8 @@ def is_initialized():
 
 
 def get_rank(group=None):
-    r = jax.process_index()
+    import os
+    r = int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
     if group is not None:
         return group.get_group_rank(r)
     return r
@@ -146,9 +147,13 @@ def get_rank(group=None):
 
 def get_world_size(group=None):
     # logical world = all addressable devices (chips), matching the
-    # one-process-per-GPU reference model where world_size == #devices
+    # one-process-per-GPU reference model where world_size == #devices;
+    # spawned per-rank workers see the launcher-set world instead
+    import os
     if group is not None:
         return group.nranks
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
     return jax.device_count()
 
 

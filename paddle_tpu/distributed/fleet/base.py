@@ -52,23 +52,31 @@ class DistributedStrategy:
 class _RoleMakerBase:
     def __init__(self, is_collective=True, **kw):
         self._is_collective = is_collective
+        # PS-mode roles come from the launcher env (ref: role_maker.py
+        # PaddleCloudRoleMaker TRAINING_ROLE); collective mode is all-worker
+        import os
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
 
     def worker_index(self):
-        import jax
-        return jax.process_index()
+        from ..collective import get_rank
+        return get_rank()
 
     def worker_num(self):
+        from ..collective import get_world_size
         import jax
-        return jax.process_count()
+        try:
+            return get_world_size()
+        except Exception:  # pragma: no cover
+            return jax.process_count()
 
     def is_worker(self):
-        return True
+        return self._role == "TRAINER"
 
     def is_server(self):
-        return False
+        return self._role == "PSERVER"
 
     def is_first_worker(self):
-        return self.worker_index() == 0
+        return self.is_worker() and self.worker_index() == 0
 
 
 class PaddleCloudRoleMaker(_RoleMakerBase):
@@ -76,7 +84,21 @@ class PaddleCloudRoleMaker(_RoleMakerBase):
 
 
 class UserDefinedRoleMaker(_RoleMakerBase):
-    pass
+    def __init__(self, is_collective=True, current_id=0, role=None,
+                 worker_num=None, server_endpoints=None, **kw):
+        super().__init__(is_collective, **kw)
+        if role is not None:
+            self._role = str(role).upper()
+            if self._role == "WORKER":
+                self._role = "TRAINER"
+        self._current_id = current_id
+        self._worker_num = worker_num
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num or super().worker_num()
 
 
 class Fleet:
@@ -139,17 +161,23 @@ class Fleet:
     def save_inference_model(self, *a, **kw):
         pass
 
+    # ---- PS-mode lifecycle (ref: fleet_base init_server/run_server; the
+    # host-offloaded sparse-table runtime lives in distributed/ps.py) ----
     def stop_worker(self):
-        pass
+        from .. import ps
+        ps.stop_worker()
 
     def init_worker(self):
-        pass
+        from .. import ps
+        ps.init_worker()
 
-    def init_server(self, *a):
-        pass
+    def init_server(self, *a, **kw):
+        from .. import ps
+        ps.init_server(*a, **kw)
 
     def run_server(self):
-        pass
+        from .. import ps
+        ps.run_server()
 
 
 fleet = Fleet()

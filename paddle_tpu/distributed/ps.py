@@ -1,0 +1,178 @@
+"""PS-lite — host-offloaded sparse embedding tables (parameter-server mode).
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py:256 and
+fleet/meta_optimizers/parameter_server_optimizer.py — paddle's PS mode keeps
+huge recsys embedding tables on parameter-server processes; trainers pull
+rows, compute, and push sparse gradients back (async SGD).
+
+TPU-first rework: the accelerator-side analogue of a parameter server is
+HOST RAM. TPU VMs carry ~10-20x more host memory than HBM, so the sparse
+tables live host-side as numpy arrays; the dense minibatch of pulled rows is
+what travels to the device. The pull -> device compute -> push-sparse-grad
+cycle is the same contract as the reference's PS, with the "server" being
+the local host arena (single-host) — multi-host sharding splits tables by
+row range across workers, each host serving its shard (rows are routed by
+`row % num_shards`, the reference's default hash policy).
+
+  SparseTable        — host table with sgd/adagrad sparse updates
+  PSEmbedding        — nn.Layer: pull rows -> device gather; backward pushes
+                       the sparse grads back on .apply_gradients()
+  fleet role API     — is_server/is_worker/init_server/run_server/
+                       init_worker/stop_worker (fleet/base.py wires these)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import nn
+
+
+class SparseTable:
+    """Host-resident [rows, dim] embedding table with sparse updates.
+
+    Updates are applied with np.add.at (duplicate ids accumulate, the
+    reference's sum-merge of sparse grads).
+    """
+
+    def __init__(self, rows, dim, init_std=0.01, optimizer="sgd",
+                 learning_rate=0.1, seed=0, num_shards=1, shard_id=0):
+        self.rows, self.dim = rows, dim
+        self.num_shards, self.shard_id = num_shards, shard_id
+        rng = np.random.RandomState(seed)
+        # each shard materializes only its own rows (row % num_shards ==
+        # shard_id); a dense local index maps global row -> local slot
+        self._global_rows = np.arange(shard_id, rows, num_shards)
+        self.data = (rng.randn(len(self._global_rows), dim) * init_std) \
+            .astype(np.float32)
+        self.optimizer = optimizer
+        self.lr = learning_rate
+        if optimizer == "adagrad":
+            self._g2 = np.zeros_like(self.data)
+        elif optimizer != "sgd":
+            raise ValueError(f"unsupported sparse optimizer {optimizer!r}")
+
+    def _local(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        if self.num_shards > 1:
+            mine = (ids % self.num_shards) == self.shard_id
+            if not mine.all():
+                raise ValueError("ids routed to the wrong shard")
+        return ids // self.num_shards
+
+    def pull(self, ids):
+        """Gather rows for `ids` -> [n, dim] float32 (host array; the
+        caller ships it to device)."""
+        return self.data[self._local(ids)]
+
+    def push(self, ids, grads):
+        """Apply sparse gradients (sum-merged over duplicate ids)."""
+        li = self._local(ids)
+        g = np.asarray(grads, np.float32).reshape(len(li), self.dim)
+        if self.optimizer == "adagrad":
+            np.add.at(self._g2, li, g * g)
+            g = g / (np.sqrt(self._g2[li]) + 1e-6)
+        np.add.at(self.data, li, -self.lr * g)
+
+    def state_dict(self):
+        d = {"data": self.data, "global_rows": self._global_rows}
+        if self.optimizer == "adagrad":
+            d["g2"] = self._g2
+        return d
+
+    def set_state_dict(self, d):
+        self.data = np.asarray(d["data"], np.float32)
+        if "g2" in d and self.optimizer == "adagrad":
+            self._g2 = np.asarray(d["g2"], np.float32)
+
+
+class PSEmbedding(nn.Layer):
+    """Sparse-table-backed embedding layer.
+
+    forward(ids) pulls rows host-side, ships the dense [.., dim] block to
+    the device as a differentiable leaf; after loss.backward(), call
+    .apply_gradients() to push the accumulated grads back to the table.
+    This is the reference's distributed-lookup-table op pair
+    (lookup_table -> send sparse grad) recast for host-offload."""
+
+    def __init__(self, num_embeddings, embedding_dim, table=None,
+                 optimizer="sgd", learning_rate=0.1):
+        super().__init__()
+        self.table = table or SparseTable(num_embeddings, embedding_dim,
+                                          optimizer=optimizer,
+                                          learning_rate=learning_rate)
+        self._pending = []
+
+    def forward(self, ids):
+        import jax.numpy as jnp
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        pulled = Tensor(jnp.asarray(self.table.pull(ids_np.reshape(-1))))
+        pulled.stop_gradient = False
+        self._pending.append((ids_np.reshape(-1), pulled))
+        out = pulled.reshape(list(ids_np.shape) + [self.table.dim])
+        return out
+
+    def apply_gradients(self):
+        """Push grads of every pull since the last call."""
+        for ids, pulled in self._pending:
+            if pulled.grad is not None:
+                self.table.push(ids, np.asarray(pulled.grad._value))
+        self._pending.clear()
+
+
+# ----------------------------------------------------------- fleet PS roles
+
+class _PSRuntime:
+    """Single-host PS runtime: the 'server' is the local table registry.
+    Multi-host would route pull/push by row-shard over the network; the
+    role API below keeps the reference's call sequence intact."""
+
+    def __init__(self):
+        self.tables = {}
+        self.running = False
+
+    def register_table(self, name, table):
+        self.tables[name] = table
+        return table
+
+
+_runtime = _PSRuntime()
+
+
+def runtime():
+    return _runtime
+
+
+def init_server(model_dir=None, **kwargs):
+    _runtime.running = True
+    if model_dir:
+        import os
+        import pickle
+        path = os.path.join(model_dir, "sparse_tables.pkl")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                states = pickle.load(f)
+            for name, st in states.items():
+                if name in _runtime.tables:
+                    _runtime.tables[name].set_state_dict(st)
+
+
+def run_server():
+    _runtime.running = True
+
+
+def init_worker():
+    pass
+
+
+def stop_worker():
+    _runtime.running = False
+
+
+def save_persistables(dirname, **kwargs):
+    import os
+    import pickle
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "sparse_tables.pkl"), "wb") as f:
+        pickle.dump({n: t.state_dict()
+                     for n, t in _runtime.tables.items()}, f)

@@ -1,0 +1,132 @@
+"""distributed.spawn (real per-rank processes) + PS-lite host-offloaded
+sparse tables (VERDICT r2 next #8).
+
+Ref: python/paddle/distributed/spawn.py:238,
+python/paddle/fluid/transpiler/distribute_transpiler.py:256.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.ps import PSEmbedding, SparseTable
+
+
+def _spawn_worker_write(path):
+    # runs in a fresh spawned process: record rank/world as the worker sees
+    import paddle_tpu.distributed as dist
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    with open(os.path.join(path, f"rank_{rank}.txt"), "w") as f:
+        f.write(f"{rank}/{world}")
+
+
+def _spawn_worker_fail():
+    raise RuntimeError("worker exploded on purpose")
+
+
+class TestSpawn:
+    def test_spawn_forks_real_processes(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        dist.spawn(_spawn_worker_write, args=(str(tmp_path),), nprocs=2)
+        got = sorted(os.listdir(str(tmp_path)))
+        assert got == ["rank_0.txt", "rank_1.txt"], got
+        for i in range(2):
+            with open(str(tmp_path / f"rank_{i}.txt")) as f:
+                assert f.read() == f"{i}/2"
+
+    def test_spawn_collects_worker_errors(self):
+        import paddle_tpu.distributed as dist
+        with pytest.raises(RuntimeError, match="exploded on purpose"):
+            dist.spawn(_spawn_worker_fail, nprocs=1)
+
+
+class TestSparseTable:
+    def test_pull_push_sgd(self):
+        t = SparseTable(100, 4, learning_rate=1.0, seed=0)
+        before = t.pull([3, 7]).copy()
+        g = np.ones((2, 4), np.float32)
+        t.push([3, 7], g)
+        after = t.pull([3, 7])
+        np.testing.assert_allclose(after, before - 1.0, atol=1e-6)
+
+    def test_duplicate_ids_accumulate(self):
+        t = SparseTable(10, 2, learning_rate=1.0)
+        before = t.pull([5])[0].copy()
+        t.push([5, 5], np.ones((2, 2), np.float32))
+        np.testing.assert_allclose(t.pull([5])[0], before - 2.0, atol=1e-6)
+
+    def test_adagrad(self):
+        t = SparseTable(10, 2, optimizer="adagrad", learning_rate=1.0)
+        before = t.pull([1])[0].copy()
+        t.push([1], np.full((1, 2), 2.0, np.float32))
+        # adagrad: step = g / sqrt(g^2) = 1.0
+        np.testing.assert_allclose(t.pull([1])[0], before - 1.0, rtol=1e-4)
+
+    def test_row_sharding_routes_by_modulo(self):
+        shard0 = SparseTable(10, 2, num_shards=2, shard_id=0, seed=1)
+        shard1 = SparseTable(10, 2, num_shards=2, shard_id=1, seed=1)
+        shard0.pull([0, 2, 4])
+        shard1.pull([1, 3, 5])
+        with pytest.raises(ValueError, match="wrong shard"):
+            shard0.pull([1])
+
+    def test_state_roundtrip(self):
+        t = SparseTable(10, 2, seed=3)
+        t.push([2], np.ones((1, 2), np.float32))
+        st = t.state_dict()
+        t2 = SparseTable(10, 2, seed=99)
+        t2.set_state_dict(st)
+        np.testing.assert_allclose(t2.pull([2]), t.pull([2]))
+
+
+class TestPSEmbedding:
+    def test_train_recsys_tower(self):
+        """A tiny recsys tower: PS-backed sparse embedding + dense MLP.
+        The sparse table must actually learn (loss decreases) through the
+        pull -> device grad -> push cycle."""
+        paddle.seed(0)
+        emb = PSEmbedding(50, 8, learning_rate=0.5)
+        fc = nn.Linear(8, 1)
+        import paddle_tpu.optimizer as opt
+        dense_opt = opt.Adam(learning_rate=0.05,
+                             parameters=fc.parameters())
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 50, (32,))
+        y = (ids % 2).astype(np.float32)[:, None]  # parity of the id
+        losses = []
+        for _ in range(60):
+            e = emb(Tensor(jnp.asarray(ids.astype(np.int32))))
+            out = fc(e)
+            loss = ((out - Tensor(jnp.asarray(y))) ** 2).mean()
+            loss.backward()
+            dense_opt.step()
+            dense_opt.clear_grad()
+            emb.apply_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+
+    def test_fleet_ps_role_api(self, tmp_path, monkeypatch):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed import ps as psmod
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        f = fleet.init(is_collective=False)
+        assert fleet.fleet.is_server() and not fleet.fleet.is_worker()
+        t = psmod.runtime().register_table(
+            "emb", SparseTable(10, 2, seed=4))
+        fleet.fleet.init_server()
+        fleet.fleet.run_server()
+        t.push([1], np.ones((1, 2), np.float32))
+        psmod.save_persistables(str(tmp_path))
+        # fresh runtime state restores from the saved dir
+        t.data[:] = 0
+        fleet.fleet.init_server(str(tmp_path))
+        assert np.abs(t.data).sum() > 0
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        f = fleet.init(is_collective=True)
+        assert fleet.fleet.is_worker()
