@@ -444,7 +444,10 @@ class TestCollectivesAPI:
         base = opt.Adam(learning_rate=0.01, parameters=[p])
         wrapped = fleet.distributed_optimizer(base, strategy)
         assert isinstance(wrapped, opt.Lamb)
-        assert fleet.worker_num() == 1
+        # worker_num follows the collective world (one logical worker per
+        # device), consistent with dist.get_world_size()
+        import paddle_tpu.distributed as dist
+        assert fleet.worker_num() == dist.get_world_size()
 
     def test_new_group_halves_the_mesh(self):
         # VERDICT r1 #8: collectives must honor group= — reduce over half
