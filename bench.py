@@ -72,20 +72,19 @@ def main():
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         cfg = GPT2Config()  # GPT-2 small, 124M params
-        batch, seq = 8, 1024
-        warmup, iters = 3, 10
+        batch_candidates, seq = (24, 16, 8), 1024
+        inner = 10  # steps per dispatch (lax.scan)
     else:  # CI/smoke fallback
         cfg = GPT2Config.tiny()
-        batch, seq = 4, 128
-        warmup, iters = 2, 5
+        batch_candidates, seq = (4,), 128
+        inner = 3
     cfg.dropout = 0.0
 
     loss_fn, init_params, model = build_train_step(cfg, remat=False)
-    params = init_params()
-    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    params0 = init_params()
+    n_params = sum(int(np.prod(v.shape)) for v in params0.values())
 
     optimizer = opt_mod.AdamW(learning_rate=1e-4, weight_decay=0.01)
-    opt_state = optimizer.functional_init(params)
 
     # Mixed precision (the reference's AMP headline config): f32 master
     # params, forward/backward in bf16 on the MXU, f32 optimizer update.
@@ -98,40 +97,61 @@ def main():
         pb = jax.tree_util.tree_map(_to_bf16, p32)
         return loss_fn(pb, batch_data, key).astype(jnp.float32)
 
-    def train_step(params, opt_state, batch_data, key):
-        loss, grads = jax.value_and_grad(amp_loss)(params, batch_data, key)
-        new_params, new_state = optimizer.functional_update(params, grads,
-                                                            opt_state)
-        return loss, new_params, new_state
-
-    jitted = jax.jit(train_step, donate_argnums=(0, 1))
-
     rng = np.random.RandomState(0)
-    data = {
-        "input_ids": jnp.asarray(
-            rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
-        "labels": jnp.asarray(
-            rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
-    }
     key = jax.random.key(0)
 
-    for i in range(warmup):
-        loss, params, opt_state = jitted(params, opt_state, data,
-                                         jax.random.fold_in(key, i))
-    # device_get, not block_until_ready: the axon tunnel's block_until_ready
-    # returns before the computation finishes, which inflated throughput ~100x.
-    # Fetching the scalar loss is the only reliable completion barrier.
-    float(jax.device_get(loss))
+    def run_config(batch):
+        """Time `inner` train steps inside ONE jitted lax.scan dispatch —
+        the axon tunnel costs ~8ms per RPC, which at a ~80ms step is a ~10%
+        phantom tax on per-call timing; a production train loop amortizes
+        dispatch, so device throughput is what this bench reports. (The
+        loss is fetched via device_get: the tunnel's block_until_ready
+        returns early, so fetching the scalar is the completion barrier.)"""
+        data = {
+            "input_ids": jnp.asarray(rng.randint(
+                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+            "labels": jnp.asarray(rng.randint(
+                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+        }
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        loss, params, opt_state = jitted(params, opt_state, data,
-                                         jax.random.fold_in(key, 100 + i))
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
+        def step(carry, i):
+            p, s = carry
+            loss, grads = jax.value_and_grad(amp_loss)(
+                p, data, jax.random.fold_in(key, i))
+            np_, ns = optimizer.functional_update(p, grads, s)
+            return (np_, ns), loss
+
+        @jax.jit
+        def train_n(p, s):
+            (p, s), losses = jax.lax.scan(step, (p, s),
+                                          jnp.arange(inner))
+            return p, s, losses[-1]
+
+        params = init_params()
+        opt_state = optimizer.functional_init(params)
+        params, opt_state, loss = train_n(params, opt_state)  # compile+warm
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        params, opt_state, loss = train_n(params, opt_state)
+        float(jax.device_get(loss))
+        dt = (time.perf_counter() - t0) / inner
+        return dt, float(loss)
+
+    batch = dt = loss = None
+    for cand in batch_candidates:
+        try:
+            dt, loss = run_config(cand)
+            batch = cand
+            break
+        except Exception as e:  # noqa: BLE001 — OOM etc.: try smaller batch
+            msg = str(e)[:140].replace("\n", " ")
+            print(f"# bench: batch={cand} failed ({msg}); trying smaller",
+                  file=sys.stderr)
+    if batch is None:
+        raise RuntimeError("no batch candidate ran")
 
     tokens_per_step = batch * seq
-    tokens_per_sec = tokens_per_step * iters / dt
+    tokens_per_sec = tokens_per_step / dt
     flops_per_token = 6 * n_params  # fwd+bwd transformer rule of thumb
     achieved_flops = tokens_per_sec * flops_per_token
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
@@ -148,7 +168,7 @@ def main():
         record["degraded"] = True  # TPU probe failed; see stderr probe log
     print(json.dumps(record))
     print(f"# loss={float(loss):.4f} params={n_params/1e6:.1f}M "
-          f"mfu={mfu:.3f} step={dt/iters*1000:.1f}ms backend="
+          f"mfu={mfu:.3f} step={dt*1000:.1f}ms batch={batch} backend="
           f"{jax.default_backend()}", file=sys.stderr)
 
 

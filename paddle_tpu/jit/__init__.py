@@ -164,6 +164,57 @@ def jnp_asarray(x):
 _PDMODEL_MAGIC = b"PTPUEXP1"
 
 
+def write_artifact(path_prefix, exported, params, bufs, meta):
+    """Write the (.pdmodel, .pdiparams) artifact pair: magic + JSON header +
+    serialized StableHLO module; params/buffers as a plain npz."""
+    import io as _io
+    import json
+
+    import numpy as np
+
+    blob = exported.serialize()
+    header = json.dumps(meta).encode("utf-8")
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(_PDMODEL_MAGIC)
+        f.write(len(header).to_bytes(8, "little"))
+        f.write(header)
+        f.write(blob)
+    arrays = {}
+    for k, v in (params or {}).items():
+        arrays["p:" + k] = np.asarray(v)
+    for k, v in (bufs or {}).items():
+        arrays["b:" + k] = np.asarray(v)
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        f.write(buf.getvalue())
+    return path_prefix + ".pdmodel"
+
+
+def read_artifact(path_prefix):
+    """Read back (exported, params, bufs, meta) from the artifact pair."""
+    import json
+
+    import numpy as np
+    from jax import export as jexport
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        magic = f.read(len(_PDMODEL_MAGIC))
+        if magic != _PDMODEL_MAGIC:
+            raise ValueError(
+                f"{path_prefix}.pdmodel is not a paddle_tpu jit.save "
+                f"artifact (bad magic {magic!r}) — re-save with jit.save")
+        hlen = int.from_bytes(f.read(8), "little")
+        meta = json.loads(f.read(hlen).decode("utf-8"))
+        blob = f.read()
+    exported = jexport.deserialize(blob)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        npz = np.load(f, allow_pickle=False)
+        params = {k[2:]: npz[k] for k in npz.files if k.startswith("p:")}
+        bufs = {k[2:]: npz[k] for k in npz.files if k.startswith("b:")}
+    return exported, params, bufs, meta
+
+
 def _resolve_input_specs(input_spec):
     """InputSpec/Tensor/ndarray list -> ShapeDtypeStructs. None/-1 dims
     become jax.export symbolic dimensions, so the serialized program stays
@@ -207,9 +258,6 @@ def save(layer, path, input_spec=None, **configs):
     input_spec: list of InputSpec / Tensor / ndarray giving the forward's
     input shapes+dtypes (required — tracing needs concrete avals).
     """
-    import io as _io
-    import json
-
     from jax import export as jexport
 
     if input_spec is None:
@@ -244,7 +292,6 @@ def save(layer, path, input_spec=None, **configs):
         except Exception:
             exported = jexport.export(jf)(p_specs, b_specs, *in_specs)
 
-        blob = exported.serialize()
         meta = {
             "format": "paddle_tpu.jit/1",
             "class_name": type(layer).__name__,
@@ -252,23 +299,7 @@ def save(layer, path, input_spec=None, **configs):
             "in_specs": [[[str(d) for d in s.shape], str(s.dtype)]
                          for s in in_specs],
         }
-        header = json.dumps(meta).encode("utf-8")
-        with open(path + ".pdmodel", "wb") as f:
-            f.write(_PDMODEL_MAGIC)
-            f.write(len(header).to_bytes(8, "little"))
-            f.write(header)
-            f.write(blob)
-
-        import numpy as np
-        arrays = {}
-        for k, v in params.items():
-            arrays["p:" + k] = np.asarray(v)
-        for k, v in bufs.items():
-            arrays["b:" + k] = np.asarray(v)
-        buf = _io.BytesIO()
-        np.savez(buf, **arrays)
-        with open(path + ".pdiparams", "wb") as f:
-            f.write(buf.getvalue())
+        write_artifact(path, exported, params, bufs, meta)
     finally:
         if was_training:
             layer.train()
@@ -278,26 +309,7 @@ def save(layer, path, input_spec=None, **configs):
 def load(path, **configs):
     """paddle.jit.load — rebuild a runnable TranslatedLayer from the
     .pdmodel (StableHLO) + .pdiparams archive. No model class import."""
-    import json
-
-    import numpy as np
-    from jax import export as jexport
-
-    with open(path + ".pdmodel", "rb") as f:
-        magic = f.read(len(_PDMODEL_MAGIC))
-        if magic != _PDMODEL_MAGIC:
-            raise ValueError(
-                f"{path}.pdmodel is not a paddle_tpu jit.save artifact "
-                f"(bad magic {magic!r}) — re-save with jit.save")
-        hlen = int.from_bytes(f.read(8), "little")
-        meta = json.loads(f.read(hlen).decode("utf-8"))
-        blob = f.read()
-    exported = jexport.deserialize(blob)
-
-    with open(path + ".pdiparams", "rb") as f:
-        npz = np.load(f, allow_pickle=False)
-        params = {k[2:]: npz[k] for k in npz.files if k.startswith("p:")}
-        bufs = {k[2:]: npz[k] for k in npz.files if k.startswith("b:")}
+    exported, params, bufs, meta = read_artifact(path)
     return TranslatedLayer(exported, params, bufs, meta)
 
 

@@ -96,6 +96,15 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
         program = program if program is not None else default_main_program()
+        from .io import LoadedProgram
+        if isinstance(program, LoadedProgram):  # deserialized artifact
+            outs = program(feed or {})
+            if fetch_list:
+                names = [v.name if isinstance(v, Variable) else str(v)
+                         for v in fetch_list]
+                idx = {n: i for i, n in enumerate(program.fetch_names)}
+                outs = [outs[idx[n]] for n in names]
+            return [np.asarray(o) for o in outs] if return_numpy else outs
         if hasattr(program, "_program"):  # CompiledProgram
             program = program._program
         scope = scope if scope is not None else _global_scope

@@ -1,48 +1,127 @@
 """Static-graph inference model save/load.
 
-Reference: python/paddle/fluid/io.py save_inference_model/load_inference_model
-(serializes the pruned ProgramDesc + params). TPU-first: we serialize the
-scope's parameter arrays plus a spec of feed/fetch names; at load time the
-caller re-binds them against a rebuilt program (programs are python-defined
-here, not a portable protobuf — the deployable artifact is params + jitted
-callable via paddle_tpu.jit.save / inference.Predictor).
+Reference: python/paddle/fluid/io.py:1198 save_inference_model /
+load_inference_model (serializes the pruned ProgramDesc + params).
+TPU-first: the Program's forward is lowered to one pure function
+`(params, *feeds) -> fetches` and exported as a serialized StableHLO
+module via jax.export — the SAME (.pdmodel, .pdiparams) artifact pair
+jit.save produces, so a static-graph model deploys through
+inference.create_predictor / a fresh process with no Program rebuild.
 """
 from __future__ import annotations
 
 import os
 
+import jax
+import numpy as np
+
 from ..core.tensor import Tensor
-from ..framework.io import load as fload
-from ..framework.io import save as fsave
+from ..jit import read_artifact, write_artifact
 from .executor import _global_scope
 from .program import Variable, default_main_program
 
 
+class LoadedProgram:
+    """Runnable handle for a loaded inference artifact (plays the role of
+    the reference's returned inference_program). Executor.run accepts it,
+    or call it directly: fetches = loaded(feed_dict)."""
+
+    def __init__(self, exported, params, meta):
+        self._exported = exported
+        self._params = params
+        self.feed_names = list(meta["feed_names"])
+        self.fetch_names = list(meta["fetch_names"])
+        self._call = jax.jit(exported.call)
+
+    def __call__(self, feed):
+        import jax.numpy as jnp
+        xs = []
+        for n in self.feed_names:
+            v = feed[n]
+            v = v._value if isinstance(v, Tensor) else jnp.asarray(
+                np.asarray(v))
+            xs.append(v)
+        outs = self._call(self._params, *xs)
+        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                          program=None, **kwargs):
+    """Lower the Program's forward to (params, *feeds) -> fetches and write
+    the StableHLO deployment artifact (ref: fluid/io.py:1198)."""
+    from jax import export as jexport
+
+    from .executor import _forward_env
+
     program = program or default_main_program()
     scope = _global_scope
-    state = {}
+    feed_names = [v.name if isinstance(v, Variable) else str(v)
+                  for v in feed_vars]
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in fetch_vars]
+
+    params = {}
     for v in program.global_block().vars.values():
         if v.persistable and scope.find_var(v.name) is not None:
-            state[v.name] = Tensor(scope.find_var(v.name))
-    spec = {
-        "feed_names": [v.name if isinstance(v, Variable) else str(v)
-                       for v in feed_vars],
-        "fetch_names": [v.name if isinstance(v, Variable) else str(v)
-                        for v in fetch_vars],
+            val = scope.find_var(v.name)
+            params[v.name] = val._value if isinstance(val, Tensor) else val
+
+    feed_specs = []
+    by_name = {n: v for n, v in zip(
+        feed_names,
+        [v for v in feed_vars if isinstance(v, Variable)] or feed_vars)}
+    n_sym = 0
+    for n in feed_names:
+        v = by_name.get(n)
+        dims = []
+        for d in (v.shape if isinstance(v, Variable)
+                  else np.asarray(v).shape):
+            if d is None or (isinstance(d, int) and d < 0):
+                # None/-1 feed dims stay polymorphic in the artifact
+                (sym,) = jexport.symbolic_shape(f"_s{n_sym}")
+                n_sym += 1
+                dims.append(sym)
+            else:
+                dims.append(d)
+        dtype = v.dtype if isinstance(v, Variable) else np.asarray(v).dtype
+        feed_specs.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
+
+    key = jax.random.key(0)  # inference: stochastic ops run is_test
+
+    def pure(params, *feeds):
+        fv = dict(zip(feed_names, feeds))
+        env = _forward_env(program, params, fv, key)
+        return tuple(env[n] for n in fetch_names)
+
+    p_specs = jax.tree_util.tree_map(
+        lambda v: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                       np.asarray(v).dtype), params)
+    jf = jax.jit(pure)
+    try:
+        exported = jexport.export(jf, platforms=("cpu", "tpu"))(
+            p_specs, *feed_specs)
+    except Exception:
+        exported = jexport.export(jf)(p_specs, *feed_specs)
+
+    meta = {
+        "format": "paddle_tpu.static/1",
+        "feed_names": feed_names,
+        "fetch_names": fetch_names,
+        "platforms": list(exported.platforms),
     }
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
-    fsave({"params": state, "spec": spec}, path_prefix + ".pdmodel")
-    return path_prefix + ".pdmodel"
+    return write_artifact(path_prefix, exported, params, {}, meta)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    payload = fload(path_prefix + ".pdmodel")
+    """Load the artifact back (ref returns [program, feeds, fetches]); the
+    returned LoadedProgram runs standalone — no Program rebuild, no model
+    code. Also primes the scope with the saved params for legacy flows."""
+    exported, params, _, meta = read_artifact(path_prefix)
     scope = _global_scope
-    for name, t in payload["params"].items():
-        scope.set(name, t._value)
-    spec = payload["spec"]
-    return spec["feed_names"], spec["fetch_names"]
+    for name, v in params.items():
+        scope.set(name, v)
+    loaded = LoadedProgram(exported, params, meta)
+    return loaded, loaded.feed_names, loaded.fetch_names

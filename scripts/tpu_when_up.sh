@@ -1,0 +1,23 @@
+#!/bin/bash
+# Wait for the axon TPU tunnel to recover, then run the perf work:
+# bench.py (scan-based) + model batch sweep + longseq kernel proof.
+cd /root/repo
+for i in $(seq 1 40); do
+  if timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256,256)) @ jnp.ones((256,256))
+print('PROBE_OK', float(jax.device_get(jnp.sum(x))))" 2>/dev/null | grep -q PROBE_OK; then
+    echo "=== tunnel up after $i probes $(date) ==="
+    echo "=== bench.py ==="
+    timeout 1200 python bench.py 2>&1 | grep -v WARNING
+    echo "=== longseq streaming bwd ==="
+    timeout 900 python scripts/perf_sweep.py --section longseq 2>&1 | grep -v WARNING
+    echo "=== model batch sweep ==="
+    timeout 1500 python scripts/perf_sweep.py --section model --batches 8,16,24 2>&1 | grep -v WARNING
+    exit 0
+  fi
+  echo "probe $i failed $(date)"
+  sleep 60
+done
+echo "=== tunnel never recovered ==="
+exit 1

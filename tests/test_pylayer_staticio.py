@@ -84,9 +84,19 @@ class TestStaticInferenceIO:
             (ref,) = exe.run(main, feed={"x": xd}, fetch_list=[out])
             prefix = str(tmp_path / "model")
             static.save_inference_model(prefix, [x], [out], exe, program=main)
-            feeds, fetches = static.load_inference_model(prefix, exe)
+            loaded, feeds, fetches = static.load_inference_model(prefix, exe)
             assert feeds == ["x"] and fetches == [out.name]
             (again,) = exe.run(main, feed={"x": xd}, fetch_list=[out])
             np.testing.assert_allclose(again, ref, rtol=1e-6)
+            # the loaded program runs standalone (serialized StableHLO —
+            # no Program rebuild) and via Executor.run
+            (lo,) = loaded({"x": xd})
+            np.testing.assert_allclose(np.asarray(lo), ref, rtol=1e-6)
+            (le,) = exe.run(loaded, feed={"x": xd}, fetch_list=fetches)
+            np.testing.assert_allclose(np.asarray(le), ref, rtol=1e-6)
+            # batch-polymorphic on the None dim
+            x3 = np.random.rand(7, 4).astype(np.float32)
+            (l3,) = loaded({"x": x3})
+            assert np.asarray(l3).shape == (7, 2)
         finally:
             paddle.disable_static()
