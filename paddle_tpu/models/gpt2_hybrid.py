@@ -128,8 +128,13 @@ def _stage_fn(stage, x, *, sp_axis, mp_axis, ring_impl):
         k = jnp.moveaxis(qkv[:, :, :, 1], 1, 2)
         v = jnp.moveaxis(qkv[:, :, :, 2], 1, 2)
         if sp_axis is not None:
-            o = ring_attention(q, k, v, axis_name=sp_axis, causal=True,
-                               impl=ring_impl)
+            if ring_impl == "ulysses":  # all-to-all sequence parallelism
+                from ..parallel.ulysses import ulysses_attention
+                o = ulysses_attention(q, k, v, axis_name=sp_axis,
+                                      causal=True)
+            else:
+                o = ring_attention(q, k, v, axis_name=sp_axis, causal=True,
+                                   impl=ring_impl)
         else:  # no sp axis: plain causal attention
             s = q.shape[2]
             logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)

@@ -15,3 +15,4 @@ from .api import (  # noqa: F401
     sharded_train_step,
 )
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import sp_attention, ulysses_attention  # noqa: F401

@@ -538,6 +538,126 @@ class TestCollectivesAPI:
                 check_rep=False)(jnp.arange(8.0).reshape(8, 1))
         assert float(np.asarray(out).ravel()[0]) == 1.0
 
+    def test_ulysses_matches_full_attention(self):
+        """All-to-all sequence parallelism (the second long-context mode):
+        seq->head all_to_all, local full-S flash, head->seq all_to_all
+        must match plain attention exactly."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.parallel.ulysses import ulysses_attention
+
+        b, h, s, d = 2, 8, 256, 32
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+                   for _ in range(3))
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        spec = P(None, None, "sp", None)
+
+        def inner(q, k, v):
+            return ulysses_attention(q, k, v, axis_name="sp", causal=True)
+
+        out = shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
+                        out_specs=spec, check_rep=False)(q, k, v)
+        scale = d ** -0.5
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        ref = jnp.einsum("bhqk,bhkd->bhqd",
+                         jax.nn.softmax(jnp.where(mask, logits, -1e30), -1),
+                         v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_ulysses_backward_matches_full(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.parallel.ulysses import ulysses_attention
+
+        b, h, s, d = 1, 4, 256, 32
+        rng = np.random.RandomState(1)
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+                   for _ in range(3))
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        spec = P(None, None, "sp", None)
+
+        def sp_loss(q, k, v):
+            def inner(q, k, v):
+                o = ulysses_attention(q, k, v, axis_name="sp", causal=True)
+                return o
+            o = shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
+                          out_specs=spec, check_rep=False)(q, k, v)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        def ref_loss(q, k, v):
+            scale = d ** -0.5
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            o = jnp.einsum(
+                "bhqk,bhkd->bhqd",
+                jax.nn.softmax(jnp.where(mask, logits, -1e30), -1), v)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        g_sp = jax.grad(sp_loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(g_sp, g_ref):
+            scale_ = float(jnp.max(jnp.abs(r))) + 1e-9
+            err = float(jnp.max(jnp.abs(a - r))) / scale_
+            assert err < 3e-2, err
+
+    def test_sp_attention_auto_picks(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.parallel.ulysses import sp_attention
+
+        mesh = Mesh(np.array(jax.devices()), ("sp",))
+        spec = P(None, None, "sp", None)
+        rng = np.random.RandomState(2)
+        # h=4 < sp=8: auto must fall back to ring (ulysses impossible)
+        q, k, v = (jnp.asarray(rng.randn(1, 4, 512, 32).astype(np.float32))
+                   for _ in range(3))
+
+        def inner(q, k, v):
+            return sp_attention(q, k, v, axis_name="sp", causal=True)
+
+        out = shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
+                        out_specs=spec, check_rep=False)(q, k, v)
+        assert out.shape == q.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_ulysses_mode_in_hybrid_gpt2(self):
+        """ring_impl='ulysses' swaps the sp mode of the 4D model; parity
+        vs the meshless oracle must hold exactly like the ring mode."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.models.gpt2_hybrid import (
+            build_hybrid_gpt2_loss, init_hybrid_gpt2_params, reference_loss)
+
+        mesh = make_mesh(dp=1, mp=2, pp=2, sp=2)
+        V = 129
+        params = init_hybrid_gpt2_params(
+            jax.random.key(0), vocab_size=V, hidden=128, num_heads=4,
+            num_layers=4, pp=2, max_position=256, mp=2)
+        rng = np.random.RandomState(0)
+        batch = {
+            "input_ids": jnp.asarray(rng.randint(0, V, (4, 256), np.int32)),
+            "labels": jnp.asarray(rng.randint(0, V, (4, 256), np.int32))}
+        loss_u = build_hybrid_gpt2_loss(mesh, num_microbatches=2,
+                                        vocab_size=V, ring_impl="ulysses")
+        ref = float(jax.jit(functools.partial(
+            reference_loss, vocab_size=V))(params, batch))
+        hyb = float(jax.jit(loss_u)(params, batch))
+        assert abs(ref - hyb) < 1e-3 * max(1.0, abs(ref)), (ref, hyb)
+
     def test_group_world_size_and_honest_semantics(self):
         # VERDICT r2 weak #6: get_world_size(group) must honor its argument
         import paddle_tpu.distributed as dist
