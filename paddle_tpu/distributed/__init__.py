@@ -11,7 +11,9 @@ from .collective import (  # noqa: F401
     scatter, split, reduce_scatter, alltoall, wait,
 )
 from .parallel import DataParallel  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import fleet  # noqa: F401
+from . import ps  # noqa: F401
 
 
 class MultiprocessContext:

@@ -1,0 +1,141 @@
+"""Dataset classes for PS-style file feeding.
+
+Reference: python/paddle/fluid/dataset.py (InMemoryDataset:329,
+QueueDataset:941) — file-list driven feeding for recsys training, lines
+parsed into slots by a data generator. TPU-first rework: no pipe
+subprocess protocol; lines are parsed host-side by a
+fleet.MultiSlot*DataGenerator (or a whitespace-float fallback) and batches
+come out as dicts of numpy arrays ready for device upload. InMemory loads
+and shuffles in RAM; Queue streams files lazily.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_vars = []
+        self.pipe_command = None
+        self.filelist = []
+        self._generator = None
+
+    # --- reference init/config surface ---------------------------------
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+        self.use_vars = list(use_var or [])
+        self.pipe_command = pipe_command
+        return self
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self.thread_num = thread_num
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_pipe_command(self, pipe_command):
+        self.pipe_command = pipe_command
+
+    def set_data_generator(self, generator):
+        """TPU-first replacement for the pipe protocol: parse lines with a
+        fleet.DataGenerator instance directly (no subprocess)."""
+        self._generator = generator
+
+    # --- parsing -------------------------------------------------------
+    def _parse_line(self, line):
+        if self._generator is not None:
+            return list(self._generator.generate_sample(line)())
+        # fallback: whitespace-separated floats, one unnamed slot
+        vals = [float(t) for t in line.split()]
+        return [("slot_0", vals)]
+
+    def _iter_files(self):
+        for path in self.filelist:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if line:
+                        yield self._parse_line(line)
+
+    @staticmethod
+    def _batch(samples):
+        slots = {}
+        for sample in samples:
+            for name, vals in sample:
+                slots.setdefault(name, []).append(vals)
+        return {k: np.asarray(v) for k, v in slots.items()}
+
+
+class InMemoryDataset(DatasetBase):
+    """ref: fluid/dataset.py:329 — load the full filelist into host RAM,
+    shuffle there, then iterate batches."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory = []
+
+    def load_into_memory(self):
+        self._memory = list(self._iter_files())
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        random.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host: global == local; multi-host would all-to-all rows
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._memory = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def __iter__(self):
+        for i in range(0, len(self._memory), self.batch_size):
+            yield self._batch(self._memory[i:i + self.batch_size])
+
+
+class QueueDataset(DatasetBase):
+    """ref: fluid/dataset.py:941 — streaming: files are read lazily, no
+    global shuffle available (matches the reference's contract)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams files; use InMemoryDataset for shuffle "
+            "(same contract as the reference)")
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        raise NotImplementedError(
+            "QueueDataset streams files; use InMemoryDataset for shuffle")
+
+    def __iter__(self):
+        buf = []
+        for sample in self._iter_files():
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield self._batch(buf)
+                buf = []
+        if buf:
+            yield self._batch(buf)

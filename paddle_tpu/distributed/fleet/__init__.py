@@ -16,6 +16,8 @@ from .base import (  # noqa: F401
     distributed_optimizer, distributed_model,
 )
 from .meta import apply_strategy, build_hybrid_train_step  # noqa: F401
+from .data_generator import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator)
 
 
 class UtilBase:
@@ -45,27 +47,8 @@ class Role:
     SERVER = 2
 
 
-class MultiSlotDataGenerator:
-    """Slot-format data generator contract (ref: fleet/data_generator/).
-    Subclasses implement generate_sample(line) yielding (slot, values)
-    pairs; run() streams stdin to stdout in the slot text protocol."""
-
-    def set_batch(self, batch_size):
-        self._batch = batch_size
-
-    def generate_sample(self, line):
-        raise NotImplementedError
-
-    def run_from_stdin(self):
-        import sys
-        for line in sys.stdin:
-            g = self.generate_sample(line)
-            for rec in (g() if callable(g) else g):
-                parts = []
-                for _, vals in rec:
-                    parts.append(str(len(vals)))
-                    parts += [str(v) for v in vals]
-                sys.stdout.write(" ".join(parts) + "\n")
+# (MultiSlotDataGenerator and friends live in data_generator.py — imported
+# above; an earlier inline stub was removed in favor of the real module.)
 
 
 from . import metrics  # noqa: E402,F401

@@ -65,8 +65,14 @@ def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
               flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
               min_max_aspect_ratios_order=False, name=None):
     """SSD prior boxes, normalized coords (ref: prior_box_op.cc)."""
-    fh, fw = _val(input).shape[2], _val(input).shape[3]
-    ih, iw = _val(image).shape[2], _val(image).shape[3]
+    # only the static shapes are consumed — works for Tensors, arrays and
+    # graph Variables alike (static.nn.multi_box_head passes Variables)
+    in_shape = tuple(input.shape) if hasattr(input, "shape") \
+        else _val(input).shape
+    im_shape = tuple(image.shape) if hasattr(image, "shape") \
+        else _val(image).shape
+    fh, fw = in_shape[2], in_shape[3]
+    ih, iw = im_shape[2], im_shape[3]
     step_w = steps[0] or iw / fw
     step_h = steps[1] or ih / fh
     ars = list(aspect_ratios)

@@ -118,3 +118,436 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
     if act:
         out = getattr(ops, act)(out)
     return out
+
+
+# --------------------------------------------------------------------------
+# control flow (re-exported: ops/control.py lowers to lax.cond/while/switch;
+# they trace fine inside static programs through the op-capture hook)
+# ref: python/paddle/fluid/layers/control_flow.py
+from ..ops.control import case, cond, switch_case, while_loop  # noqa: E402,F401
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: fluid/layers/tensor.py create_parameter."""
+    attr = ParamAttr._to_attr(attr) if attr is not None else ParamAttr(name=name)
+    return _create_param(tuple(shape), dtype, attr, is_bias=is_bias,
+                         default_init=default_initializer)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """ref: fluid/layers/nn.py prelu — alpha shape by mode."""
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (x.shape[1],)
+    else:  # element
+        shape = tuple(x.shape[1:])
+    a = _create_param(shape, "float32", param_attr,
+                      default_init=I.Constant(0.25))
+    return ops.prelu(x, a)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+                  name=None):
+    c = input.shape[1]
+    w = _create_param((c,), "float32", param_attr,
+                      default_init=I.Constant(1.0)) \
+        if param_attr is not False else None
+    b = _create_param((c,), "float32", bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    return ops.instance_norm(input, w, b, epsilon)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+               act=None, data_layout="NCHW", name=None):
+    c = input.shape[1]
+    w = _create_param((c,), "float32", param_attr,
+                      default_init=I.Constant(1.0)) \
+        if param_attr is not False else None
+    b = _create_param((c,), "float32", bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = ops.group_norm(input, groups, w, b, epsilon)
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """ref: fluid/layers/nn.py spectral_norm — power iteration with
+    persistable u/v vectors."""
+    import jax.numpy as jnp
+    w = weight
+    h = w.shape[dim]
+    u = _create_param((h,), "float32", ParamAttr(name=None),
+                      default_init=I.Normal(0.0, 1.0))
+    wm = ops.reshape(ops.transpose(
+        w, [dim] + [i for i in range(len(w.shape)) if i != dim]), [h, -1])
+    uv = u
+    vv = None
+    for _ in range(max(1, power_iters)):
+        vv = ops.matmul(uv, wm)
+        vv = vv / (ops.norm(vv) + eps)
+        uv = ops.matmul(wm, vv)
+        uv = uv / (ops.norm(uv) + eps)
+    sigma = ops.sum(uv * ops.matmul(wm, vv))
+    return w / sigma
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,  # noqa: A002
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    cin = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _create_param((cin, num_filters // (groups or 1), fs[0], fs[1]),
+                      "float32", param_attr)
+    b = _create_param((num_filters,), "float32", bias_attr, is_bias=True)
+    out = ops.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                               dilation=dilation, groups=groups or 1)
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,  # noqa: A002
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCDHW"):
+    cin = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = _create_param((num_filters, cin // (groups or 1)) + tuple(fs),
+                      "float32", param_attr)
+    b = _create_param((num_filters,), "float32", bias_attr, is_bias=True)
+    out = ops.conv3d(input, w, b, stride=stride, padding=padding,
+                     dilation=dilation, groups=groups or 1)
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,  # noqa: A002
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCDHW"):
+    cin = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = _create_param((cin, num_filters // (groups or 1)) + tuple(fs),
+                      "float32", param_attr)
+    b = _create_param((num_filters,), "float32", bias_attr, is_bias=True)
+    out = ops.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                               dilation=dilation, groups=groups or 1)
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x^T W_k y + b_k (ref: fluid/layers/nn.py
+    bilinear_tensor_product)."""
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = _create_param((size, dx, dy), "float32", param_attr)
+    b = _create_param((size,), "float32", bias_attr, is_bias=True)
+    out = ops.einsum("bi,kij,bj->bk", x, w, y)
+    if b is not None:
+        out = out + b
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """ref: fluid/layers/nn.py data_norm — normalization by accumulated
+    batch statistics (batch_size/batch_sum/batch_square_sum persistables),
+    no learnable scale/shift unless enabled."""
+    c = input.shape[-1] if data_layout == "NHWC" else input.shape[1]
+    bsize = _create_param((c,), "float32", ParamAttr(name=None),
+                          default_init=I.Constant(1e4))
+    bsum = _create_param((c,), "float32", ParamAttr(name=None),
+                         default_init=I.Constant(0.0))
+    bsqs = _create_param((c,), "float32", ParamAttr(name=None),
+                         default_init=I.Constant(1e4))
+    mean = bsum / bsize
+    scale = ops.rsqrt(bsqs / bsize + epsilon)
+    shape = [1, -1] + [1] * (len(input.shape) - 2) \
+        if data_layout == "NCHW" else [1] * (len(input.shape) - 1) + [-1]
+    out = (input - ops.reshape(mean, shape)) * ops.reshape(scale, shape)
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """Lookahead row convolution (ref: row_conv_op): out[t] = sum_{i=0..F}
+    w[i] * x[t+i], dense [B, T, D] layout."""
+    import jax.numpy as jnp
+    d = input.shape[-1]
+    f = future_context_size + 1
+    w = _create_param((f, d), "float32", param_attr)
+    xv = input._value if hasattr(input, "_value") else input
+    from ..core.tensor import Tensor
+    from ..ops._registry import apply_op
+
+    def core(xv, wv):
+        pads = [(0, 0)] * xv.ndim
+        pads[1] = (0, f - 1)
+        xp = jnp.pad(xv, pads)
+        t = xv.shape[1]
+        out = sum(xp[:, i:i + t] * wv[i] for i in range(f))
+        return out
+
+    out = apply_op(core, "row_conv", (input, w), {})
+    if act:
+        out = getattr(ops, act)(out)
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref: fluid/layers/nn.py py_func — run a host Python callable inside
+    the graph. Lowered with jax.pure_callback (traced) or a direct call
+    (eager)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.core as jcore
+    from ..core.tensor import Tensor
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    vals = [v._value if isinstance(v, Tensor) else v for v in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype
+              if not isinstance(o.dtype, str) else o.dtype)
+              for o in outs]
+
+    def host(*arrs):
+        r = func(*arrs)
+        rs = r if isinstance(r, (list, tuple)) else [r]
+        return tuple(np.asarray(v) for v in rs)
+
+    if any(isinstance(v, jcore.Tracer) for v in vals):
+        res = jax.pure_callback(host, tuple(shapes), *vals)
+    else:
+        res = host(*vals)
+    res = [Tensor(jnp.asarray(r)) for r in res]
+    return res if isinstance(out, (list, tuple)) else res[0]
+
+
+def crf_decoding(input, param_attr=None, length=None, label=None):  # noqa: A002
+    """Viterbi decode over a linear-chain CRF (ref: crf_decoding_op).
+    input: [B, T, N] unary potentials (dense layout), transition param
+    [N+2, N] with paddle's start/stop rows at indices 0/1."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..ops._registry import apply_op
+
+    n = input.shape[-1]
+    w = _create_param((n + 2, n), "float32", param_attr)
+
+    def core(emis, trans):
+        start, stop, t_mat = trans[0], trans[1], trans[2:]
+
+        def viterbi(emis_b):
+            a0 = start + emis_b[0]
+
+            def step(alpha, e_t):
+                scores = alpha[:, None] + t_mat + e_t[None, :]
+                return jnp.max(scores, axis=0), jnp.argmax(scores, axis=0)
+
+            alpha, bps = jax.lax.scan(step, a0, emis_b[1:])
+            last = jnp.argmax(alpha + stop)
+
+            def back(tag, bp):
+                return bp[tag], bp[tag]
+
+            _, path_rev = jax.lax.scan(back, last, bps, reverse=True)
+            return jnp.concatenate([path_rev, jnp.asarray([last])])
+
+        return jax.vmap(viterbi)(emis)
+
+    return apply_op(core, "crf_decoding", (input, w), {}, nondiff=True)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=5, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (ref: nce_op). TPU-first: dense
+    uniform negative sampling, logistic loss over pos + sampled negs."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..core import rng as rng_mod
+    from ..ops._registry import apply_op
+
+    d = input.shape[-1]
+    w = _create_param((num_total_classes, d), "float32", param_attr)
+    b = _create_param((num_total_classes,), "float32", bias_attr,
+                      is_bias=True)
+    key = rng_mod.next_key()
+
+    def core(xv, lv, wv, bv):
+        bsz = xv.shape[0]
+        lv = lv.reshape(-1).astype(jnp.int32)
+        negs = jax.random.randint(key, (bsz, num_neg_samples), 0,
+                                  num_total_classes)
+        pos_logit = jnp.sum(xv * wv[lv], -1) + bv[lv]
+        neg_logit = jnp.einsum("bd,bnd->bn", xv, wv[negs]) + bv[negs]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jnp.sum(jax.nn.softplus(neg_logit), -1)
+        return (pos_loss + neg_loss)[:, None]
+
+    return apply_op(core, "nce", (input, label, w, b), {})
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,  # noqa: A002
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (ref: fluid/layers/detection.py multi_box_head):
+    per feature map, a conv predicts loc+conf and prior_box generates the
+    anchors; outputs concatenated over maps."""
+    from ..nn.functional.detection import prior_box as _prior_box
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+
+    if min_sizes is None:
+        # reference ratio interpolation
+        num_layer = len(inputs)
+        min_ratio = min_ratio or 20
+        max_ratio = max_ratio or 90
+        step = int((max_ratio - min_ratio) / max(1, (num_layer - 2)))
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[: num_layer - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[: num_layer - 1]
+
+    class _ShapeOnly:  # prior_box only consumes .shape; Variables aren't
+        def __init__(self, shape):  # convertible to arrays
+            self.shape = tuple(shape)
+
+    image_s = _ShapeOnly(image.shape)
+    locs, confs, boxes, vars_ = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        mn = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        mx = [max_sizes[i]] if max_sizes and max_sizes[i] else None
+        box, var = _prior_box(_ShapeOnly(feat.shape), image_s, mn, mx, ar,
+                              variance, flip, clip, offset=offset)
+        num_priors = int(np.prod(box.shape[:-1])) // int(
+            np.prod(feat.shape[2:]))
+        loc = conv2d(feat, num_priors * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(feat, num_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        bsz = feat.shape[0]
+        loc = ops.reshape(ops.transpose(loc, [0, 2, 3, 1]), [bsz, -1, 4])
+        conf = ops.reshape(ops.transpose(conf, [0, 2, 3, 1]),
+                           [bsz, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes.append(ops.reshape(box, [-1, 4]))
+        vars_.append(ops.reshape(var, [-1, 4]))
+    mbox_locs = ops.concat(locs, 1)
+    mbox_confs = ops.concat(confs, 1)
+    box = ops.concat(boxes, 0)
+    var = ops.concat(vars_, 0)
+    return mbox_locs, mbox_confs, box, var
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """Deformable conv v2 (ref: deformable_conv_op): bilinear sampling at
+    offset locations then a dense contraction. Dense TPU formulation:
+    gather the kH*kW sampled patches with vectorized bilinear interp."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops._registry import apply_op
+
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    kh, kw = fs
+    cin = x.shape[1]
+    w = _create_param((num_filters, cin // (groups or 1), kh, kw),
+                      "float32", param_attr)
+    b = _create_param((num_filters,), "float32", bias_attr, is_bias=True)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    pd = padding if isinstance(padding, (list, tuple)) else (padding, padding)
+    dl = dilation if isinstance(dilation, (list, tuple)) \
+        else (dilation, dilation)
+
+    def core(xv, off, msk, wv, *bias):
+        bsz, c, h, wdt = xv.shape
+        ho = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        wo = (wdt + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        # base sampling grid [ho, wo, kh, kw]
+        oy = jnp.arange(ho) * st[0]
+        ox = jnp.arange(wo) * st[1]
+        ky = jnp.arange(kh) * dl[0]
+        kx = jnp.arange(kw) * dl[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        off = off.reshape(bsz, deformable_groups, kh * kw, 2, ho, wo)
+        dy = jnp.moveaxis(off[:, :, :, 0], -2, 2).reshape(
+            bsz, deformable_groups, ho, wo, kh, kw)
+        dx = jnp.moveaxis(off[:, :, :, 1], -2, 2).reshape(
+            bsz, deformable_groups, ho, wo, kh, kw)
+        sy = base_y[None, None] + dy
+        sx = base_x[None, None] + dx
+        hp, wp = xp.shape[2], xp.shape[3]
+        sy = jnp.clip(sy, 0.0, hp - 1.0)
+        sx = jnp.clip(sx, 0.0, wp - 1.0)
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, hp - 1)
+        x1 = jnp.minimum(x0 + 1, wp - 1)
+        wy = sy - y0
+        wx = sx - x0
+        cg = c // deformable_groups
+
+        def gather(yi, xi):
+            # xp: [B, C, HP, WP]; yi/xi: [B, G, ho, wo, kh, kw]
+            yi = jnp.repeat(yi, cg, axis=1)  # -> [B, C, ...]
+            xi = jnp.repeat(xi, cg, axis=1)
+            bidx = jnp.arange(bsz)[:, None, None, None, None, None]
+            cidx = jnp.arange(c)[None, :, None, None, None, None]
+            return xp[bidx, cidx, yi, xi]
+
+        w00 = ((1 - wy) * (1 - wx))
+        w01 = ((1 - wy) * wx)
+        w10 = (wy * (1 - wx))
+        w11 = (wy * wx)
+
+        def wexp(wt):
+            return jnp.repeat(wt, cg, axis=1)
+
+        patches = (gather(y0, x0) * wexp(w00) + gather(y0, x1) * wexp(w01)
+                   + gather(y1, x0) * wexp(w10) + gather(y1, x1) * wexp(w11))
+        if msk is not None:
+            m = msk.reshape(bsz, deformable_groups, kh * kw, ho, wo)
+            m = jnp.moveaxis(m, 2, -1).reshape(
+                bsz, deformable_groups, ho, wo, kh, kw)
+            patches = patches * jnp.repeat(m, cg, axis=1)
+        out = jnp.einsum("bchwyx,ocyx->bohw", patches, wv)
+        if bias:
+            out = out + bias[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, mask, w]
+    if b is not None:
+        args.append(b)
+    return apply_op(core, "deform_conv2d", tuple(args), {})

@@ -1,0 +1,114 @@
+"""Public-API parity audit: reference __all__ exports vs the rebuild.
+
+Regex-extracts each reference module's __all__ (no reference import — the
+reference's C core doesn't build here) and hasattr-checks the rebuilt
+namespace. Prints missing symbols per namespace; exit 1 if any.
+"""
+import ast
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REF = "/root/reference/python/paddle"
+
+# (reference __init__ path, rebuild attr path)
+PAIRS = [
+    ("", ""),
+    ("nn", "nn"),
+    ("nn/functional", "nn.functional"),
+    ("nn/initializer", "nn.initializer"),
+    ("tensor", "tensor"),
+    ("static", "static"),
+    ("static/nn", "static.nn"),
+    ("distributed", "distributed"),
+    ("distributed/fleet", "distributed.fleet"),
+    ("metric", "metric"),
+    ("vision", "vision"),
+    ("vision/models", "vision.models"),
+    ("vision/datasets", "vision.datasets"),
+    ("vision/transforms", "vision.transforms"),
+    ("vision/ops", "vision.ops"),
+    ("io", "io"),
+    ("jit", "jit"),
+    ("amp", "amp"),
+    ("optimizer", "optimizer"),
+    ("distribution", "distribution"),
+    ("utils", "utils"),
+    ("text/datasets", "text.datasets"),
+    ("reader", "reader"),
+    ("inference", "inference"),
+    ("onnx", "onnx"),
+]
+
+
+def ref_all(relpath):
+    for cand in (os.path.join(REF, relpath, "__init__.py"),
+                 os.path.join(REF, relpath + ".py")):
+        if os.path.exists(cand):
+            break
+    else:
+        return None
+    with open(cand, encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    names = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "__all__"
+                   for t in targets):
+                try:
+                    val = ast.literal_eval(node.value)
+                    names.extend(val)
+                except Exception:
+                    pass
+    # `__all__ += something.__all__` patterns: regex the += module refs
+    for m in re.finditer(r"__all__\s*\+=\s*(\w[\w.]*)\.__all__", src):
+        sub = m.group(1)
+        subnames = ref_all(os.path.join(relpath, sub.replace(".", "/")))
+        if subnames:
+            names.extend(subnames)
+    return sorted(set(n for n in names if isinstance(n, str)))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+
+    total_missing = 0
+    for rel, attr in PAIRS:
+        names = ref_all(rel)
+        if not names:
+            continue
+        obj = paddle
+        ok = True
+        for part in (attr.split(".") if attr else []):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                ok = False
+                break
+        if not ok:
+            print(f"{attr or 'paddle'}: NAMESPACE MISSING")
+            total_missing += len(names)
+            continue
+        missing = [n for n in names if not hasattr(obj, n)]
+        label = attr or "paddle"
+        if missing:
+            total_missing += len(missing)
+            print(f"{label}: {len(missing)}/{len(names)} missing: "
+                  f"{missing[:12]}{'...' if len(missing) > 12 else ''}")
+        else:
+            print(f"{label}: OK ({len(names)} symbols)")
+    print(f"TOTAL MISSING: {total_missing}")
+    sys.exit(1 if total_missing else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""static.nn completion (parity audit r3): the 20 fluid layers that were
+missing from static.nn, plus InMemoryDataset/QueueDataset and the fleet
+data generators.
+
+Ref: python/paddle/fluid/layers/nn.py, fluid/dataset.py,
+distributed/fleet/data_generator/.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+class TestStaticNNExtra:
+    def test_param_layers_run(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            img = static.data("img", [4, 3, 16, 16], "float32")
+            y2 = static.data("y2", [4, 8], "float32")
+            p = static.nn.prelu(x, mode="channel")
+            inorm = static.nn.instance_norm(img)
+            gnorm = static.nn.group_norm(img, groups=3)
+            ct = static.nn.conv2d_transpose(img, 6, 3)
+            btp = static.nn.bilinear_tensor_product(x, y2, 7)
+            par = static.nn.create_parameter([3, 3], "float32")
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.rand(4, 8).astype(np.float32),
+                "img": np.random.rand(4, 3, 16, 16).astype(np.float32),
+                "y2": np.random.rand(4, 8).astype(np.float32)}
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[p, inorm, gnorm, ct, btp])
+        assert [tuple(np.asarray(o).shape) for o in outs] == [
+            (4, 8), (4, 3, 16, 16), (4, 3, 16, 16), (4, 6, 18, 18), (4, 7)]
+        # instance_norm: per-sample-per-channel zero mean
+        mu = np.asarray(outs[1]).mean(axis=(2, 3))
+        np.testing.assert_allclose(mu, 0.0, atol=1e-4)
+
+    def test_conv3d_variants(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x3 = static.data("x3", [2, 3, 4, 8, 8], "float32")
+            c3 = static.nn.conv3d(x3, 5, 3)
+            c3t = static.nn.conv3d_transpose(x3, 5, 3)
+        exe = static.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed={
+            "x3": np.random.rand(2, 3, 4, 8, 8).astype(np.float32)},
+            fetch_list=[c3, c3t])
+        assert np.asarray(outs[0]).shape == (2, 5, 2, 6, 6)
+        assert np.asarray(outs[1]).shape == (2, 5, 6, 10, 10)
+
+    def test_crf_decoding_prefers_high_emission(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            emis = static.data("emis", [1, 4, 3], "float32")
+            path = static.nn.crf_decoding(emis)
+        exe = static.Executor()
+        exe.run(startup)
+        e = np.full((1, 4, 3), -5.0, np.float32)
+        want = [0, 2, 1, 0]
+        for t, c in enumerate(want):
+            e[0, t, c] = 5.0
+        (out,) = exe.run(main, feed={"emis": e}, fetch_list=[path])
+        # transitions start near-zero -> argmax path follows emissions
+        assert list(np.asarray(out)[0]) == want
+
+    def test_row_conv_lookahead(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            seq = static.data("seq", [1, 5, 2], "float32")
+            rc = static.nn.row_conv(seq, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main, feed={
+            "seq": np.ones((1, 5, 2), np.float32)}, fetch_list=[rc])
+        assert np.asarray(out).shape == (1, 5, 2)
+
+    def test_nce_and_deform_and_mbox(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            lbl = static.data("lbl", [4, 1], "int64")
+            loss = static.nn.nce(x, lbl, 100, num_neg_samples=3)
+            img = static.data("img", [2, 4, 8, 8], "float32")
+            off = static.data("off", [2, 18, 8, 8], "float32")
+            msk = static.data("msk", [2, 9, 8, 8], "float32")
+            dc = static.nn.deform_conv2d(img, off, msk, 6, 3, padding=1)
+            image = static.data("image", [2, 3, 32, 32], "float32")
+            f1 = static.data("f1", [2, 8, 8, 8], "float32")
+            locs, confs, box, var = static.nn.multi_box_head(
+                [f1], image, base_size=32, num_classes=5,
+                aspect_ratios=[[2.0]], min_ratio=20, max_ratio=90)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        outs = exe.run(main, feed={
+            "x": rng.rand(4, 8).astype(np.float32),
+            "lbl": rng.randint(0, 100, (4, 1)).astype(np.int64),
+            "img": rng.rand(2, 4, 8, 8).astype(np.float32),
+            "off": (rng.rand(2, 18, 8, 8) - 0.5).astype(np.float32),
+            "msk": rng.rand(2, 9, 8, 8).astype(np.float32),
+            "image": rng.rand(2, 3, 32, 32).astype(np.float32),
+            "f1": rng.rand(2, 8, 8, 8).astype(np.float32),
+        }, fetch_list=[loss, dc, locs, confs, box])
+        assert np.asarray(outs[0]).shape == (4, 1)
+        assert np.asarray(outs[1]).shape == (2, 6, 8, 8)
+        assert np.asarray(outs[2]).shape[0] == 2
+        assert np.asarray(outs[4]).shape[-1] == 4
+
+    def test_deform_conv_zero_offset_matches_plain(self, static_mode):
+        """With zero offsets and all-ones mask, deformable conv must equal
+        an ordinary convolution with the same weights."""
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("img", [1, 2, 6, 6], "float32")
+            off = static.data("off", [1, 18, 6, 6], "float32")
+            msk = static.data("msk", [1, 9, 6, 6], "float32")
+            dc = static.nn.deform_conv2d(img, off, msk, 3, 3, padding=1)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        xv = rng.rand(1, 2, 6, 6).astype(np.float32)
+        (out,) = exe.run(main, feed={
+            "img": xv,
+            "off": np.zeros((1, 18, 6, 6), np.float32),
+            "msk": np.ones((1, 9, 6, 6), np.float32)}, fetch_list=[dc])
+        # plain conv with the created weight
+        import jax
+        from paddle_tpu.static.executor import _global_scope
+        wname = [k for k in _global_scope.keys() if "w_0" in k or "param" in k]
+        # recompute via lax.conv with the same weight from the scope
+        import jax.numpy as jnp
+        w = None
+        for k in _global_scope.keys():
+            v = _global_scope.find_var(k)
+            if v is not None and hasattr(v, "shape") \
+                    and tuple(np.asarray(v).shape) == (3, 2, 3, 3):
+                w = np.asarray(v)
+        assert w is not None
+        ref = jax.lax.conv_general_dilated(
+            xv, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        got = np.asarray(out)
+        # bias (zeros) included; interior must match the plain conv
+        np.testing.assert_allclose(got, np.asarray(ref), atol=1e-4)
+
+
+class TestPSDatasets:
+    def _write_files(self, tmp_path, n_files=2, lines_per=5):
+        paths = []
+        rng = np.random.RandomState(0)
+        for i in range(n_files):
+            p = tmp_path / f"part-{i}.txt"
+            with open(p, "w") as f:
+                for j in range(lines_per):
+                    f.write(" ".join(str(rng.randint(0, 9))
+                                     for _ in range(4)) + "\n")
+            paths.append(str(p))
+        return paths
+
+    def test_in_memory_dataset(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=4, use_var=[])
+        ds.set_filelist(self._write_files(tmp_path))
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 10
+        ds.local_shuffle()
+        batches = list(ds)
+        assert len(batches) == 3  # 4+4+2
+        assert batches[0]["slot_0"].shape == (4, 4)
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams_and_rejects_shuffle(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        ds = dist.QueueDataset()
+        ds.init(batch_size=3)
+        ds.set_filelist(self._write_files(tmp_path))
+        batches = list(ds)
+        assert sum(b["slot_0"].shape[0] for b in batches) == 10
+        with pytest.raises(NotImplementedError):
+            ds.local_shuffle()
+
+    def test_multislot_data_generator(self, tmp_path):
+        from paddle_tpu.distributed.fleet import (
+            MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+
+        class G(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    toks = line.split()
+                    yield ("ids", [int(t) for t in toks[:2]])
+                    yield ("label", [float(toks[2])])
+                return gen
+
+        g = G()
+        samples = g.run_from_memory(["1 2 0", "3 4 1"])
+        assert samples[0][0] == ("ids", [1, 2])
+        assert samples[1][1] == ("label", [1.0])
+        # protocol line: n_slots len vals len vals
+        assert g._to_protocol(samples[0]) == "2 2 1 2 1 0.0\n"
+
+        class S(MultiSlotStringDataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    yield ("words", line.split())
+                return gen
+
+        s = S().run_from_memory(["a b c"])
+        assert s[0][0] == ("words", ["a", "b", "c"])
+
+        # dataset integration: generator-parsed batches
+        import paddle_tpu.distributed as dist
+        p = tmp_path / "f.txt"
+        with open(p, "w") as f:
+            f.write("1 2 0\n3 4 1\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(p)])
+        ds.set_data_generator(G())
+        ds.load_into_memory()
+        (b,) = list(ds)
+        np.testing.assert_array_equal(b["ids"], [[1, 2], [3, 4]])
+        np.testing.assert_array_equal(b["label"], [[0.0], [1.0]])
