@@ -69,12 +69,12 @@ from . import fluid  # noqa: E402,F401
 
 
 def __getattr__(name):
-    # lazy model zoo (PEP 562): deployment processes (inference.Predictor on
-    # a jit.save'd artifact) never pay for — or depend on — the model
-    # classes; `paddle_tpu.models` still works on first touch
-    if name == "models":
-        mod = _importlib.import_module(".models", __name__)
-        globals()["models"] = mod
+    # lazy heavy namespaces (PEP 562): deployment processes (inference.
+    # Predictor on a jit.save'd artifact) never pay for — or depend on —
+    # the model classes / dataset loaders; first touch still works
+    if name in ("models", "dataset"):
+        mod = _importlib.import_module("." + name, __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 

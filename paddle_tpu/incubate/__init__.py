@@ -46,3 +46,4 @@ class LookAhead:
         self.step()
 
 from .. import reader  # noqa: E402,F401  (the real decorator module)
+from . import complex  # noqa: E402,F401,A004  (complex tensor ops)

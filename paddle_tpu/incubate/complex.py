@@ -1,0 +1,91 @@
+"""paddle.incubate.complex — complex-number tensor ops.
+
+Reference: python/paddle/incubate/complex/tensor/{math,linalg,
+manipulation}.py. There a ComplexVariable carries a (real, imag) pair of
+Variables because the fluid core has no complex dtype; here jax.numpy has
+first-class complex64/128, so a ComplexVariable is simply a complex-dtype
+Tensor (compat.py) and every op is the jnp op — XLA lowers complex
+arithmetic to fused real/imag pairs on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._registry import apply_op
+
+__all__ = [
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "kron", "trace", "sum", "matmul", "reshape",
+    "transpose",
+]
+
+
+def _c(x):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if not jnp.issubdtype(v.dtype, jnp.complexfloating):
+        v = v.astype(jnp.complex64)
+    return v
+
+
+def _binop(fn, name, x, y):
+    return apply_op(lambda a, b: fn(_c(a), _c(b)), name,
+                    (x if isinstance(x, Tensor) else Tensor(_c(x)),
+                     y if isinstance(y, Tensor) else Tensor(_c(y))), {})
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return _binop(jnp.add, "complex_add", x, y)
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return _binop(jnp.subtract, "complex_sub", x, y)
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return _binop(jnp.multiply, "complex_mul", x, y)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return _binop(jnp.divide, "complex_div", x, y)
+
+
+def kron(x, y, name=None):
+    return _binop(jnp.kron, "complex_kron", x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    def core(a, b):
+        a, b = _c(a), _c(b)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * (a @ b)
+    return apply_op(core, "complex_matmul",
+                    (x if isinstance(x, Tensor) else Tensor(_c(x)),
+                     y if isinstance(y, Tensor) else Tensor(_c(y))), {})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda a: jnp.trace(_c(a), offset=offset, axis1=axis1, axis2=axis2),
+        "complex_trace", (x if isinstance(x, Tensor) else Tensor(_c(x)),),
+        {})
+
+
+def sum(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op(
+        lambda a: jnp.sum(_c(a), axis=axis, keepdims=keepdim),
+        "complex_sum", (x if isinstance(x, Tensor) else Tensor(_c(x)),), {})
+
+
+def reshape(x, shape, inplace=False, name=None):
+    return apply_op(lambda a: jnp.reshape(_c(a), shape), "complex_reshape",
+                    (x if isinstance(x, Tensor) else Tensor(_c(x)),), {})
+
+
+def transpose(x, perm, name=None):
+    return apply_op(lambda a: jnp.transpose(_c(a), perm),
+                    "complex_transpose",
+                    (x if isinstance(x, Tensor) else Tensor(_c(x)),), {})
