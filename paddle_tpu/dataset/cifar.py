@@ -8,8 +8,9 @@ import numpy as np
 
 
 def _reader(cls, mode):
+    ds = cls(mode=mode)  # parse the archive once, not per epoch
+
     def reader():
-        ds = cls(mode=mode)
         for i in range(len(ds)):
             img = ds.images[i].astype(np.float32).reshape(-1) / 255.0
             yield img, int(ds.labels[i])

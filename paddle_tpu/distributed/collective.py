@@ -166,6 +166,16 @@ def _mesh_1d():
     return jax.sharding.Mesh(devs, ("dp",))
 
 
+def _global_rank_in(mesh):
+    """Traced global linear rank across ALL mesh axes (row-major, matching
+    jax device order) — axis_index of the first axis alone is only the
+    global rank on a 1-D mesh."""
+    me = jnp.zeros((), jnp.int32)
+    for a in mesh.axis_names:
+        me = me * mesh.shape[a] + jax.lax.axis_index(a)
+    return me
+
+
 def _collective_1d(x, op):
     """Run `op` over a 1-D mesh covering all devices via shard_map.
 
@@ -253,12 +263,16 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
                ReduceOp.MIN: jax.lax.pmin,
                ReduceOp.AVG: jax.lax.pmean}.get(op, jax.lax.psum)
     mesh = _mesh_1d()
-    axis = mesh.axis_names[0]
     kw = _group_kwargs(group)
     try:
-        reduced = reducer(x, axis if group is not None else mesh.axis_names,
-                          **kw)
-        me = jax.lax.axis_index(axis)
+        if group is not None:
+            # groups are defined along the first axis (1-D contract shared
+            # with all_reduce's axis_index_groups lowering)
+            reduced = reducer(x, mesh.axis_names[0], **kw)
+            me = jax.lax.axis_index(mesh.axis_names[0])
+        else:
+            reduced = reducer(x, mesh.axis_names)
+            me = _global_rank_in(mesh)  # dst is a GLOBAL rank
         out = jnp.where(me == dst, reduced, x)
     except NameError:  # eager, 1 participant: reduce == identity
         out = x
@@ -278,7 +292,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     vals = jnp.stack([_unwrap(t) for t in tensor_list])
     try:
         mesh = _mesh_1d()
-        me = jax.lax.axis_index(mesh.axis_names[0])
+        me = jax.lax.axis_index(mesh.axis_names[0]) if group is not None \
+            else _global_rank_in(mesh)  # slots are GLOBAL ranks
         if group is not None:
             # position within the group; non-members keep their input
             gr = jnp.asarray(group.ranks)

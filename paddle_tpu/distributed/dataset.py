@@ -57,7 +57,10 @@ class DatasetBase:
     # --- parsing -------------------------------------------------------
     def _parse_line(self, line):
         if self._generator is not None:
-            return list(self._generator.generate_sample(line)())
+            # go through the generator's _gen hook so MultiSlot numeric
+            # validation / string coercion apply, and both callable and
+            # plain-generator generate_sample returns are accepted
+            return self._generator._gen(line)
         # fallback: whitespace-separated floats, one unnamed slot
         vals = [float(t) for t in line.split()]
         return [("slot_0", vals)]

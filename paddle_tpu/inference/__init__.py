@@ -136,10 +136,16 @@ class Predictor:
             self._params, self._bufs = {}, {}
 
     # --- direct call API ----------------------------------------------
+    @staticmethod
+    def _handle_order(name):
+        # input_10 must come after input_2: sort by numeric suffix
+        stem, _, idx = name.rpartition("_")
+        return (stem, int(idx)) if idx.isdigit() else (name, -1)
+
     def run(self, inputs=None):
         if inputs is None:  # handle-based flow (reference predictor.run())
             xs = [self._in_handles[n]._array
-                  for n in sorted(self._in_handles)]
+                  for n in sorted(self._in_handles, key=self._handle_order)]
             out = self._run_raw(xs)
             flat = jax.tree_util.tree_leaves(out)
             self._out_arrays = [np.asarray(

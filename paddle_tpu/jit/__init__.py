@@ -215,26 +215,34 @@ def read_artifact(path_prefix):
     return exported, params, bufs, meta
 
 
+def _symbolic_dims(n):
+    """n fresh symbolic dims sharing ONE export scope — jax.export rejects
+    mixing scopes within a single export, so per-dim symbolic_shape calls
+    would break any model with two or more dynamic dims."""
+    from jax import export as jexport
+    if n == 0:
+        return []
+    return list(jexport.symbolic_shape(
+        ", ".join(f"_d{i}" for i in range(n))))
+
+
 def _resolve_input_specs(input_spec):
     """InputSpec/Tensor/ndarray list -> ShapeDtypeStructs. None/-1 dims
     become jax.export symbolic dimensions, so the serialized program stays
     batch-size-polymorphic like the reference's -1 feed shapes."""
-    from jax import export as jexport
-
     from ..static.program import InputSpec
+
+    def is_dyn(d):
+        return d is None or (isinstance(d, int) and d < 0)
+
+    n_dyn = sum(1 for s in input_spec if isinstance(s, InputSpec)
+                for d in s.shape if is_dyn(d))
+    syms = iter(_symbolic_dims(n_dyn))
     specs = []
-    n_sym = 0
     for s in input_spec:
         if isinstance(s, InputSpec):
-            dims = []
-            for d in s.shape:
-                if d is None or (isinstance(d, int) and d < 0):
-                    (sym,) = jexport.symbolic_shape(f"_d{n_sym}")
-                    n_sym += 1
-                    dims.append(sym)
-                else:
-                    dims.append(d)
-            specs.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
+            dims = tuple(next(syms) if is_dyn(d) else d for d in s.shape)
+            specs.append(jax.ShapeDtypeStruct(dims, s.dtype))
         elif isinstance(s, Tensor):
             specs.append(jax.ShapeDtypeStruct(tuple(s.shape),
                                               s._value.dtype))

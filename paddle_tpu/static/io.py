@@ -66,25 +66,29 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
             val = scope.find_var(v.name)
             params[v.name] = val._value if isinstance(val, Tensor) else val
 
-    feed_specs = []
+    from ..jit import _symbolic_dims
+
     by_name = {n: v for n, v in zip(
         feed_names,
         [v for v in feed_vars if isinstance(v, Variable)] or feed_vars)}
-    n_sym = 0
+
+    def shape_of(v):
+        return v.shape if isinstance(v, Variable) else np.asarray(v).shape
+
+    def is_dyn(d):
+        return d is None or (isinstance(d, int) and d < 0)
+
+    # all dynamic feed dims share ONE symbolic scope (jax.export rejects
+    # scope mixing — per-dim scopes broke multi-dynamic-dim programs)
+    n_dyn = sum(1 for n in feed_names for d in shape_of(by_name[n])
+                if is_dyn(d))
+    syms = iter(_symbolic_dims(n_dyn))
+    feed_specs = []
     for n in feed_names:
         v = by_name.get(n)
-        dims = []
-        for d in (v.shape if isinstance(v, Variable)
-                  else np.asarray(v).shape):
-            if d is None or (isinstance(d, int) and d < 0):
-                # None/-1 feed dims stay polymorphic in the artifact
-                (sym,) = jexport.symbolic_shape(f"_s{n_sym}")
-                n_sym += 1
-                dims.append(sym)
-            else:
-                dims.append(d)
+        dims = tuple(next(syms) if is_dyn(d) else d for d in shape_of(v))
         dtype = v.dtype if isinstance(v, Variable) else np.asarray(v).dtype
-        feed_specs.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
+        feed_specs.append(jax.ShapeDtypeStruct(dims, dtype))
 
     key = jax.random.key(0)  # inference: stochastic ops run is_test
 

@@ -1,0 +1,37 @@
+"""Shared timing helpers for the perf scripts.
+
+The axon tunnel's block_until_ready returns early and each RPC costs
+~8ms, so: (a) completion barriers fetch a reduced scalar via device_get,
+(b) kernels are timed as `inner` carry-dependent iterations inside ONE
+jitted lax.scan (the carry dependence defeats CSE/hoisting)."""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def sync(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    float(jax.device_get(jnp.sum(leaves[0]).astype(jnp.float32)))
+
+
+def scan_time(step_of_carry, carry0, inner=20, reps=3):
+    """Best per-iteration wall time of `inner` chained iterations in one
+    dispatch. step_of_carry: carry -> carry (make the compute depend on
+    the carry, e.g. x + carry * 1e-30)."""
+
+    @jax.jit
+    def many(c0):
+        c, _ = jax.lax.scan(lambda c, _: (step_of_carry(c), None), c0,
+                            None, length=inner)
+        return c
+
+    sync(many(carry0))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(many(carry0))
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best

@@ -9,25 +9,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-
-def _sync(out):
-    leaves = jax.tree_util.tree_leaves(out)
-    float(jax.device_get(jnp.sum(leaves[0]).astype(jnp.float32)))
-
-
-def scan_time(step, c0, inner=20, reps=3):
-    @jax.jit
-    def many(c):
-        c, _ = jax.lax.scan(lambda c, _: (step(c), None), c, None,
-                            length=inner)
-        return c
-    _sync(many(c0))
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _sync(many(c0))
-        best = min(best, (time.perf_counter() - t0) / inner)
-    return best
+from _bench_util import scan_time
 
 
 def main():

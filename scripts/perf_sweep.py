@@ -21,24 +21,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _timeit(fn, *args, iters=10, warmup=3):
-    import jax
-    for i in range(warmup):
-        out = fn(*args)
-    _sync(out)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = fn(*args)
-    _sync(out)
-    return (time.perf_counter() - t0) / iters
-
-
-def _sync(out):
-    import jax
-    leaves = jax.tree_util.tree_leaves(out)
-    # fetch one scalar reduced from the first leaf — reliable barrier on axon
-    import jax.numpy as jnp
-    float(jax.device_get(jnp.sum(leaves[0]).astype(jnp.float32)))
+from _bench_util import scan_time as _scan_timer, sync as _sync  # noqa: E402
 
 
 def section_model(batch_sizes=(8, 16, 24)):
@@ -119,28 +102,6 @@ def section_model(batch_sizes=(8, 16, 24)):
         print(f"batch={batch} seq={seq}: fwd={t_fwd*1e3:.1f}ms "
               f"step={t_step*1e3:.1f}ms "
               f"tok/s={toks/t_step:,.0f} MFU={mfu:.3f}", flush=True)
-
-
-def _scan_timer(step_of_carry, carry0, inner=20, reps=3):
-    """Time `inner` data-dependent iterations inside ONE jitted scan — the
-    axon tunnel adds ~8ms dispatch overhead per RPC, so per-call timing
-    cannot resolve sub-10ms kernels. The carry dependency defeats CSE."""
-    import jax
-
-    @jax.jit
-    def many(c0):
-        c, _ = jax.lax.scan(lambda c, _: (step_of_carry(c), None), c0,
-                            None, length=inner)
-        return c
-    c = many(carry0)  # compile + warm
-    _sync(c)
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        c = many(carry0)
-        _sync(c)
-        best = min(best, (time.perf_counter() - t0) / inner)
-    return best
 
 
 def section_flash_blocks():

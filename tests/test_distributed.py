@@ -688,6 +688,29 @@ class TestCollectivesAPI:
         expected[3] = 28.0  # sum(0..7) lands on dst only
         np.testing.assert_allclose(out, expected)
 
+    def test_reduce_dst_on_multi_axis_mesh(self):
+        # code-review r3: dst is a GLOBAL rank; on a 2-axis mesh the
+        # first-axis index alone would deliver to the wrong ranks
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.distributed as dist
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.parallel.mesh import mesh_guard
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("a", "b"))
+        with mesh_guard(mesh):
+            out = shard_map(
+                lambda x: dist.reduce(Tensor(x), dst=5)._value,
+                mesh=mesh, in_specs=P(("a", "b")), out_specs=P(("a", "b")),
+                check_rep=False)(jnp.arange(8.0).reshape(8, 1))
+        out = np.asarray(out).ravel()
+        expected = np.arange(8.0)
+        expected[5] = 28.0  # only global rank 5 (a=1, b=1) gets the sum
+        np.testing.assert_allclose(out, expected)
+
     def test_traced_scatter(self):
         # VERDICT r2 weak #6: scatter must work inside a traced region —
         # rank i selects tensor_list[i] by axis_index

@@ -68,6 +68,21 @@ class TestJitSaveLoad:
         out2 = loaded(Tensor(jnp.asarray(x2)))
         assert tuple(out2.shape) == (9, 3)
 
+    def test_multiple_dynamic_dims_share_one_scope(self, tmp_path):
+        """code-review r3: per-dim symbolic scopes broke any model with
+        2+ dynamic dims (jax.export rejects scope mixing)."""
+        paddle.seed(5)
+        net = _Net()
+        net.eval()
+        prefix = str(tmp_path / "dyn2")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, None, 4], "float32")])
+        loaded = paddle.jit.load(prefix)
+        for b, s in ((2, 3), (5, 7)):
+            x = np.random.rand(b, s, 4).astype(np.float32)
+            out = loaded(Tensor(jnp.asarray(x)))
+            assert tuple(out.shape) == (b, s, 3)
+
     def test_save_requires_input_spec(self, tmp_path):
         with pytest.raises(ValueError, match="input_spec"):
             paddle.jit.save(_Net(), str(tmp_path / "m"))

@@ -233,3 +233,26 @@ class TestPSDatasets:
         (b,) = list(ds)
         np.testing.assert_array_equal(b["ids"], [[1, 2], [3, 4]])
         np.testing.assert_array_equal(b["label"], [[0.0], [1.0]])
+
+    def test_dataset_generator_coercion_applies(self, tmp_path):
+        """code-review r3: _parse_line must route through the generator's
+        _gen hook so MultiSlotString coercion / numeric checks apply."""
+        from paddle_tpu.distributed.fleet import MultiSlotStringDataGenerator
+        import paddle_tpu.distributed as dist
+
+        class S(MultiSlotStringDataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    yield ("words", [int(t) for t in line.split()])  # ints!
+                return gen
+
+        p = tmp_path / "s.txt"
+        with open(p, "w") as f:
+            f.write("1 2\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=1)
+        ds.set_filelist([str(p)])
+        ds.set_data_generator(S())
+        ds.load_into_memory()
+        (b,) = list(ds)
+        assert b["words"].dtype.kind in ("U", "S")  # coerced to strings
