@@ -7,6 +7,7 @@ numbers this round).
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -121,7 +122,7 @@ def main():
             np_, ns = optimizer.functional_update(p, grads, s)
             return (np_, ns), loss
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_n(p, s):
             (p, s), losses = jax.lax.scan(step, (p, s),
                                           jnp.arange(inner))

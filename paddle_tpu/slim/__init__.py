@@ -386,6 +386,63 @@ def _swap(model, mode, weight_bits, activation_bits, act_observer,
     return wrapped
 
 
+def fuse_conv_bn_weights(w, b, running_mean, running_var, eps, gamma, beta):
+    """Fold BatchNorm stats into conv weights (ref: the reference's
+    conv+bn fuse passes in slim quantization): w' = w·γ/σ per out channel,
+    b' = (b-μ)·γ/σ + β."""
+    std = jnp.sqrt(running_var + eps)
+    scale = (gamma / std) if gamma is not None else (1.0 / std)
+    w2 = w * scale.reshape(-1, *([1] * (w.ndim - 1)))
+    b0 = b if b is not None else jnp.zeros_like(running_mean)
+    b2 = (b0 - running_mean) * scale + (beta if beta is not None else 0.0)
+    return w2, b2
+
+
+def fuse_conv_bn(model):
+    """Fuse every adjacent (Conv2D, BatchNorm2D) pair inside Sequential
+    containers into a single Conv2D with folded weights — the standard
+    pre-quantization transform (run before PTQ/QAT so the int8 conv sees
+    the deployed weights). Returns the number of pairs fused."""
+    from ..nn.layer.layers import Sequential
+    fused = 0
+
+    def visit(layer):
+        nonlocal fused
+        if isinstance(layer, Sequential):
+            names = list(layer._sub_layers)
+            i = 0
+            while i + 1 < len(names):
+                a = layer._sub_layers[names[i]]
+                bnl = layer._sub_layers[names[i + 1]]
+                if type(a) is nn.Conv2D and isinstance(
+                        bnl, (nn.BatchNorm2D, nn.BatchNorm)):
+                    w2, b2 = fuse_conv_bn_weights(
+                        a.weight._value,
+                        a.bias._value if a.bias is not None else None,
+                        bnl._mean._value, bnl._variance._value,
+                        bnl.epsilon,
+                        bnl.weight._value if bnl.weight is not None
+                        else None,
+                        bnl.bias._value if bnl.bias is not None else None)
+                    a.weight._value = w2
+                    if a.bias is None:
+                        from ..core.tensor import Parameter
+                        a.bias = Parameter(b2)
+                    else:
+                        a.bias._value = b2
+                    from ..nn import Identity
+                    layer._sub_layers[names[i + 1]] = Identity()
+                    fused += 1
+                    i += 2
+                    continue
+                i += 1
+        for child in layer._sub_layers.values():
+            visit(child)
+
+    visit(model)
+    return fused
+
+
 class ImperativeQuantAware:
     """QAT driver (ref: imperative/qat.py ImperativeQuantAware): swaps
     Linear/Conv2D for fake-quant wrappers; after training call

@@ -131,6 +131,36 @@ class TestPTQ:
         assert errs["channel_wise_abs_max"] < errs["abs_max"] * 0.25, errs
 
 
+class TestFuseConvBN:
+    def test_fused_matches_unfused_eval(self):
+        """conv+bn folding must be output-exact in eval mode, and the PTQ
+        path after folding quantizes the DEPLOYED weights."""
+        from paddle_tpu.slim import fuse_conv_bn
+        paddle.seed(9)
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1),
+                          nn.BatchNorm2D(8), nn.ReLU(),
+                          nn.Conv2D(8, 4, 3, padding=1), nn.BatchNorm2D(4))
+        # make the BN stats non-trivial
+        rng = np.random.RandomState(0)
+        x = Tensor(jnp.asarray(rng.rand(4, 3, 8, 8).astype(np.float32)))
+        m.train()
+        for _ in range(3):
+            m(x)
+        m.eval()
+        ref = np.asarray(m(x).numpy())
+        n = fuse_conv_bn(m)
+        assert n == 2
+        out = np.asarray(m(x).numpy())
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        # downstream PTQ sees plain convs (BN replaced by Identity)
+        from paddle_tpu.slim import QuantedConv2D
+        ptq = PostTrainingQuantization(model=m, algo="abs_max")
+        ptq.quantize(data_loader=[(np.asarray(x.numpy()),)], batch_nums=1)
+        convs = [s for _, s in m.named_sublayers()
+                 if isinstance(s, QuantedConv2D)]
+        assert len(convs) == 2
+
+
 class TestQAT:
     def test_qat_trains_and_converts(self):
         rng = np.random.RandomState(1)
