@@ -19,6 +19,8 @@ from . import callbacks as cb_mod
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = list(inputs) if inputs is not None else []
+        self._labels = list(labels) if labels is not None else []
         self._optimizer = None
         self._loss = None
         self._metrics = []
@@ -241,9 +243,22 @@ class Model:
 
     # ---- io --------------------------------------------------------------
     def save(self, path, training=True):
+        """training=True: checkpoint (params + opt state). training=False:
+        deployment artifact via jit.save — serialized StableHLO + npz,
+        loadable by inference.create_predictor with no model class (ref:
+        hapi/model.py save -> jit.save when training=False). Needs the
+        Model's `inputs` InputSpecs (as the reference does)."""
+        if not training:
+            from .. import jit
+            if not self._inputs:
+                raise ValueError(
+                    "Model.save(training=False) needs Model(network, "
+                    "inputs=[InputSpec(...)]) to trace the forward")
+            jit.save(self.network, path, input_spec=self._inputs)
+            return
         from ..framework.io import save as fsave
         fsave(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             fsave(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

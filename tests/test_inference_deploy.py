@@ -135,6 +135,31 @@ class TestJitSaveLoad:
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
+class TestHapiDeploy:
+    def test_model_save_training_false_is_deployable(self, tmp_path):
+        """hapi Model.save(training=False) emits the StableHLO artifact;
+        a Predictor rebuilds it without the network class."""
+        paddle.seed(8)
+        net = _Net()
+        model = paddle.Model(net, inputs=[InputSpec([None, 4], "float32")])
+        prefix = str(tmp_path / "hapi_deploy")
+        model.save(prefix, training=False)
+        x = np.random.RandomState(4).randn(3, 4).astype(np.float32)
+        net.eval()
+        ref = np.asarray(net(Tensor(jnp.asarray(x))).numpy())
+        from paddle_tpu.inference import Config, create_predictor
+        pred = create_predictor(Config(prefix + ".pdmodel",
+                                       prefix + ".pdiparams"))
+        out = pred.run([x])
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_model_save_training_false_requires_inputs(self, tmp_path):
+        model = paddle.Model(_Net())
+        with pytest.raises(ValueError, match="inputs"):
+            model.save(str(tmp_path / "x"), training=False)
+
+
 class TestQuantizedDeploy:
     def test_save_quantized_model_roundtrip(self, tmp_path):
         """slim.save_quantized_model rides the same artifact path: the int8
