@@ -152,7 +152,11 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
-    return 0
+    """Per-layer FLOPs profile of one forward (ref: paddle.flops /
+    hapi/dynamic_flops.py) — hook-based counter in hapi/static_flops.py."""
+    from .hapi.static_flops import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)
 
 from .version import commit, full_version  # noqa: E402,F401
 

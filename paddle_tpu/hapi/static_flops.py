@@ -1,0 +1,140 @@
+"""paddle.flops — per-layer FLOPs profiler.
+
+Reference: python/paddle/hapi/static_flops.py + dynamic_flops.py — counts
+multiply-accumulates per layer via forward hooks on a dummy forward.
+Same design here: one dummy forward with zeros, post-hooks record each
+leaf layer's FLOPs from its input/output shapes. `custom_ops` maps layer
+classes to `fn(layer, input_shape, output_shape) -> flops` overrides.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+
+
+def _numel(shape):
+    return int(np.prod([d for d in shape if d is not None])) if shape else 0
+
+
+def _linear(layer, in_shape, out_shape):
+    # [.., in] @ [in, out]: 2*in*out per output row
+    batch = _numel(out_shape[:-1])
+    return 2 * batch * layer.weight.shape[0] * layer.weight.shape[1]
+
+
+def _conv(layer, in_shape, out_shape):
+    w = layer.weight
+    out_elems = _numel(out_shape)
+    per_out = 2 * _numel(w.shape[1:])  # cin/groups * kh * kw MACs
+    return out_elems * per_out
+
+
+def _norm(layer, in_shape, out_shape):
+    return 5 * _numel(in_shape)  # mean, var, normalize, scale, shift
+
+
+def _pool(layer, in_shape, out_shape):
+    return _numel(out_shape) * 9  # window reduce, kernel-size bounded est.
+
+def _embedding(layer, in_shape, out_shape):
+    return 0  # gather: no MACs
+
+
+def _act(layer, in_shape, out_shape):
+    return _numel(out_shape)
+
+
+_DEFAULT = [
+    (nn.Linear, _linear),
+    (nn.Conv2D, _conv),
+    (nn.Conv3D, _conv) if hasattr(nn, "Conv3D") else None,
+    (nn.Conv2DTranspose, _conv) if hasattr(nn, "Conv2DTranspose") else None,
+    (nn.Embedding, _embedding),
+    (nn.ReLU, _act),
+    (nn.GELU, _act) if hasattr(nn, "GELU") else None,
+    (nn.Sigmoid, _act) if hasattr(nn, "Sigmoid") else None,
+]
+
+
+def _norm_classes():
+    out = []
+    for name in ("BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+                 "LayerNorm", "GroupNorm", "InstanceNorm2D", "SyncBatchNorm"):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            out.append(cls)
+    return tuple(out)
+
+
+def _pool_classes():
+    out = []
+    for name in ("MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D",
+                 "AdaptiveMaxPool2D"):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            out.append(cls)
+    return tuple(out)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count FLOPs of one forward at `input_size` (ref: paddle.flops).
+    Returns the total; prints a per-layer table when print_detail."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    custom_ops = custom_ops or {}
+    table = {cls: fn for item in _DEFAULT if item
+             for cls, fn in [item]}
+    norms = _norm_classes()
+    pools = _pool_classes()
+
+    rows = []
+    handles = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, outputs):
+            x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+            in_shape = tuple(getattr(x, "shape", ()) or ())
+            y = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            out_shape = tuple(getattr(y, "shape", ()) or ())
+            cls = type(lyr)
+            if cls in custom_ops:
+                fl = custom_ops[cls](lyr, in_shape, out_shape)
+            elif cls in table:
+                fl = table[cls](lyr, in_shape, out_shape)
+            elif isinstance(lyr, norms):
+                fl = _norm(lyr, in_shape, out_shape)
+            elif isinstance(lyr, pools):
+                fl = _pool(lyr, in_shape, out_shape)
+            else:
+                return
+            rows.append((type(lyr).__name__, in_shape, out_shape, int(fl)))
+        return hook
+
+    leaves = [m for _, m in net.named_sublayers()
+              if not m._sub_layers] or [net]
+    for m in leaves:
+        handles.append(m.register_forward_post_hook(make_hook(m)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor(jnp.zeros(tuple(input_size), jnp.float32))
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for m in leaves:
+            m._forward_post_hooks.clear()
+
+    total = sum(r[3] for r in rows)
+    if print_detail:
+        print(f"{'Layer':<20}{'Input':<22}{'Output':<22}{'FLOPs':>14}")
+        for name, i, o, fl in rows:
+            print(f"{name:<20}{str(i):<22}{str(o):<22}{fl:>14,}")
+    print(f"Total Flops: {total}     Total Params: "
+          f"{sum(int(np.prod(p.shape)) for p in net.parameters())}")
+    return total
