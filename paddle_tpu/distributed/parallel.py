@@ -23,10 +23,25 @@ class DataParallel(Layer):
         return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):
+        """Pass-through: per-shard losses here are means (pmean'd in the
+        sharded step), not sums over a split batch, so the reference's
+        divide-by-nranks would double-scale. Kept for API parity."""
         return loss
 
     def apply_collective_grads(self):
-        pass
+        """Inside a shard_map/pjit region (eager tape running on traced
+        values): psum-average every param grad over the mesh — the
+        reference reducer's job. Outside traced regions it is a no-op by
+        design: the pjit data-parallel path gets its gradient reduction
+        from the shard_map transpose, and single-process eager has one
+        replica."""
+        import jax.core as jcore
+
+        from .collective import ReduceOp, all_reduce
+        for p in self._layers.parameters():
+            if p.grad is not None and isinstance(p.grad._value,
+                                                 jcore.Tracer):
+                all_reduce(p.grad, op=ReduceOp.AVG)
 
     def state_dict(self, *a, **k):
         return self._layers.state_dict(*a, **k)

@@ -632,6 +632,49 @@ class TestCollectivesAPI:
         assert out.shape == q.shape
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_data_parallel_apply_collective_grads(self):
+        """The eager tape running inside shard_map: backward produces
+        per-shard grads; apply_collective_grads psum-averages them into
+        the full-batch gradient (the reference reducer's contract)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn as nn
+        import paddle_tpu.distributed as dist
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.parallel.mesh import mesh_guard
+
+        paddle.seed(21)
+        net = nn.Linear(2, 1)
+        dp = dist.DataParallel(net)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+        y = jnp.asarray(rng.randn(8, 1).astype(np.float32))
+
+        def f(xs, ys):
+            out = dp(Tensor(xs))
+            loss = ((out - Tensor(ys)) ** 2).mean()
+            loss.backward()
+            dp.apply_collective_grads()
+            g = net.weight.grad._value
+            for p in net.parameters():  # don't leak tracers out of trace
+                p.grad = None
+            return g
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        with mesh_guard(mesh):
+            g_dp = shard_map(f, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                             out_specs=P(), check_rep=False)(x, y)
+        # full-batch reference gradient
+        out = net(Tensor(x))
+        loss = ((out - Tensor(y)) ** 2).mean()
+        loss.backward()
+        np.testing.assert_allclose(np.asarray(g_dp),
+                                   np.asarray(net.weight.grad.numpy()),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_ulysses_mode_in_hybrid_gpt2(self):
         """ring_impl='ulysses' swaps the sp mode of the 4D model; parity
         vs the meshless oracle must hold exactly like the ring mode."""
