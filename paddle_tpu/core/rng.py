@@ -14,9 +14,16 @@ import numpy as np
 
 
 class Generator:
+    """PRNG-key manager. Key materialization is LAZY: `jax.random.key`
+    initializes the XLA backend, and importing the framework must not do
+    that — multi-host programs need `jax.distributed.initialize` to run
+    before any backend touch (distributed/launch.py)."""
+
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self.manual_seed(seed)
+        self._seed = int(seed)
+        self._key = None
+        self._count = 0
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
@@ -27,9 +34,14 @@ class Generator:
     def initial_seed(self) -> int:
         return self._seed
 
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
     def next_key(self):
         """Draw a fresh key (fold_in of a monotone counter — cheap, traceable)."""
         with self._lock:
+            self._ensure()
             self._count += 1
             return jax.random.fold_in(self._key, self._count)
 
