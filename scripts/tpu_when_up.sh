@@ -2,7 +2,7 @@
 # Wait for the axon TPU tunnel to recover, then run the perf work:
 # bench.py (scan-based) + model batch sweep + longseq kernel proof.
 cd /root/repo
-for i in $(seq 1 40); do
+for i in $(seq 1 300); do
   if timeout 150 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256,256)) @ jnp.ones((256,256))

@@ -83,6 +83,27 @@ class TestJitSaveLoad:
             out = loaded(Tensor(jnp.asarray(x)))
             assert tuple(out.shape) == (b, s, 3)
 
+    def test_save_load_with_buffers_batchnorm(self, tmp_path):
+        """BN running stats are buffers: they must ship in the artifact and
+        drive the eval-mode normalization after load."""
+        paddle.seed(6)
+        net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8),
+                            nn.Linear(8, 2))
+        rng = np.random.RandomState(3)
+        net.train()
+        for _ in range(4):  # move the running stats off their init
+            net(Tensor(jnp.asarray(
+                (rng.randn(16, 4) * 3 + 1).astype(np.float32))))
+        net.eval()
+        prefix = str(tmp_path / "bn")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, 4], "float32")])
+        x = rng.randn(5, 4).astype(np.float32)
+        ref = np.asarray(net(Tensor(jnp.asarray(x))).numpy())
+        loaded = paddle.jit.load(prefix)
+        out = np.asarray(loaded(Tensor(jnp.asarray(x))).numpy())
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
     def test_save_requires_input_spec(self, tmp_path):
         with pytest.raises(ValueError, match="input_spec"):
             paddle.jit.save(_Net(), str(tmp_path / "m"))
