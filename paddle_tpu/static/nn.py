@@ -313,29 +313,61 @@ def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A0
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """ref: fluid/layers/nn.py py_func — run a host Python callable inside
     the graph. Lowered with jax.pure_callback (traced) or a direct call
-    (eager)."""
+    (eager). `backward_func(*(inputs + grads_of_outputs)) -> grads_of_
+    inputs` wires a host-side VJP (the reference's grad op pair)."""
+    import functools as _ft
+
     import jax
     import jax.numpy as jnp
-    import jax.core as jcore
     from ..core.tensor import Tensor
+    from ..ops._registry import apply_op
 
     xs = x if isinstance(x, (list, tuple)) else [x]
-    vals = [v._value if isinstance(v, Tensor) else v for v in xs]
+    ts = [v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+          for v in xs]
     outs = out if isinstance(out, (list, tuple)) else [out]
-    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype
-              if not isinstance(o.dtype, str) else o.dtype)
-              for o in outs]
+    shapes = tuple(jax.ShapeDtypeStruct(tuple(o.shape), o.dtype)
+                   for o in outs)
 
-    def host(*arrs):
+    def host_fwd(*arrs):
         r = func(*arrs)
         rs = r if isinstance(r, (list, tuple)) else [r]
-        return tuple(np.asarray(v) for v in rs)
+        return tuple(np.asarray(v, dtype=s.dtype)
+                     for v, s in zip(rs, shapes))
 
-    if any(isinstance(v, jcore.Tracer) for v in vals):
-        res = jax.pure_callback(host, tuple(shapes), *vals)
+    if backward_func is None:
+        def core(*vals):
+            res = jax.pure_callback(host_fwd, shapes, *vals)
+            return res if len(res) > 1 else res[0]
+
+        r = apply_op(core, "py_func", tuple(ts), {}, nondiff=True)
     else:
-        res = host(*vals)
-    res = [Tensor(jnp.asarray(r)) for r in res]
+        in_shapes = tuple(jax.ShapeDtypeStruct(v._value.shape,
+                                               v._value.dtype) for v in ts)
+
+        def host_bwd(*arrs):
+            g = backward_func(*arrs)
+            gs = g if isinstance(g, (list, tuple)) else [g]
+            return tuple(np.asarray(v, dtype=s.dtype)
+                         for v, s in zip(gs, in_shapes))
+
+        @jax.custom_vjp
+        def pyf(*vals):
+            res = jax.pure_callback(host_fwd, shapes, *vals)
+            return res if len(res) > 1 else res[0]
+
+        def pyf_fwd(*vals):
+            return pyf(*vals), vals
+
+        def pyf_bwd(vals, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            return jax.pure_callback(host_bwd, in_shapes, *vals, *gs)
+
+        pyf.defvjp(pyf_fwd, pyf_bwd)
+        r = apply_op(pyf, "py_func", tuple(ts), {})
+
+    res = r if isinstance(r, (list, tuple)) else [r]
+    res = [v if isinstance(v, Tensor) else Tensor(v) for v in res]
     return res if isinstance(out, (list, tuple)) else res[0]
 
 

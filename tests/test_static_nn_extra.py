@@ -155,6 +155,36 @@ class TestStaticNNExtra:
         np.testing.assert_allclose(got, np.asarray(ref), atol=1e-4)
 
 
+class TestPyFunc:
+    def test_forward_and_backward_func(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        spec = jax.ShapeDtypeStruct((3,), np.float32)
+        x = Tensor(jnp.asarray([1.0, 2.0, 3.0]))
+        x.stop_gradient = False
+        out = static.nn.py_func(lambda a: a * 2, x, spec,
+                                backward_func=lambda a, g: g * 2)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [2.0] * 3)
+
+    def test_py_func_under_jit(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        spec = jax.ShapeDtypeStruct((3,), np.float32)
+
+        def f(xv):
+            t = Tensor(xv)
+            t.stop_gradient = False
+            o = static.nn.py_func(lambda a: a * 3, t, spec,
+                                  backward_func=lambda a, g: g * 3)
+            return o._value.sum()
+
+        g = jax.grad(f)(jnp.asarray([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(np.asarray(g), [3.0] * 3)
+
+
 class TestFlops:
     def test_linear_flops_exact(self):
         import paddle_tpu.nn as nn
