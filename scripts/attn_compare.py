@@ -26,7 +26,7 @@ def main():
     from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
     def ours(c):
-        o = flash_attention(q + c * 1e-30, k, v, True)
+        o = flash_attention(q + (c * 1e-30).astype(q.dtype), k, v, True)
         return o.astype(jnp.float32).mean()
 
     t = scan_time(ours, jnp.zeros((), jnp.float32))
@@ -35,7 +35,7 @@ def main():
 
     def ours_g(c):
         g = jax.grad(lambda qq: flash_attention(qq, k, v, True)
-                     .astype(jnp.float32).sum())(q + c * 1e-30)
+                     .astype(jnp.float32).sum())(q + (c * 1e-30).astype(q.dtype))
         return g.astype(jnp.float32).mean()
 
     t = scan_time(ours_g, jnp.zeros((), jnp.float32))
@@ -47,7 +47,7 @@ def main():
             flash_attention as stock_fa, BlockSizes)
 
         def stock(c):
-            o = stock_fa(q + c * 1e-30, k, v, causal=True,
+            o = stock_fa(q + (c * 1e-30).astype(q.dtype), k, v, causal=True,
                          sm_scale=d ** -0.5)
             return o.astype(jnp.float32).mean()
 
@@ -58,7 +58,7 @@ def main():
         def stock_g(c):
             g = jax.grad(lambda qq: stock_fa(qq, k, v, causal=True,
                                              sm_scale=d ** -0.5)
-                         .astype(jnp.float32).sum())(q + c * 1e-30)
+                         .astype(jnp.float32).sum())(q + (c * 1e-30).astype(q.dtype))
             return g.astype(jnp.float32).mean()
 
         t = scan_time(stock_g, jnp.zeros((), jnp.float32))
@@ -69,7 +69,7 @@ def main():
 
     # ---- plain XLA
     def xla(c):
-        qq = (q + c * 1e-30).astype(jnp.bfloat16)
+        qq = q + (c * 1e-30).astype(q.dtype)
         sc = jnp.einsum("bhqd,bhkd->bhqk", qq, k,
                         preferred_element_type=jnp.float32) * (d ** -0.5)
         qpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
@@ -84,7 +84,7 @@ def main():
           f"(counting causal-half flops)", flush=True)
 
     def xla_g(c):
-        g = jax.grad(lambda qq: xla_loss(qq))(q + c * 1e-30)
+        g = jax.grad(lambda qq: xla_loss(qq))(q + (c * 1e-30).astype(q.dtype))
         return g.astype(jnp.float32).mean()
 
     def xla_loss(qq):

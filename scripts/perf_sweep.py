@@ -122,14 +122,14 @@ def section_flash_blocks():
                    (256, 512), (512, 256), (256, 256), (1024, 256)]:
         try:
             def fwd_step(c, bq=bq, bk=bk):
-                qc = q + c * 1e-30  # carry-dependence defeats CSE/hoisting
+                qc = q + (c * 1e-30).astype(q.dtype)  # carry-dependence defeats CSE/hoisting
                 o = flash_attention(qc, k, v, True, None, bq, bk)
                 return o.astype(jnp.float32).mean()
 
             t_f = _scan_timer(fwd_step, jnp.zeros((), jnp.float32))
 
             def bwd_step(c, bq=bq, bk=bk):
-                qc = q + c * 1e-30
+                qc = q + (c * 1e-30).astype(q.dtype)
                 g = jax.grad(lambda qq: flash_attention(
                     qq, k, v, True, None, bq, bk).astype(
                         jnp.float32).sum())(qc)
@@ -157,7 +157,7 @@ def section_longseq():
     v = jax.random.normal(jax.random.fold_in(kq, 2), (b, h, s, d),
                           jnp.bfloat16)
     def bwd_step(c):
-        qc = q + c * 1e-30
+        qc = q + (c * 1e-30).astype(q.dtype)
         gr = jax.grad(lambda qq: flash_attention(
             qq, k, v, True).astype(jnp.float32).sum())(qc)
         return gr.astype(jnp.float32).mean()

@@ -1,6 +1,6 @@
 #!/bin/bash
 # Wait for the axon TPU tunnel to recover, then run the perf work:
-# bench.py (scan-based) + model batch sweep + longseq kernel proof.
+# bench.py (scan-based) + attention compare + model batch sweep + longseq.
 cd /root/repo
 for i in $(seq 1 300); do
   if timeout 150 python -c "
@@ -10,10 +10,15 @@ print('PROBE_OK', float(jax.device_get(jnp.sum(x))))" 2>/dev/null | grep -q PROB
     echo "=== tunnel up after $i probes $(date) ==="
     echo "=== bench.py ==="
     timeout 1200 python bench.py 2>&1 | grep -v WARNING
+    echo "=== attn compare (dtype-correct) ==="
+    timeout 1200 python scripts/attn_compare.py 2>&1 | grep -v WARNING
     echo "=== longseq streaming bwd ==="
     timeout 900 python scripts/perf_sweep.py --section longseq 2>&1 | grep -v WARNING
     echo "=== model batch sweep ==="
     timeout 1500 python scripts/perf_sweep.py --section model --batches 8,16,24 2>&1 | grep -v WARNING
+    echo "=== blocks sweep (dtype-correct) ==="
+    timeout 1500 python scripts/perf_sweep.py --section blocks 2>&1 | grep -v WARNING
+    echo "=== done $(date) ==="
     exit 0
   fi
   echo "probe $i failed $(date)"
