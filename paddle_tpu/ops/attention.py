@@ -56,7 +56,12 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     if use_flash:
         try:
             from .pallas.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=is_causal, scale=scale)
+            # prescale Q once ([B,H,S,D] pass) instead of scaling every
+            # score tile in fwd + bwd recompute (S^2-proportional VPU work);
+            # the chain rule through the prescale restores dq's scale
+            sc = (q.shape[-1] ** -0.5) if scale is None else scale
+            out = flash_attention((q * sc).astype(q.dtype), k, v,
+                                  causal=is_causal, scale=1.0)
             return out, None
         except Exception:
             pass
