@@ -221,26 +221,65 @@ def _load_image(path):
 
 
 class Flowers(Dataset):
-    """Synthetic stand-in matching the reference Flowers dataset API."""
+    """Oxford 102 Flowers in the PUBLISHED layout (ref:
+    python/paddle/vision/datasets/flowers.py): 102flowers.tgz holding
+    jpg/image_%05d.jpg, imagelabels.mat (1-based class per image) and
+    setid.mat (trnid/valid/tstid index splits), parsed with scipy.io +
+    PIL; jpgs decode lazily per access like the reference's tarfile walk.
+    Synthetic fallback when no files are given (zero-egress)."""
+
+    MODE_FLAG = {"train": "trnid", "valid": "valid", "test": "tstid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
-        n = 600 if mode == "train" else 100
-        rng = np.random.RandomState(4)
-        self.labels = rng.randint(0, 102, n).astype(np.int64)
-        self.images = (rng.rand(n, 3, 64, 64) * 255).astype(np.uint8)
         self.transform = transform
+        self._tar = None
+        if data_file and os.path.exists(data_file) and label_file \
+                and os.path.exists(label_file) and setid_file \
+                and os.path.exists(setid_file):
+            import scipy.io
+            import tarfile
+            labels = scipy.io.loadmat(label_file)["labels"][0]
+            setid = scipy.io.loadmat(setid_file)
+            self.indexes = np.asarray(
+                setid[self.MODE_FLAG[mode]][0], np.int64)
+            # labels are 1-based per image id; keep 1-based like the ref
+            self.labels = np.asarray(labels, np.int64)
+            self._tar = tarfile.open(data_file, "r:*")
+            self._members = {os.path.basename(n): n
+                             for n in self._tar.getnames()
+                             if n.endswith(".jpg")}
+            self.images = None
+        else:
+            n = 600 if mode == "train" else 100
+            rng = np.random.RandomState(4)
+            self.indexes = np.arange(1, n + 1)
+            self.labels = rng.randint(1, 103, n + 1).astype(np.int64)
+            self.images = (rng.rand(n, 3, 64, 64) * 255).astype(np.uint8)
+
+    def _decode(self, image_id):
+        from PIL import Image
+        name = "image_%05d.jpg" % image_id
+        f = self._tar.extractfile(self._members[name])
+        img = np.asarray(Image.open(f).convert("RGB"))
+        return np.transpose(img, (2, 0, 1))  # CHW like the synthetic path
 
     def __getitem__(self, idx):
-        img = self.images[idx]
+        image_id = int(self.indexes[idx])
+        if self._tar is not None:
+            img = self._decode(image_id)
+            label = int(self.labels[image_id - 1])  # 1-based image ids
+        else:
+            img = self.images[idx]
+            label = int(self.labels[image_id])
         if self.transform is not None:
             img = self.transform(np.transpose(img, (1, 2, 0)))
         else:
             img = img.astype(np.float32) / 255.0
-        return img, np.array([self.labels[idx]], np.int64)
+        return img, np.array([label], np.int64)
 
     def __len__(self):
-        return len(self.images)
+        return len(self.indexes)
 
 
 class VOC2012(Dataset):

@@ -176,6 +176,39 @@ class TestDatasetFormats:
         np.testing.assert_array_equal(ds.images, imgs)
         np.testing.assert_array_equal(ds.labels, labels.astype(np.int64))
 
+    def test_flowers_published_layout(self, tmp_path):
+        """102flowers.tgz + imagelabels.mat + setid.mat round-trip."""
+        from PIL import Image
+        import scipy.io
+        from paddle_tpu.vision.datasets import Flowers
+
+        rng = np.random.RandomState(0)
+        tgz = str(tmp_path / "102flowers.tgz")
+        with tarfile.open(tgz, "w:gz") as tf:
+            for i in range(1, 7):
+                arr = (rng.rand(12, 10, 3) * 255).astype(np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format="JPEG")
+                info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+                info.size = buf.getbuffer().nbytes
+                buf.seek(0)
+                tf.addfile(info, buf)
+        lab = str(tmp_path / "imagelabels.mat")
+        scipy.io.savemat(lab, {"labels": np.arange(1, 7)[None, :]})
+        sid = str(tmp_path / "setid.mat")
+        scipy.io.savemat(sid, {"trnid": np.asarray([[1, 2, 3, 4]]),
+                               "valid": np.asarray([[5]]),
+                               "tstid": np.asarray([[6]])})
+        train = Flowers(data_file=tgz, label_file=lab, setid_file=sid,
+                        mode="train")
+        assert len(train) == 4
+        img, label = train[1]
+        assert img.shape[0] == 3  # CHW, decoded from the jpg member
+        assert int(label) == 2  # image_00002's 1-based label
+        test = Flowers(data_file=tgz, label_file=lab, setid_file=sid,
+                       mode="test")
+        assert len(test) == 1 and int(test[0][1]) == 6
+
     def test_fashion_mnist_synthetic_differs_from_mnist(self):
         f = FashionMNIST(mode="test")
         m = MNIST(mode="test")
