@@ -8,12 +8,54 @@ from ..io import Dataset
 
 
 class Imdb(Dataset):
+    """IMDB sentiment (ref: python/paddle/text/datasets/imdb.py). With
+    `data_file` it parses the PUBLISHED aclImdb_v1.tar.gz layout —
+    aclImdb/<mode>/{pos,neg}/*.txt members, frequency-sorted word dict
+    with `cutoff`, <unk> last — else deterministic synthetic docs."""
+
     def __init__(self, data_file=None, mode="train", cutoff=150):
+        import os
+        if data_file and os.path.exists(data_file):
+            self._load_archive(data_file, mode, cutoff)
+            return
         n = 2000 if mode == "train" else 400
         rng = np.random.RandomState(7)
+        self.word_idx = {i: i for i in range(5000)}
         self.docs = [rng.randint(1, 5000, rng.randint(20, 200)).astype(np.int64)
                      for _ in range(n)]
         self.labels = rng.randint(0, 2, n).astype(np.int64)
+
+    @staticmethod
+    def _tokenize(text):
+        import re
+        import string
+        return re.sub(f"[{re.escape(string.punctuation)}]", "",
+                      text.lower()).split()
+
+    def _load_archive(self, data_file, mode, cutoff):
+        import re
+        import tarfile
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        texts, labels = [], []
+        freq = {}
+        with tarfile.open(data_file, "r:*") as tf:
+            for name in sorted(tf.getnames()):
+                m = pat.match(name)
+                if not m:
+                    continue
+                toks = self._tokenize(
+                    tf.extractfile(name).read().decode("utf-8", "replace"))
+                texts.append(toks)
+                labels.append(0 if m.group(1) == "pos" else 1)  # ref: pos=0
+                for w in toks:
+                    freq[w] = freq.get(w, 0) + 1
+        kept = {w: c for w, c in freq.items() if c >= cutoff} or freq
+        ordered = sorted(kept.items(), key=lambda kv: (-kv[1], kv[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(ordered)}
+        unk = self.word_idx["<unk>"] = len(self.word_idx)
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in toks],
+                                np.int64) for toks in texts]
+        self.labels = np.asarray(labels, np.int64)
 
     def __getitem__(self, idx):
         return self.docs[idx], self.labels[idx]
@@ -120,9 +162,24 @@ class Movielens(Dataset):
 
 class UCIHousing(Dataset):
     """Boston housing regression (ref: python/paddle/text/datasets/
-    uci_housing.py); synthetic 13-feature rows."""
+    uci_housing.py). With `data_file` it parses the published
+    housing.data layout (whitespace rows, 14 columns, feature-range
+    normalization, 80/20 train/test split like the reference); else
+    synthetic 13-feature rows."""
 
     def __init__(self, data_file=None, mode="train"):
+        import os
+        if data_file and os.path.exists(data_file):
+            data = np.loadtxt(data_file).astype(np.float32)
+            assert data.shape[1] == 14, data.shape
+            feats = data[:, :-1]
+            mn, mx = feats.min(0), feats.max(0)
+            feats = (feats - feats.mean(0)) / np.maximum(mx - mn, 1e-12)
+            split = int(data.shape[0] * 0.8)
+            sl = slice(0, split) if mode == "train" else slice(split, None)
+            self.x = feats[sl]
+            self.y = data[sl, -1]
+            return
         rng = np.random.RandomState(3)
         n = 404 if mode == "train" else 102
         self.x = rng.randn(n, 13).astype(np.float32)

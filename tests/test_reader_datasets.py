@@ -214,3 +214,38 @@ class TestDatasetFormats:
         m = MNIST(mode="test")
         assert not np.array_equal(f.images, m.images)
         assert len(f) == len(m)
+
+    def test_text_imdb_aclimdb_layout(self, tmp_path):
+        from paddle_tpu.text import Imdb
+        p = str(tmp_path / "aclImdb_v1.tar.gz")
+        docs = {
+            "aclImdb/train/pos/0_9.txt": b"great great movie loved it",
+            "aclImdb/train/pos/1_8.txt": b"great fun, great cast!",
+            "aclImdb/train/neg/0_2.txt": b"terrible boring film",
+            "aclImdb/test/pos/0_10.txt": b"great",
+        }
+        with tarfile.open(p, "w:gz") as tf:
+            for name, data in docs.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        ds = Imdb(data_file=p, mode="train", cutoff=2)
+        assert len(ds) == 3
+        # 'great' appears 4x in train -> rank 0 in the freq-sorted dict
+        assert ds.word_idx["great"] == 0
+        doc, label = ds[0]
+        assert label in (0, 1)
+        test = Imdb(data_file=p, mode="test", cutoff=0)
+        assert len(test) == 1
+
+    def test_text_uci_housing_data_file(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+        rng = np.random.RandomState(5)
+        rows = np.hstack([rng.rand(10, 13), rng.rand(10, 1) * 50])
+        p = str(tmp_path / "housing.data")
+        np.savetxt(p, rows)
+        tr = UCIHousing(data_file=p, mode="train")
+        te = UCIHousing(data_file=p, mode="test")
+        assert len(tr) == 8 and len(te) == 2
+        x, y = tr[0]
+        assert x.shape == (13,)
