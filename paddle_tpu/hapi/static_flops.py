@@ -127,8 +127,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     finally:
         if was_training:
             net.train()
-        for m in leaves:
-            m._forward_post_hooks.clear()
+        for h in handles:  # remove only OUR hooks, not the user's
+            h.remove()
 
     total = sum(r[3] for r in rows)
     if print_detail:

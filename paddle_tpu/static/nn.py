@@ -315,8 +315,6 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     the graph. Lowered with jax.pure_callback (traced) or a direct call
     (eager). `backward_func(*(inputs + grads_of_outputs)) -> grads_of_
     inputs` wires a host-side VJP (the reference's grad op pair)."""
-    import functools as _ft
-
     import jax
     import jax.numpy as jnp
     from ..core.tensor import Tensor
@@ -342,14 +340,21 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
         r = apply_op(core, "py_func", tuple(ts), {}, nondiff=True)
     else:
+        # integer inputs (indices/labels) take float0 tangents, never
+        # host-computed cotangents — backward_func's outputs are consumed
+        # positionally for the FLOAT inputs only
+        is_float = [jnp.issubdtype(v._value.dtype, jnp.inexact) for v in ts]
         in_shapes = tuple(jax.ShapeDtypeStruct(v._value.shape,
-                                               v._value.dtype) for v in ts)
+                                               v._value.dtype)
+                          for v, f in zip(ts, is_float) if f)
 
         def host_bwd(*arrs):
             g = backward_func(*arrs)
-            gs = g if isinstance(g, (list, tuple)) else [g]
+            gs = list(g) if isinstance(g, (list, tuple)) else [g]
+            floats = [v for v, f in zip(gs, is_float) if f] \
+                if len(gs) == len(is_float) else gs
             return tuple(np.asarray(v, dtype=s.dtype)
-                         for v, s in zip(gs, in_shapes))
+                         for v, s in zip(floats, in_shapes))
 
         @jax.custom_vjp
         def pyf(*vals):
@@ -361,7 +366,12 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
         def pyf_bwd(vals, g):
             gs = g if isinstance(g, tuple) else (g,)
-            return jax.pure_callback(host_bwd, in_shapes, *vals, *gs)
+            fgrads = iter(jax.pure_callback(host_bwd, in_shapes,
+                                            *vals, *gs))
+            from jax.dtypes import float0
+            return tuple(
+                next(fgrads) if f else np.zeros(v.shape, float0)
+                for v, f in zip(vals, is_float))
 
         pyf.defvjp(pyf_fwd, pyf_bwd)
         r = apply_op(pyf, "py_func", tuple(ts), {})

@@ -35,21 +35,27 @@ class Imdb(Dataset):
     def _load_archive(self, data_file, mode, cutoff):
         import re
         import tarfile
-        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # vocabulary spans BOTH splits (ref imdb.py:95 builds the dict over
+        # aclImdb/((train)|(test))), so train/test token ids agree; docs
+        # come from the requested mode only. One getmembers() pass —
+        # per-name extractfile is a reverse linear scan of the archive.
+        dict_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        mode_pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
         texts, labels = [], []
         freq = {}
         with tarfile.open(data_file, "r:*") as tf:
-            for name in sorted(tf.getnames()):
-                m = pat.match(name)
-                if not m:
+            for member in sorted(tf.getmembers(), key=lambda m: m.name):
+                if not dict_pat.match(member.name):
                     continue
-                toks = self._tokenize(
-                    tf.extractfile(name).read().decode("utf-8", "replace"))
-                texts.append(toks)
-                labels.append(0 if m.group(1) == "pos" else 1)  # ref: pos=0
+                toks = self._tokenize(tf.extractfile(member).read()
+                                      .decode("utf-8", "replace"))
                 for w in toks:
                     freq[w] = freq.get(w, 0) + 1
-        kept = {w: c for w, c in freq.items() if c >= cutoff} or freq
+                m = mode_pat.match(member.name)
+                if m:
+                    texts.append(toks)
+                    labels.append(0 if m.group(1) == "pos" else 1)  # pos=0
+        kept = {w: c for w, c in freq.items() if c > cutoff} or freq
         ordered = sorted(kept.items(), key=lambda kv: (-kv[1], kv[0]))
         self.word_idx = {w: i for i, (w, _) in enumerate(ordered)}
         unk = self.word_idx["<unk>"] = len(self.word_idx)

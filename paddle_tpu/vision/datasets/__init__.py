@@ -228,27 +228,33 @@ class Flowers(Dataset):
     PIL; jpgs decode lazily per access like the reference's tarfile walk.
     Synthetic fallback when no files are given (zero-egress)."""
 
-    MODE_FLAG = {"train": "trnid", "valid": "valid", "test": "tstid"}
+    # the reference DELIBERATELY swaps the archive's split names — tstid is
+    # the big (6149-image) set and serves as train
+    # (ref: vision/datasets/flowers.py:40 MODE_FLAG_MAP)
+    MODE_FLAG = {"train": "tstid", "valid": "valid", "test": "trnid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
         self.transform = transform
+        self._data_file = None
         self._tar = None
-        if data_file and os.path.exists(data_file) and label_file \
-                and os.path.exists(label_file) and setid_file \
-                and os.path.exists(setid_file):
+        self._members = None
+        if data_file or label_file or setid_file:
+            missing = [p for p in (data_file, label_file, setid_file)
+                       if not (p and os.path.exists(p))]
+            if missing:
+                raise ValueError(
+                    f"Flowers needs data_file+label_file+setid_file; "
+                    f"missing/unreadable: {missing} (omit ALL three for "
+                    f"the synthetic fallback)")
             import scipy.io
-            import tarfile
             labels = scipy.io.loadmat(label_file)["labels"][0]
             setid = scipy.io.loadmat(setid_file)
             self.indexes = np.asarray(
                 setid[self.MODE_FLAG[mode]][0], np.int64)
             # labels are 1-based per image id; keep 1-based like the ref
             self.labels = np.asarray(labels, np.int64)
-            self._tar = tarfile.open(data_file, "r:*")
-            self._members = {os.path.basename(n): n
-                             for n in self._tar.getnames()
-                             if n.endswith(".jpg")}
+            self._data_file = data_file
             self.images = None
         else:
             n = 600 if mode == "train" else 100
@@ -257,16 +263,33 @@ class Flowers(Dataset):
             self.labels = rng.randint(1, 103, n + 1).astype(np.int64)
             self.images = (rng.rand(n, 3, 64, 64) * 255).astype(np.uint8)
 
+    def _ensure_tar(self):
+        # opened lazily PER PROCESS: an open TarFile neither pickles (the
+        # multiprocess DataLoader ships the dataset to workers) nor should
+        # hold an fd for the dataset's whole life
+        if self._tar is None:
+            import tarfile
+            self._tar = tarfile.open(self._data_file, "r:*")
+            self._members = {os.path.basename(m.name): m
+                             for m in self._tar.getmembers()
+                             if m.name.endswith(".jpg")}
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_tar"] = None
+        d["_members"] = None
+        return d
+
     def _decode(self, image_id):
         from PIL import Image
-        name = "image_%05d.jpg" % image_id
-        f = self._tar.extractfile(self._members[name])
+        self._ensure_tar()
+        f = self._tar.extractfile(self._members["image_%05d.jpg" % image_id])
         img = np.asarray(Image.open(f).convert("RGB"))
         return np.transpose(img, (2, 0, 1))  # CHW like the synthetic path
 
     def __getitem__(self, idx):
         image_id = int(self.indexes[idx])
-        if self._tar is not None:
+        if self._data_file is not None:
             img = self._decode(image_id)
             label = int(self.labels[image_id - 1])  # 1-based image ids
         else:

@@ -199,15 +199,26 @@ class TestDatasetFormats:
         scipy.io.savemat(sid, {"trnid": np.asarray([[1, 2, 3, 4]]),
                                "valid": np.asarray([[5]]),
                                "tstid": np.asarray([[6]])})
-        train = Flowers(data_file=tgz, label_file=lab, setid_file=sid,
-                        mode="train")
-        assert len(train) == 4
-        img, label = train[1]
-        assert img.shape[0] == 3  # CHW, decoded from the jpg member
-        assert int(label) == 2  # image_00002's 1-based label
+        # the reference swaps the archive's split names: train <- tstid
+        # (the big set), test <- trnid (flowers.py:40 MODE_FLAG_MAP)
         test = Flowers(data_file=tgz, label_file=lab, setid_file=sid,
                        mode="test")
-        assert len(test) == 1 and int(test[0][1]) == 6
+        assert len(test) == 4
+        img, label = test[1]
+        assert img.shape[0] == 3  # CHW, decoded from the jpg member
+        assert int(label) == 2  # image_00002's 1-based label
+        train = Flowers(data_file=tgz, label_file=lab, setid_file=sid,
+                        mode="train")
+        assert len(train) == 1 and int(train[0][1]) == 6
+        # a typo'd path must raise, not silently serve synthetic noise
+        with pytest.raises(ValueError, match="missing"):
+            Flowers(data_file=tgz, label_file=lab,
+                    setid_file=str(tmp_path / "nope.mat"))
+        # multiprocess contract: the dataset pickles (lazy tar handle)
+        import pickle as pkl
+        clone = pkl.loads(pkl.dumps(test))
+        img2, label2 = clone[1]
+        np.testing.assert_array_equal(np.asarray(img2), np.asarray(img))
 
     def test_fashion_mnist_synthetic_differs_from_mnist(self):
         f = FashionMNIST(mode="test")
@@ -231,12 +242,15 @@ class TestDatasetFormats:
                 tf.addfile(info, io.BytesIO(data))
         ds = Imdb(data_file=p, mode="train", cutoff=2)
         assert len(ds) == 3
-        # 'great' appears 4x in train -> rank 0 in the freq-sorted dict
+        # 'great' appears 5x across BOTH splits -> rank 0 (the vocabulary
+        # spans train+test like the reference, so ids agree across modes)
         assert ds.word_idx["great"] == 0
         doc, label = ds[0]
         assert label in (0, 1)
         test = Imdb(data_file=p, mode="test", cutoff=0)
         assert len(test) == 1
+        assert test.word_idx == Imdb(data_file=p, mode="train",
+                                     cutoff=0).word_idx
 
     def test_text_uci_housing_data_file(self, tmp_path):
         from paddle_tpu.text import UCIHousing

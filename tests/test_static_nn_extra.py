@@ -168,6 +168,26 @@ class TestPyFunc:
         out.sum().backward()
         np.testing.assert_allclose(np.asarray(x.grad.numpy()), [2.0] * 3)
 
+    def test_py_func_integer_inputs_get_float0_tangents(self):
+        """code-review r3b: int inputs (indices) must not receive
+        host-computed cotangents — they take float0 zeros."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        spec = jax.ShapeDtypeStruct((3,), np.float32)
+
+        def f(xv):
+            t = Tensor(xv)
+            t.stop_gradient = False
+            idx = Tensor(jnp.asarray([0, 1, 2], jnp.int32))
+            o = static.nn.py_func(
+                lambda a, i: a[i] * 2, [t, idx], spec,
+                backward_func=lambda a, i, g: (g * 2, None))
+            return o._value.sum()
+
+        g = jax.grad(f)(jnp.asarray([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(np.asarray(g), [2.0] * 3)
+
     def test_py_func_under_jit(self):
         import jax
         import jax.numpy as jnp
@@ -195,6 +215,19 @@ class TestFlops:
         n = paddle.flops(LeNet(), [1, 1, 28, 28])
         # conv1 MACs alone: 2*(1*5*5... kernel 3x3 here) — just sanity-band
         assert 5e5 < n < 5e6, n
+
+    def test_flops_preserves_user_hooks(self):
+        """code-review r3b: flops must remove only ITS hooks."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        m = nn.Linear(4, 2)
+        seen = []
+        m.register_forward_post_hook(lambda l, i, o: seen.append(1))
+        paddle.flops(m, [2, 4])
+        seen.clear()
+        m(Tensor(jnp.zeros((2, 4))))
+        assert seen, "user hook was wiped by flops()"
 
 
 class TestPSDatasets:
