@@ -68,17 +68,35 @@ def main():
 
     import paddle_tpu  # noqa: F401
     from paddle_tpu import optimizer as opt_mod
-    from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
 
+    # secondary workloads selectable via env/argv (default: the headline
+    # GPT-2 small config the driver records); bert_large covers the
+    # BASELINE "BERT-large samples/sec/chip" axis when run manually
+    model_name = (sys.argv[1] if len(sys.argv) > 1
+                  else os.environ.get("PADDLE_TPU_BENCH_MODEL", "gpt2s"))
     on_tpu = jax.default_backend() not in ("cpu",)
-    if on_tpu:
-        cfg = GPT2Config()  # GPT-2 small, 124M params
-        batch_candidates, seq = (24, 16, 8), 1024
-        inner = 10  # steps per dispatch (lax.scan)
-    else:  # CI/smoke fallback
-        cfg = GPT2Config.tiny()
-        batch_candidates, seq = (4,), 128
-        inner = 3
+    if model_name == "bert_large":
+        from paddle_tpu.models.bert import BertConfig, build_train_step
+        if on_tpu:
+            cfg = BertConfig.large()
+            batch_candidates, seq = (16, 8, 4), 512
+            inner = 10
+        else:
+            cfg = BertConfig.tiny()
+            batch_candidates, seq = (4,), 128
+            inner = 3
+        metric_name = "bert_large_train_tokens_per_sec_per_chip"
+    else:
+        from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
+        if on_tpu:
+            cfg = GPT2Config()  # GPT-2 small, 124M params
+            batch_candidates, seq = (24, 16, 8), 1024
+            inner = 10  # steps per dispatch (lax.scan)
+        else:  # CI/smoke fallback
+            cfg = GPT2Config.tiny()
+            batch_candidates, seq = (4,), 128
+            inner = 3
+        metric_name = "gpt2s_train_tokens_per_sec_per_chip"
     cfg.dropout = 0.0
 
     loss_fn, init_params, model = build_train_step(cfg, remat=False)
@@ -159,8 +177,8 @@ def main():
     mfu = achieved_flops / peak
 
     record = {
-        "metric": "gpt2s_train_tokens_per_sec_per_chip" if on_tpu
-        else "gpt2tiny_train_tokens_per_sec_CPU_DEGRADED",
+        "metric": metric_name if on_tpu
+        else f"{model_name}_tiny_train_tokens_per_sec_CPU_DEGRADED",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
