@@ -80,6 +80,7 @@ def __getattr__(name):
 
 # legacy fluid-era top-level names kept by the reference 2.0 namespace
 from .compat import *  # noqa: F401,F403,E402
+from .reader import batch  # noqa: E402,F401  (ref: python/paddle/batch.py)
 from .compat import (  # noqa: E402,F401
     ComplexVariable, LoDTensor, LoDTensorArray, VarBase,
     disable_dygraph, enable_dygraph, get_cuda_rng_state, get_cudnn_version,

@@ -20,8 +20,27 @@ import threading
 
 __all__ = [
     "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
-    "firstn", "xmap_readers", "multiprocess_reader",
+    "firstn", "xmap_readers", "multiprocess_reader", "batch",
 ]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (ref: python/paddle/batch.py
+    — also exported as paddle.batch / fluid.io.batch)."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
 
 
 def cache(reader):

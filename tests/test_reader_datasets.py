@@ -86,6 +86,15 @@ class TestReaderDecorators:
                                           order=True)())
         assert od == [i * i for i in range(40)]
 
+    def test_batch(self):
+        import paddle_tpu as paddle
+        out = list(paddle.batch(_counting_reader(7), 3)())
+        assert out == [[0, 1, 2], [3, 4, 5], [6]]
+        out = list(paddle.batch(_counting_reader(7), 3, drop_last=True)())
+        assert out == [[0, 1, 2], [3, 4, 5]]
+        with pytest.raises(ValueError):
+            paddle.batch(_counting_reader(3), 0)
+
     def test_multiprocess_reader(self):
         r = reader_mod.multiprocess_reader(
             [_counting_reader(10), _counting_reader(10)], queue_size=8)
