@@ -160,6 +160,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
                   print_detail=print_detail)
 
 from .version import commit, full_version  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
 
 
 class _OnnxShim:

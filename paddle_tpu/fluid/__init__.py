@@ -75,3 +75,38 @@ class io:
         scope = global_scope()
         for name, t in state.items():
             scope.set(name, t._value)
+
+
+# ---- GFlags surface (ref: fluid/framework.py:5670 set_flags/get_flags).
+# The C++ core's gflags become a host-side registry here; flags that map to
+# XLA behaviors are consumed by the modules that honor them.
+_FLAGS = {
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_use_pinned_memory": True,
+}
+
+
+def set_flags(flags):
+    if not isinstance(flags, dict):
+        raise TypeError("flags in set_flags should be a dict")
+    for key, value in flags.items():
+        if key not in _FLAGS:
+            raise ValueError(
+                f"Flag {key} cannot set its value through this function.")
+        _FLAGS[key] = value
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    if not isinstance(flags, (list, tuple)):
+        raise TypeError("flags in get_flags should be a list, tuple or str")
+    out = {}
+    for key in flags:
+        if key not in _FLAGS:
+            raise ValueError(f"Flag {key} is not public.")
+        out[key] = _FLAGS[key]
+    return out

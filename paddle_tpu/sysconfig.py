@@ -1,0 +1,19 @@
+"""paddle.sysconfig (ref: python/paddle/sysconfig.py) — locations of the
+native pieces a C++ extension would compile against. Here that is the
+csrc/ directory (headers == sources for the ctypes-bound runtime) and the
+directory holding the built .so."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+
+
+def get_include():
+    return _CSRC
+
+
+def get_lib():
+    return _CSRC
