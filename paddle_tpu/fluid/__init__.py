@@ -19,6 +19,7 @@ from .. import nn as _nn  # noqa: F401
 from .. import optimizer as _optimizer_mod
 from ..nn import initializer  # noqa: F401
 from .. import regularizer  # noqa: F401
+from . import contrib  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import layers  # noqa: F401
 from ..io import DataLoader  # noqa: F401

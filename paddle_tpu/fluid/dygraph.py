@@ -103,3 +103,150 @@ def _init():
 
 
 _init()
+
+
+# ---- 1.x dygraph aliases onto the 2.0 implementations (ref:
+# python/paddle/fluid/dygraph/{nn,learning_rate_scheduler,checkpoint}.py;
+# the fluid-era ctor quirks live with the aliased classes) ----
+from ..nn import (  # noqa: E402,F401
+    BilinearTensorProduct, Conv2DTranspose, Conv3D, Conv3DTranspose,
+    Dropout, Flatten, GRUCell, GroupNorm, InstanceNorm2D as InstanceNorm,
+    LSTMCell, LayerNorm, PReLU as PRelu, ParameterList, SpectralNorm,
+)
+from ..optimizer.lr import (  # noqa: E402,F401
+    CosineAnnealingDecay as CosineDecay, ExponentialDecay,
+    InverseTimeDecay, LambdaDecay, LinearWarmup as LinearLrWarmup,
+    MultiStepDecay, NaturalExpDecay, NoamDecay, PiecewiseDecay,
+    PolynomialDecay, ReduceOnPlateau as ReduceLROnPlateau, StepDecay,
+)
+from ..amp import GradScaler as AmpScaler, auto_cast as amp_guard  # noqa: E402,F401
+from ..distributed.collective import ParallelEnv  # noqa: E402,F401
+from ..distributed.parallel import DataParallel  # noqa: E402,F401
+from ..jit import (  # noqa: E402,F401
+    ProgramTranslator, TracedLayer, declarative, not_to_static,
+    set_code_level, set_verbosity, to_static as dygraph_to_static_func,
+)
+from ..core.autograd import grad  # noqa: E402,F401
+from . import dygraph as _self_mod  # noqa: E402
+
+save = save_dygraph
+load = load_dygraph
+no_grad_ = no_grad
+
+
+def disable_dygraph():
+    mode.enable_static()
+
+
+def enable_dygraph(place=None):
+    mode.disable_static()
+
+
+def prepare_context(strategy=None):
+    from ..distributed.parallel import init_parallel_env
+    return init_parallel_env()
+
+
+class GRUUnit(Layer):
+    """fluid.dygraph.GRUUnit (ref: dygraph/nn.py GRUUnit): single-step GRU
+    over pre-projected gate inputs [B, 3*hidden]."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        from ..nn import GRUCell as _GRUCell
+        self.hidden = size // 3
+        self._cell = _GRUCell(self.hidden, self.hidden)
+
+    def forward(self, input, hidden):  # noqa: A002
+        h, new = self._cell(input[:, : self.hidden], hidden)
+        return new, None, h
+
+
+class NCE(Layer):
+    """fluid.dygraph.NCE (ref: dygraph/nn.py NCE) over static.nn.nce."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=5,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False):
+        super().__init__()
+        from ..core.tensor import Parameter
+        from ..nn import initializer as I
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.weight = Parameter(I.XavierUniform()((num_total_classes, dim),
+                                                  "float32"))
+        self.bias = Parameter(I.Constant(0.0)((num_total_classes,),
+                                              "float32"))
+
+    def forward(self, input, label, sample_weight=None):  # noqa: A002
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import rng as rng_mod
+        from ..ops._registry import apply_op
+        key = rng_mod.next_key()
+        n_neg = self.num_neg_samples
+        n_cls = self.num_total_classes
+
+        def core(xv, lv, wv, bv):
+            bsz = xv.shape[0]
+            lv = lv.reshape(-1).astype(jnp.int32)
+            negs = jax.random.randint(key, (bsz, n_neg), 0, n_cls)
+            pos = jnp.sum(xv * wv[lv], -1) + bv[lv]
+            neg = jnp.einsum("bd,bnd->bn", xv, wv[negs]) + bv[negs]
+            return (jax.nn.softplus(-pos)
+                    + jnp.sum(jax.nn.softplus(neg), -1))[:, None]
+
+        return apply_op(core, "nce_layer",
+                        (input, label, self.weight, self.bias), {})
+
+
+class TreeConv(Layer):
+    """fluid.dygraph.TreeConv (ref: dygraph/nn.py TreeConv): tree-based
+    convolution over node features with adjacency-continuity weights.
+    Dense rework: nodes [B, N, D], edges adjacency [B, N, N]."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        from ..core.tensor import Parameter
+        from ..nn import initializer as I
+        self.max_depth = max_depth
+        self.act = act
+        self.W = Parameter(I.XavierUniform()(
+            (feature_size, 3, output_size, num_filters), "float32"))
+        self.bias = Parameter(I.Constant(0.0)((num_filters, output_size),
+                                              "float32"))
+
+    def forward(self, nodes_vector, edge_set):
+        import jax.numpy as jnp
+
+        from ..ops._registry import apply_op
+
+        depth = self.max_depth
+
+        def core(xv, adj, wv, bv):
+            # propagate features up to max_depth hops; weights [D,3,O,F]
+            # use the 3 continuity slots as (self, child-mean, depth-mix)
+            a = adj.astype(xv.dtype)
+            deg = jnp.maximum(a.sum(-1, keepdims=True), 1.0)
+            child = (a @ xv) / deg
+            hops = child
+            mix = 0.0
+            for _ in range(depth - 1):
+                hops = (a @ hops) / deg
+                mix = mix + hops
+            feats = jnp.stack([xv, child, mix if depth > 1
+                               else jnp.zeros_like(xv)], axis=2)
+            # y: [B, N, O, F]; bias is [F, O] -> transpose to broadcast
+            return jnp.einsum("bnsd,dsof->bnof", feats, wv) \
+                + bv.T[None, None]
+
+        out = apply_op(core, "tree_conv",
+                       (nodes_vector, edge_set, self.W, self.bias), {})
+        from .. import ops as _ops2
+        return getattr(_ops2, self.act)(out) if self.act else out

@@ -8,9 +8,58 @@ from __future__ import annotations
 from .. import ops as _ops
 from ..ops import *  # noqa: F401,F403
 from ..static.nn import (  # noqa: F401
-    batch_norm, conv2d, dropout, embedding, fc, layer_norm, pool2d,
+    batch_norm, bilinear_tensor_product, conv2d, conv2d_transpose, conv3d,
+    conv3d_transpose, crf_decoding, data_norm, deform_conv2d as
+    deformable_conv, dropout, embedding, fc, group_norm, instance_norm,
+    layer_norm, multi_box_head, nce, pool2d, prelu, py_func, row_conv,
+    spectral_norm,
 )
-from ..ops.control import case, cond, switch_case, while_loop  # noqa: F401
+from ..ops.control import (  # noqa: F401
+    case, cond, switch_case, while_loop,
+)
+# dense LoD reworks (layout contract: nn/functional/sequence.py docstring)
+from ..nn.functional.sequence import (  # noqa: F401
+    sequence_concat, sequence_conv, sequence_enumerate, sequence_expand,
+    sequence_expand_as, sequence_first_step, sequence_last_step,
+    sequence_pad, sequence_pool, sequence_reshape, sequence_reverse,
+    sequence_scatter, sequence_slice, sequence_softmax, sequence_unpad,
+)
+from ..nn.functional.detection import (  # noqa: F401
+    anchor_generator, bipartite_match, box_clip, box_coder,
+    box_decoder_and_assign, collect_fpn_proposals, density_prior_box,
+    detection_output, deformable_roi_pooling, distribute_fpn_proposals,
+    generate_mask_labels, generate_proposal_labels, generate_proposals,
+    multiclass_nms, prior_box, prroi_pool, psroi_pool,
+    retinanet_detection_output, retinanet_target_assign,
+    roi_perspective_transform, roi_pool, rpn_target_assign, target_assign,
+    yolo_box, yolov3_loss,
+)
+from ..nn.functional import (  # noqa: F401
+    linear_chain_crf, roi_align, sequence_mask,
+)
+from ..nn.functional.detection import iou_similarity, ssd_loss  # noqa: F401
+from ..nn.functional.legacy import gather_tree  # noqa: F401
+# 1.x RNN-cell / decoder classes live on in paddle.nn
+from ..nn import (  # noqa: F401
+    BeamSearchDecoder, GRUCell, LSTMCell, dynamic_decode,
+)
+from ..nn.layer.rnn import RNNCellBase as RNNCell  # noqa: F401
+# distributions kept their 1.x home in fluid.layers (ref:
+# fluid/layers/distributions.py)
+from ..distribution import (  # noqa: F401
+    Categorical, Normal, Uniform,
+)
+from .layers_legacy import *  # noqa: F401,F403,E402
+from .layers_legacy import (  # noqa: F401
+    edit_distance, hash, lrn, mean_iou, multiplex, pool3d,
+    rank_loss, sampled_softmax_with_cross_entropy, warpctc,
+)
+from .layers_legacy2 import *  # noqa: F401,F403,E402
+from .layers_legacy2 import (  # noqa: F401
+    Assert, BasicDecoder, DecodeHelper, Decoder, DynamicRNN,
+    GreedyEmbeddingHelper, IfElse, MultivariateNormalDiag, Print,
+    SampleEmbeddingHelper, StaticRNN, Switch, TrainingHelper, While,
+)
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):

@@ -1,0 +1,791 @@
+"""fluid.layers 1.x completion (ref: python/paddle/fluid/layers/*).
+
+Everything here adapts a 1.x symbol onto the TPU-native implementations
+that already power the 2.0 namespaces: sequence ops come from the dense
+LoD rework (nn/functional/sequence.py), detection from
+nn/functional/detection.py, decay functions return the corresponding
+LRScheduler, RNN cells/decoders come from nn. A handful of 1.x
+graph-construction constructs that the reference itself superseded
+(py_reader pipelines, DynamicRNN/StaticRNN/IfElse/Switch/While block
+builders) raise with migration guidance — recorded in SURVEY.md §2 #42.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as _ops
+from ..core.tensor import Tensor
+from ..ops._registry import apply_op
+
+
+_py_range = range  # the 1.x `range` op below shadows the builtin
+
+
+def _val(x):
+    import jax.numpy as jnp
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------- arithmetic
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _ops.maximum(x, y)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _ops.minimum(x, y)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _ops.mod(x, y)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _ops.pow(x, y)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _ops.floor_divide(x, y)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _ops.min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _ops.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _ops.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _ops.any(input, axis=dim, keepdim=keep_dim)
+
+
+def sums(input, out=None):  # noqa: A002
+    r = input[0]
+    for t in input[1:]:
+        r = _ops.add(r, t)
+    return r
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select: out[i] = inputs[index[i]][i] (ref: multiplex_op)."""
+    import jax.numpy as jnp
+
+    def core(idx, *ts):
+        stacked = jnp.stack(ts)  # [n, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+
+    args = [index if isinstance(index, Tensor) else Tensor(_val(index))]
+    args += [t if isinstance(t, Tensor) else Tensor(_val(t))
+             for t in inputs]
+    return apply_op(core, "multiplex", tuple(args), {})
+
+
+def cos_sim(X, Y):  # noqa: N803
+    from ..nn.functional import cosine_similarity
+    return cosine_similarity(X, Y, axis=-1)
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    from ..nn.functional import normalize
+    return normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def shape(input, name=None):  # noqa: A002
+    return Tensor(np.asarray(_val(input).shape, np.int32))
+
+
+def rank(input):  # noqa: A002
+    return Tensor(np.asarray(_val(input).ndim, np.int32))
+
+
+def size(input):  # noqa: A002
+    return Tensor(np.asarray(int(np.prod(_val(input).shape)), np.int64))
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(int(np.prod(_val(x).shape)) == 0))
+
+
+def has_inf(x):
+    return _ops.any(_ops.isinf(x))
+
+
+def has_nan(x):
+    return _ops.any(_ops.isnan(x))
+
+
+def reverse(x, axis):
+    return _ops.flip(x, axis)
+
+
+def range(start, end, step, dtype, name=None):  # noqa: A001
+    return _ops.arange(start, end, step, dtype=dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,  # noqa: A002
+                   name=None):
+    return _ops.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    return _ops.add(_ops.multiply(_ops.randn(shape, dtype=dtype),
+                                  Tensor(np.asarray(std, dtype))),
+                    Tensor(np.asarray(mean, dtype)))
+
+
+def _batch_size_like(ref, shape, input_dim_idx, output_dim_idx):
+    shape = list(shape)
+    shape[output_dim_idx] = _val(ref).shape[input_dim_idx]
+    return shape
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,  # noqa: A002
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    return _ops.full(_batch_size_like(input, shape, input_dim_idx,
+                                      output_dim_idx), value, dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",  # noqa: A002
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):  # noqa: A002
+    return uniform_random(_batch_size_like(input, shape, input_dim_idx,
+                                           output_dim_idx), dtype, min, max,
+                          seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,  # noqa: A002
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    return gaussian_random(_batch_size_like(input, shape, input_dim_idx,
+                                            output_dim_idx), mean, std, seed,
+                           dtype)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    t = Tensor(np.zeros((0,), dtype))
+    t.persistable = persistable
+    return t
+
+
+def create_array(dtype):
+    return []
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):  # noqa: A002
+    ts = [_val(t) for t in input]
+    import jax.numpy as jnp
+    out = jnp.stack(ts, axis) if use_stack else jnp.concatenate(ts, axis)
+    return Tensor(out), Tensor(np.asarray([t.shape[axis] for t in ts]))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):  # noqa: A002
+    """Sample one category id per row from softmax-ed scores (ref:
+    sampling_id_op)."""
+    from ..core import rng as rng_mod
+    import jax
+
+    def core(xv, key=None):
+        return jax.random.categorical(key, jax.nn.log_softmax(xv, -1),
+                                      axis=-1)
+
+    return apply_op(core, "sampling_id",
+                    (x if isinstance(x, Tensor) else Tensor(_val(x)),),
+                    {"key": rng_mod.next_key()}, nondiff=True)
+
+
+# ------------------------------------------------------------- activations
+
+def hard_shrink(x, threshold=0.5):
+    return _ops.hardshrink(x, threshold)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _ops.hardsigmoid(x, slope, offset)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return _ops.hardswish(x)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    import jax.numpy as jnp
+
+    def core(xv):
+        return jnp.log1p(jnp.exp(jnp.clip(xv, -threshold, threshold)))
+
+    return apply_op(core, "soft_relu",
+                    (x if isinstance(x, Tensor) else Tensor(_val(x)),), {})
+
+
+# -------------------------------------------------------------- lr decays
+# 1.x decay "layers" return the matching scheduler — optimizers accept it
+# directly (ref: fluid/layers/learning_rate_scheduler.py)
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer import lr
+    return lr.ExponentialDecay(learning_rate, gamma=decay_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer import lr
+    return lr.NaturalExpDecay(learning_rate, gamma=decay_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    from ..optimizer import lr
+    return lr.InverseTimeDecay(learning_rate, gamma=decay_rate)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from ..optimizer import lr
+    return lr.PolynomialDecay(learning_rate, decay_steps, end_learning_rate,
+                              power, cycle)
+
+
+def piecewise_decay(boundaries, values):
+    from ..optimizer import lr
+    return lr.PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from ..optimizer import lr
+    return lr.CosineAnnealingDecay(learning_rate,
+                                   T_max=step_each_epoch * epochs)
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from ..optimizer import lr
+    return lr.NoamDecay(d_model, warmup_steps, learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from ..optimizer import lr
+    base = learning_rate if isinstance(learning_rate, float) \
+        else getattr(learning_rate, "base_lr", end_lr)
+    return lr.LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr) \
+        if hasattr(lr, "LinearWarmup") else lr.PolynomialDecay(
+            base, warmup_steps, end_lr)
+
+
+# ---------------------------------------------------------------- pooling
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, ceil_mode=False, name=None,
+           exclusive=True, data_format="NCDHW"):
+    from ..nn import functional as F
+    if global_pooling:
+        return F.adaptive_max_pool3d(input, 1) if pool_type == "max" \
+            else F.adaptive_avg_pool3d(input, 1)
+    fn = F.max_pool3d if pool_type == "max" else F.avg_pool3d
+    return fn(input, pool_size, pool_stride, pool_padding,
+              ceil_mode=ceil_mode)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,  # noqa: A002
+        data_format="NCHW"):
+    from ..nn import functional as F
+    return F.local_response_norm(input, n, alpha=alpha, beta=beta, k=k,
+                                 data_format=data_format)
+
+
+def grid_sampler(x, grid, name=None):
+    return _ops.grid_sample(x, grid)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,  # noqa: A002
+          data_format="NCHW", name=None):
+    from ..nn import functional as F
+    return F.pad(input, list(paddings), mode="constant" if
+                 mode == "constant" else mode, value=pad_value,
+                 data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    import jax.numpy as jnp
+
+    def core(xv, yv):
+        pads = [(0, xs - ys) for xs, ys in zip(xv.shape, yv.shape)]
+        return jnp.pad(yv, pads, constant_values=pad_value)
+
+    return apply_op(core, "pad_constant_like",
+                    (x if isinstance(x, Tensor) else Tensor(_val(x)),
+                     y if isinstance(y, Tensor) else Tensor(_val(y))), {})
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    xv = _val(x)
+    offsets = offsets or [0] * xv.ndim
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+
+    def core(xv):
+        return xv[slices]
+
+    return apply_op(core, "crop_tensor",
+                    (x if isinstance(x, Tensor) else Tensor(xv),), {})
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,  # noqa: A002
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "LINEAR": "linear",
+            "BICUBIC": "bicubic"}[resample.upper()]
+    return _ops.interpolate(input, size=out_shape, scale_factor=scale,
+                            mode=mode, align_corners=align_corners)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):  # noqa: A002
+    h, w = _val(input).shape[2], _val(input).shape[3]
+    if h < w:
+        out = [out_short_len, int(w * out_short_len / h)]
+    else:
+        out = [int(h * out_short_len / w), out_short_len]
+    return image_resize(input, out_shape=out, resample=resample)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, **kw):  # noqa: A002
+    return image_resize(input, out_shape, scale, resample="BILINEAR")
+
+
+def resize_nearest(input, out_shape=None, scale=None, **kw):  # noqa: A002
+    return image_resize(input, out_shape, scale, resample="NEAREST")
+
+
+def resize_linear(input, out_shape=None, scale=None, **kw):  # noqa: A002
+    return image_resize(input, out_shape, scale, resample="LINEAR")
+
+
+def resize_trilinear(input, out_shape=None, scale=None, **kw):  # noqa: A002
+    return image_resize(input, out_shape, scale, resample="TRILINEAR")
+
+
+def random_crop(x, shape, seed=None):
+    import jax
+
+    from ..core import rng as rng_mod
+
+    def core(xv, key=None):
+        starts = [jax.random.randint(jax.random.fold_in(key, i), (),
+                                     0, xs - s + 1)
+                  for i, (xs, s) in enumerate(zip(xv.shape[1:], shape))]
+        idx = tuple([slice(None)] + [
+            slice(None)] * 0)
+        out = xv
+        for i, (st, s) in enumerate(zip(starts, shape)):
+            out = jax.lax.dynamic_slice_in_dim(out, st, s, axis=i + 1)
+        return out
+
+    return apply_op(core, "random_crop",
+                    (x if isinstance(x, Tensor) else Tensor(_val(x)),),
+                    {"key": rng_mod.next_key()}, nondiff=True)
+
+
+def shuffle_channel(x, group, name=None):
+    import jax.numpy as jnp
+
+    def core(xv):
+        b, c, h, w = xv.shape
+        return xv.reshape(b, group, c // group, h, w) \
+            .swapaxes(1, 2).reshape(b, c, h, w)
+
+    return apply_op(core, "shuffle_channel",
+                    (x if isinstance(x, Tensor) else Tensor(_val(x)),), {})
+
+
+def space_to_depth(x, blocksize, name=None):
+    import jax.numpy as jnp
+
+    def core(xv):
+        b, c, h, w = xv.shape
+        bs = blocksize
+        xv = xv.reshape(b, c, h // bs, bs, w // bs, bs)
+        return xv.transpose(0, 3, 5, 1, 2, 4).reshape(
+            b, c * bs * bs, h // bs, w // bs)
+
+    return apply_op(core, "space_to_depth",
+                    (x if isinstance(x, Tensor) else Tensor(_val(x)),), {})
+
+
+def similarity_focus(input, axis, indexes, name=None):  # noqa: A002
+    """Similarity-focus mask (ref: similarity_focus_op): per selected
+    channel, mark max positions across the remaining dims."""
+    import jax.numpy as jnp
+
+    def core(xv):
+        mask = jnp.zeros_like(xv)
+        for idx in indexes:
+            ch = jnp.take(xv, idx, axis=axis)  # [B, ...]
+            m1 = (ch == ch.max(axis=-1, keepdims=True))
+            m2 = (ch == ch.max(axis=-2, keepdims=True))
+            sel = (m1 | m2).astype(xv.dtype)
+            mask = mask + jnp.expand_dims(sel, axis) * 0 + \
+                jnp.expand_dims(sel, axis)
+        return jnp.minimum(mask, 1.0)
+
+    return apply_op(core, "similarity_focus",
+                    (input if isinstance(input, Tensor)
+                     else Tensor(_val(input)),), {})
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001,A002
+    """Integer feature hashing (ref: hash_op): deterministic mod-hash of
+    id sequences into `hash_size` buckets, `num_hash` different salts."""
+    import jax.numpy as jnp
+
+    def core(xv):
+        xv = xv.astype(jnp.int64)
+        outs = []
+        for i in _py_range(num_hash):
+            salt = jnp.int64(0x9E3779B1 + i * 0x85EBCA77)
+            h = (xv * salt) % jnp.int64(hash_size)
+            outs.append(h)
+        return jnp.stack(outs, -1).reshape(xv.shape[:-1] + (-1,))
+
+    return apply_op(core, "hash",
+                    (input if isinstance(input, Tensor)
+                     else Tensor(_val(input)),), {}, nondiff=True)
+
+
+# ------------------------------------------------------------------ losses
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    from ..nn import functional as F
+    delta = 1.0 / (sigma * sigma)
+    return F.smooth_l1_loss(x, y, reduction="none", delta=delta)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    from ..nn import functional as F
+    return F.kl_div(x, target, reduction=reduction)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _ops.relu(_ops.add(
+        _ops.multiply(_ops.scale(label, -1.0),
+                      _ops.subtract(left, right)),
+        Tensor(np.asarray(margin, np.float32))))
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (ref: rank_loss_op)."""
+    import jax.numpy as jnp
+
+    def core(lv, l_, r_):
+        o = l_ - r_
+        return jnp.log1p(jnp.exp(o)) - lv * o
+
+    return apply_op(core, "rank_loss",
+                    tuple(t if isinstance(t, Tensor) else Tensor(_val(t))
+                          for t in (label, left, right)), {})
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    from ..nn import functional as F
+    return F.dice_loss(input, label, epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    from ..nn import functional as F
+    return F.log_loss(input, label, epsilon)
+
+
+def teacher_student_sigmoid_loss(input, label,  # noqa: A002
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation loss (ref: teacher_student_sigmoid_loss_op): CTR
+    teacher-student sigmoid cross-entropy."""
+    import jax.numpy as jnp
+
+    def core(xv, yv):
+        x = jnp.clip(xv, soft_max_lower_bound, soft_max_up_bound)
+        return jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0.0) \
+            - x * yv
+
+    return apply_op(core, "ts_sigmoid_loss",
+                    (input if isinstance(input, Tensor)
+                     else Tensor(_val(input)),
+                     label if isinstance(label, Tensor)
+                     else Tensor(_val(label))), {})
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix for distillation (ref:
+    fsp_op): [B, Cx, Cy] = x·y^T over spatial dims / (H*W)."""
+    import jax.numpy as jnp
+
+    def core(xv, yv):
+        b, cx, h, w = xv.shape
+        cy = yv.shape[1]
+        xf = xv.reshape(b, cx, h * w)
+        yf = yv.reshape(b, cy, h * w)
+        return jnp.einsum("bxs,bys->bxy", xf, yf) / (h * w)
+
+    return apply_op(core, "fsp_matrix",
+                    (x if isinstance(x, Tensor) else Tensor(_val(x)),
+                     y if isinstance(y, Tensor) else Tensor(_val(y))), {})
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=
+                                       True, use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Sampled softmax CE (ref: sample_logits_op): uniform negatives +
+    the true class, softmax over the reduced set."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import rng as rng_mod
+
+    def core(lg, lb, key=None):
+        bsz, n_cls = lg.shape
+        lb = lb.reshape(-1)
+        negs = jax.random.randint(key, (bsz, num_samples), 0, n_cls)
+        idx = jnp.concatenate([lb[:, None], negs], -1)  # true first
+        sel = jnp.take_along_axis(lg, idx, axis=1)
+        if remove_accidental_hits:
+            hit = (idx == lb[:, None]) & \
+                (jnp.arange(idx.shape[1])[None] > 0)
+            sel = jnp.where(hit, -1e20, sel)
+        return -jax.nn.log_softmax(sel, -1)[:, 0:1]
+
+    return apply_op(core, "sampled_softmax_ce",
+                    (logits if isinstance(logits, Tensor)
+                     else Tensor(_val(logits)),
+                     label if isinstance(label, Tensor)
+                     else Tensor(_val(label))),
+                    {"key": rng_mod.next_key()})
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,  # noqa: A002
+            input_length=None, label_length=None):
+    from ..nn import functional as F
+    return F.ctc_loss(input, label, input_length, label_length, blank=blank,
+                      reduction="none")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A002
+                  input_length=None, label_length=None):
+    """Levenshtein distance per pair (ref: edit_distance_op). Dense
+    [B, T] int sequences; host-side DP via pure_callback (the reference
+    computes on CPU too)."""
+    import jax
+
+    iv, lv = _val(input), _val(label)
+
+    def _dist(a, b):
+        la, lb = len(a), len(b)
+        dp = np.arange(lb + 1, dtype=np.int64)
+        for i in _py_range(1, la + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in _py_range(1, lb + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[lb]
+
+    def host(iv, lv, il, ll):
+        out = np.zeros((iv.shape[0], 1), np.float32)
+        seq_num = np.asarray([iv.shape[0]], np.int64)
+        for b in _py_range(iv.shape[0]):
+            a = iv[b][: int(il[b])] if il is not None else iv[b]
+            c = lv[b][: int(ll[b])] if ll is not None else lv[b]
+            if ignored_tokens:
+                a = [t for t in a if t not in ignored_tokens]
+                c = [t for t in c if t not in ignored_tokens]
+            d = _dist(list(a), list(c))
+            out[b, 0] = d / max(len(c), 1) if normalized else d
+        return out, seq_num
+
+    il = _val(input_length) if input_length is not None else None
+    ll = _val(label_length) if label_length is not None else None
+    out, seq_num = host(np.asarray(iv), np.asarray(lv),
+                        np.asarray(il) if il is not None else None,
+                        np.asarray(ll) if ll is not None else None)
+    return Tensor(out), Tensor(seq_num)
+
+
+def mean_iou(input, label, num_classes):  # noqa: A002
+    """Mean intersection-over-union over classes (ref: mean_iou_op)."""
+    pv, lv = np.asarray(_val(input)), np.asarray(_val(label))
+    ious, wrong, correct = [], [], []
+    for c in np.arange(num_classes):
+        pred_c = pv == c
+        lbl_c = lv == c
+        inter = np.logical_and(pred_c, lbl_c).sum()
+        union = np.logical_or(pred_c, lbl_c).sum()
+        if union > 0:
+            ious.append(inter / union)
+        correct.append(inter)
+        wrong.append(np.logical_xor(pred_c, lbl_c).sum())
+    miou = float(np.mean(ious)) if ious else 0.0
+    return (Tensor(np.asarray(miou, np.float32)),
+            Tensor(np.asarray(wrong, np.int64)),
+            Tensor(np.asarray(correct, np.int64)))
+
+
+# ------------------------------------------------------------- rnn family
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    from ..nn.layer.rnn import RNN
+    return RNN(cell, is_reverse=is_reverse, time_major=time_major)(
+        inputs, initial_states)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,  # noqa: A002
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """Single GRU step (ref: gru_unit_op) via nn.GRUCell."""
+    from ..nn import GRUCell
+    in_dim = _val(input).shape[-1]
+    cell = gru_unit._cells.setdefault(
+        (in_dim, size // 3), GRUCell(in_dim, size // 3))
+    h, new = cell(input, hidden)
+    return new, None, h
+
+
+gru_unit._cells = {}
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    from ..nn import LSTMCell
+    in_dim = _val(x_t).shape[-1]
+    hid = _val(hidden_t_prev).shape[-1]
+    cell = lstm_unit._cells.setdefault((in_dim, hid), LSTMCell(in_dim, hid))
+    h, (h2, c2) = cell(x_t, (hidden_t_prev, cell_t_prev))
+    return h2, c2
+
+
+lstm_unit._cells = {}
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,  # noqa: A002
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False):
+    """Dense rework of the LoD dynamic_gru (ref: dynamic_gru_op): input
+    [B, T, 3*size] pre-projected gates -> outputs [B, T, size]."""
+    from ..nn import GRU
+    in_dim = _val(input).shape[-1]
+    net = dynamic_gru._nets.setdefault(
+        (in_dim, size, is_reverse),
+        GRU(in_dim, size, direction="backward" if is_reverse else "forward"))
+    out, _ = net(input)
+    return out
+
+
+dynamic_gru._nets = {}
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,  # noqa: A002
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """Dense rework of LoD dynamic_lstm: [B, T, 4*size//4...] -> (h, c)."""
+    from ..nn import LSTM
+    in_dim = _val(input).shape[-1]
+    hid = size // 4
+    net = dynamic_lstm._nets.setdefault(
+        (in_dim, hid, is_reverse),
+        LSTM(in_dim, hid, direction="backward" if is_reverse else "forward"))
+    out, (h, c) = net(input)
+    return out, out
+
+
+dynamic_lstm._nets = {}
+
+
+def dynamic_lstmp(input, size, proj_size, **kw):  # noqa: A002
+    out, cell = dynamic_lstm(input, size, **{k: v for k, v in kw.items()
+                                             if k in ("is_reverse",)})
+    from ..nn import Linear
+    proj = dynamic_lstmp._projs.setdefault(
+        (_val(out).shape[-1], proj_size),
+        Linear(_val(out).shape[-1], proj_size))
+    return proj(out), cell
+
+
+dynamic_lstmp._projs = {}
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,  # noqa: A002
+         dropout_prob=0.0, is_bidirec=False, **kw):
+    from ..nn import LSTM
+    in_dim = _val(input).shape[-1]
+    net = lstm._nets.setdefault(
+        (in_dim, hidden_size, num_layers, is_bidirec),
+        LSTM(in_dim, hidden_size, num_layers=num_layers,
+             direction="bidirect" if is_bidirec else "forward"))
+    out, (h, c) = net(input, (init_h, init_c) if init_h is not None
+                      else None)
+    return out, h, c
+
+
+lstm._nets = {}
+
+
+# ----------------------------------------------------- 1.x-only constructs
+# (documented in SURVEY.md §2 #42: superseded block-style program builders)
+
+def _superseded(name, replacement):
+    def fn(*a, **kw):
+        raise NotImplementedError(
+            f"fluid.layers.{name} is a 1.x block-style program builder the "
+            f"reference itself superseded; use {replacement} on this "
+            f"backend (SURVEY.md §2 #42)")
+    fn.__name__ = name
+    return fn
+
+
+py_reader = _superseded("py_reader", "paddle.io.DataLoader")
+create_py_reader_by_data = _superseded("create_py_reader_by_data",
+                                       "paddle.io.DataLoader")
+double_buffer = _superseded("double_buffer",
+                            "paddle.io.DataLoader (C++ prefetch built in)")
+read_file = _superseded("read_file", "paddle.io.DataLoader")
+load = _superseded("load", "paddle.static.load_inference_model")
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return x  # dense backend: rows are already a dense tensor
+
+
+def merge_selected_rows(x, name=None):
+    return x
+
+
+def continuous_value_model(input, cvm, use_cvm=True):  # noqa: A002
+    """CTR continuous-value feature op (ref: cvm_op): keeps or strips the
+    2 leading show/click columns."""
+    return input if use_cvm else _ops.slice(
+        input, axes=[1], starts=[2], ends=[_val(input).shape[1]])
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Tag-filtering (ref: filter_by_instag_op), dense semantics: keep rows
+    whose tag is in filter_tag."""
+    iv = np.asarray(_val(ins))
+    tags = np.asarray(_val(ins_tag)).reshape(-1)
+    keep = np.isin(tags, np.asarray(_val(filter_tag)).reshape(-1))
+    idx = np.nonzero(keep)[0]
+    if idx.size == 0:
+        out = np.full((1,) + iv.shape[1:], out_val_if_empty, iv.dtype)
+        return Tensor(out), Tensor(np.asarray([0], np.int64)), \
+            Tensor(np.asarray([0], np.int64))
+    return (Tensor(iv[idx]), Tensor(idx.astype(np.int64)),
+            Tensor(np.asarray([idx.size], np.int64)))

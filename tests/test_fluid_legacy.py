@@ -1,0 +1,231 @@
+"""fluid 1.x legacy completion (audit: fluid.layers 309, fluid.dygraph 62,
+fluid.contrib 37 — all present). Smoke/numeric tests for the pieces that
+are real implementations here (aliases are covered by their 2.0 homes).
+
+Ref: python/paddle/fluid/layers/*, fluid/dygraph/nn.py, fluid/contrib/.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid.layers as L
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(a):
+    return Tensor(jnp.asarray(np.asarray(a)))
+
+
+class TestLegacyLayers:
+    def test_multiplex(self):
+        a = _t(np.asarray([[1.0, 2], [3, 4]]))
+        b = _t(np.asarray([[10.0, 20], [30, 40]]))
+        idx = _t(np.asarray([[1], [0]], np.int32))
+        out = np.asarray(L.multiplex([a, b], idx).numpy())
+        np.testing.assert_allclose(out, [[10, 20], [3, 4]])
+
+    def test_elementwise_and_reduce_family(self):
+        x = _t(np.asarray([1.0, 5.0]))
+        y = _t(np.asarray([3.0, 2.0]))
+        np.testing.assert_allclose(
+            np.asarray(L.elementwise_max(x, y).numpy()), [3, 5])
+        np.testing.assert_allclose(
+            np.asarray(L.reduce_prod(_t([2.0, 3.0])).numpy()), 6.0)
+
+    def test_decay_layers_return_schedulers(self):
+        from paddle_tpu.optimizer.lr import LRScheduler
+        for sched in (L.exponential_decay(0.1, 100, 0.9),
+                      L.piecewise_decay([10, 20], [0.1, 0.05, 0.01]),
+                      L.cosine_decay(0.1, 10, 3),
+                      L.noam_decay(512, 100)):
+            assert isinstance(sched, LRScheduler), sched
+
+    def test_rank_loss_and_bpr(self):
+        lbl = _t(np.asarray([[1.0], [0.0]]))
+        left = _t(np.asarray([[2.0], [0.5]]))
+        right = _t(np.asarray([[1.0], [1.5]]))
+        out = np.asarray(L.rank_loss(lbl, left, right).numpy())
+        assert out.shape == (2, 1) and np.isfinite(out).all()
+        scores = _t(np.random.RandomState(0).randn(4, 5))
+        bl = np.asarray(L.bpr_loss(scores,
+                                   _t(np.asarray([[0], [1], [2], [3]],
+                                                 np.int64))).numpy())
+        assert bl.shape == (4, 1) and (bl > 0).all()
+
+    def test_edit_distance(self):
+        a = _t(np.asarray([[1, 2, 3, 4]], np.int64))
+        b = _t(np.asarray([[1, 5, 3]], np.int64))
+        dist, n = L.edit_distance(a, b, normalized=False)
+        assert float(np.asarray(dist.numpy())[0, 0]) == 2.0  # sub + del
+
+    def test_ctc_greedy_decoder(self):
+        # logits prefer: [a a blank b b] -> "a b"
+        probs = np.full((1, 5, 3), -5.0, np.float32)
+        for t, c in enumerate([1, 1, 0, 2, 2]):
+            probs[0, t, c] = 5.0
+        ids, lens = L.ctc_greedy_decoder(_t(probs), blank=0)
+        assert list(np.asarray(ids.numpy())[0][:2]) == [1, 2]
+        assert int(np.asarray(lens.numpy())[0]) == 2
+
+    def test_space_to_depth_and_shuffle_channel(self):
+        x = _t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out = L.space_to_depth(x, 2)
+        assert tuple(out.shape) == (1, 4, 2, 2)
+        x2 = _t(np.random.rand(1, 4, 2, 2).astype(np.float32))
+        sc = L.shuffle_channel(x2, 2)
+        assert tuple(sc.shape) == (1, 4, 2, 2)
+
+    def test_add_position_encoding_and_affine_channel(self):
+        x = _t(np.zeros((1, 4, 8), np.float32))
+        pe = np.asarray(L.add_position_encoding(x, 1.0, 1.0).numpy())
+        assert not np.allclose(pe, 0)  # the sinusoid landed
+        img = _t(np.ones((1, 2, 3, 3), np.float32))
+        out = np.asarray(L.affine_channel(
+            img, _t(np.asarray([2.0, 3.0])),
+            _t(np.asarray([1.0, -1.0]))).numpy())
+        np.testing.assert_allclose(out[0, 0], 3.0)
+        np.testing.assert_allclose(out[0, 1], 2.0)
+
+    def test_beam_search_step(self):
+        # 2 beams, vocab 4: flat top-2 of accumulated scores
+        scores = _t(np.asarray([[0.1, 0.9, 0.0, 0.0],
+                                [0.0, 0.0, 0.8, 0.2]], np.float32))
+        ids = _t(np.zeros((2, 4), np.int64))
+        sel_ids, sel_scores, parent = L.beam_search(
+            None, _t(np.zeros((2, 1))), ids, scores, beam_size=2,
+            end_id=0, return_parent_idx=True)
+        assert float(np.asarray(sel_scores.numpy())[0, 0]) == \
+            pytest.approx(0.9)
+        assert int(np.asarray(parent.numpy())[0]) == 0
+        assert int(np.asarray(parent.numpy())[1]) == 1  # 0.8 from beam 1
+
+    def test_training_helper_basic_decoder(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        cell = nn.GRUCell(4, 4)
+        inputs = np.random.RandomState(1).randn(2, 3, 4).astype(np.float32)
+        helper = L.TrainingHelper(_t(inputs))
+        dec = L.BasicDecoder(cell, helper)
+        h0 = _t(np.zeros((2, 4), np.float32))
+        inp, states, finished = dec.initialize(h0)
+        out, states, inp, finished = dec.step(_t(np.asarray(0)), inp,
+                                              states)
+        assert tuple(out.cell_outputs.shape) == (2, 4)
+        assert tuple(np.asarray(out.sample_ids.numpy()).shape) == (2,)
+
+    def test_mvn_diag_distribution(self):
+        d = L.MultivariateNormalDiag(_t(np.zeros(2, np.float32)),
+                                     _t(np.eye(2, dtype=np.float32) * 2.0))
+        lp = np.asarray(d.log_prob(_t(np.zeros(2, np.float32))).numpy())
+        ref = -0.5 * 2 * np.log(2 * np.pi * 4.0)  # 2 dims, var = 2^2
+        np.testing.assert_allclose(lp, ref, rtol=1e-5)
+        s = d.sample((5,))
+        assert tuple(s.shape) == (5, 2)
+
+    def test_blocks_raise_with_guidance(self):
+        for cls in (L.While, L.IfElse, L.Switch, L.DynamicRNN, L.StaticRNN):
+            with pytest.raises(NotImplementedError, match="SURVEY"):
+                cls(None)
+
+    def test_chunk_eval_and_auc(self):
+        # IOB, 1 chunk type: tags B=0 I=1 O=2
+        pred = _t(np.asarray([0, 1, 2, 0], np.int64))
+        lbl = _t(np.asarray([0, 1, 2, 0], np.int64))
+        p, r, f1, npc, nlc, tp = L.chunk_eval(pred, lbl, "IOB", 1)
+        assert float(np.asarray(f1.numpy())) == 1.0
+        score = _t(np.asarray([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        label = _t(np.asarray([[1], [0]], np.int64))
+        a, _, _ = L.auc(score, label)
+        assert 0.0 <= float(np.asarray(a.numpy())) <= 1.0
+
+    def test_arrays_and_counters(self):
+        arr = L.array_write(_t(np.asarray([1.0])), _t(np.asarray(0)))
+        L.array_write(_t(np.asarray([2.0])), _t(np.asarray(1)), arr)
+        assert int(np.asarray(L.array_length(arr).numpy())) == 2
+        got = np.asarray(L.array_read(arr, _t(np.asarray(1))).numpy())
+        np.testing.assert_allclose(got, [2.0])
+        c1 = int(np.asarray(
+            L.autoincreased_step_counter("t_c").numpy()))
+        c2 = int(np.asarray(
+            L.autoincreased_step_counter("t_c").numpy()))
+        assert c2 == c1 + 1
+
+    def test_matrix_nms(self):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                            [50, 50, 60, 60]], np.float32)
+        scores = np.asarray([[0.9, 0.85, 0.8]], np.float32)  # one class
+        out, n = L.matrix_nms(_t(boxes), _t(scores), 0.0, 0.1, 3, 3,
+                              background_label=-1)  # class 0 is real here
+        assert int(np.asarray(n.numpy())[0]) >= 2  # decayed, not dropped
+
+
+class TestContrib:
+    def test_basic_gru_lstm(self):
+        from paddle_tpu.fluid import contrib as C
+        paddle.seed(1)
+        x = _t(np.random.RandomState(2).randn(2, 5, 8).astype(np.float32))
+        out, h = C.basic_gru(x, None, hidden_size=6)
+        assert tuple(out.shape) == (2, 5, 6)
+        out, h, c = C.basic_lstm(x, None, None, hidden_size=6)
+        assert tuple(out.shape) == (2, 5, 6)
+
+    def test_partial_ops_and_shuffle(self):
+        from paddle_tpu.fluid import contrib as C
+        a = _t(np.asarray([[1.0, 2, 3], [4, 5, 6]]))
+        b = _t(np.asarray([[7.0, 8, 9], [10, 11, 12]]))
+        pc = np.asarray(C.partial_concat([a, b], 0, 2).numpy())
+        assert pc.shape == (2, 4)
+        ps = np.asarray(C.partial_sum([a, b], 0, 2).numpy())
+        np.testing.assert_allclose(ps, [[8, 10], [14, 16]])
+        sb = C.shuffle_batch(a)
+        assert sorted(np.asarray(sb.numpy())[:, 0].tolist()) == [1.0, 4.0]
+
+    def test_correlation_shape(self):
+        from paddle_tpu.fluid import contrib as C
+        x = _t(np.random.rand(1, 2, 6, 6).astype(np.float32))
+        y = _t(np.random.rand(1, 2, 6, 6).astype(np.float32))
+        out = C.correlation(x, y, pad_size=1, kernel_size=1,
+                            max_displacement=1, stride1=1, stride2=1)
+        assert tuple(out.shape) == (1, 9, 6, 6)
+
+    def test_cluster_only_pieces_raise(self):
+        from paddle_tpu.fluid import contrib as C
+        with pytest.raises(NotImplementedError, match="SURVEY"):
+            C.HDFSClient()
+        with pytest.raises(NotImplementedError, match="SURVEY"):
+            C.distributed_batch_reader(None)
+
+    def test_decoupled_weight_decay_factory(self):
+        from paddle_tpu.fluid import contrib as C
+        import paddle_tpu.optimizer as opt
+        cls = C.extend_with_decoupled_weight_decay(opt.Momentum)
+        p = paddle.Parameter(np.ones(4, np.float32))
+        o = cls(learning_rate=0.1, weight_decay=0.01, parameters=[p])
+        assert o._decoupled()
+
+
+class TestDygraphAliases:
+    def test_layer_aliases_construct(self):
+        import paddle_tpu.fluid.dygraph as D
+        assert D.Conv2DTranspose is paddle.nn.Conv2DTranspose
+        assert D.AmpScaler is paddle.amp.GradScaler
+        lw = D.LinearLrWarmup(0.1, 10, 0.0, 0.1)
+        nce = D.NCE(20, 8)
+        out = nce(_t(np.random.rand(3, 8).astype(np.float32)),
+                  _t(np.asarray([[1], [2], [3]], np.int64)))
+        assert tuple(out.shape) == (3, 1)
+
+    def test_gru_unit_and_tree_conv(self):
+        import paddle_tpu.fluid.dygraph as D
+        paddle.seed(2)
+        g = D.GRUUnit(12)  # hidden 4
+        h, _, _ = g(_t(np.random.rand(2, 12).astype(np.float32)),
+                    _t(np.zeros((2, 4), np.float32)))
+        assert tuple(h.shape) == (2, 4)
+        tc = D.TreeConv(6, 5, num_filters=2)
+        nodes = _t(np.random.rand(1, 4, 6).astype(np.float32))
+        adj = _t(np.eye(4, dtype=np.float32)[None])
+        out = tc(nodes, adj)
+        assert tuple(out.shape)[0] == 1
