@@ -16,3 +16,9 @@ def get_default_dtype():
 def set_default_dtype(d):
     from ..core.dtype import set_default_dtype as s
     return s(d)
+from ..core.place import CUDAPinnedPlace  # noqa: E402,F401
+from ..core.param_attr import ParamAttr  # noqa: E402,F401
+from ..core.autograd import grad, no_grad  # noqa: E402,F401
+from ..distributed.parallel import DataParallel  # noqa: E402,F401
+from ..nn.layer.layers import LayerList  # noqa: E402,F401
+from ..fluid.layers import create_parameter  # noqa: E402,F401

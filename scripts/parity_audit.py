@@ -43,6 +43,7 @@ PAIRS = [
     ("fluid/layers", "fluid.layers"),
     ("fluid/dygraph", "fluid.dygraph"),
     ("fluid/contrib", "fluid.contrib"),
+    ("framework", "framework"),
 ]
 
 
