@@ -29,16 +29,16 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,  # noqa: A002
               gate_activation=None, activation=None, dtype="float32",
               name="basic_gru"):
     from ..nn import GRU
+    from ..nn.functional.legacy import legacy_param_store
     in_dim = _val(input).shape[-1]
-    net = basic_gru._nets.setdefault(
-        (in_dim, hidden_size, num_layers, bidirectional),
-        GRU(in_dim, hidden_size, num_layers=num_layers,
-            direction="bidirect" if bidirectional else "forward"))
+    # parameters are identified by NAME (1.x program semantics) via the
+    # LegacyParamStore — no shape-keyed sharing across distinct call sites
+    net = legacy_param_store().layer(
+        f"{name}/{in_dim}x{hidden_size}l{num_layers}b{int(bidirectional)}",
+        lambda: GRU(in_dim, hidden_size, num_layers=num_layers,
+                    direction="bidirect" if bidirectional else "forward"))
     out, h = net(_t(input), init_hidden)
     return out, h
-
-
-basic_gru._nets = {}
 
 
 def basic_lstm(input, init_hidden, init_cell, hidden_size,  # noqa: A002
@@ -47,17 +47,15 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size,  # noqa: A002
                bias_attr=None, gate_activation=None, activation=None,
                forget_bias=1.0, dtype="float32", name="basic_lstm"):
     from ..nn import LSTM
+    from ..nn.functional.legacy import legacy_param_store
     in_dim = _val(input).shape[-1]
-    net = basic_lstm._nets.setdefault(
-        (in_dim, hidden_size, num_layers, bidirectional),
-        LSTM(in_dim, hidden_size, num_layers=num_layers,
-             direction="bidirect" if bidirectional else "forward"))
+    net = legacy_param_store().layer(
+        f"{name}/{in_dim}x{hidden_size}l{num_layers}b{int(bidirectional)}",
+        lambda: LSTM(in_dim, hidden_size, num_layers=num_layers,
+                     direction="bidirect" if bidirectional else "forward"))
     states = None if init_hidden is None else (init_hidden, init_cell)
     out, (h, c) = net(_t(input), states)
     return out, h, c
-
-
-basic_lstm._nets = {}
 
 
 def fused_bn_add_act(x, y, momentum=0.9, epsilon=1e-5, param_attr=None,
@@ -250,12 +248,11 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
     """Distributed sparse embedding (ref: contrib/layers/sparse_embedding):
     the PS-lite host table IS the sparse parameter here."""
     from ..distributed.ps import PSEmbedding
-    layer = sparse_embedding._tables.setdefault(
-        tuple(size), PSEmbedding(size[0], size[1]))
+    from ..nn.functional.legacy import legacy_param_store
+    nm = getattr(param_attr, "name", None) or f"sparse_emb_{size[0]}x{size[1]}"
+    layer = legacy_param_store().layer(
+        nm, lambda: PSEmbedding(size[0], size[1]))
     return layer(_t(input))
-
-
-sparse_embedding._tables = {}
 
 
 def ctr_metric_bundle(input, label):  # noqa: A002
@@ -349,7 +346,6 @@ def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,  # n
     """Pyramid hash embedding (ref: search_pyramid_hash_op): n-gram ids
     hashed into a shared space, summed per pyramid layer — simplified
     dense rework."""
-    from .layers_legacy import hash as _hash
     from ..static.nn import _create_param
     import jax.numpy as jnp
     table = _create_param((space_len, num_emb), dtype, param_attr)
@@ -447,14 +443,12 @@ def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
               max_depth=2, act="tanh", param_attr=None, bias_attr=None,
               name=None):
     from .dygraph import TreeConv
+    from ..nn.functional.legacy import legacy_param_store
     d = _val(nodes_vector).shape[-1]
-    layer = tree_conv._layers.setdefault(
-        (d, output_size, num_filters, max_depth),
-        TreeConv(d, output_size, num_filters, max_depth, act))
+    nm = (name or "tree_conv") + f"/{d}x{output_size}f{num_filters}"
+    layer = legacy_param_store().layer(
+        nm, lambda: TreeConv(d, output_size, num_filters, max_depth, act))
     return layer(_t(nodes_vector), _t(edge_set))
-
-
-tree_conv._layers = {}
 
 
 class mixed_precision:

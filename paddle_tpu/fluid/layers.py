@@ -38,7 +38,22 @@ from ..nn.functional import (  # noqa: F401
     linear_chain_crf, roi_align, sequence_mask,
 )
 from ..nn.functional.detection import iou_similarity, ssd_loss  # noqa: F401
-from ..nn.functional.legacy import gather_tree  # noqa: F401
+# the canonical fluid-1.x shims (fresh-params-per-unnamed-call semantics +
+# LegacyParamStore for named reuse) — single source of truth, NOT
+# re-implemented here (code-review r3c)
+from ..nn.functional.legacy import (  # noqa: F401
+    add_position_encoding, affine_channel, array_length, array_read,
+    array_write, autoincreased_step_counter, birnn, bpr_loss, center_loss,
+    continuous_value_model, create_array, dice_loss, dynamic_gru,
+    dynamic_lstm, dynamic_lstmp, filter_by_instag, fsp_matrix, gather_tree,
+    gru_unit, hash, im2sequence, image_resize, image_resize_short,
+    lod_append, lod_reset, lstm, lstm_unit, merge_selected_rows, pad2d,
+    pad_constant_like, polygon_box_transform, pool3d, random_crop,
+    reorder_lod_tensor_by_rank, resize_bilinear, resize_nearest,
+    resize_trilinear, shuffle_channel, similarity_focus, smooth_l1,
+    soft_relu, space_to_depth, teacher_student_sigmoid_loss,
+    tensor_array_to_tensor, warpctc,
+)
 # 1.x RNN-cell / decoder classes live on in paddle.nn
 from ..nn import (  # noqa: F401
     BeamSearchDecoder, GRUCell, LSTMCell, dynamic_decode,
@@ -51,8 +66,8 @@ from ..distribution import (  # noqa: F401
 )
 from .layers_legacy import *  # noqa: F401,F403,E402
 from .layers_legacy import (  # noqa: F401
-    edit_distance, hash, lrn, mean_iou, multiplex, pool3d,
-    rank_loss, sampled_softmax_with_cross_entropy, warpctc,
+    edit_distance, lrn, mean_iou, multiplex,
+    rank_loss, sampled_softmax_with_cross_entropy,
 )
 from .layers_legacy2 import *  # noqa: F401,F403,E402
 from .layers_legacy2 import (  # noqa: F401

@@ -175,17 +175,6 @@ def create_tensor(dtype, name=None, persistable=False):
     return t
 
 
-def create_array(dtype):
-    return []
-
-
-def tensor_array_to_tensor(input, axis=1, use_stack=False):  # noqa: A002
-    ts = [_val(t) for t in input]
-    import jax.numpy as jnp
-    out = jnp.stack(ts, axis) if use_stack else jnp.concatenate(ts, axis)
-    return Tensor(out), Tensor(np.asarray([t.shape[axis] for t in ts]))
-
-
 def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):  # noqa: A002
     """Sample one category id per row from softmax-ed scores (ref:
     sampling_id_op)."""
@@ -214,20 +203,6 @@ def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
 def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
     return _ops.hardswish(x)
 
-
-def soft_relu(x, threshold=40.0, name=None):
-    import jax.numpy as jnp
-
-    def core(xv):
-        return jnp.log1p(jnp.exp(jnp.clip(xv, -threshold, threshold)))
-
-    return apply_op(core, "soft_relu",
-                    (x if isinstance(x, Tensor) else Tensor(_val(x)),), {})
-
-
-# -------------------------------------------------------------- lr decays
-# 1.x decay "layers" return the matching scheduler — optimizers accept it
-# directly (ref: fluid/layers/learning_rate_scheduler.py)
 
 def exponential_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
@@ -281,18 +256,6 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
 
 # ---------------------------------------------------------------- pooling
 
-def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
-           pool_padding=0, global_pooling=False, ceil_mode=False, name=None,
-           exclusive=True, data_format="NCDHW"):
-    from ..nn import functional as F
-    if global_pooling:
-        return F.adaptive_max_pool3d(input, 1) if pool_type == "max" \
-            else F.adaptive_avg_pool3d(input, 1)
-    fn = F.max_pool3d if pool_type == "max" else F.avg_pool3d
-    return fn(input, pool_size, pool_stride, pool_padding,
-              ceil_mode=ceil_mode)
-
-
 def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,  # noqa: A002
         data_format="NCHW"):
     from ..nn import functional as F
@@ -302,26 +265,6 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,  # noqa: A002
 
 def grid_sampler(x, grid, name=None):
     return _ops.grid_sample(x, grid)
-
-
-def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,  # noqa: A002
-          data_format="NCHW", name=None):
-    from ..nn import functional as F
-    return F.pad(input, list(paddings), mode="constant" if
-                 mode == "constant" else mode, value=pad_value,
-                 data_format=data_format)
-
-
-def pad_constant_like(x, y, pad_value=0.0, name=None):
-    import jax.numpy as jnp
-
-    def core(xv, yv):
-        pads = [(0, xs - ys) for xs, ys in zip(xv.shape, yv.shape)]
-        return jnp.pad(yv, pads, constant_values=pad_value)
-
-    return apply_op(core, "pad_constant_like",
-                    (x if isinstance(x, Tensor) else Tensor(_val(x)),
-                     y if isinstance(y, Tensor) else Tensor(_val(y))), {})
 
 
 def crop_tensor(x, shape=None, offsets=None, name=None):
@@ -336,134 +279,9 @@ def crop_tensor(x, shape=None, offsets=None, name=None):
                     (x if isinstance(x, Tensor) else Tensor(xv),), {})
 
 
-def image_resize(input, out_shape=None, scale=None, name=None,  # noqa: A002
-                 resample="BILINEAR", actual_shape=None, align_corners=True,
-                 align_mode=1, data_format="NCHW"):
-    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
-            "TRILINEAR": "trilinear", "LINEAR": "linear",
-            "BICUBIC": "bicubic"}[resample.upper()]
-    return _ops.interpolate(input, size=out_shape, scale_factor=scale,
-                            mode=mode, align_corners=align_corners)
-
-
-def image_resize_short(input, out_short_len, resample="BILINEAR"):  # noqa: A002
-    h, w = _val(input).shape[2], _val(input).shape[3]
-    if h < w:
-        out = [out_short_len, int(w * out_short_len / h)]
-    else:
-        out = [int(h * out_short_len / w), out_short_len]
-    return image_resize(input, out_shape=out, resample=resample)
-
-
-def resize_bilinear(input, out_shape=None, scale=None, **kw):  # noqa: A002
-    return image_resize(input, out_shape, scale, resample="BILINEAR")
-
-
-def resize_nearest(input, out_shape=None, scale=None, **kw):  # noqa: A002
-    return image_resize(input, out_shape, scale, resample="NEAREST")
-
-
 def resize_linear(input, out_shape=None, scale=None, **kw):  # noqa: A002
+    from ..nn.functional.legacy import image_resize
     return image_resize(input, out_shape, scale, resample="LINEAR")
-
-
-def resize_trilinear(input, out_shape=None, scale=None, **kw):  # noqa: A002
-    return image_resize(input, out_shape, scale, resample="TRILINEAR")
-
-
-def random_crop(x, shape, seed=None):
-    import jax
-
-    from ..core import rng as rng_mod
-
-    def core(xv, key=None):
-        starts = [jax.random.randint(jax.random.fold_in(key, i), (),
-                                     0, xs - s + 1)
-                  for i, (xs, s) in enumerate(zip(xv.shape[1:], shape))]
-        idx = tuple([slice(None)] + [
-            slice(None)] * 0)
-        out = xv
-        for i, (st, s) in enumerate(zip(starts, shape)):
-            out = jax.lax.dynamic_slice_in_dim(out, st, s, axis=i + 1)
-        return out
-
-    return apply_op(core, "random_crop",
-                    (x if isinstance(x, Tensor) else Tensor(_val(x)),),
-                    {"key": rng_mod.next_key()}, nondiff=True)
-
-
-def shuffle_channel(x, group, name=None):
-    import jax.numpy as jnp
-
-    def core(xv):
-        b, c, h, w = xv.shape
-        return xv.reshape(b, group, c // group, h, w) \
-            .swapaxes(1, 2).reshape(b, c, h, w)
-
-    return apply_op(core, "shuffle_channel",
-                    (x if isinstance(x, Tensor) else Tensor(_val(x)),), {})
-
-
-def space_to_depth(x, blocksize, name=None):
-    import jax.numpy as jnp
-
-    def core(xv):
-        b, c, h, w = xv.shape
-        bs = blocksize
-        xv = xv.reshape(b, c, h // bs, bs, w // bs, bs)
-        return xv.transpose(0, 3, 5, 1, 2, 4).reshape(
-            b, c * bs * bs, h // bs, w // bs)
-
-    return apply_op(core, "space_to_depth",
-                    (x if isinstance(x, Tensor) else Tensor(_val(x)),), {})
-
-
-def similarity_focus(input, axis, indexes, name=None):  # noqa: A002
-    """Similarity-focus mask (ref: similarity_focus_op): per selected
-    channel, mark max positions across the remaining dims."""
-    import jax.numpy as jnp
-
-    def core(xv):
-        mask = jnp.zeros_like(xv)
-        for idx in indexes:
-            ch = jnp.take(xv, idx, axis=axis)  # [B, ...]
-            m1 = (ch == ch.max(axis=-1, keepdims=True))
-            m2 = (ch == ch.max(axis=-2, keepdims=True))
-            sel = (m1 | m2).astype(xv.dtype)
-            mask = mask + jnp.expand_dims(sel, axis) * 0 + \
-                jnp.expand_dims(sel, axis)
-        return jnp.minimum(mask, 1.0)
-
-    return apply_op(core, "similarity_focus",
-                    (input if isinstance(input, Tensor)
-                     else Tensor(_val(input)),), {})
-
-
-def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001,A002
-    """Integer feature hashing (ref: hash_op): deterministic mod-hash of
-    id sequences into `hash_size` buckets, `num_hash` different salts."""
-    import jax.numpy as jnp
-
-    def core(xv):
-        xv = xv.astype(jnp.int64)
-        outs = []
-        for i in _py_range(num_hash):
-            salt = jnp.int64(0x9E3779B1 + i * 0x85EBCA77)
-            h = (xv * salt) % jnp.int64(hash_size)
-            outs.append(h)
-        return jnp.stack(outs, -1).reshape(xv.shape[:-1] + (-1,))
-
-    return apply_op(core, "hash",
-                    (input if isinstance(input, Tensor)
-                     else Tensor(_val(input)),), {}, nondiff=True)
-
-
-# ------------------------------------------------------------------ losses
-
-def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
-    from ..nn import functional as F
-    delta = 1.0 / (sigma * sigma)
-    return F.smooth_l1_loss(x, y, reduction="none", delta=delta)
 
 
 def kldiv_loss(x, target, reduction="mean", name=None):
@@ -491,50 +309,9 @@ def rank_loss(label, left, right, name=None):
                           for t in (label, left, right)), {})
 
 
-def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
-    from ..nn import functional as F
-    return F.dice_loss(input, label, epsilon)
-
-
 def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
     from ..nn import functional as F
     return F.log_loss(input, label, epsilon)
-
-
-def teacher_student_sigmoid_loss(input, label,  # noqa: A002
-                                 soft_max_up_bound=15.0,
-                                 soft_max_lower_bound=-15.0):
-    """Distillation loss (ref: teacher_student_sigmoid_loss_op): CTR
-    teacher-student sigmoid cross-entropy."""
-    import jax.numpy as jnp
-
-    def core(xv, yv):
-        x = jnp.clip(xv, soft_max_lower_bound, soft_max_up_bound)
-        return jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0.0) \
-            - x * yv
-
-    return apply_op(core, "ts_sigmoid_loss",
-                    (input if isinstance(input, Tensor)
-                     else Tensor(_val(input)),
-                     label if isinstance(label, Tensor)
-                     else Tensor(_val(label))), {})
-
-
-def fsp_matrix(x, y):
-    """Flow-of-solution-procedure matrix for distillation (ref:
-    fsp_op): [B, Cx, Cy] = x·y^T over spatial dims / (H*W)."""
-    import jax.numpy as jnp
-
-    def core(xv, yv):
-        b, cx, h, w = xv.shape
-        cy = yv.shape[1]
-        xf = xv.reshape(b, cx, h * w)
-        yf = yv.reshape(b, cy, h * w)
-        return jnp.einsum("bxs,bys->bxy", xf, yf) / (h * w)
-
-    return apply_op(core, "fsp_matrix",
-                    (x if isinstance(x, Tensor) else Tensor(_val(x)),
-                     y if isinstance(y, Tensor) else Tensor(_val(y))), {})
 
 
 def sampled_softmax_with_cross_entropy(logits, label, num_samples,
@@ -568,13 +345,6 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
                      label if isinstance(label, Tensor)
                      else Tensor(_val(label))),
                     {"key": rng_mod.next_key()})
-
-
-def warpctc(input, label, blank=0, norm_by_times=False,  # noqa: A002
-            input_length=None, label_length=None):
-    from ..nn import functional as F
-    return F.ctc_loss(input, label, input_length, label_length, blank=blank,
-                      reduction="none")
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A002
@@ -646,101 +416,6 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
         inputs, initial_states)
 
 
-def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,  # noqa: A002
-             activation="tanh", gate_activation="sigmoid",
-             origin_mode=False):
-    """Single GRU step (ref: gru_unit_op) via nn.GRUCell."""
-    from ..nn import GRUCell
-    in_dim = _val(input).shape[-1]
-    cell = gru_unit._cells.setdefault(
-        (in_dim, size // 3), GRUCell(in_dim, size // 3))
-    h, new = cell(input, hidden)
-    return new, None, h
-
-
-gru_unit._cells = {}
-
-
-def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
-              param_attr=None, bias_attr=None, name=None):
-    from ..nn import LSTMCell
-    in_dim = _val(x_t).shape[-1]
-    hid = _val(hidden_t_prev).shape[-1]
-    cell = lstm_unit._cells.setdefault((in_dim, hid), LSTMCell(in_dim, hid))
-    h, (h2, c2) = cell(x_t, (hidden_t_prev, cell_t_prev))
-    return h2, c2
-
-
-lstm_unit._cells = {}
-
-
-def dynamic_gru(input, size, param_attr=None, bias_attr=None,  # noqa: A002
-                is_reverse=False, gate_activation="sigmoid",
-                candidate_activation="tanh", h_0=None, origin_mode=False):
-    """Dense rework of the LoD dynamic_gru (ref: dynamic_gru_op): input
-    [B, T, 3*size] pre-projected gates -> outputs [B, T, size]."""
-    from ..nn import GRU
-    in_dim = _val(input).shape[-1]
-    net = dynamic_gru._nets.setdefault(
-        (in_dim, size, is_reverse),
-        GRU(in_dim, size, direction="backward" if is_reverse else "forward"))
-    out, _ = net(input)
-    return out
-
-
-dynamic_gru._nets = {}
-
-
-def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,  # noqa: A002
-                 bias_attr=None, use_peepholes=True, is_reverse=False,
-                 gate_activation="sigmoid", cell_activation="tanh",
-                 candidate_activation="tanh", dtype="float32", name=None):
-    """Dense rework of LoD dynamic_lstm: [B, T, 4*size//4...] -> (h, c)."""
-    from ..nn import LSTM
-    in_dim = _val(input).shape[-1]
-    hid = size // 4
-    net = dynamic_lstm._nets.setdefault(
-        (in_dim, hid, is_reverse),
-        LSTM(in_dim, hid, direction="backward" if is_reverse else "forward"))
-    out, (h, c) = net(input)
-    return out, out
-
-
-dynamic_lstm._nets = {}
-
-
-def dynamic_lstmp(input, size, proj_size, **kw):  # noqa: A002
-    out, cell = dynamic_lstm(input, size, **{k: v for k, v in kw.items()
-                                             if k in ("is_reverse",)})
-    from ..nn import Linear
-    proj = dynamic_lstmp._projs.setdefault(
-        (_val(out).shape[-1], proj_size),
-        Linear(_val(out).shape[-1], proj_size))
-    return proj(out), cell
-
-
-dynamic_lstmp._projs = {}
-
-
-def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,  # noqa: A002
-         dropout_prob=0.0, is_bidirec=False, **kw):
-    from ..nn import LSTM
-    in_dim = _val(input).shape[-1]
-    net = lstm._nets.setdefault(
-        (in_dim, hidden_size, num_layers, is_bidirec),
-        LSTM(in_dim, hidden_size, num_layers=num_layers,
-             direction="bidirect" if is_bidirec else "forward"))
-    out, (h, c) = net(input, (init_h, init_c) if init_h is not None
-                      else None)
-    return out, h, c
-
-
-lstm._nets = {}
-
-
-# ----------------------------------------------------- 1.x-only constructs
-# (documented in SURVEY.md §2 #42: superseded block-style program builders)
-
 def _superseded(name, replacement):
     def fn(*a, **kw):
         raise NotImplementedError(
@@ -764,28 +439,3 @@ def get_tensor_from_selected_rows(x, name=None):
     return x  # dense backend: rows are already a dense tensor
 
 
-def merge_selected_rows(x, name=None):
-    return x
-
-
-def continuous_value_model(input, cvm, use_cvm=True):  # noqa: A002
-    """CTR continuous-value feature op (ref: cvm_op): keeps or strips the
-    2 leading show/click columns."""
-    return input if use_cvm else _ops.slice(
-        input, axes=[1], starts=[2], ends=[_val(input).shape[1]])
-
-
-def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
-                     out_val_if_empty=0):
-    """Tag-filtering (ref: filter_by_instag_op), dense semantics: keep rows
-    whose tag is in filter_tag."""
-    iv = np.asarray(_val(ins))
-    tags = np.asarray(_val(ins_tag)).reshape(-1)
-    keep = np.isin(tags, np.asarray(_val(filter_tag)).reshape(-1))
-    idx = np.nonzero(keep)[0]
-    if idx.size == 0:
-        out = np.full((1,) + iv.shape[1:], out_val_if_empty, iv.dtype)
-        return Tensor(out), Tensor(np.asarray([0], np.int64)), \
-            Tensor(np.asarray([0], np.int64))
-    return (Tensor(iv[idx]), Tensor(idx.astype(np.int64)),
-            Tensor(np.asarray([idx.size], np.int64)))

@@ -59,41 +59,6 @@ def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
 
 # -------------------------------------------------------- tensor arrays
 
-def array_write(x, i, array=None):
-    """ref: control_flow.py array_write — LoDTensorArray is a host list."""
-    if array is None:
-        array = []
-    idx = int(np.asarray(_val(i)))
-    while len(array) <= idx:
-        array.append(None)
-    array[idx] = _t(x)
-    return array
-
-
-def array_read(array, i):
-    return array[int(np.asarray(_val(i)))]
-
-
-def array_length(array):
-    return Tensor(np.asarray(len(array), np.int64))
-
-
-_step_counters = {}
-
-
-def autoincreased_step_counter(counter_name=None, begin=1, step=1):
-    """ref: layers/nn.py autoincreased_step_counter — persistable counter
-    bumped per call."""
-    key = counter_name or "@STEP_COUNTER@"
-    val = _step_counters.get(key, begin - step) + step
-    _step_counters[key] = val
-    return Tensor(np.asarray(val, np.int64))
-
-
-# ---------------------------------------------------- seq2seq decoders
-# (ref: fluid/layers/rnn.py Decoder/BasicDecoder + helpers; 2.0 keeps
-# BeamSearchDecoder/dynamic_decode which live in paddle.nn here)
-
 class Decoder:
     """Abstract decoder contract (initialize/step/finalize)."""
 
@@ -235,7 +200,11 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
     if not is_accumulated:
         sv = _val(pre_scores).reshape(-1, 1) + jnp.log(
             jnp.maximum(sv, 1e-20))
-    nb = sv.shape[0] // beam_size if sv.shape[0] % beam_size == 0 else 1
+    # rows not divisible by beam_size = the first decode step (one row per
+    # batch item): each row is its own group — NEVER merge candidates
+    # across batch boundaries (code-review r3c)
+    nb = sv.shape[0] // beam_size if sv.shape[0] % beam_size == 0 \
+        else sv.shape[0]
     v = sv.shape[-1]
     flat = sv.reshape(nb, -1)  # [B, beam*V]
     top_s, top_i = jnp.sort(flat, -1)[:, ::-1][:, :beam_size], \
@@ -341,36 +310,6 @@ def adaptive_pool3d(input, pool_size, pool_type="max",  # noqa: A002
 
 # ------------------------------------------------------------- misc math
 
-def add_position_encoding(input, alpha, beta, name=None):  # noqa: A002
-    """x*alpha + sinusoid(pos)*beta (ref: add_position_encoding_op)."""
-    import jax.numpy as jnp
-
-    def core(xv):
-        b, t, d = xv.shape
-        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
-        div = jnp.exp(jnp.arange(0, d, 2, dtype=jnp.float32)
-                      * (-np.log(10000.0) / d))
-        pe = jnp.zeros((t, d), jnp.float32)
-        pe = pe.at[:, 0::2].set(jnp.sin(pos * div))
-        pe = pe.at[:, 1::2].set(jnp.cos(pos * div[: (d - d // 2)]))
-        return alpha * xv + beta * pe[None]
-
-    return apply_op(core, "add_position_encoding", (_t(input),), {})
-
-
-def affine_channel(x, scale=None, bias=None, data_format="NCHW",
-                   act=None, name=None):
-    import jax.numpy as jnp
-
-    def core(xv, sv, bv):
-        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
-        return xv * sv.reshape(shape) + bv.reshape(shape)
-
-    out = apply_op(core, "affine_channel",
-                   (_t(x), _t(scale), _t(bias)), {})
-    return getattr(_ops, act)(out) if act else out
-
-
 def brelu(x, t_min=0.0, t_max=24.0, name=None):
     return _ops.clip(x, t_min, t_max)
 
@@ -390,19 +329,6 @@ def unique_with_counts(x, dtype="int32"):
     return out, index, counts
 
 
-def im2sequence(input, filter_size=1, stride=1, padding=0,  # noqa: A002
-                input_image_size=None, out_stride=1, name=None):
-    """im2col to [B*out_h*out_w, C*kh*kw] rows (ref: im2sequence_op),
-    dense layout."""
-    from ..nn import functional as F
-    fs = filter_size if isinstance(filter_size, (list, tuple)) \
-        else (filter_size, filter_size)
-    cols = F.unfold(_t(input), list(fs), strides=stride, paddings=padding)
-    cv = _val(cols)  # [B, C*kh*kw, L]
-    import jax.numpy as jnp
-    return Tensor(jnp.swapaxes(cv, 1, 2).reshape(-1, cv.shape[1]))
-
-
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,  # noqa: A002
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
@@ -417,58 +343,6 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,  # noqa
 
 
 # ----------------------------------------------------------------- losses
-
-def bpr_loss(input, label, name=None):  # noqa: A002
-    """Bayesian personalized ranking loss (ref: bpr_loss_op)."""
-    import jax.numpy as jnp
-
-    def core(xv, lv):
-        pos = jnp.take_along_axis(xv, lv.reshape(-1, 1), axis=1)
-        diff = pos - xv  # [B, C]
-        loss = -jnp.log(jax_sigmoid(diff) + 1e-12)
-        mask = jnp.ones_like(xv).at[
-            jnp.arange(xv.shape[0]), lv.reshape(-1)].set(0.0)
-        return ((loss * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0))[:, None]
-
-    def jax_sigmoid(v):
-        import jax
-        return jax.nn.sigmoid(v)
-
-    return apply_op(core, "bpr_loss", (_t(input), _t(label)), {})
-
-
-_center_state = {}
-
-
-def center_loss(input, label, num_classes, alpha, param_attr=None,  # noqa: A002
-                update_center=True):
-    """Center loss (ref: center_loss_op): pull features toward per-class
-    centers; centers update host-side with rate alpha."""
-    import jax.numpy as jnp
-    key = (num_classes, _val(input).shape[-1])
-    centers = _center_state.setdefault(
-        key, np.zeros((num_classes, _val(input).shape[-1]), np.float32))
-    lv = np.asarray(_val(label)).reshape(-1)
-
-    def core(xv, cv):
-        diff = xv - cv[lv]
-        return 0.5 * (diff ** 2).sum(-1, keepdims=True)
-
-    out = apply_op(core, "center_loss",
-                   (_t(input), Tensor(jnp.asarray(centers))), {})
-    if update_center:
-        import jax.core as jcore
-        xv = _val(input)
-        if not isinstance(xv, jcore.Tracer):
-            xa = np.asarray(xv)
-            for c in np.unique(lv):
-                m = lv == c
-                delta = (centers[c] - xa[m]).mean(0)
-                centers[c] -= alpha * delta
-    return out
-
-
-# ---------------------------------------------------------------- metrics
 
 def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
         topk=1, slide_steps=1):
@@ -488,18 +362,27 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
     lv = np.asarray(_val(label)).reshape(-1)
 
     def extract(tags):
+        # the O tag is num_chunk_types*n_tag (ref chunk_eval_op): it is
+        # OUTSIDE every chunk — it terminates the open chunk, never
+        # starts one
         chunks = []
         start = None
         ctype = None
         n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[chunk_scheme]
+        o_tag = num_chunk_types * n_tag
         for i, t in enumerate(tags):
             t = int(t)
+            if t >= o_tag:  # Outside
+                if start is not None:
+                    chunks.append((start, i - 1, ctype))
+                    start = None
+                continue
             tag_type = t % n_tag
             cty = t // n_tag
             begin = (chunk_scheme == "IOB" and tag_type == 0) or \
                 (chunk_scheme == "IOBES" and tag_type in (0, 3)) or \
                 chunk_scheme == "plain"
-            if begin:
+            if begin or (start is not None and cty != ctype):
                 if start is not None:
                     chunks.append((start, i - 1, ctype))
                 start, ctype = i, cty
@@ -566,6 +449,8 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
         order = jnp.argsort(-s)[:nms_top_k]
         b = bv[order]
         s = s[order]
+        # the reference pre-filters below score_threshold BEFORE decay
+        pre = s >= score_threshold
         iou = _iou_matrix(b, b)
         iou = jnp.triu(iou, k=1)
         max_iou = iou.max(0)
@@ -574,7 +459,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
         else:
             decay = (1 - max_iou)
         s2 = s * decay
-        keep = s2 >= post_threshold
+        keep = (s2 >= post_threshold) & pre
         for i in np.nonzero(np.asarray(keep))[0]:
             outs.append([c, float(s2[i]), *np.asarray(b[i])])
     outs.sort(key=lambda r: -r[1])
@@ -599,39 +484,3 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                           background_label=background_label)
 
 
-def polygon_box_transform(input, name=None):  # noqa: A002
-    """Quad-geometry map offsets -> absolute corner coords (ref:
-    polygon_box_transform_op, EAST). [B, 8, H, W]."""
-    import jax.numpy as jnp
-
-    def core(xv):
-        b, c, h, w = xv.shape
-        xs = jnp.arange(w, dtype=xv.dtype)[None, None, None, :]
-        ys = jnp.arange(h, dtype=xv.dtype)[None, None, :, None]
-        is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
-        return jnp.where(is_x, 4 * xs - xv, 4 * ys - xv)
-
-    return apply_op(core, "polygon_box_transform", (_t(input),), {})
-
-
-# -------------------------------------------------------- LoD pass-throughs
-# (dense backend: LoD is the dense padded layout contract of
-# nn/functional/sequence.py — these keep 1.x call sites running)
-
-def lod_reset(x, y=None, target_lod=None):
-    return _t(x)
-
-
-def lod_append(x, level):
-    return _t(x)
-
-
-def reorder_lod_tensor_by_rank(x, rank_table):
-    return _t(x)
-
-
-def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
-          time_major=False, **kwargs):
-    from ..nn.layer.rnn import BiRNN
-    return BiRNN(cell_fw, cell_bw, time_major=time_major)(
-        inputs, initial_states)

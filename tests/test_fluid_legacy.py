@@ -129,6 +129,50 @@ class TestLegacyLayers:
             with pytest.raises(NotImplementedError, match="SURVEY"):
                 cls(None)
 
+    def test_single_source_of_truth_with_nn_functional(self):
+        """code-review r3c: fluid.layers must re-export the canonical
+        nn/functional/legacy implementations, not divergent copies."""
+        import paddle_tpu.nn.functional.legacy as canon
+        for name in ("pad2d", "hash", "smooth_l1", "dynamic_lstm",
+                     "array_write", "center_loss", "add_position_encoding",
+                     "affine_channel", "autoincreased_step_counter"):
+            assert getattr(L, name) is getattr(canon, name), name
+
+    def test_pad2d_orientation_and_hash_run(self):
+        out = L.pad2d(_t(np.ones((1, 1, 2, 2), np.float32)), (1, 0, 0, 0))
+        assert tuple(np.asarray(out.numpy()).shape) == (1, 1, 3, 2)
+        h = np.asarray(L.hash(_t(np.asarray([[3, 7]], np.int64)),
+                              100).numpy())
+        assert (0 <= h).all() and (h < 100).all()
+
+    def test_chunk_eval_outside_tag(self):
+        """code-review r3c: the O tag terminates chunks, never starts one."""
+        tags = _t(np.asarray([0, 1, 2, 0], np.int64))  # B I O B
+        p, r, f1, npc, nlc, tp = L.chunk_eval(tags, tags, "IOB", 1)
+        assert int(np.asarray(nlc.numpy())) == 2
+        assert float(np.asarray(f1.numpy())) == 1.0
+
+    def test_beam_search_first_step_grouping(self):
+        """code-review r3c: rows not divisible by beam_size (first decode
+        step) group per-row — candidates never merge across batch items."""
+        scores = _t(np.asarray([[0.1, 0.9, 0, 0], [0, 0, 0.8, 0.2],
+                                [0.5, 0, 0, 0.4]], np.float32))
+        ids = _t(np.zeros((3, 4), np.int64))
+        sel_ids, sel_scores = L.beam_search(None, _t(np.zeros((3, 1))),
+                                            ids, scores, beam_size=4,
+                                            end_id=0)
+        got = np.asarray(sel_scores.numpy()).reshape(3, 4)
+        # each row's best score survives in its own group
+        np.testing.assert_allclose(got[:, 0], [0.9, 0.8, 0.5])
+
+    def test_matrix_nms_score_threshold_prefilters(self):
+        boxes = np.asarray([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+        scores = np.asarray([[0.9, 0.3]], np.float32)
+        out, n = L.matrix_nms(_t(boxes), _t(scores), score_threshold=0.5,
+                              post_threshold=0.0, nms_top_k=2, keep_top_k=2,
+                              background_label=-1)
+        assert int(np.asarray(n.numpy())[0]) == 1  # 0.3 pre-filtered
+
     def test_chunk_eval_and_auc(self):
         # IOB, 1 chunk type: tags B=0 I=1 O=2
         pred = _t(np.asarray([0, 1, 2, 0], np.int64))
