@@ -181,6 +181,26 @@ class TestHapiDeploy:
             model.save(str(tmp_path / "x"), training=False)
 
 
+class TestFlagshipDeploy:
+    def test_gpt2_tiny_save_load_parity(self, tmp_path):
+        """The flagship transformer (embeddings + attention + tied logits)
+        must survive the StableHLO round-trip — the full deployment story,
+        not just MLPs."""
+        from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+        paddle.seed(13)
+        model = GPT2(GPT2Config.tiny())
+        model.eval()
+        prefix = str(tmp_path / "gpt2")
+        paddle.jit.save(model, prefix,
+                        input_spec=[InputSpec([2, 64], "int64")])
+        ids = np.random.RandomState(6).randint(0, 1024, (2, 64)) \
+            .astype(np.int64)
+        ref = np.asarray(model(Tensor(jnp.asarray(ids))).numpy())
+        loaded = paddle.jit.load(prefix)
+        out = np.asarray(loaded(Tensor(jnp.asarray(ids))).numpy())
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
 class TestQuantizedDeploy:
     def test_save_quantized_model_roundtrip(self, tmp_path):
         """slim.save_quantized_model rides the same artifact path: the int8
