@@ -14,9 +14,18 @@ from ..core import dtype as dtype_mod
 from ._registry import defop
 
 
+def _dim(s):
+    """Normalize a target dim: plain ints stay ints; jax.export symbolic
+    dims (batch-polymorphic jit.save) pass through untouched."""
+    try:
+        return int(s)
+    except Exception:  # symbolic dimension — no concrete value
+        return s
+
+
 @defop()
 def reshape(x, shape):
-    return jnp.reshape(x, tuple(int(s) for s in shape))
+    return jnp.reshape(x, tuple(_dim(s) for s in shape))
 
 
 @defop()
