@@ -185,6 +185,11 @@ def main():
     achieved_flops = tokens_per_sec * flops_per_token
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
     mfu = achieved_flops / peak
+    # attention-inclusive accounting (PaLM appendix, causal /2):
+    # + 6*L*S*d_model per token fwd+bwd — reported for honesty, the
+    # headline mfu keeps the 6N convention for round-over-round comparison
+    attn_ft = 6 * cfg.num_layers * seq * cfg.hidden_size
+    mfu_attn = tokens_per_sec * (flops_per_token + attn_ft) / peak
 
     record = {
         "metric": metric_name if on_tpu
@@ -197,7 +202,8 @@ def main():
         record["degraded"] = True  # TPU probe failed; see stderr probe log
     print(json.dumps(record))
     print(f"# loss={float(loss):.4f} params={n_params/1e6:.1f}M "
-          f"mfu={mfu:.3f} step={dt*1000:.1f}ms batch={batch} backend="
+          f"mfu={mfu:.3f} mfu_attn_incl={mfu_attn:.3f} "
+          f"step={dt*1000:.1f}ms batch={batch} backend="
           f"{jax.default_backend()}", file=sys.stderr)
 
 
