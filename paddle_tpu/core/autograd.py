@@ -26,9 +26,9 @@ _hooks: dict = {}  # id(tensor) -> list[hook]
 
 class Node:
     __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "name", "multi",
-                 "_out_mask")
+                 "_out_mask", "pure_fn")
 
-    def __init__(self, vjp_fn, inputs, outputs, name, multi):
+    def __init__(self, vjp_fn, inputs, outputs, name, multi, pure_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs: List[Tensor] = inputs          # strong refs upstream
         self.out_refs = [weakref.ref(o) for o in outputs]
@@ -36,6 +36,10 @@ class Node:
         self.name = name
         self.multi = multi
         self._out_mask = None  # True per original output position kept as Tensor
+        # primal closure (diff input values -> raw outputs): lets
+        # `grad(create_graph=True)` re-derive the pullback as a *recorded*
+        # op, so grads-of-grads re-enter the tape (double backward)
+        self.pure_fn = pure_fn
 
 
 def grad_enabled() -> bool:
@@ -112,11 +116,22 @@ def _run_hooks(tensor: Tensor, g):
 def _accumulate_leaf(tensor: Tensor, g):
     if tensor.stop_gradient:
         return
+    if isinstance(g, Tensor):
+        # create_graph path: keep the grad's own tape so it can be
+        # differentiated again (paddle.grad(..., create_graph=True))
+        for hook in _hooks.get(id(tensor), []):
+            out = hook(g)
+            if out is not None:
+                g = out if isinstance(out, Tensor) else Tensor(out)
+        tensor.grad = g if tensor.grad is None else tensor.grad + g
+        return
     g = _run_hooks(tensor, g)
     if tensor.grad is None:
         tensor.grad = Tensor(g, stop_gradient=True)
     else:
-        tensor.grad = Tensor(tensor.grad._value + g, stop_gradient=True)
+        grad_val = (tensor.grad._value if isinstance(tensor.grad, Tensor)
+                    else tensor.grad)
+        tensor.grad = Tensor(grad_val + g, stop_gradient=True)
 
 
 def _topo_from(root: Node) -> List[Node]:
@@ -137,8 +152,36 @@ def _topo_from(root: Node) -> List[Node]:
     return order  # post-order: dependencies first; iterate reversed for backward
 
 
+def _recorded_pullback(node: Node, full):
+    """Run `node`'s pullback as a *recorded* framework op, so the returned
+    grads carry their own tape (arbitrary-order differentiation). The op's
+    tensor inputs are the primal diff inputs plus the (possibly graphed)
+    cotangents; inside, the forward is re-linearized with jax.vjp — the
+    recompute is the price of making d(grad)/d(input) exact, residual terms
+    included."""
+    from ..ops._registry import apply_op
+
+    n_in = len(node.inputs)
+    pure_fn = node.pure_fn
+    multi = node.multi
+    out_mask = node._out_mask
+
+    def pb_fn(*vals):
+        xs, cts = vals[:n_in], list(vals[n_in:])
+        _, vjp = jax.vjp(pure_fn, *xs)
+        if out_mask is not None and len(out_mask) != len(cts):
+            it = iter(cts)
+            cts = [next(it) if keep else None for keep in out_mask]
+        ct = tuple(cts) if multi else cts[0]
+        return tuple(vjp(ct))
+
+    args = tuple(node.inputs) + tuple(full)
+    out = apply_op(pb_fn, node.name + "_grad", args, {})
+    return list(out)
+
+
 def backward(tensor: Tensor, grad_tensor: Optional[Tensor] = None,
-             retain_graph: bool = False):
+             retain_graph: bool = False, create_graph: bool = False):
     if grad_tensor is None:
         seed = jnp.ones(tensor._value.shape, tensor._value.dtype)
     else:
@@ -147,16 +190,25 @@ def backward(tensor: Tensor, grad_tensor: Optional[Tensor] = None,
     if tensor._node is None:
         _accumulate_leaf(tensor, seed)
         return
+    if create_graph:
+        retain_graph = True
 
     topo = _topo_from(tensor._node)
-    # node id -> list of cotangents (one slot per output)
+    # node id -> list of cotangents (one slot per output); under
+    # create_graph the slots may hold Tensors (graphed cotangents)
     cots: dict = {}
+
+    def _add_cts(a, b):
+        if isinstance(a, Tensor) or isinstance(b, Tensor):
+            a = a if isinstance(a, Tensor) else Tensor(a)
+            return a + b
+        return a + b
 
     def seed_output(node: Node, t: Tensor, g):
         slots = cots.setdefault(id(node), [None] * len(node.out_refs))
         for i, ref in enumerate(node.out_refs):
             if ref() is t:
-                slots[i] = g if slots[i] is None else slots[i] + g
+                slots[i] = g if slots[i] is None else _add_cts(slots[i], g)
                 return
         raise RuntimeError("tensor not found among its node outputs")
 
@@ -169,19 +221,31 @@ def backward(tensor: Tensor, grad_tensor: Optional[Tensor] = None,
         full = []
         for s, (shape, dtype) in zip(slots, node.out_avals):
             full.append(_zero_cotangent(shape, dtype) if s is None else s)
-        if node._out_mask is not None and len(node._out_mask) != len(full):
-            # re-insert None cotangents for None outputs of the primal fn
-            it = iter(full)
-            full = [next(it) if keep else None for keep in node._out_mask]
-        ct = tuple(full) if node.multi else full[0]
-        in_grads = node.vjp_fn(ct)
+        if create_graph and node.pure_fn is None:
+            # PyLayer etc.: the pullback is an opaque user function — we
+            # cannot re-record it, and silently detaching would make
+            # higher-order grads wrong instead of loud
+            raise RuntimeError(
+                f"op '{node.name}' is not twice differentiable: its backward "
+                "is a user-defined function (PyLayer); create_graph=True "
+                "cannot flow through it")
+        if create_graph:
+            in_grads = _recorded_pullback(node, full)
+        else:
+            raw = [g._value if isinstance(g, Tensor) else g for g in full]
+            if node._out_mask is not None and len(node._out_mask) != len(raw):
+                # re-insert None cotangents for None outputs of the primal fn
+                it = iter(raw)
+                raw = [next(it) if keep else None for keep in node._out_mask]
+            ct = tuple(raw) if node.multi else raw[0]
+            in_grads = node.vjp_fn(ct)
         for t, g in zip(node.inputs, in_grads):
             if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
                 continue
             if t._node is not None:
                 seed_output(t._node, t, g)
                 if id(t) in _hooks:
-                    _run_hooks(t, g)
+                    _run_hooks(t, g._value if isinstance(g, Tensor) else g)
             else:
                 _accumulate_leaf(t, g)
         if not retain_graph:
@@ -211,7 +275,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         t.stop_gradient = False
     try:
         for o, go in zip(outputs, gos):
-            backward(o, go, retain_graph=True if retain_graph is None else retain_graph)
+            backward(o, go,
+                     retain_graph=True if retain_graph is None else retain_graph,
+                     create_graph=create_graph)
         result = []
         for t in inputs:
             if t.grad is None:

@@ -36,14 +36,15 @@ def _flatten(args, kwargs):
     return leaves, treedef
 
 
-def _wrap_outputs(res, record_node, name, diff_tensors, vjp_fn):
+def _wrap_outputs(res, record_node, name, diff_tensors, vjp_fn,
+                  pure_fn=None):
     multi = isinstance(res, (tuple, list))
     outs_raw = list(res) if multi else [res]
     outs = [None if o is None else Tensor(o, stop_gradient=not record_node)
             for o in outs_raw]
     if record_node:
         live = [o for o in outs if o is not None]
-        node = Node(vjp_fn, diff_tensors, live, name, multi)
+        node = Node(vjp_fn, diff_tensors, live, name, multi, pure_fn=pure_fn)
         node._out_mask = [o is not None for o in outs]
         for o in live:
             o._node = node
@@ -114,7 +115,7 @@ def apply_op(fn, name, args, kwargs, nondiff=False, stochastic=False):
         return fn(*a2, **k2)
 
     res, vjp_fn = jax.vjp(pure, *[t._value for t in diff_tensors])
-    return _wrap_outputs(res, True, name, diff_tensors, vjp_fn)
+    return _wrap_outputs(res, True, name, diff_tensors, vjp_fn, pure_fn=pure)
 
 
 def defop(name=None, nondiff=False, stochastic=False):

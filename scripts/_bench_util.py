@@ -17,6 +17,41 @@ def sync(out):
     float(jax.device_get(jnp.sum(leaves[0]).astype(jnp.float32)))
 
 
+def gpt2_amp_setup():
+    """Shared GPT-2-small AMP harness for the perf sections: returns
+    (cfg, params0, amp_loss, make_data) with the exact bf16-compute /
+    f32-master recipe bench.py times, so every sweep measures the same
+    configuration as the headline bench."""
+    import numpy as np
+
+    from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
+
+    cfg = GPT2Config()
+    cfg.dropout = 0.0
+    loss_fn, init_params, _ = build_train_step(cfg, remat=False)
+    params0 = init_params()
+
+    def _to_bf16(x):
+        return x.astype(jnp.bfloat16) \
+            if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+    def amp_loss(p32, data, key):
+        pb = jax.tree_util.tree_map(_to_bf16, p32)
+        return loss_fn(pb, data, key).astype(jnp.float32)
+
+    rng = np.random.RandomState(0)
+
+    def make_data(batch, seq=1024):
+        return {
+            "input_ids": jnp.asarray(rng.randint(
+                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+            "labels": jnp.asarray(rng.randint(
+                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
+        }
+
+    return cfg, params0, amp_loss, make_data
+
+
 def scan_time(step_of_carry, carry0, inner=20, reps=3):
     """Best per-iteration wall time of `inner` chained iterations in one
     dispatch. step_of_carry: carry -> carry (make the compute depend on

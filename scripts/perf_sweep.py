@@ -28,35 +28,18 @@ def section_model(batch_sizes=(8, 16, 24)):
     import jax
     import jax.numpy as jnp
     from paddle_tpu import optimizer as opt_mod
-    from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
 
-    cfg = GPT2Config()
-    cfg.dropout = 0.0
-    loss_fn, init_params, _ = build_train_step(cfg, remat=False)
-    params0 = init_params()
+    from _bench_util import gpt2_amp_setup
+    _cfg, params0, amp_loss, make_data = gpt2_amp_setup()
     n_params = sum(int(np.prod(v.shape)) for v in params0.values())
-
-    def _to_bf16(x):
-        return x.astype(jnp.bfloat16) \
-            if jnp.issubdtype(x.dtype, jnp.floating) else x
-
-    def amp_loss(p32, data, key):
-        pb = jax.tree_util.tree_map(_to_bf16, p32)
-        return loss_fn(pb, data, key).astype(jnp.float32)
 
     optimizer = opt_mod.AdamW(learning_rate=1e-4, weight_decay=0.01)
 
-    rng = np.random.RandomState(0)
     for batch in batch_sizes:
         seq = 1024
-        data = {
-            "input_ids": jnp.asarray(rng.randint(
-                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
-            "labels": jnp.asarray(rng.randint(
-                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
-        }
+        data = make_data(batch, seq)
         key = jax.random.key(0)
-        params = init_params()
+        params = params0
         opt_state = optimizer.functional_init(params)
         inner = 10
 
@@ -169,10 +152,67 @@ def section_longseq():
           f"(~{flops/t/1e12:.1f} TFLOP/s)", flush=True)
 
 
+def section_ablate(batch=16):
+    """Attention-share decomposition: time the GPT-2 fwd and fwd+bwd with
+    (a) the Pallas flash path, (b) plain-XLA attention, (c) attention
+    replaced by identity (v passthrough). (c)-(a) is the exact wall-clock
+    the attention layers cost inside the real model — the number the
+    microbenches only estimate."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.ops as P_ops
+    from paddle_tpu.ops.attention import scaled_dot_product_attention as sdpa
+
+    from _bench_util import gpt2_amp_setup
+    _cfg, params0, amp_loss, make_data = gpt2_amp_setup()
+    data = make_data(batch)
+    key = jax.random.key(0)
+
+    def identity_attn(q, k, v, attn_mask=None, dropout_p=0.0,
+                      is_causal=False, scale=None, **kw):
+        return v, None
+
+    def xla_attn(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                 scale=None, **kw):
+        from paddle_tpu.ops.attention import _xla_attention
+        out, _w = _xla_attention(q, k, v, mask=attn_mask, scale=scale,
+                                 causal=is_causal)
+        return out, None
+
+    variants = [("flash", sdpa), ("xla", xla_attn),
+                ("identity", identity_attn)]
+    orig = P_ops.scaled_dot_product_attention
+    try:
+        for name, impl in variants:
+            P_ops.scaled_dot_product_attention = impl
+
+            def fwd_step(c):
+                p2 = dict(params0)
+                k0 = next(iter(p2))
+                p2[k0] = p2[k0] + (c * 1e-30).astype(p2[k0].dtype)
+                return amp_loss(p2, data, key)
+
+            t_f = _scan_timer(fwd_step, jnp.zeros((), jnp.float32))
+
+            def bwd_step(c):
+                p2 = dict(params0)
+                k0 = next(iter(p2))
+                p2[k0] = p2[k0] + (c * 1e-30).astype(p2[k0].dtype)
+                _, g = jax.value_and_grad(amp_loss)(p2, data, key)
+                return g[k0].astype(jnp.float32).mean()
+
+            t_b = _scan_timer(bwd_step, jnp.zeros((), jnp.float32))
+            print(f"ablate[{name}] batch={batch}: fwd={t_f*1e3:.1f}ms "
+                  f"fwd+bwd={t_b*1e3:.1f}ms", flush=True)
+    finally:
+        P_ops.scaled_dot_product_attention = orig
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--section", default="all",
-                    choices=["all", "model", "blocks", "longseq"])
+                    choices=["all", "model", "blocks", "longseq", "ablate"])
     ap.add_argument("--batches", default="8,16,24")
     args = ap.parse_args()
     import jax
@@ -184,6 +224,8 @@ def main():
         section_longseq()
     if args.section in ("all", "model"):
         section_model(tuple(int(x) for x in args.batches.split(",")))
+    if args.section in ("all", "ablate"):
+        section_ablate()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,79 @@
+"""Higher-order autograd: paddle.grad(create_graph=True) must return grads
+that are themselves differentiable (ref: the imperative engine's double-grad
+support, python/paddle/fluid/dygraph/base.py grad(create_graph=...), used by
+GAN gradient penalties). Rebuild: backward re-runs each node's pullback as a
+recorded op (jax.vjp re-linearization), so grads re-enter the tape."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_second_and_third_order():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0])  # 3x^2
+    (g2,) = paddle.grad(g, [x], create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), [12.0])  # 6x
+    (g3,) = paddle.grad(g2, [x])
+    np.testing.assert_allclose(g3.numpy(), [6.0])
+
+
+def test_gradient_penalty_pattern():
+    # d/dx of (dy/dx)^2 — the WGAN-GP shape: grads feed a new loss
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (h,) = paddle.grad(y, [x], create_graph=True)
+    pen = (h * h).sum()
+    (hp,) = paddle.grad(pen, [x])
+    np.testing.assert_allclose(hp.numpy(), [288.0])  # 36x^3
+
+
+def test_mixed_partial():
+    a = paddle.to_tensor([3.0], stop_gradient=False)
+    b = paddle.to_tensor([5.0], stop_gradient=False)
+    f = a * a * b
+    (ga,) = paddle.grad(f, [a], create_graph=True)
+    (gab,) = paddle.grad(ga, [b])
+    np.testing.assert_allclose(gab.numpy(), [6.0])  # d2f/da db = 2a
+
+
+def test_double_grad_through_layer():
+    # second-order through a real layer stack (Linear + activation)
+    paddle.seed(7)
+    lin = paddle.nn.Linear(4, 1)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32), stop_gradient=False)
+    y = paddle.nn.functional.tanh(lin(x)).sum()
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    gnorm = (gx * gx).sum()
+    (ggx,) = paddle.grad(gnorm, [x], allow_unused=False)
+    # finite-difference cross-check of d(|dy/dx|^2)/dx[0,0]
+    eps = 1e-3
+
+    def gnorm_at(v00):
+        xv = np.ones((2, 4), np.float32)
+        xv[0, 0] = v00
+        xt = paddle.to_tensor(xv, stop_gradient=False)
+        yt = paddle.nn.functional.tanh(lin(xt)).sum()
+        (g,) = paddle.grad(yt, [xt])
+        return float((g * g).sum().numpy())
+
+    fd = (gnorm_at(1.0 + eps) - gnorm_at(1.0 - eps)) / (2 * eps)
+    np.testing.assert_allclose(float(ggx.numpy()[0, 0]), fd, rtol=2e-2,
+                               atol=1e-4)
+
+
+def test_first_order_unchanged_without_create_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert g.stop_gradient  # detached by default, as before
+
+
+def test_backward_accumulation_not_regressed():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
