@@ -1,6 +1,7 @@
 #!/bin/bash
-# Wait for the axon TPU tunnel to recover, then run the perf work:
-# bench.py (scan-based) + attention compare + model batch sweep + longseq.
+# Wait for the axon TPU tunnel to recover, then run the perf measurement set
+# in diagnostic order: raw-op envelope first (is the GEMM ceiling even
+# reachable?), then the in-model attention share, then the bench.
 cd /root/repo
 for i in $(seq 1 300); do
   if timeout 150 python -c "
@@ -8,16 +9,20 @@ import jax, jax.numpy as jnp
 x = jnp.ones((256,256)) @ jnp.ones((256,256))
 print('PROBE_OK', float(jax.device_get(jnp.sum(x))))" 2>/dev/null | grep -q PROBE_OK; then
     echo "=== tunnel up after $i probes $(date) ==="
-    echo "=== bench.py ==="
-    timeout 1200 python bench.py 2>&1 | grep -v WARNING
+    echo "=== raw op envelope (GEMM ceiling, exp rate) ==="
+    timeout 1200 python scripts/raw_ops_bench.py 2>&1 | grep -v WARNING
+    echo "=== attention share ablation (flash/xla/identity in-model) ==="
+    timeout 1500 python scripts/perf_sweep.py --section ablate 2>&1 | grep -v WARNING
     echo "=== attn compare (dtype-correct) ==="
     timeout 1200 python scripts/attn_compare.py 2>&1 | grep -v WARNING
+    echo "=== bench.py ==="
+    timeout 1200 python bench.py 2>&1 | grep -v WARNING
     echo "=== longseq streaming bwd ==="
     timeout 900 python scripts/perf_sweep.py --section longseq 2>&1 | grep -v WARNING
-    echo "=== model batch sweep ==="
-    timeout 1500 python scripts/perf_sweep.py --section model --batches 8,16,24 2>&1 | grep -v WARNING
     echo "=== blocks sweep (dtype-correct) ==="
     timeout 1500 python scripts/perf_sweep.py --section blocks 2>&1 | grep -v WARNING
+    echo "=== model batch sweep ==="
+    timeout 1500 python scripts/perf_sweep.py --section model --batches 8,16,24 2>&1 | grep -v WARNING
     echo "=== done $(date) ==="
     exit 0
   fi
