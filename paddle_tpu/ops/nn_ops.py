@@ -604,8 +604,13 @@ def alpha_dropout(x, p=0.5, training=True, key=None):
 @defop()
 def embedding(ids, weight, padding_idx=None, sparse=False):
     if padding_idx is not None:
+        vocab = weight.shape[0]
+        if not -vocab <= padding_idx < vocab:  # reference range-checks
+            raise ValueError(
+                f"padding_idx must be within [-{vocab}, {vocab}), "
+                f"got {padding_idx}")
         if padding_idx < 0:  # reference normalizes negative indices
-            padding_idx += weight.shape[0]
+            padding_idx += vocab
         # padding row contributes no gradient (ref: lookup_table_v2_op padding_idx)
         frozen_row = jax.lax.stop_gradient(weight[padding_idx])
         weight = weight.at[padding_idx].set(frozen_row)

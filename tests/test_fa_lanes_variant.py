@@ -1,0 +1,59 @@
+"""Lane-replicated flash-forward variant (PADDLE_TPU_FA_LANES=1): online
+softmax state kept as [bq, 128] replicated registers (the stock TPU layout)
+instead of [bq, 1] slices. Must match the default kernel and the reference
+attention exactly; interpret-mode covers numerics (the layout effect is an
+on-chip A/B, scripts/perf_sweep.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops.pallas.flash_attention as fa
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_lanes_variant_matches_default(monkeypatch, causal):
+    rs = np.random.RandomState(0)
+    b, h, s, d = 2, 3, 256, 64
+    q = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+
+    ref = fa._reference_attention(q, k, v, d ** -0.5, causal)
+
+    monkeypatch.setattr(fa, "_FA_LANES", False)
+    out_def, lse_def = fa._flash_fwd_lse(q, k, v, d ** -0.5, causal,
+                                         128, 128, True)
+    monkeypatch.setattr(fa, "_FA_LANES", True)
+    out_ln, lse_ln = fa._flash_fwd_lse(q, k, v, d ** -0.5, causal,
+                                       128, 128, True)
+
+    np.testing.assert_allclose(np.asarray(out_ln), np.asarray(out_def),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse_ln), np.asarray(lse_def),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_ln), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_lanes_variant_backward_parity(monkeypatch):
+    # the bwd kernels consume the lse the lanes-variant fwd produced —
+    # end-to-end grad must match the default path
+    rs = np.random.RandomState(1)
+    b, h, s, d = 1, 2, 256, 64
+    q = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, h, s, d).astype(np.float32))
+
+    def loss(q, k, v):
+        return fa.flash_attention(q, k, v, True, None, 128, 128,
+                                  True).sum()
+
+    monkeypatch.setattr(fa, "_FA_LANES", False)
+    g_def = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setattr(fa, "_FA_LANES", True)
+    g_ln = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_def, g_ln):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-5, atol=2e-5)

@@ -1,0 +1,55 @@
+"""WGAN-GP smoke: the gradient-penalty loss needs grads that are themselves
+differentiable (paddle.grad(create_graph=True)) — the canonical double-grad
+consumer (ref: dygraph double-grad tests / gan applications)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_wgan_gp_step_decreases_critic_loss():
+    paddle.seed(11)
+    rs = np.random.RandomState(3)
+
+    critic = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=critic.parameters())
+
+    real = rs.randn(16, 8).astype(np.float32) + 1.5
+    fake = rs.randn(16, 8).astype(np.float32) - 1.5
+
+    def critic_loss():
+        xr = paddle.to_tensor(real)
+        xf = paddle.to_tensor(fake)
+        # interpolates require grads for the penalty
+        eps = paddle.to_tensor(rs.rand(16, 1).astype(np.float32))
+        xi = paddle.to_tensor(
+            (eps.numpy() * real + (1 - eps.numpy()) * fake),
+            stop_gradient=False)
+        d_real = critic(xr).mean()
+        d_fake = critic(xf).mean()
+        d_xi = critic(xi).sum()
+        (gx,) = paddle.grad(d_xi, [xi], create_graph=True)
+        gnorm = ((gx * gx).sum(axis=1) + 1e-12).sqrt()
+        penalty = ((gnorm - 1.0) ** 2).mean()
+        return d_fake - d_real + 10.0 * penalty
+
+    def separation():
+        d_r = critic(paddle.to_tensor(real)).mean()
+        d_f = critic(paddle.to_tensor(fake)).mean()
+        return float((d_r - d_f).numpy())
+
+    sep0 = separation()
+    for _ in range(12):
+        loss = critic_loss()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss.numpy()))
+
+    # the critic must learn to separate real from fake on this toy; the
+    # loss itself is noisy (fresh eps each step), so assert the estimated
+    # Wasserstein separation instead
+    # (the GP's Lipschitz constraint bounds how fast separation can grow;
+    # +0.3 over 12 steps is the observed reliable margin at this lr)
+    assert separation() > sep0 + 0.3, (sep0, separation())
