@@ -126,6 +126,169 @@ class GPT2(nn.Layer):
             ops.reshape(logits, [-1, self.cfg.vocab_size]),
             ops.reshape(labels, [-1]))
 
+    def generate(self, input_ids, max_new_tokens, temperature=0.0,
+                 eos_token_id=None, seed=0):
+        """Autoregressive decoding with a KV cache (serving path; ref
+        capability: fluid beam_search/sampling decode ops). TPU-first:
+        static shapes throughout — prefill compiles once per prompt shape,
+        then a `lax.scan` emits one token per step against a fixed-size
+        cache, so the whole generate is two XLA computations regardless of
+        token count. temperature=0 is greedy; >0 samples."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core.tensor import Tensor
+
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(np.asarray(input_ids))
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 0:
+            raise ValueError("max_new_tokens must be >= 0")
+        if max_new_tokens == 0:
+            return Tensor(ids, stop_gradient=True)
+        if ids.shape[1] + max_new_tokens > self.cfg.max_position:
+            raise ValueError(
+                f"prompt ({ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_position "
+                f"({self.cfg.max_position})")
+        params, _ = self.functional_state()
+        out = _generate_jit(self.cfg, params, ids, max_new_tokens,
+                            float(temperature),
+                            -1 if eos_token_id is None else int(eos_token_id),
+                            int(seed))
+        return Tensor(out, stop_gradient=True)
+
+
+def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed):
+    import jax
+
+    spec = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
+            cfg.layer_norm_epsilon, cfg.tie_embeddings)
+    fn = _generate_impl(spec, max_new, temp, eos)
+    # the PRNG key is a traced argument: new seeds reuse the compiled
+    # program instead of recompiling the whole prefill + decode scan
+    return fn(params, ids, jax.random.key(seed))
+
+
+import functools as _functools  # noqa: E402
+
+
+@_functools.lru_cache(maxsize=16)
+def _generate_impl(spec, max_new, temp, eos):
+    """Build + jit the (params, ids, key) -> tokens decode program for one
+    static configuration. Two XLA computations total: a prefill over the
+    prompt and a lax.scan of single-token steps against a fixed-size KV
+    cache [L, B, H, S0+max_new, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    L, H, Dh, E, eps, tied = spec
+    scale = Dh ** -0.5
+
+    def ln(x, w, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+    def mlp(p, i, x):
+        hdn = jax.nn.gelu(x @ p[f"h.{i}.fc1.weight"] + p[f"h.{i}.fc1.bias"],
+                          approximate=True)
+        return hdn @ p[f"h.{i}.fc2.weight"] + p[f"h.{i}.fc2.bias"]
+
+    def qkv_split(p, i, a):
+        # a: [..., E] -> q, k, v each [..., H, Dh]
+        qkv = a @ p[f"h.{i}.qkv_proj.weight"] + p[f"h.{i}.qkv_proj.bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        new = q.shape[:-1] + (H, Dh)
+        return q.reshape(new), k.reshape(new), v.reshape(new)
+
+    def step_fn(params, ids, key0):
+        B, S0 = ids.shape
+        S = S0 + max_new
+        wte = params["wte.weight"]
+        wpe = params["wpe.weight"]
+        dt = wte.dtype
+
+        def head(xf):
+            w = wte.T if tied else params["lm_head.weight"]
+            return (xf @ w).astype(jnp.float32)
+
+        # ---- prefill over the prompt (causal full attention) ----
+        x = wte[ids] + wpe[jnp.arange(S0)]
+        ck = jnp.zeros((L, B, H, S, Dh), dt)
+        cv = jnp.zeros((L, B, H, S, Dh), dt)
+        causal = jnp.tril(jnp.ones((S0, S0), bool))
+        for i in range(L):
+            a = ln(x, params[f"h.{i}.ln_1.weight"],
+                   params[f"h.{i}.ln_1.bias"])
+            q, k, v = qkv_split(params, i, a)       # [B, S0, H, Dh]
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            ck = ck.at[i, :, :, :S0].set(k)
+            cv = cv.at[i, :, :, :S0].set(v)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32) * scale
+            s = jnp.where(causal, s, -1e30)
+            w = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S0, E)
+            x = x + o @ params[f"h.{i}.out_proj.weight"] \
+                + params[f"h.{i}.out_proj.bias"]
+            m = ln(x, params[f"h.{i}.ln_2.weight"],
+                   params[f"h.{i}.ln_2.bias"])
+            x = x + mlp(params, i, m)
+        xf = ln(x[:, -1], params["ln_f.weight"], params["ln_f.bias"])
+        logits0 = head(xf)
+
+        def pick(logits, key):
+            if temp > 0.0:
+                return jax.random.categorical(key, logits / temp, axis=-1)
+            return jnp.argmax(logits, axis=-1)
+
+        key0, sub0 = jax.random.split(key0)
+        tok0 = pick(logits0, sub0).astype(jnp.int32)
+        done0 = (tok0 == eos) if eos >= 0 else jnp.zeros(B, bool)
+
+        # ---- decode: one token per scan step against the cache ----
+        def body(carry, step):
+            tok, done, ck, cv, key = carry
+            t = S0 + step  # absolute position of `tok`
+            x = wte[tok] + wpe[t]                   # [B, E]
+            for i in range(L):
+                a = ln(x, params[f"h.{i}.ln_1.weight"],
+                       params[f"h.{i}.ln_1.bias"])
+                q, k, v = qkv_split(params, i, a)   # [B, H, Dh]
+                ck = ck.at[i, :, :, t].set(k)
+                cv = cv.at[i, :, :, t].set(v)
+                s = jnp.einsum("bhd,bhsd->bhs", q, ck[i]).astype(
+                    jnp.float32) * scale
+                s = jnp.where(jnp.arange(s.shape[-1]) <= t, s, -1e30)
+                w = jax.nn.softmax(s, axis=-1).astype(dt)
+                o = jnp.einsum("bhs,bhsd->bhd", w, cv[i]).reshape(B, E)
+                x = x + o @ params[f"h.{i}.out_proj.weight"] \
+                    + params[f"h.{i}.out_proj.bias"]
+                m = ln(x, params[f"h.{i}.ln_2.weight"],
+                       params[f"h.{i}.ln_2.bias"])
+                x = x + mlp(params, i, m)
+            xf = ln(x, params["ln_f.weight"], params["ln_f.bias"])
+            logits = head(xf)
+            key, sub = jax.random.split(key)
+            nxt = pick(logits, sub).astype(jnp.int32)
+            if eos >= 0:
+                nxt = jnp.where(done, eos, nxt)
+                done = done | (nxt == eos)
+            return (nxt, done, ck, cv, key), tok
+
+        (last, _, _, _, _), toks = jax.lax.scan(
+            body, (tok0, done0, ck, cv, key0),
+            jnp.arange(max_new - 1)) if max_new > 1 else \
+            ((tok0, None, None, None, None), jnp.zeros((0, B), jnp.int32))
+        seq = jnp.concatenate([ids, toks.T.astype(jnp.int32),
+                               last[:, None]], axis=1)
+        return seq
+
+    return jax.jit(step_fn)
+
 
 def build_train_step(cfg: GPT2Config, remat=False, dtype="float32"):
     """Pure functional GPT-2 loss for pjit/fleet: returns
