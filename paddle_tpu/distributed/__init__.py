@@ -12,6 +12,7 @@ from .collective import (  # noqa: F401
 )
 from .parallel import DataParallel  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import ps  # noqa: F401
 

@@ -1,0 +1,223 @@
+"""Sharded distributed checkpointing (SURVEY §2.36 at scale).
+
+`paddle.save` materializes every array on one host — correct on a single
+process, but a dp/mp-sharded train state on a multi-host mesh is neither
+addressable nor affordable there. This module writes each process's
+ADDRESSABLE shards only (the multi-host contract: every host writes its own
+slice, no cross-host gather; replicated slabs are written once, by their
+replica-0 owner), with an index describing global shape/dtype and the saved
+slab layout; load reassembles lazily per target device via
+`jax.make_array_from_callback`, so a checkpoint can be loaded into a
+DIFFERENT mesh/sharding than it was saved from (reshard-on-load).
+
+Consistency model: every save stamps a fresh `save_id` into its per-process
+index and shard filenames; the per-process index is written last (write +
+atomic rename). `load` merges ONLY the index parts carrying the newest
+save_id and raises if fewer parts than the recorded `process_count` are
+present — a crash mid-save or a stale mix from an older save is detected
+instead of silently loading mixed-version weights.
+
+Ref lineage: fleet checkpoint utils (python/paddle/distributed/fleet/utils/
+fs.py + meta_optimizers' checkpoint hooks); design is jax.Array-native
+instead of per-rank file copies.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import re as _re
+import uuid
+
+import numpy as np
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def _flatten(state):
+    """(key -> leaf, treedef) via tree_util paths — handles dicts, lists,
+    tuples AND namedtuples (typical optimizer state) uniformly."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(state)
+    return {jax.tree_util.keystr(p): v for p, v in paths}, treedef
+
+
+def save(state, ckpt_dir, process_index=None):
+    """Write this process's addressable shards of `state` (a pytree of
+    jax.Arrays / Tensors / scalars) under `ckpt_dir`. Every process calls
+    this. Shard files carry a per-save id; the per-process index is
+    renamed into place last, so readers never observe a partial save as
+    current."""
+    if process_index is None:
+        process_index = jax.process_index()
+    os.makedirs(ckpt_dir, exist_ok=True)
+    save_id = uuid.uuid4().hex[:12]
+    flat, _ = _flatten(state)
+    index = {"__meta__": {"save_id": save_id,
+                          "process_count": jax.process_count()}}
+    for key, val in flat.items():
+        if isinstance(val, Tensor):
+            val = val._value
+        if not isinstance(val, jax.Array):
+            index[key] = {"scalar": val}
+            continue
+        shards = []
+        for sh in val.addressable_shards:
+            if sh.replica_id != 0:
+                continue  # replicated slab: its replica-0 owner writes it
+            starts = tuple(0 if s.start is None else int(s.start)
+                           for s in sh.index)
+            stops = tuple(val.shape[d] if s.stop is None else int(s.stop)
+                          for d, s in enumerate(sh.index))
+            safe_key = key.replace("/", "_").replace("'", "").replace(
+                "[", ".").replace("]", "")
+            fname = (f"{safe_key}.{save_id}.p{process_index}"
+                     f".{'_'.join(map(str, starts))}.npy")
+            tmp = os.path.join(ckpt_dir, fname + ".tmp")
+            with open(tmp, "wb") as f:  # np.save(path) would append .npy
+                np.save(f, np.asarray(sh.data))
+            os.replace(tmp, os.path.join(ckpt_dir, fname))
+            shards.append({"starts": starts, "stops": stops,
+                           "file": fname})
+        index[key] = {"shape": tuple(val.shape), "dtype": str(val.dtype),
+                      "shards": shards}
+    ipath = os.path.join(ckpt_dir, f"index.p{process_index}.pkl")
+    with open(ipath + ".tmp", "wb") as f:
+        pickle.dump(index, f, protocol=4)
+    os.replace(ipath + ".tmp", ipath)
+    # best-effort cleanup: THIS process's files from older saves, and (on
+    # process 0) leftovers from ranks beyond the current process count
+    # (e.g. a 4-host save resumed as 2 hosts)
+    count = jax.process_count()
+    for fn in os.listdir(ckpt_dir):
+        stale_own = (fn.endswith(".npy") and f".p{process_index}." in fn
+                     and f".{save_id}." not in fn)
+        stale_rank = False
+        if process_index == 0:
+            if fn.startswith("index.p") and fn.endswith(".pkl"):
+                try:
+                    stale_rank = int(fn[len("index.p"):-len(".pkl")]) \
+                        >= count
+                except ValueError:
+                    pass
+            elif fn.endswith(".npy"):
+                m = _re.search(r"\.p(\d+)\.", fn)
+                if m and int(m.group(1)) >= count:
+                    stale_rank = True
+        if stale_own or stale_rank:
+            try:
+                os.remove(os.path.join(ckpt_dir, fn))
+            except OSError:
+                pass
+
+
+def _merged_index(ckpt_dir):
+    parts = []
+    for p in sorted(os.listdir(ckpt_dir)):
+        if p.startswith("index.p") and p.endswith(".pkl"):
+            with open(os.path.join(ckpt_dir, p), "rb") as f:
+                parts.append(pickle.load(f))
+    if not parts:
+        raise FileNotFoundError(f"no index.p*.pkl in {ckpt_dir}")
+    by_id: dict = {}
+    for part in parts:
+        by_id.setdefault(part["__meta__"]["save_id"], []).append(part)
+    # a save is loadable only if ALL its process indexes are present; a
+    # newer save overwrites index.p0..pN-1, so at most one save_id can be
+    # complete at a time — stale leftovers from older/larger runs are
+    # incomplete by construction and ignored
+    complete = [(sid, ps) for sid, ps in by_id.items()
+                if len(ps) == ps[0]["__meta__"]["process_count"]]
+    if not complete:
+        sid, ps = max(by_id.items(), key=lambda kv: len(kv[1]))
+        raise ValueError(
+            f"checkpoint {ckpt_dir} has no complete save: best candidate "
+            f"{sid} has {len(ps)}/"
+            f"{ps[0]['__meta__']['process_count']} process indexes "
+            "(crashed save or missing files)")
+    if len(complete) > 1:
+        raise ValueError(
+            f"checkpoint {ckpt_dir} holds {len(complete)} complete saves "
+            "— directory was shared between unrelated runs")
+    save_id, chosen = complete[0]
+    merged: dict = {}
+    for part in chosen:
+        for key, meta in part.items():
+            if key == "__meta__":
+                continue
+            if key not in merged:
+                merged[key] = dict(meta)
+            elif "shards" in meta:
+                have = {tuple(s["starts"]) for s in merged[key]["shards"]}
+                merged[key]["shards"] += [
+                    s for s in meta["shards"]
+                    if tuple(s["starts"]) not in have]
+    return merged
+
+
+def load(ckpt_dir, like):
+    """Rebuild the checkpoint into the structure AND shardings of `like`
+    (a pytree whose array leaves are jax.Arrays with target shardings —
+    e.g. the freshly-initialized sharded train state). Each target device
+    reads only the saved slabs overlapping its shard, so loading neither
+    gathers globally nor requires the saved and target meshes to match."""
+    index = _merged_index(ckpt_dir)
+    flat_like, treedef = _flatten(like)
+    out = []
+    for key, tgt in flat_like.items():
+        meta = index.get(key)
+        if meta is None:
+            raise KeyError(f"checkpoint {ckpt_dir} has no entry '{key}'")
+        if "scalar" in meta:
+            out.append(meta["scalar"])
+            continue
+        was_tensor = isinstance(tgt, Tensor)
+        tgt_arr = tgt._value if was_tensor else tgt
+        shape = tuple(meta["shape"])
+        if tuple(tgt_arr.shape) != shape:
+            raise ValueError(f"shape mismatch for '{key}': checkpoint "
+                             f"{shape} vs target {tuple(tgt_arr.shape)}")
+        if str(tgt_arr.dtype) != meta["dtype"]:
+            raise ValueError(
+                f"dtype mismatch for '{key}': checkpoint {meta['dtype']} "
+                f"vs target {tgt_arr.dtype} — cast explicitly after load")
+        dtype = np.dtype(jax.numpy.dtype(meta["dtype"]))
+        slabs = [(tuple(s["starts"]), tuple(s["stops"]), s["file"])
+                 for s in meta["shards"]]
+        files: dict = {}
+
+        def read(fname, _files=files):
+            if fname not in _files:
+                _files[fname] = np.load(os.path.join(ckpt_dir, fname),
+                                        mmap_mode="r")
+            return _files[fname]
+
+        def cb(idx, *, _slabs=slabs, _shape=shape, _dtype=dtype,
+               _read=read):
+            starts = tuple(0 if s.start is None else int(s.start)
+                           for s in idx)
+            stops = tuple(_shape[d] if s.stop is None else int(s.stop)
+                          for d, s in enumerate(idx))
+            block = np.empty([b - a for a, b in zip(starts, stops)],
+                             _dtype)
+            filled = np.zeros(block.shape, bool)
+            for sst, ssp, fname in _slabs:
+                inter_a = [max(a, b) for a, b in zip(starts, sst)]
+                inter_b = [min(a, b) for a, b in zip(stops, ssp)]
+                if any(a >= b for a, b in zip(inter_a, inter_b)):
+                    continue
+                src = tuple(slice(a - o, b - o)
+                            for a, b, o in zip(inter_a, inter_b, sst))
+                dst = tuple(slice(a - o, b - o)
+                            for a, b, o in zip(inter_a, inter_b, starts))
+                block[dst] = _read(fname)[src]
+                filled[dst] = True
+            if not filled.all():
+                raise ValueError(
+                    "checkpoint shards do not cover the requested slice "
+                    "(multi-host load missing files?)")
+            return block
+
+        arr = jax.make_array_from_callback(shape, tgt_arr.sharding, cb)
+        out.append(Tensor(arr) if was_tensor else arr)
+    return jax.tree_util.tree_unflatten(treedef, out)

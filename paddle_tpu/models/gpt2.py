@@ -153,7 +153,7 @@ class GPT2(nn.Layer):
                 f"({self.cfg.max_position})")
         params, _ = self.functional_state()
         out = _generate_jit(self.cfg, params, ids, max_new_tokens,
-                            float(temperature),
+                            temperature,
                             -1 if eos_token_id is None else int(eos_token_id),
                             int(seed))
         return Tensor(out, stop_gradient=True)
@@ -161,25 +161,28 @@ class GPT2(nn.Layer):
 
 def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed):
     import jax
+    import jax.numpy as jnp
 
     spec = (cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
             cfg.layer_norm_epsilon, cfg.tie_embeddings)
-    fn = _generate_impl(spec, max_new, temp, eos)
-    # the PRNG key is a traced argument: new seeds reuse the compiled
-    # program instead of recompiling the whole prefill + decode scan
-    return fn(params, ids, jax.random.key(seed))
+    fn = _generate_impl(spec, max_new)
+    # key/temperature/eos are traced arguments: new seeds, temperatures or
+    # eos ids reuse the compiled program instead of recompiling the whole
+    # prefill + decode scan (only max_new — the scan length — is static)
+    return fn(params, ids, jax.random.key(seed),
+              jnp.float32(temp), jnp.int32(eos))
 
 
 import functools as _functools  # noqa: E402
 
 
 @_functools.lru_cache(maxsize=16)
-def _generate_impl(spec, max_new, temp, eos):
-    """Build + jit the (params, ids, key) -> tokens decode program for one
-    static configuration. Two XLA computations total: a prefill over the
-    prompt and a lax.scan of single-token steps against a fixed-size KV
-    cache [L, B, H, S0+max_new, D]."""
+def _generate_impl(spec, max_new):
+    """Build + jit the (params, ids, key, temp, eos) -> tokens decode
+    program for one static configuration. Two XLA computations total: a
+    prefill over the prompt and a lax.scan of single-token steps against a
+    fixed-size KV cache [L, B, H, S0+max_new, D]."""
     import jax
     import jax.numpy as jnp
 
@@ -203,7 +206,7 @@ def _generate_impl(spec, max_new, temp, eos):
         new = q.shape[:-1] + (H, Dh)
         return q.reshape(new), k.reshape(new), v.reshape(new)
 
-    def step_fn(params, ids, key0):
+    def step_fn(params, ids, key0, temp, eos):
         B, S0 = ids.shape
         S = S0 + max_new
         wte = params["wte.weight"]
@@ -241,13 +244,18 @@ def _generate_impl(spec, max_new, temp, eos):
         logits0 = head(xf)
 
         def pick(logits, key):
-            if temp > 0.0:
-                return jax.random.categorical(key, logits / temp, axis=-1)
-            return jnp.argmax(logits, axis=-1)
+            # temp is traced: branch with lax.cond so both sampling modes
+            # live in one compiled program
+            return jax.lax.cond(
+                temp > 0.0,
+                lambda: jax.random.categorical(
+                    key, logits / jnp.maximum(temp, 1e-6),
+                    axis=-1).astype(jnp.int32),
+                lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
 
         key0, sub0 = jax.random.split(key0)
-        tok0 = pick(logits0, sub0).astype(jnp.int32)
-        done0 = (tok0 == eos) if eos >= 0 else jnp.zeros(B, bool)
+        tok0 = pick(logits0, sub0)
+        done0 = (tok0 == eos) & (eos >= 0)
 
         # ---- decode: one token per scan step against the cache ----
         def body(carry, step):
@@ -273,10 +281,10 @@ def _generate_impl(spec, max_new, temp, eos):
             xf = ln(x, params["ln_f.weight"], params["ln_f.bias"])
             logits = head(xf)
             key, sub = jax.random.split(key)
-            nxt = pick(logits, sub).astype(jnp.int32)
-            if eos >= 0:
-                nxt = jnp.where(done, eos, nxt)
-                done = done | (nxt == eos)
+            nxt = pick(logits, sub)
+            # eos is traced (-1 disables): once done, keep emitting eos
+            nxt = jnp.where(done, eos, nxt)
+            done = done | ((nxt == eos) & (eos >= 0))
             return (nxt, done, ck, cv, key), tok
 
         (last, _, _, _, _), toks = jax.lax.scan(

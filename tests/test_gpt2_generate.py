@@ -83,3 +83,20 @@ def test_sampling_is_reproducible_and_plausible():
     np.testing.assert_array_equal(a, b)  # same seed -> same sample
     assert a.shape == (1, 12)
     assert (a[:, :4] == ids).all()
+
+
+def test_no_recompile_across_seed_temp_eos():
+    from paddle_tpu.models import gpt2 as gpt2_mod
+    paddle.seed(4)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    ids = np.array([[1, 2, 3]], np.int64)
+    before = gpt2_mod._generate_impl.cache_info().misses
+    model.generate(ids, 4, temperature=0.7, seed=1)
+    model.generate(ids, 4, temperature=1.3, seed=2, eos_token_id=5)
+    model.generate(ids, 4, temperature=0.0, seed=3)
+    after = gpt2_mod._generate_impl.cache_info().misses
+    # seed/temperature/eos are traced: one compiled program serves all
+    assert after - before == 1
