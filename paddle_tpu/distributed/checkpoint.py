@@ -42,16 +42,29 @@ def _flatten(state):
     return {jax.tree_util.keystr(p): v for p, v in paths}, treedef
 
 
-def save(state, ckpt_dir, process_index=None):
+def _coordinated_save_id():
+    """One save_id for ALL processes of this save: process 0 draws it and
+    broadcasts (jax.distributed must be initialized on multi-host, which
+    multi-host meshes already require)."""
+    if jax.process_count() == 1:
+        return uuid.uuid4().hex[:12]
+    from jax.experimental import multihost_utils
+    seed = np.frombuffer(uuid.uuid4().bytes[:8], np.uint32).copy()
+    seed = multihost_utils.broadcast_one_to_all(seed)
+    return "".join(f"{int(x):08x}" for x in seed)[:12]
+
+
+def save(state, ckpt_dir, process_index=None, save_id=None):
     """Write this process's addressable shards of `state` (a pytree of
     jax.Arrays / Tensors / scalars) under `ckpt_dir`. Every process calls
-    this. Shard files carry a per-save id; the per-process index is
-    renamed into place last, so readers never observe a partial save as
-    current."""
+    this. Shard files carry a per-save id (coordinated across processes);
+    the per-process index is renamed into place last, so readers never
+    observe a partial save as current."""
     if process_index is None:
         process_index = jax.process_index()
     os.makedirs(ckpt_dir, exist_ok=True)
-    save_id = uuid.uuid4().hex[:12]
+    if save_id is None:
+        save_id = _coordinated_save_id()
     flat, _ = _flatten(state)
     index = {"__meta__": {"save_id": save_id,
                           "process_count": jax.process_count()}}
@@ -71,7 +84,9 @@ def save(state, ckpt_dir, process_index=None):
                           for d, s in enumerate(sh.index))
             safe_key = key.replace("/", "_").replace("'", "").replace(
                 "[", ".").replace("]", "")
-            fname = (f"{safe_key}.{save_id}.p{process_index}"
+            # rank FIRST: cleanup/ownership parse the fixed-position
+            # tokens, immune to rank-like substrings in parameter names
+            fname = (f"r{process_index}.{save_id}.{safe_key}"
                      f".{'_'.join(map(str, starts))}.npy")
             tmp = os.path.join(ckpt_dir, fname + ".tmp")
             with open(tmp, "wb") as f:  # np.save(path) would append .npy
@@ -90,20 +105,20 @@ def save(state, ckpt_dir, process_index=None):
     # (e.g. a 4-host save resumed as 2 hosts)
     count = jax.process_count()
     for fn in os.listdir(ckpt_dir):
-        stale_own = (fn.endswith(".npy") and f".p{process_index}." in fn
-                     and f".{save_id}." not in fn)
-        stale_rank = False
-        if process_index == 0:
-            if fn.startswith("index.p") and fn.endswith(".pkl"):
-                try:
-                    stale_rank = int(fn[len("index.p"):-len(".pkl")]) \
-                        >= count
-                except ValueError:
-                    pass
-            elif fn.endswith(".npy"):
-                m = _re.search(r"\.p(\d+)\.", fn)
-                if m and int(m.group(1)) >= count:
-                    stale_rank = True
+        stale_own = stale_rank = False
+        if fn.endswith(".npy"):
+            # fixed-position tokens: r<rank>.<save_id>.<key>...
+            m = _re.match(r"r(\d+)\.([0-9a-f]{12})\.", fn)
+            if m:
+                rank, sid = int(m.group(1)), m.group(2)
+                stale_own = rank == process_index and sid != save_id
+                stale_rank = process_index == 0 and rank >= count
+        elif fn.startswith("index.p") and fn.endswith(".pkl") \
+                and process_index == 0:
+            try:
+                stale_rank = int(fn[len("index.p"):-len(".pkl")]) >= count
+            except ValueError:
+                pass
         if stale_own or stale_rank:
             try:
                 os.remove(os.path.join(ckpt_dir, fn))

@@ -100,3 +100,59 @@ class TransformerModel(nn.Layer):
             nxt = ops.argmax(logits[:, -1], axis=-1).astype("int32")
             tgt = ops.concat([tgt, ops.unsqueeze(nxt, 1)], axis=1)
         return tgt
+
+    def beam_search_decode(self, src_ids, beam_size=4, max_len=32,
+                           length_penalty=0.6):
+        """Beam search with the GNMT length penalty lp = ((5+len)/6)^alpha
+        (ref capability: fluid.layers.beam_search / beam_search_decode).
+        Finished beams are frozen (only an eos continuation at unchanged
+        score); returns the best hypothesis per batch row, [B, <=max_len].
+        beam_size=1 reproduces greedy_decode exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        src = src_ids._value if isinstance(src_ids, Tensor) \
+            else jnp.asarray(np.asarray(src_ids))
+        B, K, V = src.shape[0], int(beam_size), self.cfg.tgt_vocab_size
+        eos, bos = self.cfg.eos_id, self.cfg.bos_id
+        srcK = jnp.repeat(src, K, axis=0)                    # [B*K, S]
+        tgt = jnp.full((B * K, 1), bos, jnp.int32)
+        # only beam 0 is live at step 0 — otherwise K identical beams
+        scores = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (K - 1), jnp.float32)[None],
+            (B, 1))                                          # [B, K]
+        finished = jnp.zeros((B, K), bool)
+        row = jnp.arange(B)[:, None]
+        for _ in range(max_len - 1):
+            logits = self(Tensor(srcK), Tensor(tgt))._value[:, -1]
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), -1).reshape(B, K, V)
+            eos_only = jnp.where(jnp.arange(V)[None, None, :] == eos,
+                                 0.0, -jnp.inf)
+            cont = scores[:, :, None] + jnp.where(
+                finished[:, :, None], eos_only, logp)        # [B, K, V]
+            top_s, top_i = jax.lax.top_k(cont.reshape(B, K * V), K)
+            beam_idx = top_i // V                            # [B, K]
+            tok = (top_i % V).astype(jnp.int32)
+            gather = (row * K + beam_idx).reshape(-1)
+            tgt = jnp.concatenate([tgt[gather], tok.reshape(-1, 1)], 1)
+            finished = finished[row, beam_idx] | (tok == eos)
+            scores = top_s
+            if bool(finished.all()):
+                break
+        # hypothesis length = tokens up to and including the first eos
+        seq = tgt.reshape(B, K, -1)
+        T = seq.shape[-1]
+        is_eos = seq == eos
+        first_eos = jnp.where(is_eos.any(-1), is_eos.argmax(-1),
+                              T - 1)                         # [B, K]
+        lengths = (first_eos + 1).astype(jnp.float32)
+        lp = ((5.0 + lengths) / 6.0) ** length_penalty
+        best = jnp.argmax(scores / lp, axis=-1)              # [B]
+        out = seq[jnp.arange(B), best]
+        # pad everything after the first eos with eos
+        pos = jnp.arange(T)[None, :]
+        cut = jnp.where(is_eos[jnp.arange(B), best].any(-1),
+                        first_eos[jnp.arange(B), best], T - 1)[:, None]
+        out = jnp.where(pos <= cut, out, eos)
+        return Tensor(out, stop_gradient=True)

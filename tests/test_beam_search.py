@@ -1,0 +1,78 @@
+"""Beam-search decode for the seq2seq Transformer (ref capability:
+fluid.layers.beam_search). beam_size=1 must equal greedy; wider beams must
+never score worse than greedy under the model's own log-likelihood."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.transformer import TransformerConfig, TransformerModel
+
+
+def _model():
+    paddle.seed(5)
+    cfg = TransformerConfig.tiny()
+    cfg.dropout = 0.0
+    m = TransformerModel(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _seq_logprob(model, src, tgt):
+    """Model log-likelihood of tgt (teacher-forced), summed over steps."""
+    import jax
+    import jax.numpy as jnp
+    logits = model(paddle.to_tensor(src),
+                   paddle.to_tensor(tgt[:, :-1]))._value
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    tok = jnp.asarray(tgt[:, 1:])
+    picked = jnp.take_along_axis(logp, tok[:, :, None], -1)[..., 0]
+    # stop accumulating after the first eos
+    eos = 1
+    before_eos = jnp.cumsum((tok == eos).astype(jnp.int32), axis=1) <= 1
+    return np.asarray((picked * before_eos).sum(1))
+
+
+def test_beam1_equals_greedy():
+    model, cfg = _model()
+    rs = np.random.RandomState(0)
+    src = rs.randint(2, cfg.src_vocab_size, (2, 6)).astype(np.int64)
+    greedy = model.greedy_decode(paddle.to_tensor(src), max_len=8).numpy()
+    beam1 = model.beam_search_decode(src, beam_size=1, max_len=8,
+                                     length_penalty=0.0).numpy()
+    # identical until greedy's first eos (beam pads after eos)
+    for b in range(src.shape[0]):
+        g = greedy[b]
+        stop = np.where(g == cfg.eos_id)[0]
+        n = (stop[0] + 1) if len(stop) else len(g)
+        np.testing.assert_array_equal(beam1[b, :n], g[:n])
+
+
+def test_wider_beam_no_worse_than_greedy():
+    model, cfg = _model()
+    rs = np.random.RandomState(1)
+    src = rs.randint(2, cfg.src_vocab_size, (3, 5)).astype(np.int64)
+    greedy = model.greedy_decode(paddle.to_tensor(src), max_len=10).numpy()
+    beam = model.beam_search_decode(src, beam_size=4, max_len=10,
+                                    length_penalty=0.0).numpy()
+    # pad greedy to beam's length for scoring
+    T = max(greedy.shape[1], beam.shape[1])
+
+    def pad(x):
+        return np.pad(x, ((0, 0), (0, T - x.shape[1])),
+                      constant_values=cfg.eos_id)
+
+    lp_beam = _seq_logprob(model, src, pad(beam))
+    lp_greedy = _seq_logprob(model, src, pad(greedy))
+    assert (lp_beam >= lp_greedy - 1e-4).all(), (lp_beam, lp_greedy)
+
+
+def test_eos_padding_and_shapes():
+    model, cfg = _model()
+    rs = np.random.RandomState(2)
+    src = rs.randint(2, cfg.src_vocab_size, (2, 4)).astype(np.int64)
+    out = model.beam_search_decode(src, beam_size=3, max_len=7).numpy()
+    assert out.shape[0] == 2 and out.shape[1] <= 7
+    assert (out[:, 0] == cfg.bos_id).all()
+    for rowv in out:
+        hits = np.where(rowv == cfg.eos_id)[0]
+        if len(hits):  # everything after the first eos is eos
+            assert (rowv[hits[0]:] == cfg.eos_id).all()

@@ -105,6 +105,43 @@ def test_replicated_saved_once(tmp_path, state):
     assert len(b_files) == 1  # replicated leaf written by replica 0 only
 
 
+def test_rank_like_key_survives_cleanup(tmp_path):
+    # a parameter literally named 'p1' must not be mistaken for a rank-1
+    # file by the stale-rank cleanup (single process: count = 1)
+    mesh = _mesh((8,), ("dp",))
+    sh = NamedSharding(mesh, P("dp"))
+    st = {"p1": jax.device_put(jnp.arange(8.0), sh)}
+    d = str(tmp_path / "ck")
+    ckpt.save(st, d)
+    out = ckpt.load(d, {"p1": jax.device_put(jnp.zeros(8), sh)})
+    np.testing.assert_array_equal(np.asarray(out["p1"]), np.arange(8.0))
+
+
+def test_simulated_two_process_save(tmp_path, monkeypatch, state):
+    # the two halves of a 2-process save share ONE save_id; load merges
+    # them and the completeness check passes
+    d = str(tmp_path / "ck")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    ckpt.save(state, d, process_index=0, save_id="aaaaaaaaaaaa")
+    ckpt.save(state, d, process_index=1, save_id="aaaaaaaaaaaa")
+    like = {"w": jax.device_put(jnp.zeros_like(state["w"]),
+                                state["w"].sharding),
+            "nested": {"b": jax.device_put(
+                jnp.zeros_like(state["nested"]["b"]),
+                state["nested"]["b"].sharding)},
+            "step": 0}
+    out = ckpt.load(d, like)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(state["w"]))
+
+    # uncoordinated ids (the bug this guards against) -> incomplete, loud
+    d2 = str(tmp_path / "ck2")
+    ckpt.save(state, d2, process_index=0, save_id="bbbbbbbbbbbb")
+    ckpt.save(state, d2, process_index=1, save_id="cccccccccccc")
+    with pytest.raises(ValueError, match="no complete save"):
+        ckpt.load(d2, like)
+
+
 def test_tensor_leaves_and_missing_key(tmp_path, state):
     d = str(tmp_path / "ck")
     t_state = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32))}
