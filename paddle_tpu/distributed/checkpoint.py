@@ -65,6 +65,11 @@ def save(state, ckpt_dir, process_index=None, save_id=None):
     os.makedirs(ckpt_dir, exist_ok=True)
     if save_id is None:
         save_id = _coordinated_save_id()
+    elif not _re.fullmatch(r"[0-9a-f]{12}", save_id):
+        # the cleanup pass parses filenames by this exact token shape; a
+        # free-form id would orphan its shard files forever
+        raise ValueError(
+            f"save_id must be 12 lowercase hex chars, got {save_id!r}")
     flat, _ = _flatten(state)
     index = {"__meta__": {"save_id": save_id,
                           "process_count": jax.process_count()}}

@@ -127,7 +127,7 @@ class GPT2(nn.Layer):
             ops.reshape(labels, [-1]))
 
     def generate(self, input_ids, max_new_tokens, temperature=0.0,
-                 eos_token_id=None, seed=0):
+                 eos_token_id=None, seed=0, top_k=0, top_p=1.0):
         """Autoregressive decoding with a KV cache (serving path; ref
         capability: fluid beam_search/sampling decode ops). TPU-first:
         static shapes throughout — prefill compiles once per prompt shape,
@@ -155,34 +155,37 @@ class GPT2(nn.Layer):
         out = _generate_jit(self.cfg, params, ids, max_new_tokens,
                             temperature,
                             -1 if eos_token_id is None else int(eos_token_id),
-                            int(seed))
+                            int(seed),
+                            min(int(top_k), self.cfg.vocab_size), top_p)
         return Tensor(out, stop_gradient=True)
 
 
-def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed):
+def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed,
+                  top_k=0, top_p=1.0):
     import jax
     import jax.numpy as jnp
 
     spec = (cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
             cfg.layer_norm_epsilon, cfg.tie_embeddings)
-    fn = _generate_impl(spec, max_new)
-    # key/temperature/eos are traced arguments: new seeds, temperatures or
-    # eos ids reuse the compiled program instead of recompiling the whole
-    # prefill + decode scan (only max_new — the scan length — is static)
+    fn = _generate_impl(spec, max_new, top_k, top_p < 1.0)
+    # key/temperature/eos/top_p are traced arguments: new values reuse the
+    # compiled program (static: max_new — the scan length — top_k, which
+    # fixes the lax.top_k output shape, and WHETHER nucleus filtering is
+    # on, so the default top_p=1.0 path never pays the per-token sort)
     return fn(params, ids, jax.random.key(seed),
-              jnp.float32(temp), jnp.int32(eos))
+              jnp.float32(temp), jnp.int32(eos), jnp.float32(top_p))
 
 
 import functools as _functools  # noqa: E402
 
 
 @_functools.lru_cache(maxsize=16)
-def _generate_impl(spec, max_new):
-    """Build + jit the (params, ids, key, temp, eos) -> tokens decode
-    program for one static configuration. Two XLA computations total: a
-    prefill over the prompt and a lax.scan of single-token steps against a
-    fixed-size KV cache [L, B, H, S0+max_new, D]."""
+def _generate_impl(spec, max_new, top_k=0, nucleus=False):
+    """Build + jit the (params, ids, key, temp, eos, top_p) -> tokens
+    decode program for one static configuration. Two XLA computations
+    total: a prefill over the prompt and a lax.scan of single-token steps
+    against a fixed-size KV cache [L, B, H, S0+max_new, D]."""
     import jax
     import jax.numpy as jnp
 
@@ -206,7 +209,7 @@ def _generate_impl(spec, max_new):
         new = q.shape[:-1] + (H, Dh)
         return q.reshape(new), k.reshape(new), v.reshape(new)
 
-    def step_fn(params, ids, key0, temp, eos):
+    def step_fn(params, ids, key0, temp, eos, top_p):
         B, S0 = ids.shape
         S = S0 + max_new
         wte = params["wte.weight"]
@@ -244,13 +247,29 @@ def _generate_impl(spec, max_new):
         logits0 = head(xf)
 
         def pick(logits, key):
-            # temp is traced: branch with lax.cond so both sampling modes
-            # live in one compiled program
+            # temp/top_p are traced: branch with lax.cond so every
+            # sampling mode lives in one compiled program
+            def sample():
+                l = logits / jnp.maximum(temp, 1e-6)
+                if top_k > 0:  # static: fixes the lax.top_k shape
+                    kth = jax.lax.top_k(l, top_k)[0][..., -1:]
+                    l = jnp.where(l < kth, -jnp.inf, l)
+                if nucleus:  # static: the top_p=1 default skips the sort
+                    # keep the smallest prefix of desc-sorted tokens whose
+                    # exclusive cumulative prob stays under top_p (the
+                    # top-1 token always survives)
+                    sl = jnp.sort(l, axis=-1)[..., ::-1]
+                    probs = jax.nn.softmax(sl, axis=-1)
+                    cum = jnp.cumsum(probs, axis=-1) - probs
+                    n_keep = jnp.maximum(
+                        jnp.sum(cum < top_p, axis=-1, keepdims=True), 1)
+                    kth_val = jnp.take_along_axis(sl, n_keep - 1, axis=-1)
+                    l = jnp.where(l < kth_val, -jnp.inf, l)
+                return jax.random.categorical(
+                    key, l, axis=-1).astype(jnp.int32)
+
             return jax.lax.cond(
-                temp > 0.0,
-                lambda: jax.random.categorical(
-                    key, logits / jnp.maximum(temp, 1e-6),
-                    axis=-1).astype(jnp.int32),
+                temp > 0.0, sample,
                 lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
 
         key0, sub0 = jax.random.split(key0)

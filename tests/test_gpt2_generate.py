@@ -85,6 +85,28 @@ def test_sampling_is_reproducible_and_plausible():
     assert (a[:, :4] == ids).all()
 
 
+def test_top_k_top_p_filtering():
+    paddle.seed(6)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    ids = np.array([[2, 4, 6]], np.int64)
+    # top_k=1 sampling degenerates to greedy regardless of temperature
+    greedy = model.generate(ids, 5).numpy()
+    k1 = model.generate(ids, 5, temperature=1.5, top_k=1, seed=3).numpy()
+    np.testing.assert_array_equal(k1, greedy)
+    # tiny top_p likewise collapses to the argmax token
+    p_small = model.generate(ids, 5, temperature=1.5, top_p=1e-6,
+                             seed=4).numpy()
+    np.testing.assert_array_equal(p_small, greedy)
+    # permissive settings still produce valid tokens
+    free = model.generate(ids, 5, temperature=1.0, top_k=50,
+                          top_p=0.9, seed=5).numpy()
+    assert free.shape == (1, 8)
+    assert (free >= 0).all() and (free < cfg.vocab_size).all()
+
+
 def test_no_recompile_across_seed_temp_eos():
     from paddle_tpu.models import gpt2 as gpt2_mod
     paddle.seed(4)
