@@ -182,10 +182,15 @@ import functools as _functools  # noqa: E402
 
 @_functools.lru_cache(maxsize=16)
 def _generate_impl(spec, max_new, top_k=0, nucleus=False):
-    """Build + jit the (params, ids, key, temp, eos, top_p) -> tokens
-    decode program for one static configuration. Two XLA computations
-    total: a prefill over the prompt and a lax.scan of single-token steps
-    against a fixed-size KV cache [L, B, H, S0+max_new, D]."""
+    import jax
+    return jax.jit(_build_decode_fn(spec, max_new, top_k, nucleus))
+
+
+def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
+    """Build the raw (params, ids, key, temp, eos, top_p) -> tokens decode
+    function for one static configuration. Two XLA computations total: a
+    prefill over the prompt and a lax.scan of single-token steps against a
+    fixed-size KV cache [L, B, H, S0+max_new, D]."""
     import jax
     import jax.numpy as jnp
 
@@ -314,7 +319,70 @@ def _generate_impl(spec, max_new, top_k=0, nucleus=False):
                                last[:, None]], axis=1)
         return seq
 
-    return jax.jit(step_fn)
+    return step_fn
+
+
+def export_generator(model: "GPT2", path_prefix, prompt_len,
+                     max_new_tokens, top_k=0, top_p_enabled=False,
+                     batch_size=None):
+    """Serialize the KV-cache decode program as the standard deployment
+    artifact (.pdmodel StableHLO + .pdiparams npz) so text generation runs
+    in a serving process with NO Python model class:
+
+        served = paddle.jit.load(path_prefix)
+        tokens = served(ids, seed, temperature, eos, top_p)
+
+    ids: [B, prompt_len] int32 (B symbolic when batch_size is None);
+    seed uint32; temperature/top_p float32 (top_p only filters when
+    exported with top_p_enabled); eos int32 (-1 disables)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import jit as jit_mod
+
+    cfg = model.cfg
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1 for an exported "
+                         "generator (a 0-token artifact has no decode)")
+    if prompt_len + max_new_tokens > cfg.max_position:
+        raise ValueError("prompt_len + max_new_tokens exceeds max_position")
+    spec = (cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
+            cfg.layer_norm_epsilon, cfg.tie_embeddings)
+    decode = _build_decode_fn(spec, int(max_new_tokens),
+                              min(int(top_k), cfg.vocab_size),
+                              bool(top_p_enabled))
+
+    def serving_fn(params, bufs, ids, seed, temp, eos, top_p):
+        del bufs  # GPT-2 has no buffers; kept for the artifact convention
+        return decode(params, ids, jax.random.key(seed), temp, eos, top_p)
+
+    params, _ = model.functional_state()
+    if batch_size is None:
+        (bdim,) = jit_mod._symbolic_dims(1)
+    else:
+        bdim = int(batch_size)
+    from jax import export as jexport
+    args = (jax.ShapeDtypeStruct((bdim, int(prompt_len)), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.uint32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32))
+    p_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    jf = jax.jit(serving_fn)
+    try:
+        # multi-platform like jit.save: a dev-box export must serve on TPU
+        exported = jexport.export(jf, platforms=("cpu", "tpu"))(
+            p_specs, {}, *args)
+    except Exception:
+        exported = jexport.export(jf)(p_specs, {}, *args)
+    meta = {"kind": "gpt2_generator", "prompt_len": int(prompt_len),
+            "max_new_tokens": int(max_new_tokens), "top_k": int(top_k),
+            "top_p_enabled": bool(top_p_enabled),
+            "inputs": ["ids[int32]", "seed[uint32]",
+                       "temperature[f32]", "eos[int32]", "top_p[f32]"]}
+    return jit_mod.write_artifact(path_prefix, exported, params, {}, meta)
 
 
 def build_train_step(cfg: GPT2Config, remat=False, dtype="float32"):
