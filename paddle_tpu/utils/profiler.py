@@ -67,3 +67,81 @@ def summary():
 
 def reset():
     _records.clear()
+
+
+def _aggregate_ops(fn, steps, trace_dir, include_host):
+    """Run `fn()` `steps` times under jax.profiler.trace and aggregate
+    event durations by op name: {name: [total_ms, count]}. Only ONE
+    timeline level is counted — the 'XLA Ops' lines when the trace has
+    them (TPU), else all non-python lines — so module/step envelope
+    events are not double-counted on top of their member ops."""
+    import glob
+    import os
+    import tempfile
+    from collections import defaultdict as _dd
+
+    from jax.profiler import ProfileData
+
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="ptpu_prof_")
+    fn()  # warm/compile outside the trace
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            fn()
+    files = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not files:
+        raise RuntimeError(f"no xplane.pb under {trace_dir}")
+    pd = ProfileData.from_file(files[-1])
+    planes = list(pd.planes)
+    device_planes = [p for p in planes
+                     if not p.name.startswith("/host:")
+                     and "Task Environment" not in p.name]
+    if not device_planes or include_host:
+        device_planes = planes
+    # one level only: prefer the per-op timeline when present
+    plane_lines = []
+    for plane in device_planes:
+        lines = [ln for ln in plane.lines if ln.name != "python"]
+        op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
+        plane_lines.append(op_lines or lines)
+    totals = _dd(lambda: [0.0, 0])
+    for lines in plane_lines:
+        for line in lines:
+            for ev in line.events:
+                name = ev.name
+                if name.startswith("end:") or not ev.duration_ns:
+                    continue
+                t = totals[name]
+                t[0] += ev.duration_ns / 1e6
+                t[1] += 1
+    return totals
+
+
+def top_ops(fn, steps=3, k=25, trace_dir=None, include_host=False):
+    """Profile `fn()` (already-compiled, zero-arg) and return the top-k
+    device ops by total time: [(op_name, total_ms, count)].
+
+    The missing tool for MFU work: runs `steps` calls under
+    jax.profiler.trace, parses the xplane with jax.profiler.ProfileData
+    (no TensorBoard round-trip), and aggregates event durations on the
+    device planes — on TPU that is the XLA-op timeline, so the answer to
+    "where do the milliseconds go" is one call away.
+    """
+    totals = _aggregate_ops(fn, steps, trace_dir, include_host)
+    return sorted(((n, ms, c) for n, (ms, c) in totals.items()),
+                  key=lambda x: -x[1])[:k]
+
+
+def print_top_ops(fn, steps=3, k=25):
+    totals = _aggregate_ops(fn, steps, None, False)
+    grand = sum(ms for ms, _ in totals.values())
+    rows = sorted(((n, ms, c) for n, (ms, c) in totals.items()),
+                  key=lambda x: -x[1])[:k]
+    shown = sum(ms for _, ms, _ in rows)
+    print(f"{'op':<60} {'ms':>10} {'count':>7} {'%':>6}")
+    for name, ms, c in rows:
+        print(f"{name[:60]:<60} {ms:>10.3f} {c:>7} "
+              f"{100 * ms / max(grand, 1e-9):>5.1f}%")
+    print(f"# top-{len(rows)} covers {100 * shown / max(grand, 1e-9):.1f}% "
+          f"of {grand:.1f}ms total device-op time")
+    return rows

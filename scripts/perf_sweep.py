@@ -227,10 +227,42 @@ def section_ablate(batch=16):
         P_ops.scaled_dot_product_attention = orig
 
 
+def section_profile(batch=16):
+    """Per-op time breakdown of ONE fused train step (fwd+bwd+optimizer)
+    via utils.profiler.top_ops — the ground truth for where the
+    milliseconds go (attention kernels vs GEMMs vs scatter vs optimizer)."""
+    import jax
+
+    from paddle_tpu import optimizer as opt_mod
+    from paddle_tpu.utils import profiler as prof
+
+    from _bench_util import gpt2_amp_setup
+    _cfg, params0, amp_loss, make_data = gpt2_amp_setup()
+    data = make_data(batch)
+    key = jax.random.key(0)
+    optimizer = opt_mod.AdamW(learning_rate=1e-4, weight_decay=0.01)
+    opt_state = optimizer.functional_init(params0)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(amp_loss)(p, data, key)
+        np_, ns = optimizer.functional_update(p, g, s)
+        return np_, ns, loss
+
+    state = {"p": params0, "s": opt_state}
+
+    def run():
+        state["p"], state["s"], loss = step(state["p"], state["s"])
+        float(jax.device_get(loss))
+
+    prof.print_top_ops(run, steps=3, k=30)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--section", default="all",
-                    choices=["all", "model", "blocks", "longseq", "ablate"])
+                    choices=["all", "model", "blocks", "longseq", "ablate",
+                             "profile"])
     ap.add_argument("--batches", default="8,16,24")
     args = ap.parse_args()
     import jax
@@ -244,6 +276,8 @@ def main():
         section_model(tuple(int(x) for x in args.batches.split(",")))
     if args.section in ("all", "ablate"):
         section_ablate()
+    if args.section == "profile":  # not in "all": trace files are big
+        section_profile(int(args.batches.split(",")[0]))
 
 
 if __name__ == "__main__":

@@ -11,6 +11,8 @@ print('PROBE_OK', float(jax.device_get(jnp.sum(x))))" 2>/dev/null | grep -q PROB
     echo "=== tunnel up after $i probes $(date) ==="
     echo "=== raw op envelope (GEMM ceiling, exp rate) ==="
     timeout 1200 python scripts/raw_ops_bench.py 2>&1 | grep -v WARNING
+    echo "=== per-op profile of one fused train step (batch 16) ==="
+    timeout 1200 python scripts/perf_sweep.py --section profile --batches 16 2>&1 | grep -v WARNING
     echo "=== attention share ablation (flash/xla/identity in-model) ==="
     timeout 1500 python scripts/perf_sweep.py --section ablate 2>&1 | grep -v WARNING
     echo "=== attn compare (dtype-correct) ==="
