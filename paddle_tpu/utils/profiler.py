@@ -82,39 +82,45 @@ def _aggregate_ops(fn, steps, trace_dir, include_host):
 
     from jax.profiler import ProfileData
 
+    own_dir = trace_dir is None
     trace_dir = trace_dir or tempfile.mkdtemp(prefix="ptpu_prof_")
-    fn()  # warm/compile outside the trace
-    with jax.profiler.trace(trace_dir):
-        for _ in range(steps):
-            fn()
-    files = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                             recursive=True))
-    if not files:
-        raise RuntimeError(f"no xplane.pb under {trace_dir}")
-    pd = ProfileData.from_file(files[-1])
-    planes = list(pd.planes)
-    device_planes = [p for p in planes
-                     if not p.name.startswith("/host:")
-                     and "Task Environment" not in p.name]
-    if not device_planes or include_host:
-        device_planes = planes
-    # one level only: prefer the per-op timeline when present
-    plane_lines = []
-    for plane in device_planes:
-        lines = [ln for ln in plane.lines if ln.name != "python"]
-        op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
-        plane_lines.append(op_lines or lines)
-    totals = _dd(lambda: [0.0, 0])
-    for lines in plane_lines:
-        for line in lines:
-            for ev in line.events:
-                name = ev.name
-                if name.startswith("end:") or not ev.duration_ns:
-                    continue
-                t = totals[name]
-                t[0] += ev.duration_ns / 1e6
-                t[1] += 1
-    return totals
+    try:
+        fn()  # warm/compile outside the trace
+        with jax.profiler.trace(trace_dir):
+            for _ in range(steps):
+                fn()
+        files = sorted(glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
+        if not files:
+            raise RuntimeError(f"no xplane.pb under {trace_dir}")
+        pd = ProfileData.from_file(files[-1])
+        planes = list(pd.planes)
+        device_planes = [p for p in planes
+                         if not p.name.startswith("/host:")
+                         and "Task Environment" not in p.name]
+        if not device_planes or include_host:
+            device_planes = planes
+        # one level only: prefer the per-op timeline when present
+        plane_lines = []
+        for plane in device_planes:
+            lines = [ln for ln in plane.lines if ln.name != "python"]
+            op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
+            plane_lines.append(op_lines or lines)
+        totals = _dd(lambda: [0.0, 0])
+        for lines in plane_lines:
+            for line in lines:
+                for ev in line.events:
+                    name = ev.name
+                    if name.startswith("end:") or not ev.duration_ns:
+                        continue
+                    t = totals[name]
+                    t[0] += ev.duration_ns / 1e6
+                    t[1] += 1
+        return totals
+    finally:
+        if own_dir:  # don't leak multi-MB xplane traces into /tmp
+            import shutil
+            shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 def top_ops(fn, steps=3, k=25, trace_dir=None, include_host=False):

@@ -263,8 +263,9 @@ def main():
     ap.add_argument("--section", default="all",
                     choices=["all", "model", "blocks", "longseq", "ablate",
                              "profile"])
-    ap.add_argument("--batches", default="8,16,24")
+    ap.add_argument("--batches", default=None)
     args = ap.parse_args()
+    model_batches = args.batches or "8,16,24"
     import jax
     print(f"backend={jax.default_backend()} devices={jax.devices()}",
           file=sys.stderr)
@@ -273,11 +274,13 @@ def main():
     if args.section in ("all", "longseq"):
         section_longseq()
     if args.section in ("all", "model"):
-        section_model(tuple(int(x) for x in args.batches.split(",")))
+        section_model(tuple(int(x) for x in model_batches.split(",")))
     if args.section in ("all", "ablate"):
         section_ablate()
     if args.section == "profile":  # not in "all": trace files are big
-        section_profile(int(args.batches.split(",")[0]))
+        # default batch 16 = the headline bench config; --batches overrides
+        section_profile(int(args.batches.split(",")[0]) if args.batches
+                        else 16)
 
 
 if __name__ == "__main__":

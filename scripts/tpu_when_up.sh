@@ -25,6 +25,12 @@ print('PROBE_OK', float(jax.device_get(jnp.sum(x))))" 2>/dev/null | grep -q PROB
     timeout 1500 python scripts/perf_sweep.py --section blocks 2>&1 | grep -v WARNING
     echo "=== model batch sweep ==="
     timeout 1500 python scripts/perf_sweep.py --section model --batches 8,16,24 2>&1 | grep -v WARNING
+    echo "=== bench flag A/B: onehot-embed-vjp ==="
+    PADDLE_TPU_EMBED_ONEHOT_VJP=1 timeout 1200 python bench.py 2>&1 | grep -v WARNING
+    echo "=== bench flag A/B: fa-lanes ==="
+    PADDLE_TPU_FA_LANES=1 timeout 1200 python bench.py 2>&1 | grep -v WARNING
+    echo "=== bench flag A/B: both ==="
+    PADDLE_TPU_EMBED_ONEHOT_VJP=1 PADDLE_TPU_FA_LANES=1 timeout 1200 python bench.py 2>&1 | grep -v WARNING
     echo "=== done $(date) ==="
     exit 0
   fi
