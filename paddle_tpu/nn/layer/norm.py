@@ -78,10 +78,36 @@ class BatchNorm3D(_BatchNormBase):
 
 
 class SyncBatchNorm(_BatchNormBase):
-    """Cross-replica batch norm. Under pjit/shard_map the batch axis is a mesh
-    axis; stats computed with jnp.mean over the global batch are already
-    correct because XLA sees the full logical batch (GSPMD). In explicit
-    shard_map contexts the parallel env installs a psum-based reducer."""
+    """Cross-replica batch norm (ref: sync_batch_norm_op + NCCL stats
+    all-reduce). Under plain pjit the batch axis is GSPMD-sharded and jnp
+    stats already span the global batch; inside an EXPLICIT shard_map/pmap
+    region each shard only sees its local batch, so training mode
+    dispatches to `ops.sync_batch_norm`, which psums the f32 moments over
+    the layer's `sync_axes` (default ("dp",) — the data-parallel group,
+    NOT mp/pp/sp axes, whose shards hold different channels/stages).
+    Eager mode (no bound axes) degrades to local stats, which there ARE
+    the global batch. Being a registered op, it records on the autograd
+    tape like every other layer."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None, sync_axes=("dp",)):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
+        self.sync_axes = tuple(sync_axes) if sync_axes else ()
+
+    def forward(self, x):
+        if not self.training or self.use_global_stats:
+            return super().forward(x)
+        out, new_mean, new_var = ops.sync_batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format, sync_axes=self.sync_axes)
+        self._mean._value = new_mean._value if isinstance(new_mean, Tensor) \
+            else new_mean
+        self._variance._value = new_var._value \
+            if isinstance(new_var, Tensor) else new_var
+        return out
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):

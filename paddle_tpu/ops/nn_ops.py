@@ -472,6 +472,51 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
 
 @defop()
+def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                    momentum=0.9, epsilon=1e-5, data_format="NCHW",
+                    sync_axes=("dp",)):
+    """Training-mode batch norm with CROSS-REPLICA statistics (ref:
+    sync_batch_norm_op + its NCCL stats all-reduce). Moments (sum, sumsq,
+    count) are computed in f32 and psummed over each axis in `sync_axes`
+    that is bound in the surrounding shard_map/pmap; unbound axes (eager,
+    plain pjit where GSPMD already sees the global batch) degrade to
+    local = global. Running stats update with the unbiased variance, same
+    as `batch_norm`. Returns (out, new_running_mean, new_running_var)."""
+    c_axis = 1 if not data_format.endswith("C") or x.ndim == 2 else x.ndim - 1
+    if data_format in ("NHWC", "NLC", "NDHWC") and x.ndim > 2:
+        c_axis = x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    xf = x.astype(jnp.float32)
+    n_local = 1
+    for i in reduce_axes:
+        n_local *= x.shape[i]
+    s1 = jnp.sum(xf, axis=reduce_axes)
+    s2 = jnp.sum(jnp.square(xf), axis=reduce_axes)
+    n = jnp.asarray(float(n_local), jnp.float32)
+    for a in (sync_axes or ()):
+        try:
+            s1, s2, n = jax.lax.psum((s1, s2, n), a)
+        except NameError:
+            pass  # axis not bound here
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+    new_mean = momentum * running_mean \
+        + (1 - momentum) * jax.lax.stop_gradient(mean)
+    new_var = momentum * running_var \
+        + (1 - momentum) * jax.lax.stop_gradient(unbiased)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(
+        var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape).astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.reshape(shape).astype(jnp.float32)
+    return out.astype(x.dtype), new_mean, new_var
+
+
+@defop()
 def layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=None,
                normalized_ndim=None):
     """Normalize over trailing dims (paddle LayerNorm normalized_shape)."""
