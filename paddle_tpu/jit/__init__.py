@@ -54,15 +54,38 @@ class StaticFunction:
 
         if layer is None:
             if self._compiled is None:
-                self._compiled = jax.jit(
-                    lambda a, k: jax.tree_util.tree_map(
+                def _traced_free(a, k):
+                    # runs at TRACE time only: snapshot live layer state so
+                    # the finally-restore below can undo tracer writes to
+                    # closure-captured layers (BN running stats etc.) —
+                    # jit is pure, such mutations cannot persist, and
+                    # leaking the tracers would crash the next eager use.
+                    # Steady-state (cached-compile) calls never execute
+                    # this body, so they skip the O(all-layers) scan.
+                    from ..nn.layer.layers import _LIVE_LAYERS
+                    self._trace_snap = [
+                        (t, t._value) for live in list(_LIVE_LAYERS)
+                        for t in list(live.parameters(
+                            include_sublayers=False))
+                        + list(live.buffers(include_sublayers=False))]
+                    return jax.tree_util.tree_map(
                         _unwrap, self._fn(*a, **k),
-                        is_leaf=lambda x: isinstance(x, Tensor)))
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                self._compiled = jax.jit(_traced_free)
             raw_args = jax.tree_util.tree_map(
                 _unwrap, call_args, is_leaf=lambda x: isinstance(x, Tensor))
             raw_kw = jax.tree_util.tree_map(
                 _unwrap, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
-            out = self._compiled(raw_args, raw_kw)
+            try:
+                out = self._compiled(raw_args, raw_kw)
+            finally:
+                snap = getattr(self, "_trace_snap", None)
+                if snap:
+                    self._trace_snap = None
+                    import jax.core as _jcore
+                    for t, v in snap:
+                        if isinstance(t._value, _jcore.Tracer):
+                            t._value = v
             return jax.tree_util.tree_map(_wrap, out)
 
         # layer path: functionalize params/buffers

@@ -19,9 +19,19 @@ from ...core.tensor import Parameter, Tensor
 from ...core import unique_name
 from .. import initializer as I
 
+# weak registry of live Layers: jit's free-function path uses it to undo
+# trace-time tracer writes into closure-captured layer state (see
+# jit.StaticFunction — buffer mutations inside a traced FREE function
+# cannot persist; without the cleanup they leak tracers that crash the
+# next eager use of the layer)
+import weakref
+
+_LIVE_LAYERS: "weakref.WeakSet[Layer]" = weakref.WeakSet()
+
 
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
+        _LIVE_LAYERS.add(self)
         self.training = True
         self._dtype = dtype
         self._parameters = collections.OrderedDict()

@@ -442,13 +442,30 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
 
 # ------------------------------------------------------------------ norm ----
 
+def _bn_channel_axis(data_format, ndim):
+    c_axis = 1 if not data_format.endswith("C") or ndim == 2 else ndim - 1
+    if data_format in ("NHWC", "NLC", "NDHWC") and ndim > 2:
+        c_axis = ndim - 1
+    return c_axis
+
+
+def _bn_normalize(x, mean, var, weight, bias, epsilon, c_axis):
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    out = (x - mean.reshape(shape).astype(x.dtype)) * jax.lax.rsqrt(
+        var.reshape(shape).astype(x.dtype) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape).astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape(shape).astype(x.dtype)
+    return out
+
+
 @defop()
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
                use_global_stats=None):
-    c_axis = 1 if not data_format.endswith("C") or x.ndim == 2 else x.ndim - 1
-    if data_format in ("NHWC", "NLC", "NDHWC") and x.ndim > 2:
-        c_axis = x.ndim - 1
+    c_axis = _bn_channel_axis(data_format, x.ndim)
     reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
     use_batch = training and not use_global_stats
     if use_batch:
@@ -461,13 +478,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     else:
         mean, var = running_mean, running_var
         new_mean, new_var = running_mean, running_var
-    shape = [1] * x.ndim
-    shape[c_axis] = x.shape[c_axis]
-    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
-    if weight is not None:
-        out = out * weight.reshape(shape)
-    if bias is not None:
-        out = out + bias.reshape(shape)
+    out = _bn_normalize(x, mean, var, weight, bias, epsilon, c_axis)
     return out, new_mean, new_var
 
 
@@ -482,9 +493,7 @@ def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
     plain pjit where GSPMD already sees the global batch) degrade to
     local = global. Running stats update with the unbiased variance, same
     as `batch_norm`. Returns (out, new_running_mean, new_running_var)."""
-    c_axis = 1 if not data_format.endswith("C") or x.ndim == 2 else x.ndim - 1
-    if data_format in ("NHWC", "NLC", "NDHWC") and x.ndim > 2:
-        c_axis = x.ndim - 1
+    c_axis = _bn_channel_axis(data_format, x.ndim)
     reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
     xf = x.astype(jnp.float32)
     n_local = 1
@@ -505,14 +514,7 @@ def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
         + (1 - momentum) * jax.lax.stop_gradient(mean)
     new_var = momentum * running_var \
         + (1 - momentum) * jax.lax.stop_gradient(unbiased)
-    shape = [1] * x.ndim
-    shape[c_axis] = x.shape[c_axis]
-    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(
-        var.reshape(shape) + epsilon)
-    if weight is not None:
-        out = out * weight.reshape(shape).astype(jnp.float32)
-    if bias is not None:
-        out = out + bias.reshape(shape).astype(jnp.float32)
+    out = _bn_normalize(xf, mean, var, weight, bias, epsilon, c_axis)
     return out.astype(x.dtype), new_mean, new_var
 
 

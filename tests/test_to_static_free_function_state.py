@@ -1,0 +1,49 @@
+"""@to_static on a FREE function touching closure-captured stateful layers
+(BatchNorm running stats): jit is pure, so buffer writes cannot persist —
+but they must also not leak trace-time tracers that crash the next eager
+use (the pre-fix failure). Layer-path decoration still persists stats."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.mark.parametrize("cls", ["BatchNorm1D", "SyncBatchNorm"])
+def test_free_function_no_tracer_leak(cls):
+    paddle.seed(0)
+    bn = getattr(paddle.nn, cls)(4)
+    bn.train()
+
+    @paddle.jit.to_static
+    def step(x):
+        return (bn(x) ** 2).sum()
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    s = float(step(x).numpy())
+    # the layer must stay eagerly usable after the traced call
+    e = float((bn(x) ** 2).sum().numpy())
+    np.testing.assert_allclose(s, e, rtol=1e-5)
+    # buffers hold concrete values, not tracers
+    assert isinstance(bn._mean.numpy(), np.ndarray)
+
+
+def test_layer_path_still_persists_buffers():
+    paddle.seed(1)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = paddle.nn.BatchNorm1D(3)
+
+        def forward(self, x):
+            return self.bn(x).sum()
+
+    net = paddle.jit.to_static(Net())
+    net.train()
+    before = net.bn._mean.numpy().copy()
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(6, 3).astype(np.float32) + 5)
+    net(x)
+    after = net.bn._mean.numpy()
+    assert not np.allclose(before, after)  # stats advanced through jit
