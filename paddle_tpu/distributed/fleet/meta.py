@@ -46,11 +46,37 @@ def wrap_optimizer(fleet_obj, optimizer, strategy):
     return optimizer
 
 
+def _remat_policy(strategy):
+    """Map recompute_configs to a jax.checkpoint policy — the TPU analogue
+    of the reference's per-op checkpoints list (recompute_optimizer.py):
+      granularity 'full'      -> recompute everything (default; max memory
+                                 savings, most recompute FLOPs)
+      granularity 'selective' -> save weight-matmul outputs, recompute
+                                 batched (attention-score) dots and
+                                 elementwise — the Megatron selective
+                                 recompute
+      granularity 'dots'      -> save every dot output, recompute only
+                                 elementwise chains
+    """
+    gran = (strategy.recompute_configs or {}).get("granularity", "full")
+    import jax.ad_checkpoint as adc
+    table = {
+        "full": None,  # jax.checkpoint default: recompute everything
+        "selective": adc.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots": adc.checkpoint_policies.dots_saveable,
+    }
+    if gran not in table:
+        raise ValueError(
+            f"recompute_configs.granularity must be one of {list(table)}, "
+            f"got {gran!r}")
+    return table[gran]
+
+
 def apply_strategy(strategy, loss_fn):
     """Wrap a pure loss_fn(params, batch, key) per strategy flags."""
     fn = loss_fn
     if strategy.recompute:
-        fn = jax.checkpoint(fn)
+        fn = jax.checkpoint(fn, policy=_remat_policy(strategy))
     if strategy.amp:
         inner = fn
 

@@ -80,12 +80,40 @@ class StaticFunction:
                 out = self._compiled(raw_args, raw_kw)
             finally:
                 snap = getattr(self, "_trace_snap", None)
-                if snap:
+                if snap is not None:
                     self._trace_snap = None
                     import jax.core as _jcore
+                    snapped = set()
                     for t, v in snap:
+                        snapped.add(id(t))
                         if isinstance(t._value, _jcore.Tracer):
                             t._value = v
+                    # layers CREATED during the trace have no pre-trace
+                    # values to restore — their params ARE tracers. A
+                    # layer that outlives the call (cached in a closure/
+                    # global) will crash on its next eager use; warn now
+                    # with an actionable message. (Raising would break
+                    # harmless inline temporaries that are about to be
+                    # garbage-collected.)
+                    import warnings
+
+                    from ..nn.layer.layers import _LIVE_LAYERS
+                    for live in list(_LIVE_LAYERS):
+                        for t in list(live.parameters(
+                                include_sublayers=False)) \
+                                + list(live.buffers(
+                                    include_sublayers=False)):
+                            if id(t) not in snapped and isinstance(
+                                    t._value, _jcore.Tracer):
+                                warnings.warn(
+                                    f"Layer {type(live).__name__} was "
+                                    "constructed inside a @to_static free "
+                                    "function and holds trace-time "
+                                    "tracers; if it is reused eagerly it "
+                                    "will fail — construct layers before "
+                                    "decorating, or decorate the Layer "
+                                    "itself", stacklevel=2)
+                                break
             return jax.tree_util.tree_map(_wrap, out)
 
         # layer path: functionalize params/buffers

@@ -450,14 +450,17 @@ def _bn_channel_axis(data_format, ndim):
 
 
 def _bn_normalize(x, mean, var, weight, bias, epsilon, c_axis):
+    # natural dtype promotion (low-precision x with f32 running stats
+    # computes — and returns — in f32, matching the pre-refactor behavior;
+    # callers wanting the input dtype cast the result themselves)
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]
-    out = (x - mean.reshape(shape).astype(x.dtype)) * jax.lax.rsqrt(
-        var.reshape(shape).astype(x.dtype) + epsilon)
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+        var.reshape(shape) + epsilon)
     if weight is not None:
-        out = out * weight.reshape(shape).astype(x.dtype)
+        out = out * weight.reshape(shape)
     if bias is not None:
-        out = out + bias.reshape(shape).astype(x.dtype)
+        out = out + bias.reshape(shape)
     return out
 
 

@@ -155,6 +155,32 @@ class TestDataParallelStep:
         assert np.isfinite(float(loss))
         assert params["w1"].dtype == jnp.float32  # master weights stay f32
 
+    def test_recompute_granularity_policies(self):
+        # recompute_configs.granularity maps to jax.checkpoint policies
+        # (the reference's selective-recompute checkpoints list); every
+        # granularity must produce identical losses/grads — only the
+        # memory/recompute trade differs
+        def loss_fn(params, batch, key):
+            h = jnp.tanh(batch["x"] @ params["w1"])
+            return jnp.mean((h @ params["w2"]) ** 2)
+
+        params = {"w1": jnp.ones((4, 8), jnp.float32) * 0.1,
+                  "w2": jnp.ones((8, 1), jnp.float32) * 0.2}
+        batch = {"x": np.random.RandomState(0).rand(16, 4).astype(
+            np.float32)}
+        ref_grads = jax.grad(loss_fn)(params, batch, None)
+        from paddle_tpu.distributed.fleet.meta import apply_strategy
+        for gran in ("full", "selective", "dots"):
+            strategy = fleet.DistributedStrategy()
+            strategy.recompute = True
+            strategy.recompute_configs = {"granularity": gran}
+            fn = apply_strategy(strategy, loss_fn)
+            g = jax.grad(fn)(params, batch, None)
+            for k in ref_grads:
+                np.testing.assert_allclose(np.asarray(g[k]),
+                                           np.asarray(ref_grads[k]),
+                                           rtol=1e-6, err_msg=gran)
+
 
 class TestStrategyFlagLowering:
     """VERDICT r1 #3: every DistributedStrategy flag must lower to a real
