@@ -482,7 +482,11 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         mean, var = running_mean, running_var
         new_mean, new_var = running_mean, running_var
     out = _bn_normalize(x, mean, var, weight, bias, epsilon, c_axis)
-    return out, new_mean, new_var
+    # reference semantics: BN returns the INPUT dtype (normalization
+    # computed in the promoted precision of the f32 running stats, then
+    # cast back) — without this an AMP bf16 network silently re-promotes
+    # to f32 at its first BatchNorm
+    return out.astype(x.dtype), new_mean, new_var
 
 
 @defop()

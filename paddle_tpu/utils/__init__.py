@@ -2,6 +2,8 @@
 from __future__ import annotations
 
 from . import profiler  # noqa: F401
+from . import watchdog  # noqa: F401
+from .watchdog import Watchdog  # noqa: F401
 
 
 def try_import(name):
