@@ -127,7 +127,8 @@ class GPT2(nn.Layer):
             ops.reshape(labels, [-1]))
 
     def generate(self, input_ids, max_new_tokens, temperature=0.0,
-                 eos_token_id=None, seed=0, top_k=0, top_p=1.0):
+                 eos_token_id=None, seed=0, top_k=0, top_p=1.0,
+                 pad_token_id=None):
         """Autoregressive decoding with a KV cache (serving path; ref
         capability: fluid beam_search/sampling decode ops). TPU-first:
         static shapes throughout — prefill compiles once per prompt shape,
@@ -151,17 +152,28 @@ class GPT2(nn.Layer):
                 f"prompt ({ids.shape[1]}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_position "
                 f"({self.cfg.max_position})")
+        if pad_token_id is not None:
+            # batched variable-length prompts must be LEFT-padded: the
+            # decode reads the prompt's last token at position -1
+            valid = np.asarray(ids) != pad_token_id
+            if not valid.any(axis=1).all():
+                raise ValueError("a prompt row is entirely padding")
+            if (np.diff(valid.astype(np.int8), axis=1) < 0).any():
+                raise ValueError(
+                    "prompts must be LEFT-padded (pad tokens only at the "
+                    "start of each row)")
         params, _ = self.functional_state()
         out = _generate_jit(self.cfg, params, ids, max_new_tokens,
                             temperature,
                             -1 if eos_token_id is None else int(eos_token_id),
                             int(seed),
-                            min(int(top_k), self.cfg.vocab_size), top_p)
+                            min(int(top_k), self.cfg.vocab_size), top_p,
+                            -1 if pad_token_id is None else int(pad_token_id))
         return Tensor(out, stop_gradient=True)
 
 
 def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed,
-                  top_k=0, top_p=1.0):
+                  top_k=0, top_p=1.0, pad=-1):
     import jax
     import jax.numpy as jnp
 
@@ -169,12 +181,14 @@ def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed,
             cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
             cfg.layer_norm_epsilon, cfg.tie_embeddings)
     fn = _generate_impl(spec, max_new, top_k, top_p < 1.0)
-    # key/temperature/eos/top_p are traced arguments: new values reuse the
-    # compiled program (static: max_new — the scan length — top_k, which
-    # fixes the lax.top_k output shape, and WHETHER nucleus filtering is
-    # on, so the default top_p=1.0 path never pays the per-token sort)
+    # key/temperature/eos/top_p/pad are traced arguments: new values reuse
+    # the compiled program (static: max_new — the scan length — top_k,
+    # which fixes the lax.top_k output shape, and WHETHER nucleus
+    # filtering is on, so the default top_p=1.0 path never pays the
+    # per-token sort)
     return fn(params, ids, jax.random.key(seed),
-              jnp.float32(temp), jnp.int32(eos), jnp.float32(top_p))
+              jnp.float32(temp), jnp.int32(eos), jnp.float32(top_p),
+              jnp.int32(pad))
 
 
 import functools as _functools  # noqa: E402
@@ -214,7 +228,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
         new = q.shape[:-1] + (H, Dh)
         return q.reshape(new), k.reshape(new), v.reshape(new)
 
-    def step_fn(params, ids, key0, temp, eos, top_p):
+    def step_fn(params, ids, key0, temp, eos, top_p, pad):
         B, S0 = ids.shape
         S = S0 + max_new
         wte = params["wte.weight"]
@@ -225,11 +239,20 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
             w = wte.T if tied else params["lm_head.weight"]
             return (xf @ w).astype(jnp.float32)
 
+        # LEFT-padding support: pad is a traced token id (-1 = no padding,
+        # valid everywhere). Pad keys are masked out of attention, pad
+        # positions don't consume wpe slots, and the rightmost position is
+        # always a real token, so x[:, -1] stays the correct read-out.
+        valid = ids != pad                           # [B, S0] bool
+        pos = jnp.maximum(jnp.cumsum(valid, axis=1) - 1, 0)
+        n_valid = valid.sum(axis=1)                  # [B]
+
         # ---- prefill over the prompt (causal full attention) ----
-        x = wte[ids] + wpe[jnp.arange(S0)]
+        x = wte[ids] + wpe[pos]
         ck = jnp.zeros((L, B, H, S, Dh), dt)
         cv = jnp.zeros((L, B, H, S, Dh), dt)
         causal = jnp.tril(jnp.ones((S0, S0), bool))
+        kmask = causal[None, None] & valid[:, None, None, :]
         for i in range(L):
             a = ln(x, params[f"h.{i}.ln_1.weight"],
                    params[f"h.{i}.ln_1.bias"])
@@ -239,7 +262,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
             cv = cv.at[i, :, :, :S0].set(v)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
                 jnp.float32) * scale
-            s = jnp.where(causal, s, -1e30)
+            s = jnp.where(kmask, s, -1e30)
             w = jax.nn.softmax(s, axis=-1).astype(dt)
             o = jnp.einsum("bhqk,bhkd->bhqd", w, v)
             o = o.transpose(0, 2, 1, 3).reshape(B, S0, E)
@@ -282,10 +305,13 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
         done0 = (tok0 == eos) & (eos >= 0)
 
         # ---- decode: one token per scan step against the cache ----
+        vfull = jnp.concatenate(
+            [valid, jnp.ones((B, max_new), bool)], axis=1)  # [B, S]
+
         def body(carry, step):
             tok, done, ck, cv, key = carry
-            t = S0 + step  # absolute position of `tok`
-            x = wte[tok] + wpe[t]                   # [B, E]
+            t = S0 + step  # absolute cache slot of `tok`
+            x = wte[tok] + wpe[n_valid + step]      # per-row position
             for i in range(L):
                 a = ln(x, params[f"h.{i}.ln_1.weight"],
                        params[f"h.{i}.ln_1.bias"])
@@ -294,7 +320,8 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
                 cv = cv.at[i, :, :, t].set(v)
                 s = jnp.einsum("bhd,bhsd->bhs", q, ck[i]).astype(
                     jnp.float32) * scale
-                s = jnp.where(jnp.arange(s.shape[-1]) <= t, s, -1e30)
+                s = jnp.where((jnp.arange(s.shape[-1]) <= t)[None, None]
+                              & vfull[:, None, :], s, -1e30)
                 w = jax.nn.softmax(s, axis=-1).astype(dt)
                 o = jnp.einsum("bhs,bhsd->bhd", w, cv[i]).reshape(B, E)
                 x = x + o @ params[f"h.{i}.out_proj.weight"] \
@@ -330,11 +357,13 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
     in a serving process with NO Python model class:
 
         served = paddle.jit.load(path_prefix)
-        tokens = served(ids, seed, temperature, eos, top_p)
+        tokens = served(ids, seed, temperature, eos, top_p, pad)
 
     ids: [B, prompt_len] int32 (B symbolic when batch_size is None);
     seed uint32; temperature/top_p float32 (top_p only filters when
-    exported with top_p_enabled); eos int32 (-1 disables)."""
+    exported with top_p_enabled); eos int32 (-1 disables); pad int32
+    (-1 = no padding, otherwise prompts must be LEFT-padded with this
+    token id and pads are masked from attention)."""
     import jax
     import jax.numpy as jnp
 
@@ -353,9 +382,10 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
                               min(int(top_k), cfg.vocab_size),
                               bool(top_p_enabled))
 
-    def serving_fn(params, bufs, ids, seed, temp, eos, top_p):
+    def serving_fn(params, bufs, ids, seed, temp, eos, top_p, pad):
         del bufs  # GPT-2 has no buffers; kept for the artifact convention
-        return decode(params, ids, jax.random.key(seed), temp, eos, top_p)
+        return decode(params, ids, jax.random.key(seed), temp, eos, top_p,
+                      pad)
 
     params, _ = model.functional_state()
     if batch_size is None:
@@ -367,7 +397,8 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
             jax.ShapeDtypeStruct((), jnp.uint32),
             jax.ShapeDtypeStruct((), jnp.float32),
             jax.ShapeDtypeStruct((), jnp.int32),
-            jax.ShapeDtypeStruct((), jnp.float32))
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32))
     p_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for k, v in params.items()}
     jf = jax.jit(serving_fn)
@@ -381,7 +412,8 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
             "max_new_tokens": int(max_new_tokens), "top_k": int(top_k),
             "top_p_enabled": bool(top_p_enabled),
             "inputs": ["ids[int32]", "seed[uint32]",
-                       "temperature[f32]", "eos[int32]", "top_p[f32]"]}
+                       "temperature[f32]", "eos[int32]", "top_p[f32]",
+                       "pad[int32] (-1 disables left-pad masking)"]}
     return jit_mod.write_artifact(path_prefix, exported, params, {}, meta)
 
 

@@ -450,9 +450,11 @@ def _bn_channel_axis(data_format, ndim):
 
 
 def _bn_normalize(x, mean, var, weight, bias, epsilon, c_axis):
-    # natural dtype promotion (low-precision x with f32 running stats
-    # computes — and returns — in f32, matching the pre-refactor behavior;
-    # callers wanting the input dtype cast the result themselves)
+    # computes in the naturally-promoted dtype (low-precision x with f32
+    # stats -> f32 math) and RETURNS promoted; both op-level callers cast
+    # back to the input dtype themselves — that cast is the op contract
+    # (reference BN returns the input dtype), do not return promoted
+    # values from a new op without it
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]
     out = (x - mean.reshape(shape)) * jax.lax.rsqrt(

@@ -68,10 +68,14 @@ class Watchdog:
             self._thread = None  # reap a fired/finished thread: re-arm
         if self._thread is not None:
             return self
-        self._stop.clear()
+        # PER-START stop event: a previous thread still draining its
+        # on_timeout callback holds the OLD event, so a stop()+start()
+        # cycle can never let it resurrect and fire against the new run
+        self._stop = threading.Event()
         self._last = time.monotonic()
         self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="paddle-tpu-watchdog")
+                                        name="paddle-tpu-watchdog",
+                                        args=(self._stop,))
         self._thread.start()
         return self
 
@@ -92,8 +96,10 @@ class Watchdog:
         return self._fired
 
     # ---- internals -------------------------------------------------------
-    def _run(self):
-        while not self._stop.wait(self.poll):
+    def _run(self, stop):
+        # `stop` is THIS thread's own event (see start()) — checking the
+        # instance attribute would race with a stop()+start() re-arm
+        while not stop.wait(self.poll):
             idle = time.monotonic() - self._last
             if idle < self.timeout:
                 continue
@@ -107,7 +113,7 @@ class Watchdog:
                     traceback.print_exc(file=sys.stderr)
             # the callback takes time; if the loop finished cleanly and
             # stop() ran meanwhile, do NOT kill/interrupt a healthy exit
-            if self._stop.is_set():
+            if stop.is_set():
                 return
             if self.action == "interrupt":
                 import _thread

@@ -20,7 +20,7 @@ def test_exported_generator_matches_generate(tmp_path):
     ids = np.random.RandomState(0).randint(
         0, cfg.vocab_size, (2, 5)).astype(np.int32)
     out = served(ids, np.uint32(0), np.float32(0.0), np.int32(-1),
-                 np.float32(1.0)).numpy()
+                 np.float32(1.0), np.int32(-1)).numpy()
     ref = model.generate(ids, max_new_tokens=6).numpy()
     np.testing.assert_array_equal(out, ref)
 
@@ -28,7 +28,7 @@ def test_exported_generator_matches_generate(tmp_path):
     ids3 = np.random.RandomState(1).randint(
         0, cfg.vocab_size, (3, 5)).astype(np.int32)
     out3 = served(ids3, np.uint32(0), np.float32(0.0), np.int32(-1),
-                  np.float32(1.0)).numpy()
+                  np.float32(1.0), np.int32(-1)).numpy()
     np.testing.assert_array_equal(out3,
                                   model.generate(ids3, 6).numpy())
 
@@ -45,8 +45,8 @@ def test_exported_generator_sampling_reproducible(tmp_path):
     served = paddle.jit.load(prefix)
     ids = np.array([[1, 2, 3, 4]], np.int32)
     a = served(ids, np.uint32(7), np.float32(0.9), np.int32(-1),
-               np.float32(1.0)).numpy()
+               np.float32(1.0), np.int32(-1)).numpy()
     b = served(ids, np.uint32(7), np.float32(0.9), np.int32(-1),
-               np.float32(1.0)).numpy()
+               np.float32(1.0), np.int32(-1)).numpy()
     np.testing.assert_array_equal(a, b)
     assert a.shape == (1, 9)

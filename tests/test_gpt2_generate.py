@@ -85,6 +85,32 @@ def test_sampling_is_reproducible_and_plausible():
     assert (a[:, :4] == ids).all()
 
 
+def test_left_padded_batch_matches_per_row():
+    # variable-length prompts, left-padded into one batch: each row must
+    # decode exactly as it would alone (pads masked from attention,
+    # positions not consumed by pads)
+    paddle.seed(10)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    pad = 0
+    p1 = np.array([5, 9, 2, 7], np.int64)        # length 4
+    p2 = np.array([11, 3], np.int64)             # length 2
+    batch = np.stack([p1, np.concatenate([[pad, pad], p2])])
+    out = model.generate(batch, 5, pad_token_id=pad).numpy()
+    r1 = model.generate(p1[None], 5).numpy()[0]
+    r2 = model.generate(p2[None], 5).numpy()[0]
+    np.testing.assert_array_equal(out[0, 4:], r1[4:])
+    np.testing.assert_array_equal(out[1, 4:], r2[2:])
+
+    # right padding is rejected loudly
+    bad = np.stack([p1, np.concatenate([p2, [pad, pad]])])
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="LEFT-padded"):
+        model.generate(bad, 3, pad_token_id=pad)
+
+
 def test_top_k_top_p_filtering():
     paddle.seed(6)
     cfg = GPT2Config.tiny()
