@@ -58,7 +58,15 @@ def main():
                                    "/tmp/gpt2_deploy.pdiparams"))
     logits = pred.run([ids.astype(np.int64)])
     print("deployed predictor logits:", tuple(logits.shape))
-    print("OK: trained, checkpointed, exported, served")
+
+    # text generation: KV-cache decode with sampling; left-padded batches
+    # of unequal prompts decode row-independently
+    pad = 0
+    prompts = np.array([[3, 5, 7, 9], [pad, pad, 11, 13]], np.int64)
+    out = model.generate(prompts, max_new_tokens=8, temperature=0.8,
+                         top_k=40, seed=1, pad_token_id=pad)
+    print("generated:", out.numpy()[1].tolist())
+    print("OK: trained, checkpointed, exported, served, generated")
 
 
 if __name__ == "__main__":
