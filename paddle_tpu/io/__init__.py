@@ -252,6 +252,13 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        if persistent_workers and num_workers > 0:
+            import warnings
+            warnings.warn(
+                "persistent_workers is accepted for API compatibility but "
+                "is a no-op here: workers are forked per epoch, which is "
+                "milliseconds under the fork start method (no interpreter "
+                "re-import)", stacklevel=2)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
