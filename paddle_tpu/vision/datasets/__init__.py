@@ -36,13 +36,18 @@ class MNIST(Dataset):
                 self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
         else:
             # synthetic fallback: class-conditional patterns so models can
-            # actually fit (loss decreases) in tests/benchmarks
+            # actually fit. The class PROTOTYPES are shared between train
+            # and test (only labels/noise differ per mode) — otherwise the
+            # test split is a different task and eval accuracy is chance
             n = 6000 if mode == "train" else 1000
             seeds = type(self)._SYN_SEEDS
+            # prototypes use their own stream, independent of the
+            # per-mode label/noise draws
+            base = np.random.RandomState(hash(seeds) % (1 << 31)).rand(
+                10, 28, 28) * 255
             rng = np.random.RandomState(
                 seeds[0] if mode == "train" else seeds[1])
             self.labels = rng.randint(0, 10, n).astype(np.int64)
-            base = rng.rand(10, 28, 28) * 255
             noise = rng.rand(n, 28, 28) * 64
             self.images = np.clip(base[self.labels] * 0.75 + noise, 0,
                                   255).astype(np.uint8)
@@ -90,12 +95,14 @@ class Cifar10(Dataset):
         if data_file and os.path.exists(data_file):
             self._load_file(data_file, mode)
         else:
+            # shared class prototypes across modes (see MNIST note)
             n = 5000 if mode == "train" else 1000
             seeds = type(self)._SYN_SEEDS
+            base = np.random.RandomState(hash(seeds) % (1 << 31)).rand(
+                self.num_classes, 3, 32, 32) * 255
             rng = np.random.RandomState(
                 seeds[0] if mode == "train" else seeds[1])
             self.labels = rng.randint(0, self.num_classes, n).astype(np.int64)
-            base = rng.rand(self.num_classes, 3, 32, 32) * 255
             noise = rng.rand(n, 3, 32, 32) * 64
             self.images = np.clip(base[self.labels] * 0.75 + noise, 0,
                                   255).astype(np.uint8)

@@ -272,3 +272,47 @@ class TestDatasetFormats:
         assert len(tr) == 8 and len(te) == 2
         x, y = tr[0]
         assert x.shape == (13,)
+
+
+class TestSyntheticFallbackGeneralization:
+    def test_train_test_share_class_prototypes(self):
+        # the synthetic fallback must be ONE task across splits: a model
+        # fit on train must transfer to test (regression: per-mode seeds
+        # once drew different class prototypes, making eval accuracy
+        # chance level)
+        import paddle_tpu as paddle
+        tr = paddle.vision.datasets.MNIST(mode="train")
+        te = paddle.vision.datasets.MNIST(mode="test")
+        # nearest-prototype classify test images using prototypes
+        # estimated from TRAIN data only
+        acc = {}
+        for i in range(600):
+            img, lab = tr[i]
+            acc.setdefault(int(np.ravel(lab)[0]), []).append(
+                np.asarray(img))
+        prot = np.stack([np.mean(acc[c], 0) for c in range(10)])
+        correct = 0
+        n = 200
+        for i in range(n):
+            img, lab = te[i]
+            d = ((prot - np.asarray(img)) ** 2).sum(axis=(1, 2, 3))
+            correct += int(d.argmin()) == int(np.ravel(lab)[0])
+        assert correct / n > 0.9, correct / n
+
+    def test_cifar_prototypes_shared(self):
+        import paddle_tpu as paddle
+        tr = paddle.vision.datasets.Cifar10(mode="train")
+        te = paddle.vision.datasets.Cifar10(mode="test")
+        prot = {}
+        for i in range(500):
+            img, lab = tr[i]
+            prot.setdefault(int(np.ravel(lab)[0]), []).append(np.asarray(img))
+        prot = {c: np.mean(v, 0) for c, v in prot.items()}
+        correct = 0
+        n = 100
+        for i in range(n):
+            img, lab = te[i]
+            d = {c: ((p - np.asarray(img)) ** 2).sum()
+                 for c, p in prot.items()}
+            correct += min(d, key=d.get) == int(np.ravel(lab)[0])
+        assert correct / n > 0.9, correct / n
