@@ -91,6 +91,16 @@ def apply_op(fn, name, args, kwargs, nondiff=False, stochastic=False):
         kwargs["key"] = rng.next_key()
 
     leaves, treedef = _flatten(args, kwargs)
+    for leaf in leaves:
+        if type(leaf).__name__ == "Variable" and hasattr(leaf, "block"):
+            # a static-Program Variable reached an EAGER op: the guard was
+            # entered without enabling static mode (2.0 defaults to
+            # dygraph, like the reference) — fail with guidance instead of
+            # a cryptic jax abstraction error
+            raise RuntimeError(
+                f"op '{name}' received a static Program Variable while in "
+                "dygraph mode; call paddle.enable_static() before building "
+                "static programs (fluid-style code runs under static mode)")
     vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
 
     diff_idx = []

@@ -146,9 +146,17 @@ class Executor:
         param_vals = {n: scope.find_var(n) for n in param_names}
 
         opt_states = {}
+        if len(program._optimizers) > 1:
+            raise NotImplementedError(
+                "multiple minimize() calls on one Program are not "
+                "supported: the compiled step applies one optimizer — "
+                "use separate Programs or one optimizer over all params")
         if program._optimizers:
             for i, (opt, loss, params) in enumerate(program._optimizers):
-                sname = f"@opt_state_{i}"
+                # program-scoped key: the scope is global, and two
+                # programs sharing "@opt_state_0" once handed one
+                # program's Adam moments to another's parameters
+                sname = f"@opt_state_{getattr(program, '_uid', 0)}_{i}"
                 st = scope.find_var(sname)
                 if st is None:
                     ptree = {p.name: param_vals[p.name] for p in params}
@@ -158,7 +166,8 @@ class Executor:
 
         key_shapes = tuple(sorted((n, tuple(v.shape), str(v.dtype))
                                   for n, v in feed_vals.items()))
-        cache_key = (id(program), program._version, key_shapes,
+        cache_key = (getattr(program, "_uid", id(program)),
+                     program._version, key_shapes,
                      tuple(fetch_names))
         compiled = self._cache.get(cache_key) if use_program_cache else None
 
@@ -179,7 +188,7 @@ class Executor:
 
                     ptree = {n: param_vals[n] for n in pnames}
                     grads, env = jax.grad(loss_fn, has_aux=True)(ptree)
-                    sname = "@opt_state_0"
+                    sname = f"@opt_state_{getattr(program, '_uid', 0)}_0"
                     lr = opt.get_lr() if not hasattr(opt._lr, "lr_at") else None
                     if opt._grad_clip is not None and hasattr(
                             opt._grad_clip, "clip_tree"):

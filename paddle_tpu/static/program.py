@@ -12,6 +12,7 @@ reference's per-op grad-op graph rewrite).
 from __future__ import annotations
 
 import contextlib
+import itertools
 from typing import Optional
 
 import jax
@@ -152,6 +153,9 @@ class Block:
         return [v for v in self.vars.values() if v.persistable and v.trainable]
 
 
+_program_uid = itertools.count()
+
+
 class Program:
     def __init__(self):
         self.blocks = [Block(self, 0)]
@@ -160,6 +164,10 @@ class Program:
         self._loss = None
         self._optimizers = []  # [(optimizer, loss_var, param_vars)]
         self._version = 0
+        # executor caches key on this, NOT id(): CPython recycles ids of
+        # collected Programs, which once served a stale compiled step to a
+        # fresh Program that happened to reuse the address
+        self._uid = next(_program_uid)
 
     def global_block(self):
         return self.blocks[0]
@@ -186,6 +194,10 @@ class Program:
             p.__dict__.update(self.__dict__)
             p._optimizers = []
             p._loss = self._loss
+        # a clone is a DIFFERENT executable: with a shared uid, the
+        # executor would serve the training program's cached step (with
+        # its optimizer update) to the for_test clone
+        p._uid = next(_program_uid)
         return p
 
     def __repr__(self):
@@ -274,17 +286,33 @@ def _append_op(opname, fn, args, kwargs, meta):
             spec.append(("const", l))
             avals.append(l)
 
-    # shape inference via eval_shape (replaces InferShape)
-    def infer(*vals):
-        a2, k2 = jax.tree_util.tree_unflatten(treedef, list(vals))
+    # shape inference via eval_shape (replaces InferShape). Only the
+    # Variable slots become eval_shape ARGUMENTS — string/int/None
+    # constants (data_format, strides, ...) must stay baked in the
+    # closure: eval_shape rejects non-array args, and turning an int
+    # stride into a traced scalar would break ops that need it static.
+    var_idx = [i for i, (kind, _) in enumerate(spec) if kind == "var"]
+    base_vals = list(avals)
+
+    def infer(*var_avals):
+        vals = list(base_vals)
+        for i, va in zip(var_idx, var_avals):
+            vals[i] = va
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, vals)
         if meta.get("stochastic"):
             k2 = dict(k2)
             k2["key"] = jax.random.key(0)
         return fn(*a2, **k2)
 
     try:
-        out_shape = jax.eval_shape(infer, *avals)
-    except Exception:
+        out_shape = jax.eval_shape(infer, *[avals[i] for i in var_idx])
+    except Exception as e:
+        import warnings
+        warnings.warn(
+            f"static shape inference failed for op '{opname}' "
+            f"({type(e).__name__}: {str(e)[:120]}); recording scalar "
+            "shape — downstream layers sized from this output will "
+            "misbehave", stacklevel=2)
         out_shape = jax.ShapeDtypeStruct((), jnp.float32)
 
     multi = isinstance(out_shape, (tuple, list))

@@ -42,8 +42,10 @@ class MNIST(Dataset):
             n = 6000 if mode == "train" else 1000
             seeds = type(self)._SYN_SEEDS
             # prototypes use their own stream, independent of the
-            # per-mode label/noise draws
-            base = np.random.RandomState(hash(seeds) % (1 << 31)).rand(
+            # per-mode label/noise draws (fixed arithmetic combine: tuple
+            # hash() is interpreter-dependent)
+            base = np.random.RandomState(
+                ((seeds[0] << 16) ^ seeds[1] ^ 0x5EED) % (1 << 31)).rand(
                 10, 28, 28) * 255
             rng = np.random.RandomState(
                 seeds[0] if mode == "train" else seeds[1])
@@ -98,7 +100,8 @@ class Cifar10(Dataset):
             # shared class prototypes across modes (see MNIST note)
             n = 5000 if mode == "train" else 1000
             seeds = type(self)._SYN_SEEDS
-            base = np.random.RandomState(hash(seeds) % (1 << 31)).rand(
+            base = np.random.RandomState(
+                ((seeds[0] << 16) ^ seeds[1] ^ 0x5EED) % (1 << 31)).rand(
                 self.num_classes, 3, 32, 32) * 255
             rng = np.random.RandomState(
                 seeds[0] if mode == "train" else seeds[1])

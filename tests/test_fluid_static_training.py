@@ -1,0 +1,87 @@
+"""BASELINE config #1: "MNIST LeNet via paddle.fluid static Executor" —
+an era-style fluid training script must run end to end and the loss must
+decrease; using static Variables without enable_static must fail with
+guidance, not a cryptic tracer error."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_static_lenet_mnist_loss_decreases():
+    paddle.enable_static()
+    try:
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.data(name="img", shape=[None, 1, 28, 28],
+                             dtype="float32")
+            label = fluid.data(name="label", shape=[None, 1], dtype="int64")
+            conv = fluid.layers.conv2d(img, num_filters=6, filter_size=5,
+                                       act="relu")
+            pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+            fc = fluid.layers.fc(pool, size=10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(fc, label))
+            opt = fluid.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        from paddle_tpu.vision.datasets import MNIST
+        ds = MNIST(mode="train")
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(8):
+            idx = rng.randint(0, len(ds), 32)
+            xs = np.stack([np.asarray(ds[i][0]) for i in idx])
+            ys = np.stack([ds[i][1] for i in idx])
+            out, = exe.run(main, feed={"img": xs, "label": ys},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert losses[-1] < losses[0], losses
+    finally:
+        paddle.disable_static()
+
+
+def test_clone_for_test_does_not_share_compiled_step():
+    # regression: clone(for_test=True) once shared the training program's
+    # executor cache entry, so "evaluation" applied optimizer updates
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        import paddle_tpu.optimizer as popt
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, size=1)
+            loss = ((pred - y) ** 2).mean()
+            test_prog = main.clone(for_test=True)
+            popt.SGD(learning_rate=0.5).minimize(loss)
+        assert test_prog._uid != main._uid
+        exe = static.Executor()
+        exe.run(startup)
+        xd = np.random.RandomState(0).rand(16, 3).astype(np.float32)
+        yd = xd.sum(1, keepdims=True)
+        (l_train,) = exe.run(main, feed={"x": xd, "y": yd},
+                             fetch_list=[loss])
+        (l_eval_1,) = exe.run(test_prog, feed={"x": xd, "y": yd},
+                              fetch_list=[loss])
+        (l_eval_2,) = exe.run(test_prog, feed={"x": xd, "y": yd},
+                              fetch_list=[loss])
+        # eval must be a pure forward: repeated eval does not change loss
+        np.testing.assert_allclose(float(l_eval_1), float(l_eval_2),
+                                   rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_variable_in_dygraph_raises_with_guidance():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        img = fluid.data(name="x", shape=[None, 4], dtype="float32")
+        with pytest.raises(RuntimeError, match="enable_static"):
+            fluid.layers.fc(img, size=2)
