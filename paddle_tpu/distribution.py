@@ -87,13 +87,40 @@ class Normal(Distribution):
 
 
 class Categorical(Distribution):
+    """Reference semantics (distribution.py Categorical): `logits` are
+    NON-NEGATIVE WEIGHTS for sample/probs/log_prob (probs = logits/sum —
+    the reference's doc example passes paddle.rand values), while
+    entropy/kl_divergence use softmax space (e_logits/z). The asymmetry
+    is the reference's own documented behavior, reproduced for migration
+    fidelity."""
+
     def __init__(self, logits, name=None):
         self.logits = _as(logits)
 
+    @property
+    def _weight_probs(self):
+        w = self.logits
+        s = jnp.sum(w, axis=-1, keepdims=True)
+        # weights must form a distribution; failing loudly beats the
+        # silent NaNs/negative "probabilities" a bare divide produces
+        # (validation is skipped under tracing, where values are unknown)
+        import jax.core as _jcore
+        if not isinstance(w, _jcore.Tracer) and (
+                bool(jnp.any(w < 0)) or bool(jnp.any(s <= 0))):
+            raise ValueError(
+                "Categorical logits are non-negative weights with a "
+                "positive sum under the reference semantics "
+                "(probs = w / w.sum()); got negative or all-zero weights")
+        return w / s
+
     def sample(self, shape=(), seed=0):
         key = rng_mod.next_key() if not seed else jax.random.key(seed)
+        _ = self._weight_probs  # validate weights
+        # categorical takes unnormalized log-weights (same pattern as
+        # sampling_id below) — no need to normalize first
         return Tensor(jax.random.categorical(
-            key, self.logits, shape=tuple(shape) + self.logits.shape[:-1]))
+            key, jnp.log(jnp.maximum(self.logits, 1e-30)),
+            shape=tuple(shape) + self.logits.shape[:-1]))
 
     @property
     def _probs(self):
@@ -101,15 +128,18 @@ class Categorical(Distribution):
 
     def probs(self, value=None):
         if value is None:
-            return Tensor(self._probs)
+            return Tensor(self._weight_probs)
         idx = jnp.asarray(raw(value)).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(self._probs, idx[..., None],
+        p = self._weight_probs
+        if p.ndim == 1:  # unbatched distribution: gather categories
+            return Tensor(jnp.take(p, idx, axis=-1))
+        return Tensor(jnp.take_along_axis(p, idx[..., None],
                                           axis=-1)[..., 0])
 
     def log_prob(self, value):
-        logp = jax.nn.log_softmax(self.logits, axis=-1)
-        idx = jnp.asarray(raw(value)).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0])
+        # plain log like the reference: zero-probability categories give
+        # -inf, not a clamped finite value
+        return Tensor(jnp.log(raw(self.probs(value))))
 
     def entropy(self):
         p = self._probs

@@ -103,10 +103,22 @@ class TestDistributions:
             pytest.approx(np.log(0.5), abs=1e-5)
 
     def test_categorical(self):
+        # reference semantics: logits are non-negative WEIGHTS for
+        # probs/sample (probs = w / w.sum()); entropy stays softmax-space
+        # (the reference's own asymmetry)
         from paddle_tpu.distribution import Categorical
-        d = Categorical(paddle.to_tensor([0.0, 0.0]))
+        d = Categorical(paddle.to_tensor([1.0, 1.0]))
         np.testing.assert_allclose(d.probs().numpy(), [0.5, 0.5])
         assert float(d.entropy().numpy()) == pytest.approx(np.log(2), abs=1e-5)
+        w = Categorical(paddle.to_tensor([0.25, 0.25, 0.5]))
+        np.testing.assert_allclose(w.probs().numpy(), [0.25, 0.25, 0.5],
+                                   rtol=1e-6)
+        paddle.seed(3)
+        s = np.asarray(w.sample([2000]).numpy())
+        frac = np.bincount(s, minlength=3) / 2000
+        assert abs(frac[2] - 0.5) < 0.05, frac
+        assert float(np.exp(w.log_prob(paddle.to_tensor([2])).numpy())) \
+            == pytest.approx(0.5, abs=1e-5)
 
     def test_normal_kl(self):
         from paddle_tpu.distribution import Normal, kl_divergence
