@@ -17,12 +17,14 @@ import time
 import numpy as np
 
 
-def _device_probe_ok(attempts=3, timeout=110, backoff=30):
+def _device_probe_ok(attempts=2, timeout=100, backoff=20):
     """Probe jax backend init in a subprocess — the TPU tunnel can wedge
     (jax.devices() blocks for minutes) or be hard-down (UNAVAILABLE). Retry
-    with backoff (worst case 3*110+2*30 = 390s, leaving room for the CPU
-    fallback inside the driver's 600s budget); log every outcome so a CPU
-    fallback is explained, never silent. (VERDICT r1 weak #1.)"""
+    with backoff (worst case 2*100+20 = 220s: a healthy tunnel answers the
+    first attempt in seconds, and the tighter budget guarantees the CPU
+    fallback's JSON line lands inside the driver's 600s window even with a
+    cold compile cache); log every outcome so a CPU fallback is explained,
+    never silent. (VERDICT r1 weak #1.)"""
     probe = ("import jax; d = jax.devices(); "
              "import jax.numpy as jnp; "
              "(jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready()"
