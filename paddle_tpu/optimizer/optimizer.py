@@ -193,18 +193,77 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state):
-        self._step_count = int(state.get("@step", 0))
-        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
-            self._lr.set_state_dict(state["LR_Scheduler"])
+        # resolve + validate EVERYTHING first; mutate only at the end, so
+        # a rejected checkpoint leaves the optimizer untouched
         names = {name: pid for pid, name in self._param_names().items()}
-        by_param = {}
+        # saved per-param key order == parameter_list order at save time
+        saved_pnames = []
+        saved_slots = {}
         for key, v in state.items():
             if key in ("@step", "LR_Scheduler"):
                 continue
             pname, slot = key.rsplit(".", 1)
-            if pname in names:
-                arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
-                by_param.setdefault(names[pname], {})[slot] = arr
+            if pname not in saved_pnames:
+                saved_pnames.append(pname)
+            arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            saved_slots.setdefault(pname, {})[slot] = arr
+        cur_params = [p for p in (self._parameter_list or [])
+                      if p is not None]
+        unmatched = [pn for pn in saved_pnames if pn not in names]
+        if not unmatched:
+            mapping = {pn: names[pn] for pn in saved_pnames}
+        else:
+            # Same-architecture resume with regenerated global names (a
+            # second model built in the process shifts the unique
+            # counter): align saved groups to parameters by ORDER + SHAPE
+            # — all-positional once engaged (a coincidental stale name
+            # match must not override position), and shape-skipping
+            # tolerates frozen params that never grew slots.
+            import warnings
+            mapping = {}
+            ci = 0
+
+            def _shape_of(slots):
+                for a in slots.values():
+                    if hasattr(a, "shape") and a.shape:
+                        return tuple(a.shape)
+                return None
+
+            for pn in saved_pnames:
+                want = _shape_of(saved_slots[pn])
+                while ci < len(cur_params) and want is not None and \
+                        tuple(cur_params[ci].shape) != want:
+                    ci += 1  # frozen/slotless param: skip
+                if ci >= len(cur_params):
+                    raise ValueError(
+                        f"optimizer state group '{pn}' (shape {want}) has "
+                        "no positional parameter match — wrong "
+                        "architecture?")
+                mapping[pn] = id(cur_params[ci])
+                ci += 1
+            warnings.warn(
+                f"optimizer state names {unmatched[:3]}... not found; "
+                "matched saved slots to parameters by order and shape "
+                "(same-architecture resume)", stacklevel=2)
+        # shape guard for the name-matched path too
+        shapes = {id(p): tuple(p.shape) for p in cur_params}
+        by_param = {}
+        for pn, slots in saved_slots.items():
+            pid = mapping.get(pn)
+            if pid is None:
+                continue
+            for slot, arr in slots.items():
+                if hasattr(arr, "shape") and arr.shape and \
+                        tuple(arr.shape) != shapes.get(pid):
+                    raise ValueError(
+                        f"optimizer slot '{pn}.{slot}' shape "
+                        f"{tuple(arr.shape)} does not match parameter "
+                        f"shape {shapes.get(pid)}")
+            by_param[pid] = dict(slots)
+        # ---- commit ----
+        self._step_count = int(state.get("@step", 0))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
         self._slots.update(by_param)
 
     set_dict = set_state_dict

@@ -1,0 +1,104 @@
+"""Optimizer resume fidelity: set_state_dict must restore Adam moments
+even when the fresh model's global parameter names differ from the saved
+ones (same-architecture positional fallback), and must refuse a
+different architecture instead of silently corrupting slots."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _build(width=4):
+    net = paddle.nn.Linear(width, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    return net, opt
+
+
+def test_positional_resume_is_exact():
+    paddle.seed(0)
+    net, opt = _build()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(16, 4).astype(np.float32))
+    for _ in range(5):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    saved = {"net": net.state_dict(), "opt": opt.state_dict()}
+
+    net2, opt2 = _build()  # fresh global param names
+    net2.set_state_dict(saved["net"])
+    with pytest.warns(UserWarning, match="order and shape"):
+        opt2.set_state_dict(saved["opt"])
+
+    for n_, o_ in ((net, opt), (net2, opt2)):
+        loss = (n_(x) ** 2).mean()
+        loss.backward()
+        o_.step()
+        o_.clear_grad()
+    np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())
+
+
+def test_wrong_architecture_rejected_without_mutation():
+    paddle.seed(1)
+    net, opt = _build(4)
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    saved = opt.state_dict()
+
+    net3, opt3 = _build(6)  # different shape, same param count
+    step_before = opt3._step_count
+    with pytest.raises(ValueError):
+        opt3.set_state_dict(saved)
+    # a rejected checkpoint leaves the optimizer untouched
+    assert opt3._step_count == step_before
+    assert not opt3._slots
+
+
+def test_frozen_param_resume_skipped_by_shape():
+    # a frozen (never-stepped) param has no saved slots; positional
+    # matching must skip it by shape instead of failing the count check
+    paddle.seed(2)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = paddle.nn.Linear(4, 3)   # trains
+            self.frozen = paddle.nn.Linear(7, 7)  # distinct shapes
+            for p in self.frozen.parameters():
+                p.stop_gradient = True
+            self.b = paddle.nn.Linear(3, 1)   # trains
+
+        def forward(self, x):
+            return self.b(paddle.nn.functional.relu(self.a(x)))
+
+    def build():
+        net = Net()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        return net, opt
+
+    net, opt = build()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(8, 4).astype(np.float32))
+    for _ in range(3):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    saved = {"net": net.state_dict(), "opt": opt.state_dict()}
+
+    net2, opt2 = build()
+    net2.set_state_dict(saved["net"])
+    with pytest.warns(UserWarning, match="order and shape"):
+        opt2.set_state_dict(saved["opt"])
+    for n_, o_ in ((net, opt), (net2, opt2)):
+        loss = (n_(x) ** 2).mean()
+        loss.backward()
+        o_.step()
+        o_.clear_grad()
+    np.testing.assert_array_equal(net.b.weight.numpy(),
+                                  net2.b.weight.numpy())
