@@ -201,7 +201,8 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor owned by a Layer (ref: framework.py Parameter)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "no_weight_decay")
 
     def __init__(self, value, dtype=None, name=None, trainable=True):
         super().__init__(value, dtype=dtype, stop_gradient=not trainable,
