@@ -213,6 +213,17 @@ class Parameter(Tensor):
         self.need_clip = True
         self.is_distributed = False
 
+    # For a Parameter, `trainable` and `stop_gradient` are two views of one
+    # bit (ref: ParamBase couples them): freezing via either attribute must
+    # be seen by optimizers that check the other.
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, value):
+        self.stop_gradient = not value
+
     def __repr__(self):
         return ("Parameter containing:\n" + super().__repr__())
 

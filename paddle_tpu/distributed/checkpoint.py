@@ -23,6 +23,7 @@ instead of per-rank file copies.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import re as _re
@@ -89,18 +90,25 @@ def save(state, ckpt_dir, process_index=None, save_id=None):
                           for d, s in enumerate(sh.index))
             safe_key = key.replace("/", "_").replace("'", "").replace(
                 "[", ".").replace("]", "")
+            # sanitization is lossy ('/'→'_', '[x]'→'.x'); the hash makes
+            # distinct keys collision-proof on disk
+            safe_key += "-" + hashlib.sha1(key.encode()).hexdigest()[:8]
             # rank FIRST: cleanup/ownership parse the fixed-position
             # tokens, immune to rank-like substrings in parameter names
             fname = (f"r{process_index}.{save_id}.{safe_key}"
                      f".{'_'.join(map(str, starts))}.npy")
             tmp = os.path.join(ckpt_dir, fname + ".tmp")
             with open(tmp, "wb") as f:  # np.save(path) would append .npy
-                np.save(f, np.asarray(sh.data))
+                # bit-preserving byte view: np.save on an ml_dtypes array
+                # (bf16, fp8) writes an opaque '|V2' descr that np.load
+                # cannot cast back; the index records the true dtype
+                np.save(f, np.ascontiguousarray(
+                    np.asarray(sh.data)).reshape(-1).view(np.uint8))
             os.replace(tmp, os.path.join(ckpt_dir, fname))
             shards.append({"starts": starts, "stops": stops,
                            "file": fname})
         index[key] = {"shape": tuple(val.shape), "dtype": str(val.dtype),
-                      "shards": shards}
+                      "fmt": "raw1", "shards": shards}
     ipath = os.path.join(ckpt_dir, f"index.p{process_index}.pkl")
     with open(ipath + ".tmp", "wb") as f:
         pickle.dump(index, f, protocol=4)
@@ -202,14 +210,17 @@ def load(ckpt_dir, like):
                 f"dtype mismatch for '{key}': checkpoint {meta['dtype']} "
                 f"vs target {tgt_arr.dtype} — cast explicitly after load")
         dtype = np.dtype(jax.numpy.dtype(meta["dtype"]))
+        raw = meta.get("fmt") == "raw1"
         slabs = [(tuple(s["starts"]), tuple(s["stops"]), s["file"])
                  for s in meta["shards"]]
         files: dict = {}
 
-        def read(fname, _files=files):
+        def read(fname, slab_shape, _files=files, _raw=raw, _dtype=dtype):
             if fname not in _files:
-                _files[fname] = np.load(os.path.join(ckpt_dir, fname),
-                                        mmap_mode="r")
+                a = np.load(os.path.join(ckpt_dir, fname), mmap_mode="r")
+                if _raw:  # flat uint8 byte stream → true dtype + shape
+                    a = a.view(_dtype).reshape(slab_shape)
+                _files[fname] = a
             return _files[fname]
 
         def cb(idx, *, _slabs=slabs, _shape=shape, _dtype=dtype,
@@ -230,7 +241,8 @@ def load(ckpt_dir, like):
                             for a, b, o in zip(inter_a, inter_b, sst))
                 dst = tuple(slice(a - o, b - o)
                             for a, b, o in zip(inter_a, inter_b, starts))
-                block[dst] = _read(fname)[src]
+                slab_shape = [b - a for a, b in zip(sst, ssp)]
+                block[dst] = _read(fname, slab_shape)[src]
                 filled[dst] = True
             if not filled.all():
                 raise ValueError(

@@ -40,6 +40,11 @@ class StaticFunction:
         self._input_spec = input_spec
         self._compiled = None
         self._training = None
+        # trace snapshot is per-call state: keep it thread-local so two
+        # threads calling the same StaticFunction (or a retrace while
+        # another call is in flight) can't restore each other's snapshot
+        import threading
+        self._tls = threading.local()
 
     def _get_layer(self, args):
         if self._layer is not None:
@@ -63,7 +68,7 @@ class StaticFunction:
                     # Steady-state (cached-compile) calls never execute
                     # this body, so they skip the O(all-layers) scan.
                     from ..nn.layer.layers import _LIVE_LAYERS
-                    self._trace_snap = [
+                    self._tls.trace_snap = [
                         (t, t._value) for live in list(_LIVE_LAYERS)
                         for t in list(live.parameters(
                             include_sublayers=False))
@@ -79,9 +84,9 @@ class StaticFunction:
             try:
                 out = self._compiled(raw_args, raw_kw)
             finally:
-                snap = getattr(self, "_trace_snap", None)
+                snap = getattr(self._tls, "trace_snap", None)
                 if snap is not None:
-                    self._trace_snap = None
+                    self._tls.trace_snap = None
                     import jax.core as _jcore
                     snapped = set()
                     for t, v in snap:

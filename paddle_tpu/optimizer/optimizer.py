@@ -220,8 +220,23 @@ class Optimizer:
             # match must not override position), and shape-skipping
             # tolerates frozen params that never grew slots.
             import warnings
+            # positional matching is only sound when the saved run and
+            # this run have compatible parameter rosters. Slots are
+            # created lazily (only for params that received grads), so
+            # saved groups <= trainable params is legitimate — but MORE
+            # saved groups than trainable params means the architectures
+            # differ and every later group would land on a wrong,
+            # possibly same-shape, parameter undetected.
+            slot_bearing = [p for p in cur_params if p.trainable]
+            if len(saved_pnames) > len(slot_bearing):
+                raise ValueError(
+                    f"optimizer state has {len(saved_pnames)} parameter "
+                    f"groups but the model has only {len(slot_bearing)} "
+                    "trainable parameters — positional resume would "
+                    "misalign moments; architectures differ")
             mapping = {}
             ci = 0
+            pairing = []
 
             def _shape_of(slots):
                 for a in slots.values():
@@ -229,6 +244,7 @@ class Optimizer:
                         return tuple(a.shape)
                 return None
 
+            names_by_id = self._param_names()
             for pn in saved_pnames:
                 want = _shape_of(saved_slots[pn])
                 while ci < len(cur_params) and want is not None and \
@@ -240,11 +256,15 @@ class Optimizer:
                         "no positional parameter match — wrong "
                         "architecture?")
                 mapping[pn] = id(cur_params[ci])
+                pairing.append((pn, names_by_id.get(id(cur_params[ci]))))
                 ci += 1
+            shown = pairing[:5]
             warnings.warn(
                 f"optimizer state names {unmatched[:3]}... not found; "
                 "matched saved slots to parameters by order and shape "
-                "(same-architecture resume)", stacklevel=2)
+                f"(same-architecture resume): {shown}"
+                + (f" ... ({len(pairing)} pairs total)"
+                   if len(pairing) > len(shown) else ""), stacklevel=2)
         # shape guard for the name-matched path too
         shapes = {id(p): tuple(p.shape) for p in cur_params}
         by_param = {}
