@@ -7,23 +7,223 @@ all backed by the TPU-native implementations.
 from __future__ import annotations
 
 from ..core.param_attr import ParamAttr  # noqa: F401
-from ..core.place import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace  # noqa: F401
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace, XPUPlace)
 from ..core.tensor import Tensor  # noqa: F401
 from ..static import (  # noqa: F401
-    CompiledProgram, Executor, Program, data, default_main_program,
-    default_startup_program, global_scope, name_scope, program_guard,
-    scope_guard,
+    BuildStrategy, CompiledProgram, ExecutionStrategy, Executor,
+    ParallelExecutor, Program, WeightNormParamAttr, data,
+    default_main_program, default_startup_program, global_scope,
+    gradients, load_program_state, name_scope, program_guard, scope_guard,
+    set_program_state,
 )
 from ..static.program import Variable, append_backward  # noqa: F401
+from ..static.executor import Scope  # noqa: F401
 from .. import nn as _nn  # noqa: F401
 from .. import optimizer as _optimizer_mod
 from ..nn import initializer  # noqa: F401
+from ..nn import clip  # noqa: F401
 from .. import regularizer  # noqa: F401
 from . import contrib  # noqa: F401
+from . import core  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import layers  # noqa: F401
+from . import nets  # noqa: F401
 from ..io import DataLoader  # noqa: F401
 from ..core.mode import in_dygraph_mode  # noqa: F401
+
+# module-level attribute surface of the 1.x package (ref:
+# python/paddle/fluid/__init__.py:34-95 — fluid.core, fluid.profiler,
+# fluid.unique_name, the LoDTensor/Tensor aliases, dygraph toggles...):
+# real 1.x user code reaches these as attributes, most outside __all__.
+from ..core import unique_name  # noqa: F401
+from ..utils import profiler  # noqa: F401
+from ..core import rng as generator  # noqa: F401
+from . import dataset_feed as dataset  # noqa: F401  (fluid.dataset is the
+# DatasetFactory module, NOT the paddle.dataset readers package)
+from .. import framework  # noqa: F401
+from .. import incubate  # noqa: F401
+from .. import metric as metrics  # noqa: F401
+from ..static import executor  # noqa: F401
+from ..framework.io import load, save  # noqa: F401
+from ..ops import one_hot  # noqa: F401
+from .core import (  # noqa: F401
+    LoDTensor, LoDTensorArray, VarBase, _cuda_synchronize, _Scope)
+from .compat1x import (  # noqa: F401
+    DataFeeder, DistributeTranspiler, DistributeTranspilerConfig,
+    WeightedAverage, create_lod_tensor, create_random_int_lodtensor,
+    memory_optimize, release_memory)
+from .dygraph import (  # noqa: F401
+    disable_dygraph, enable_dygraph, load_dygraph, save_dygraph)
+
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+
+# dygraph layer classes the reference star-imports to fluid top level
+# (ref: fluid/__init__.py:86 `from .dygraph.nn import *`)
+from .dygraph import (  # noqa: E402,F401
+    BatchNorm, BilinearTensorProduct, Conv2D, Conv2DTranspose, Conv3D,
+    Conv3DTranspose, Dropout, Embedding, Flatten, GroupNorm, GRUUnit,
+    InstanceNorm, Layer, LayerNorm, Linear, NCE, Pool2D, PRelu,
+    SpectralNorm, TreeConv)
+from ..compat import ComplexVariable  # noqa: E402,F401
+from ..static import (  # noqa: E402,F401
+    cpu_places, cuda_pinned_places, cuda_places, device_guard)
+from ..nn.initializer import set_global_initializer  # noqa: E402,F401
+from ..utils import require_version  # noqa: E402,F401
+from .core import is_compiled_with_cuda, is_compiled_with_xpu  # noqa: E402,F401
+from ..distributed import fleet  # noqa: E402,F401
+from ..incubate import data_generator  # noqa: E402,F401
+from .dataset_feed import (  # noqa: E402,F401
+    DataFeedDesc, DatasetFactory, InMemoryDataset, QueueDataset)
+from . import dataset_feed as data_feed_desc  # noqa: E402,F401
+
+
+class backward:  # fluid.backward (ref: fluid/backward.py)
+    from ..static import gradients
+    from ..static.program import append_backward
+    gradients = staticmethod(gradients)
+    append_backward = staticmethod(append_backward)
+
+
+class compiler:  # fluid.compiler (ref: fluid/compiler.py)
+    CompiledProgram = CompiledProgram
+    BuildStrategy = BuildStrategy
+    ExecutionStrategy = ExecutionStrategy
+
+
+class parallel_executor:  # fluid.parallel_executor
+    ParallelExecutor = ParallelExecutor
+    BuildStrategy = BuildStrategy
+    ExecutionStrategy = ExecutionStrategy
+
+
+class trainer_desc:
+    """Trainer pipeline descriptors (ref: fluid/trainer_desc.py). In the
+    reference these serialize configs for the C++ MultiTrainer; here the
+    jitted whole-Program step IS the trainer, so they are plain config
+    records consumed by Executor.train_from_dataset."""
+
+    class TrainerDesc:
+        def __init__(self):
+            self.config = {}
+
+        def _set_fetch_var_and_info(self, fetch_vars, fetch_info,
+                                    print_period):
+            self.config.update(fetch_vars=fetch_vars,
+                               fetch_info=fetch_info,
+                               print_period=print_period)
+
+        def _set_debug(self, debug):
+            self.config["debug"] = debug
+
+        def _set_thread(self, thread_num):
+            self.config["thread_num"] = thread_num
+
+    class MultiTrainer(TrainerDesc):
+        pass
+
+    class DistMultiTrainer(TrainerDesc):
+        pass
+
+    class PipelineTrainer(TrainerDesc):
+        pass
+
+
+class evaluator:
+    """ref: fluid/evaluator.py — deprecated there in favor of
+    fluid.metrics; delegated accordingly."""
+
+    class Evaluator:
+        def __init__(self, name, **kwargs):
+            import warnings
+            warnings.warn(
+                "fluid.evaluator is deprecated; use fluid.metrics",
+                stacklevel=2)
+            self.metrics = []
+            self.helper = None
+            self.name = name
+
+    ChunkEvaluator = None  # bound below
+
+
+class distribute_lookup_table:
+    """ref: fluid/distribute_lookup_table.py — locate the distributed
+    (parameter-server) embedding table in a Program."""
+
+    @staticmethod
+    def find_distributed_lookup_table(program):
+        from ..static.program import Program
+        if not isinstance(program, Program):
+            raise TypeError("program must be a Program")
+        # PS sparse embeddings live in distributed.ps SparseTable on this
+        # stack, outside the Program's op list
+        return None
+
+
+class _ChunkEvaluator:
+    """Accumulating chunk F1 over batches (delegates to
+    metric.chunk_eval semantics)."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_infer = self.num_label = self.num_correct = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer += int(num_infer_chunks)
+        self.num_label += int(num_label_chunks)
+        self.num_correct += int(num_correct_chunks)
+        return self.eval()
+
+    def eval(self):
+        p = self.num_correct / self.num_infer if self.num_infer else 0.0
+        r = self.num_correct / self.num_label if self.num_label else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1
+
+
+evaluator.ChunkEvaluator = _ChunkEvaluator
+
+
+def load_op_library(lib_filename):
+    """Custom C++/CUDA op loading has no meaning against XLA: custom ops
+    on this stack are jax.custom_vjp / Pallas kernels (see ops/pallas) or
+    ctypes-bound native code (see csrc/). Raising shim, same form as the
+    ONNX drop (SURVEY §2 #39)."""
+    raise NotImplementedError(
+        "load_op_library loads reference-era .so op kernels, which cannot "
+        "run under XLA. Implement custom ops as jax.custom_vjp functions "
+        "or Pallas TPU kernels (paddle_tpu/ops/pallas has templates), or "
+        "bind host code via ctypes like paddle_tpu/csrc.")
+
+
+class average:  # fluid.average module surface (ref: fluid/average.py)
+    WeightedAverage = WeightedAverage
+
+
+class transpiler:  # fluid.transpiler (ref: fluid/transpiler/__init__.py)
+    DistributeTranspiler = DistributeTranspiler
+    DistributeTranspilerConfig = DistributeTranspilerConfig
+    memory_optimize = staticmethod(memory_optimize)
+    release_memory = staticmethod(release_memory)
+
+
+class install_check:  # fluid.install_check (ref: fluid/install_check.py)
+    from .compat1x import run_check
+    run_check = staticmethod(run_check)
+
+
+def monkey_patch_variable():
+    """Tensor operator patching is applied at import on this stack; kept
+    callable for 1.x code that invokes it explicitly."""
+
+
+def monkey_patch_varbase():
+    pass
 
 
 class optimizer:  # fluid.optimizer.* (classes with fluid-era ctor names)
@@ -76,6 +276,49 @@ class io:
         scope = global_scope()
         for name, t in state.items():
             scope.set(name, t._value)
+
+    # persist/restore whole train states + servable artifacts (ref:
+    # fluid/io.py save/load/save_inference_model/load_inference_model)
+    @staticmethod
+    def save(obj, path, **kw):
+        from ..framework.io import save as fsave
+        return fsave(obj, path, **kw)
+
+    @staticmethod
+    def load(path, **kw):
+        from ..framework.io import load as fload
+        return fload(path, **kw)
+
+    @staticmethod
+    def load_program_state(model_path, var_list=None):
+        from ..static import load_program_state as f
+        return f(model_path, var_list)
+
+    @staticmethod
+    def set_program_state(program, state_dict):
+        from ..static import set_program_state as f
+        return f(program, state_dict)
+
+    @staticmethod
+    def save_inference_model(dirname, feeded_var_names, target_vars,
+                             executor, main_program=None, **kw):
+        """1.x signature: feed names + fetch vars + a directory."""
+        import os
+
+        from ..static.io import save_inference_model as f
+        from ..static.program import default_main_program
+        prog = main_program or default_main_program()
+        feeds = [prog.global_block().var(n) if isinstance(n, str) else n
+                 for n in feeded_var_names]
+        return f(os.path.join(dirname, "model"), feeds,
+                 list(target_vars), executor, program=prog)
+
+    @staticmethod
+    def load_inference_model(dirname, executor, **kw):
+        import os
+
+        from ..static.io import load_inference_model as f
+        return f(os.path.join(dirname, "model"), executor, **kw)
 
 
 # ---- GFlags surface (ref: fluid/framework.py:5670 set_flags/get_flags).

@@ -1,0 +1,63 @@
+"""fluid.core — the 1.x C++-core attribute surface, Python-native here.
+
+Reference-era user code reaches the compiled core directly
+(`fluid.core.CPUPlace()`, `fluid.core.Scope()`, `fluid.core.LoDTensor`;
+ref: python/paddle/fluid/__init__.py:71 re-exporting from .core). On this
+stack there is no separate C++ tensor type — a LoDTensor IS the framework
+Tensor (jax.Array-backed, LoD retired with static padding/masking), and a
+Scope is the executor's name->value mapping.
+"""
+from __future__ import annotations
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace)
+from ..core.tensor import Tensor  # noqa: F401
+from ..static.executor import Scope  # noqa: F401
+
+# In the reference, LoDTensor is the C++ dense tensor and VarBase the
+# dygraph tensor; both unify onto the one jax.Array-backed Tensor here.
+LoDTensor = Tensor
+LoDTensorArray = list
+VarBase = Tensor
+_Scope = Scope
+
+NPUPlace = TPUPlace  # accepted, mapped to the accelerator place
+IPUPlace = TPUPlace
+MLUPlace = TPUPlace
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def get_cuda_device_count():
+    return 0
+
+
+def _cuda_synchronize(place=None):
+    """Block until pending device work completes (ref: core._cuda_synchronize).
+    XLA dispatch is async the same way CUDA streams are; effectful_barrier
+    is a device-agnostic drain."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def globals():  # noqa: A001 — reference name (core.globals() flag registry)
+    from . import _FLAGS
+    return dict(_FLAGS)
+
+
+def set_num_threads(n):  # host-side op threading is XLA's concern
+    return None

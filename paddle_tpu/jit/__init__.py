@@ -225,9 +225,13 @@ def write_artifact(path_prefix, exported, params, bufs, meta):
     serialized StableHLO module; params/buffers as a plain npz."""
     import io as _io
     import json
+    import os
 
     import numpy as np
 
+    parent = os.path.dirname(path_prefix)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     blob = exported.serialize()
     header = json.dumps(meta).encode("utf-8")
     with open(path_prefix + ".pdmodel", "wb") as f:

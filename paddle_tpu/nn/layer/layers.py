@@ -266,6 +266,18 @@ class Layer:
             for n, v in buffers.items():
                 lookup[n]._value = v
 
+    def functional_call(self, params, buffers, *args, **kwargs):
+        """Run forward with `params`/`buffers` substituted, restoring the
+        live state afterwards — the jit-safe way to trace a Layer as a
+        pure function of its state (tracers never leak into the module;
+        pair with `functional_state()` for the inputs)."""
+        saved_p, saved_b = self.functional_state()
+        self.load_functional_state(params, buffers)
+        try:
+            return self(*args, **kwargs)
+        finally:
+            self.load_functional_state(saved_p, saved_b)
+
     def to(self, device=None, dtype=None, blocking=None):
         if dtype is not None:
             dt = dtype_mod.convert_dtype(dtype)

@@ -129,11 +129,14 @@ class Optimizer:
             p._value = new_p
             self._slots[id(p)] = new_slots
 
-    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None, parameter_list=None):
+        # `parameter_list` is the fluid-era spelling of `parameters`
+        parameters = parameters if parameters is not None else parameter_list
         from ..core import mode
         if mode.in_static_mode():
             from ..static import program as static_program
-            return static_program._minimize(self, loss)
+            return static_program._minimize(self, loss, parameters)
         loss.backward()
         self.step()
         return None, None

@@ -5,6 +5,8 @@ executor.py. Full implementation in program.py / executor.py.
 """
 from __future__ import annotations
 
+import contextlib
+
 from ..core.mode import in_dygraph_mode  # noqa: F401
 from .program import (  # noqa: F401
     Program, Variable, append_backward, data, default_main_program,
@@ -60,6 +62,30 @@ def cuda_places(device_ids=None):
     ids = device_ids if device_ids is not None \
         else range(len(jax.devices()))
     return [TPUPlace(i) for i in ids]
+
+
+def cuda_pinned_places(device_count=None):
+    """Pinned-host staging places (ref: framework.py cuda_pinned_places);
+    host arrays are already staged via the native arena on this stack."""
+    from ..core.place import CUDAPinnedPlace
+    n = device_count or 1
+    return [CUDAPinnedPlace() for _ in range(n)]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Pin ops created in the block to a device (ref: framework.py
+    device_guard). Under XLA, placement is whole-computation: the guard
+    records the request so Program lowering can honor host-pinned
+    sections, and accepts the reference's "cpu"/"gpu:N" strings."""
+    from .program import default_main_program
+    prog = default_main_program()
+    prev = getattr(prog, "_current_device", None)
+    prog._current_device = device
+    try:
+        yield
+    finally:
+        prog._current_device = prev
 
 
 def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002

@@ -146,11 +146,6 @@ class Executor:
         param_vals = {n: scope.find_var(n) for n in param_names}
 
         opt_states = {}
-        if len(program._optimizers) > 1:
-            raise NotImplementedError(
-                "multiple minimize() calls on one Program are not "
-                "supported: the compiled step applies one optimizer — "
-                "use separate Programs or one optimizer over all params")
         if program._optimizers:
             for i, (opt, loss, params) in enumerate(program._optimizers):
                 # program-scoped key: the scope is global, and two
@@ -166,9 +161,12 @@ class Executor:
 
         key_shapes = tuple(sorted((n, tuple(v.shape), str(v.dtype))
                                   for n, v in feed_vals.items()))
+        # optimizer count is part of the key: the traced step bakes in the
+        # update ops, and infer_from_dataset runs the same program with
+        # optimizers suspended — those two steps must not share a cache slot
         cache_key = (getattr(program, "_uid", id(program)),
                      program._version, key_shapes,
-                     tuple(fetch_names))
+                     tuple(fetch_names), len(program._optimizers))
         compiled = self._cache.get(cache_key) if use_program_cache else None
 
         if compiled is None:
@@ -177,30 +175,44 @@ class Executor:
 
             def step(param_vals, opt_states, feed_vals, key):
                 if program._optimizers:
-                    opt, loss_var, params = program._optimizers[0]
-                    pnames = [p.name for p in params]
-
-                    def loss_fn(ptree):
-                        pv = dict(param_vals)
-                        pv.update(ptree)
-                        env = _forward_env(program, pv, feed_vals, key)
-                        return env[loss_var.name], env
-
-                    ptree = {n: param_vals[n] for n in pnames}
-                    grads, env = jax.grad(loss_fn, has_aux=True)(ptree)
-                    sname = f"@opt_state_{getattr(program, '_uid', 0)}_0"
-                    lr = opt.get_lr() if not hasattr(opt._lr, "lr_at") else None
-                    if opt._grad_clip is not None and hasattr(
-                            opt._grad_clip, "clip_tree"):
-                        grads = opt._grad_clip.clip_tree(grads)
-                    new_p, new_state = opt.functional_update(
-                        ptree, grads, opt_states[sname], lr=lr)
+                    # N minimize() calls compose the way the reference's
+                    # op order does (fluid/optimizer.py:740): ONE forward,
+                    # every backward at the pre-update parameter values,
+                    # then the update ops in append order (a later
+                    # optimizer sharing a param reads the updated value).
+                    # GAN-style D/G programs are the standard use.
                     out_params = dict(param_vals)
-                    out_params.update(new_p)
                     new_states = dict(opt_states)
-                    new_states[sname] = new_state
-                    for p in params:
-                        env[p.name + "@GRAD"] = grads[p.name]
+                    env = None
+                    for i, (opt, loss_var, params) in enumerate(
+                            program._optimizers):
+                        pnames = [p.name for p in params]
+
+                        def loss_fn(ptree, _loss=loss_var):
+                            pv = dict(param_vals)
+                            pv.update(ptree)
+                            env = _forward_env(program, pv, feed_vals, key)
+                            return env[_loss.name], env
+
+                        ptree = {n: param_vals[n] for n in pnames}
+                        grads, env_i = jax.grad(
+                            loss_fn, has_aux=True)(ptree)
+                        if env is None:
+                            env = env_i
+                        sname = (f"@opt_state_"
+                                 f"{getattr(program, '_uid', 0)}_{i}")
+                        lr = opt.get_lr() \
+                            if not hasattr(opt._lr, "lr_at") else None
+                        if opt._grad_clip is not None and hasattr(
+                                opt._grad_clip, "clip_tree"):
+                            grads = opt._grad_clip.clip_tree(grads)
+                        cur = {n: out_params[n] for n in pnames}
+                        new_p, new_state = opt.functional_update(
+                            cur, grads, opt_states[sname], lr=lr)
+                        out_params.update(new_p)
+                        new_states[sname] = new_state
+                        for p in params:
+                            env[p.name + "@GRAD"] = grads[p.name]
                 else:
                     grad_targets = [n[:-len("@GRAD")] for n in fetch_names
                                     if n.endswith("@GRAD")]
@@ -247,3 +259,48 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f) for f in fetches]
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive a Program over a fluid Dataset (ref: executor.py
+        train_from_dataset backed by the C++ MultiTrainer). The C++
+        trainer-thread pipeline is replaced by the jitted whole-Program
+        step: each MultiSlot batch becomes one compiled-step call, and
+        XLA's async dispatch overlaps host parsing with device compute —
+        the same overlap the reference got from feed threads."""
+        if dataset is None:
+            raise ValueError("dataset is required")
+        program = program if program is not None else default_main_program()
+        feed_names = {v.name for v in program.global_block().vars.values()
+                      if not v.persistable}
+        step = 0
+        for batch in dataset:
+            feed = {n: v for n, v in batch.items() if n in feed_names} \
+                or dict(batch)
+            outs = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            if debug and fetch_list and step % max(print_period, 1) == 0:
+                labels = fetch_info or [
+                    getattr(v, "name", str(v)) for v in fetch_list]
+                msg = ", ".join(f"{lbl}={np.asarray(o).ravel()[:4]}"
+                                for lbl, o in zip(labels, outs))
+                print(f"step {step}: {msg}")
+            step += 1
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Forward-only pass over a Dataset (ref: executor.py
+        infer_from_dataset = train_from_dataset with updates disabled):
+        the program's optimizer ops are suspended for the duration so
+        evaluation never mutates the trained weights."""
+        program = program if program is not None else default_main_program()
+        saved = program._optimizers
+        program._optimizers = []
+        try:
+            return self.train_from_dataset(program, dataset, scope, thread,
+                                           debug, fetch_list, fetch_info,
+                                           print_period)
+        finally:
+            program._optimizers = saved

@@ -359,9 +359,14 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
     return result
 
 
-def _minimize(optimizer, loss):
+def _minimize(optimizer, loss, parameter_list=None):
     prog = default_main_program()
-    params = prog.all_parameters()
+    if parameter_list is not None:
+        # the fluid API accepts Variables or their names
+        params = [prog.global_block().var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = prog.all_parameters()
     pgs = append_backward(loss, params)
     prog._optimizers.append((optimizer, loss, params))
     return pgs

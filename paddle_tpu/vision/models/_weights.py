@@ -1,0 +1,33 @@
+"""Pretrained-weights loading for the vision zoo.
+
+Reference behavior (vision/models/resnet.py:261-264): `pretrained=True`
+downloads from `model_urls` and `set_dict`s. This environment is
+zero-egress, so downloading is impossible — `pretrained=True` therefore
+loads from a local weights directory, and FAILS LOUDLY when no weights
+exist instead of silently returning random initialization (r3 weak #2).
+"""
+from __future__ import annotations
+
+import os
+
+PRETRAINED_DIR_ENV = "PADDLE_TPU_PRETRAINED_DIR"
+_DEFAULT_DIR = os.path.expanduser("~/.cache/paddle_tpu/hub")
+
+
+def load_pretrained(model, arch):
+    """Load `<dir>/<arch>.pdparams` into `model` (dir from
+    $PADDLE_TPU_PRETRAINED_DIR, falling back to ~/.cache/paddle_tpu/hub);
+    raise with actionable guidance when absent."""
+    d = os.environ.get(PRETRAINED_DIR_ENV, _DEFAULT_DIR)
+    path = os.path.join(d, f"{arch}.pdparams")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"pretrained=True for '{arch}' but no weights at {path}. "
+            "This environment cannot download weights; place a state_dict "
+            f"saved with paddle.save at that path (or set "
+            f"${PRETRAINED_DIR_ENV} to your weights directory), or pass "
+            "pretrained=False for random initialization.")
+    from ...framework.io import load
+    state = load(path)
+    model.set_state_dict(state)
+    return model

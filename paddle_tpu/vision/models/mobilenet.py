@@ -127,8 +127,16 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV1(scale=scale, **kwargs)
+    model = MobileNetV1(scale=scale, **kwargs)
+    if pretrained:
+        from ._weights import load_pretrained
+        load_pretrained(model, f"mobilenetv1_{scale}")
+    return model
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)
+    model = MobileNetV2(scale=scale, **kwargs)
+    if pretrained:
+        from ._weights import load_pretrained
+        load_pretrained(model, f"mobilenetv2_{scale}")
+    return model

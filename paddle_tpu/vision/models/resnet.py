@@ -121,7 +121,11 @@ class ResNet(nn.Layer):
 
 
 def _resnet(block, depth, pretrained=False, **kwargs):
-    return ResNet(block, depth, **kwargs)
+    model = ResNet(block, depth, **kwargs)
+    if pretrained:
+        from ._weights import load_pretrained
+        load_pretrained(model, f"resnet{depth}")
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):

@@ -54,7 +54,11 @@ class VGG(nn.Layer):
 
 
 def _vgg(arch, cfg, batch_norm, pretrained=False, **kwargs):
-    return VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
+    model = VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
+    if pretrained:
+        from ._weights import load_pretrained
+        load_pretrained(model, arch + ("_bn" if batch_norm else ""))
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

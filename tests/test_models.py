@@ -45,6 +45,27 @@ class TestVisionModels:
         out = net(t(np.random.rand(1, 3, 32, 32)))
         assert out.shape == [1, 7]
 
+    def test_pretrained_true_is_honest(self, tmp_path, monkeypatch):
+        # pretrained=True must never silently return random weights
+        # (r3 weak #2): raise with guidance when no local weights exist,
+        # load them when they do
+        from paddle_tpu.vision import models as M
+        monkeypatch.setenv("PADDLE_TPU_PRETRAINED_DIR", str(tmp_path))
+        with pytest.raises(FileNotFoundError,
+                           match="PADDLE_TPU_PRETRAINED_DIR"):
+            M.resnet18(pretrained=True)
+        # stage weights the documented way, then load them
+        src = M.resnet18(num_classes=4)
+        paddle.save(src.state_dict(), str(tmp_path / "resnet18.pdparams"))
+        dst = M.resnet18(pretrained=True, num_classes=4)
+        np.testing.assert_array_equal(
+            dst.state_dict()["conv1.weight"].numpy(),
+            src.state_dict()["conv1.weight"].numpy())
+        with pytest.raises(FileNotFoundError):
+            M.mobilenet_v2(pretrained=True)
+        with pytest.raises(FileNotFoundError):
+            M.vgg11(pretrained=True)
+
     def test_vgg11_forward(self):
         from paddle_tpu.vision.models import vgg11
         net = vgg11(num_classes=5)

