@@ -129,6 +129,12 @@ class trainer_desc:
     class PipelineTrainer(TrainerDesc):
         pass
 
+    class HeterXpuTrainer(TrainerDesc):
+        pass
+
+    class HeterBoxWorker(TrainerDesc):
+        pass
+
 
 class evaluator:
     """ref: fluid/evaluator.py — deprecated there in favor of
@@ -187,6 +193,81 @@ class _ChunkEvaluator:
 
 
 evaluator.ChunkEvaluator = _ChunkEvaluator
+
+
+# trainer descriptor classes are also reference top-level names
+TrainerDesc = trainer_desc.TrainerDesc
+MultiTrainer = trainer_desc.MultiTrainer
+DistMultiTrainer = trainer_desc.DistMultiTrainer
+PipelineTrainer = trainer_desc.PipelineTrainer
+HeterXpuTrainer = trainer_desc.HeterXpuTrainer
+HeterBoxWorker = trainer_desc.HeterBoxWorker
+
+from ..core.rng import Generator  # noqa: E402,F401
+
+
+class PSDispatcher:
+    """Assign variables to parameter-server endpoints (ref:
+    transpiler/ps_dispatcher.py). Used standalone by PS-lite table
+    placement; the program transpiler itself is superseded (see
+    DistributeTranspiler)."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """Stable name-hash placement (ref: ps_dispatcher.py:49)."""
+
+    def _hash_block(self, block_str, total):
+        import hashlib
+        # md5 not python hash(): placement must agree across processes
+        # regardless of PYTHONHASHSEED
+        return int(hashlib.md5(str(block_str).encode()).hexdigest(),
+                   16) % total
+
+    def dispatch(self, varlist):
+        return [self._eps[self._hash_block(getattr(v, "name", v),
+                                           len(self._eps))]
+                for v in varlist]
+
+
+class RoundRobin(PSDispatcher):
+    """Cyclic placement (ref: ps_dispatcher.py:91)."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _v in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class learning_rate_decay:
+    """fluid.layers.learning_rate_decay module surface at the 1.x
+    top-level name (the decay schedules themselves live in
+    layers_legacy and map onto optimizer.lr schedulers)."""
+    from .layers_legacy import (
+        cosine_decay, exponential_decay, inverse_time_decay, noam_decay,
+        natural_exp_decay, piecewise_decay, polynomial_decay)
+    cosine_decay = staticmethod(cosine_decay)
+    exponential_decay = staticmethod(exponential_decay)
+    inverse_time_decay = staticmethod(inverse_time_decay)
+    noam_decay = staticmethod(noam_decay)
+    natural_exp_decay = staticmethod(natural_exp_decay)
+    piecewise_decay = staticmethod(piecewise_decay)
+    polynomial_decay = staticmethod(polynomial_decay)
 
 
 def load_op_library(lib_filename):

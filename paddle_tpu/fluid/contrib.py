@@ -464,3 +464,116 @@ class mixed_precision:
                         init_loss_scaling=init_loss_scaling)
         except Exception:
             return optimizer
+
+
+class InitState:
+    """Initial decoder state descriptor (ref: contrib/decoder/
+    beam_search_decoder.py InitState): holds either a concrete init
+    tensor or (shape, value) to materialize lazily."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is not None:
+            import jax.numpy as jnp
+
+            from ..core.tensor import Tensor
+            boot = init_boot._value if hasattr(init_boot, "_value") \
+                else jnp.asarray(init_boot)
+            # fill_constant_batch_size_like contract (ref beam_search_
+            # decoder.py:83): shape[0] (usually -1) is REPLACED by the
+            # boot batch dim, the rest is taken verbatim
+            shape = list(shape) if shape else [-1]
+            out_shape = [int(boot.shape[0])] + [int(s) for s in shape[1:]]
+            self._init = Tensor(jnp.full(tuple(out_shape), value, dtype))
+        else:
+            raise ValueError("init or init_boot must be provided")
+        self.need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+
+class StateCell:
+    """Decoder state container driving a step function (ref: contrib
+    StateCell): registered states update each `compute_state` call via
+    the user's cell; works eagerly on this stack (the jitted decode loop
+    is `paddle.nn.dynamic_decode`)."""
+
+    def __init__(self, inputs=None, states=None, steps=None, name=None):
+        self._states = dict(states or {})
+        self._inputs = dict(inputs or {})
+        self._cur_states = {s: (v.value if isinstance(v, InitState) else v)
+                            for s, v in self._states.items()}
+        self._updaters = []
+
+    def get_state(self, name):
+        if name not in self._cur_states:
+            raise KeyError(f"unknown decoder state {name!r}")
+        return self._cur_states[name]
+
+    def get_input(self, name):
+        if name not in self._inputs:
+            raise KeyError(f"unknown decoder input {name!r}")
+        return self._inputs[name]
+
+    def set_state(self, name, value):
+        self._cur_states[name] = value
+
+    def state_updater(self, fn):
+        self._updaters.append(fn)
+        return fn
+
+    def compute_state(self, inputs):
+        self._inputs.update(inputs)
+        for fn in self._updaters:
+            fn(self)
+
+    def out_state(self):
+        return dict(self._cur_states)
+
+    def update_states(self):
+        pass  # eager semantics: set_state already committed
+
+
+class TrainingDecoder:
+    """The 1.x while-loop graph-builder decoder is superseded by the
+    dynamic decoding stack: build a `paddle.nn.RNNCell`-style cell and
+    train with teacher forcing directly, or decode with
+    `paddle.nn.BeamSearchDecoder` + `paddle.nn.dynamic_decode`
+    (block-style builder drop, same class as SURVEY §2 #42)."""
+
+    def __init__(self, state_cell, name=None):
+        raise NotImplementedError(
+            "TrainingDecoder builds 1.x while_loop blocks; on this stack "
+            "run the cell directly over the time axis (teacher forcing is "
+            "a lax.scan under jit) or use paddle.nn.dynamic_decode. "
+            "StateCell/InitState remain usable as state containers.")
+
+
+class BeamSearchDecoder:
+    """See TrainingDecoder — inference-side of the same block builder."""
+
+    def __init__(self, state_cell, *a, **kw):
+        raise NotImplementedError(
+            "contrib.BeamSearchDecoder builds 1.x while_loop blocks; use "
+            "paddle.nn.BeamSearchDecoder with paddle.nn.dynamic_decode "
+            "(tested in tests/test_beam_search.py), or model.generate() "
+            "for KV-cache decoding.")
+
+
+class QuantizeTranspiler:
+    """Static-graph quantization transpiler (ref: contrib/slim
+    QuantizeTranspiler): superseded by the imperative quantization in
+    paddle.slim — ImperativeQuantAware (QAT) and
+    PostTrainingQuantization (PTQ), both able to export a servable int8
+    artifact via save_quantized_model."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "QuantizeTranspiler rewrites 1.x Programs; quantize the Layer "
+            "instead: paddle.slim.ImperativeQuantAware().quantize(model) "
+            "for QAT or paddle.slim.PostTrainingQuantization for PTQ, "
+            "then save_quantized_model() for the int8 artifact.")

@@ -3,3 +3,10 @@ from __future__ import annotations
 
 from . import callbacks  # noqa: F401
 from .model import Model  # noqa: F401
+from .static_flops import flops  # noqa: F401
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """ref: hapi/model_summary.py — delegate to the top-level impl."""
+    import paddle_tpu
+    return paddle_tpu.summary(net, input_size, dtypes, input)

@@ -228,3 +228,10 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
 import sys as _sys  # noqa: E402
 
 metrics = _sys.modules[__name__]
+
+
+def mean_iou(input, label, num_classes):  # noqa: A002
+    """Mean IoU over classes (ref: metric/__init__.py re-exporting
+    fluid.layers.nn.mean_iou) — same computation as the fluid legacy op."""
+    from ..fluid.layers_legacy import mean_iou as _impl
+    return _impl(input, label, num_classes)

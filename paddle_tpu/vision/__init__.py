@@ -7,14 +7,38 @@ from .models import (  # noqa: F401
     mobilenet_v1, mobilenet_v2, resnet18, resnet34, resnet50, resnet101,
     resnet152, vgg11, vgg13, vgg16, vgg19,
 )
+# the reference star-imports datasets + transforms to paddle.vision top
+# level (ref: vision/__init__.py `from .datasets import *` etc.)
+from .datasets import (  # noqa: F401
+    Cifar10, Cifar100, DatasetFolder, FashionMNIST, Flowers, ImageFolder,
+    MNIST, VOC2012)
+from .transforms import (  # noqa: F401
+    BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
+    ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomCrop,
+    RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
+    RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose,
+    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop,
+    hflip, normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip)
 
 
 def set_image_backend(backend):
-    pass
+    if backend not in ("pil", "cv2", "numpy", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
 
 
 def get_image_backend():
     return "numpy"
+
+
+def image_load(path, backend=None):
+    """Load an image file to an HWC numpy array (ref: vision/image.py
+    image_load; the PIL decode feeds the numpy transform pipeline)."""
+    import numpy as np
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
 
 import sys as _sys  # noqa: E402
 

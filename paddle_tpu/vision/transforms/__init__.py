@@ -150,13 +150,28 @@ class Transpose(BaseTransform):
         return np.transpose(arr, self.order)
 
 
+def _jitter_range(value, name):
+    """Reference _check_input semantics (transforms.py:56): a scalar v
+    becomes the factor range [max(0, 1-v), 1+v]; a (lo, hi) pair is taken
+    verbatim. Factors never go negative."""
+    if isinstance(value, (tuple, list)):
+        lo, hi = float(value[0]), float(value[1])
+        if lo > hi or lo < 0:
+            raise ValueError(f"{name} range {value!r} must satisfy "
+                             "0 <= lo <= hi")
+        return lo, hi
+    if value < 0:
+        raise ValueError(f"{name} value should be non-negative")
+    return max(0.0, 1.0 - float(value)), 1.0 + float(value)
+
+
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.value = value
+        self.value = _jitter_range(value, "brightness")
 
     def _apply_image(self, img):
-        f = 1 + random.uniform(-self.value, self.value)
+        f = random.uniform(*self.value)
         return np.clip(np.asarray(img, np.float32) * f, 0,
                        255 if np.asarray(img).dtype == np.uint8 else None)
 
@@ -237,20 +252,42 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     import numpy as np
     a, chw = _hwc(img)
     k = int(round(angle / 90.0)) % 4
-    if abs(angle - 90.0 * round(angle / 90.0)) < 1e-6:
+    if abs(angle - 90.0 * round(angle / 90.0)) < 1e-6 \
+            and (not expand or center is None):
         out = np.rot90(a, k)  # right-angle fast path, no resampling
+        # for right angles rot90 IS the expanded canvas; without expand
+        # the reference also returns the rotated (possibly transposed)
+        # frame only when square — crop/pad back to the input frame
+        if not expand and out.shape[:2] != a.shape[:2]:
+            h, w = a.shape[:2]
+            oh, ow = out.shape[:2]
+            canvas = np.full_like(a, fill)
+            ct, cl = max((oh - h) // 2, 0), max((ow - w) // 2, 0)
+            t, l = max((h - oh) // 2, 0), max((w - ow) // 2, 0)
+            ch_, cw_ = min(h, oh), min(w, ow)
+            canvas[t:t + ch_, l:l + cw_] = out[ct:ct + ch_, cl:cl + cw_]
+            out = canvas
     else:
-        # nearest-neighbour rotation about the image center
+        # nearest-neighbour rotation about the image center; expand=True
+        # grows the canvas to hold the whole rotated image (ref:
+        # functional rotate expand semantics)
         h, w = a.shape[:2]
+        rad = np.deg2rad(angle)
+        if expand:
+            oh = int(np.ceil(abs(h * np.cos(rad)) + abs(w * np.sin(rad))))
+            ow = int(np.ceil(abs(w * np.cos(rad)) + abs(h * np.sin(rad))))
+        else:
+            oh, ow = h, w
         cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
             else (center[1], center[0])
-        rad = np.deg2rad(angle)
-        ys, xs = np.mgrid[0:h, 0:w]
-        sy = cy + (ys - cy) * np.cos(rad) - (xs - cx) * np.sin(rad)
-        sx = cx + (ys - cy) * np.sin(rad) + (xs - cx) * np.cos(rad)
+        ocy, ocx = ((oh - 1) / 2.0, (ow - 1) / 2.0) if expand \
+            else (cy, cx)
+        ys, xs = np.mgrid[0:oh, 0:ow]
+        sy = cy + (ys - ocy) * np.cos(rad) - (xs - ocx) * np.sin(rad)
+        sx = cx + (ys - ocy) * np.sin(rad) + (xs - ocx) * np.cos(rad)
         yi = np.clip(np.round(sy).astype(int), 0, h - 1)
         xi = np.clip(np.round(sx).astype(int), 0, w - 1)
-        valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
+        valid = (sy >= -0.5) & (sy < h - 0.5) & (sx >= -0.5) & (sx < w - 0.5)
         out = a[yi, xi]
         out[~valid] = fill
     return _restore(out, chw)
@@ -308,3 +345,154 @@ def adjust_hue(img, hue_factor):
          [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]])
     out = (rgb * scale).astype(a.dtype)
     return _restore(out, chw)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend between the grayscale image (factor 0) and the original
+    (factor 1); >1 over-saturates. (ref: functional adjust_saturation)"""
+    a, chw = _hwc(img)
+    hi = 255 if a.dtype == np.uint8 else 1.0
+    gray = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+            + 0.114 * a[..., 2])[..., None]
+    out = np.clip(gray + (a.astype(np.float32) - gray) * saturation_factor,
+                  0, hi).astype(a.dtype)
+    return _restore(out, chw)
+
+
+# ---- class transforms over the functionals above (ref: transforms.py) ----
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = _jitter_range(value, "contrast")
+
+    def _apply_image(self, img):
+        return adjust_contrast(img, random.uniform(*self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = _jitter_range(value, "saturation")
+
+    def _apply_image(self, img):
+        return adjust_saturation(img, random.uniform(*self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if isinstance(value, (tuple, list)):
+            lo, hi = float(value[0]), float(value[1])
+            if not -0.5 <= lo <= hi <= 0.5:
+                raise ValueError("hue range must be within [-0.5, 0.5]")
+            self.value = (lo, hi)
+        else:
+            if not 0 <= value <= 0.5:
+                raise ValueError("hue value should be in [0, 0.5]")
+            self.value = (-float(value), float(value))
+
+    def _apply_image(self, img):
+        return adjust_hue(img, random.uniform(*self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly jitter brightness/contrast/saturation/hue, applying the
+    four sub-transforms in random order (ref: transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        ops = []
+        if brightness:
+            ops.append(BrightnessTransform(brightness))
+        if contrast:
+            ops.append(ContrastTransform(contrast))
+        if saturation:
+            ops.append(SaturationTransform(saturation))
+        if hue:
+            ops.append(HueTransform(hue))
+        self._ops = ops
+
+    def _apply_image(self, img):
+        for t in random.sample(self._ops, len(self._ops)):
+            img = t(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            self.degrees = (-degrees, degrees)
+        else:
+            self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Crop a random area/aspect patch then resize to `size` — the
+    standard ImageNet train-time augmentation (ref: transforms.py
+    RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import math
+        arr = np.asarray(img)
+        h, w = arr.shape[0], arr.shape[1]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            log_r = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            ar = math.exp(random.uniform(*log_r))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                patch = arr[i:i + ch, j:j + cw]
+                break
+        else:  # fallback: center crop of the feasible aspect
+            ch = cw = min(h, w)
+            i, j = (h - ch) // 2, (w - cw) // 2
+            patch = arr[i:i + ch, j:j + cw]
+        return np.asarray(Resize(self.size, self.interpolation)(patch))

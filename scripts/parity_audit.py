@@ -6,7 +6,6 @@ namespace. Prints missing symbols per namespace; exit 1 if any.
 """
 import ast
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -40,10 +39,14 @@ PAIRS = [
     ("reader", "reader"),
     ("inference", "inference"),
     ("onnx", "onnx"),
+    ("fluid", "fluid"),
     ("fluid/layers", "fluid.layers"),
     ("fluid/dygraph", "fluid.dygraph"),
     ("fluid/contrib", "fluid.contrib"),
     ("framework", "framework"),
+    ("hapi", "hapi"),
+    ("incubate", "incubate"),
+    ("text", "text"),
 ]
 
 
@@ -61,23 +64,37 @@ def ref_all(relpath):
         tree = ast.parse(src)
     except SyntaxError:
         return None
+
+    def eval_all_expr(node):
+        """Evaluate the common __all__ expression shapes: list/tuple
+        literals, `+` chains, and `submodule.__all__` references
+        (resolved recursively) — e.g. fluid's
+        `__all__ = framework.__all__ + executor.__all__ + [...]`."""
+        if isinstance(node, (ast.List, ast.Tuple, ast.Constant)):
+            try:
+                return list(ast.literal_eval(node))
+            except Exception:
+                return []
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            return eval_all_expr(node.left) + eval_all_expr(node.right)
+        if isinstance(node, ast.Attribute) and node.attr == "__all__":
+            parts, cur = [], node.value
+            while isinstance(cur, ast.Attribute):
+                parts.insert(0, cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                parts.insert(0, cur.id)
+            sub = ref_all(os.path.join(relpath, *parts))
+            return sub or []
+        return []
+
     for node in ast.walk(tree):
         if isinstance(node, (ast.Assign, ast.AugAssign)):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]
             if any(isinstance(t, ast.Name) and t.id == "__all__"
                    for t in targets):
-                try:
-                    val = ast.literal_eval(node.value)
-                    names.extend(val)
-                except Exception:
-                    pass
-    # `__all__ += something.__all__` patterns: regex the += module refs
-    for m in re.finditer(r"__all__\s*\+=\s*(\w[\w.]*)\.__all__", src):
-        sub = m.group(1)
-        subnames = ref_all(os.path.join(relpath, sub.replace(".", "/")))
-        if subnames:
-            names.extend(subnames)
+                names.extend(eval_all_expr(node.value))
     return sorted(set(n for n in names if isinstance(n, str)))
 
 

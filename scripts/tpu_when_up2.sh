@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-4 recovery watcher: wait for the axon TPU tunnel, then run the FULL
+# measurement set VERDICT r3 asks for, in diagnostic order — raw-op envelope
+# (is the GEMM ceiling reachable?), per-op profile, attention ablation, the
+# three BASELINE-axis benches (GPT-2 / BERT-large / ResNet-50), decode,
+# int8-vs-bf16, long-seq backward, sweeps, and the two flag A/Bs.
+# Output: append-only log the round can mine for PERF.md/BENCH numbers.
+cd /root/repo
+LOG=${1:-/root/repo/tpu_recovery_r4.log}
+probe() {
+  timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)
+print('PROBE_OK', float(jax.device_get(jnp.sum(x.astype(jnp.float32)))))" \
+    2>/dev/null | grep -q PROBE_OK
+}
+run() {  # run <timeout> <label> <cmd...>
+  local t=$1 label=$2; shift 2
+  echo "=== $label $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
+  timeout "$t" "$@" 2>&1 | grep -v WARNING | tee -a "$LOG"
+}
+for i in $(seq 1 600); do
+  if probe; then
+    echo "=== tunnel up after $i probes $(date) ===" | tee -a "$LOG"
+    run 1200 "raw op envelope (GEMM ceiling, exp, HBM, embed A/B)" \
+        python scripts/raw_ops_bench.py
+    run 1200 "per-op profile, fused step batch 16" \
+        python scripts/perf_sweep.py --section profile --batches 16
+    run 1500 "attention ablation (flash/xla/identity)" \
+        python scripts/perf_sweep.py --section ablate
+    run 1200 "attn compare (dtype-correct)" python scripts/attn_compare.py
+    run 1200 "bench: gpt2s headline" python bench.py
+    run 1500 "bench: bert_large" python bench.py bert_large
+    run 1500 "bench: resnet50" python bench.py resnet50
+    run 1200 "bench: decode gpt2s_gen" python bench.py gpt2s_gen
+    run 1200 "int8 vs bf16 inference" python scripts/int8_bench.py
+    run 900 "longseq S=16k streaming bwd" \
+        python scripts/perf_sweep.py --section longseq
+    run 1500 "block sweep" python scripts/perf_sweep.py --section blocks
+    run 1500 "model batch sweep" \
+        python scripts/perf_sweep.py --section model --batches 8,16,24
+    echo "=== flag A/Bs on the headline ===" | tee -a "$LOG"
+    PADDLE_TPU_EMBED_ONEHOT_VJP=1 run 1200 "A/B onehot-embed-vjp" \
+        python bench.py
+    PADDLE_TPU_FA_LANES=1 run 1200 "A/B fa-lanes" python bench.py
+    PADDLE_TPU_EMBED_ONEHOT_VJP=1 PADDLE_TPU_FA_LANES=1 \
+        run 1200 "A/B both" python bench.py
+    echo "=== done $(date) ===" | tee -a "$LOG"
+    exit 0
+  fi
+  echo "probe $i failed $(date)"
+  sleep 45
+done
+echo "=== tunnel never recovered ===" | tee -a "$LOG"
+exit 1

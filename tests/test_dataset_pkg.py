@@ -121,6 +121,47 @@ class TestDatasetLoaders:
         assert chw.shape == (3, 28, 28) and chw.dtype == np.float32
 
 
+class TestVisionTransformClasses:
+    def test_color_and_geometry_transforms(self):
+        """r4: the class transforms the reference star-exports at
+        paddle.vision top level (ColorJitter, RandomResizedCrop, ...)."""
+        from paddle_tpu import vision as V
+        im = (np.random.rand(36, 48, 3) * 255).astype(np.uint8)
+        assert V.Grayscale(3)(im).shape == (36, 48, 3)
+        assert V.Pad(2)(im).shape == (40, 52, 3)
+        out = V.RandomResizedCrop(24)(im)
+        assert out.shape[:2] == (24, 24)
+        rot = V.RandomRotation(30)(im)
+        assert rot.shape == im.shape
+        jit = V.ColorJitter(brightness=0.4, contrast=0.4,
+                            saturation=0.4, hue=0.2)(im)
+        assert jit.shape == im.shape
+        # saturation 0 == grayscale; 1 == identity
+        from paddle_tpu.vision.transforms import adjust_saturation
+        g = adjust_saturation(im, 0.0)
+        assert np.abs(g[..., 0].astype(int) - g[..., 1].astype(int)).max() <= 1
+        np.testing.assert_array_equal(adjust_saturation(im, 1.0), im)
+        # transforms compose
+        pipe = V.Compose([V.RandomResizedCrop(16), V.ColorJitter(0.2),
+                          V.ToTensor()])
+        t = pipe(im)
+        assert tuple(t.shape) == (3, 16, 16)
+
+    def test_vision_toplevel_exports_and_image_load(self, tmp_path):
+        from paddle_tpu import vision as V
+        for n in ("MNIST", "Cifar10", "Flowers", "DatasetFolder",
+                  "ColorJitter", "RandomResizedCrop", "image_load"):
+            assert hasattr(V, n), n
+        from PIL import Image
+        p = tmp_path / "x.png"
+        Image.fromarray((np.random.rand(8, 9, 3) * 255).astype(
+            np.uint8)).save(str(p))
+        arr = V.image_load(str(p))
+        assert arr.shape == (8, 9, 3)
+        with pytest.raises(ValueError):
+            V.set_image_backend("opencv4")
+
+
 class TestIncubateComplex:
     def test_elementwise_and_matmul(self):
         import jax.numpy as jnp
