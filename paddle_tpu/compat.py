@@ -161,6 +161,20 @@ VarBase = Tensor
 LoDTensor = Tensor
 LoDTensorArray = list
 ComplexVariable = Tensor
+ComplexTensor = Tensor  # pre-2.0 complex type; complex dtypes are native
+
+
+def in_dynamic_mode():
+    """2.0 spelling of in_dygraph_mode (ref: paddle/__init__.py)."""
+    from .core.mode import in_dygraph_mode
+    return in_dygraph_mode()
+
+
+def reverse(x, axis):
+    """fluid.layers.reverse at the paddle root (ref: paddle/__init__.py
+    re-export) — one shim, shared with fluid.layers."""
+    from .fluid.layers_legacy import reverse as _impl
+    return _impl(x, axis)
 
 
 # ---- dygraph mode toggles (ref: fluid/dygraph/base.py) ----

@@ -47,3 +47,5 @@ class LookAhead:
 
 from .. import reader  # noqa: E402,F401  (the real decorator module)
 from . import complex  # noqa: E402,F401,A004  (complex tensor ops)
+from . import data_generator  # noqa: E402,F401  (MultiSlot generators)
+from ..distributed import fleet  # noqa: E402,F401  (ref: fluid.incubate.fleet)

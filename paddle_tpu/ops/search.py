@@ -169,7 +169,24 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
             counts = np.diff(np.append(idx, arr.size))
             rets.append(jnp.asarray(counts))
         return tuple(rets) if len(rets) > 1 else rets[0]
-    raise NotImplementedError("unique_consecutive with axis")
+    # axis version: drop a slice when it equals the previous slice along
+    # `axis` (eager-only like unique — output shape is data-dependent)
+    arr_m = np.moveaxis(arr, axis, 0)
+    if arr_m.shape[0] == 0:
+        keep = np.zeros(0, bool)
+    else:
+        flat = arr_m.reshape(arr_m.shape[0], -1)
+        same = (flat[1:] == flat[:-1]).all(axis=1)
+        keep = np.concatenate([[True], ~same])
+    out = np.moveaxis(arr_m[keep], 0, axis)
+    rets = [jnp.asarray(out)]
+    if return_inverse:
+        rets.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr_m.shape[0]))
+        rets.append(jnp.asarray(counts))
+    return tuple(rets) if len(rets) > 1 else rets[0]
 
 
 @defop()

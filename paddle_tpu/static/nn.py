@@ -30,8 +30,11 @@ def _create_param(shape, dtype, attr, is_bias=False, default_init=None):
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
-       activation=None, name=None, param_attr=None):
+       activation=None, name=None, param_attr=None, act=None):
+    # `param_attr`/`act` are the fluid 1.x spellings of
+    # `weight_attr`/`activation`
     weight_attr = weight_attr or param_attr
+    activation = activation or act
     in_dim = int(np.prod(x.shape[num_flatten_dims:]))
     if len(x.shape) > num_flatten_dims + 1:
         x = ops.flatten(x, num_flatten_dims, -1) if num_flatten_dims > 0 else x

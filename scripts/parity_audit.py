@@ -104,7 +104,15 @@ def ref_all(relpath):
 # `fluid.LoDTensor` — ref fluid/__init__.py:71-95), none of them in
 # __all__. (r3 judge probe: this class of gap was invisible to the audit.)
 ATTR_PAIRS = [
+    ("", ""),
     ("fluid", "fluid"),
+    ("static", "static"),
+    ("nn", "nn"),
+    ("distributed", "distributed"),
+    ("utils", "utils"),
+    ("io", "io"),
+    ("jit", "jit"),
+    ("vision", "vision"),
 ]
 
 # import-bound names that are python machinery, not API surface
@@ -172,10 +180,10 @@ def main():
         if not names:
             continue
         obj = paddle
-        for part in attr.split("."):
+        for part in (attr.split(".") if attr else []):
             obj = getattr(obj, part, None)
         if obj is None:
-            print(f"{attr} [attrs]: NAMESPACE MISSING")
+            print(f"{attr or 'paddle'} [attrs]: NAMESPACE MISSING")
             total_missing += len(names)
             continue
         missing = [n for n in names if not hasattr(obj, n)]

@@ -78,3 +78,29 @@ class TestSummary:
         net = nn.Linear(10, 5)
         info = paddle.summary(net)
         assert info["total_params"] == 55
+
+
+class TestUtilsProfilerSurface:
+    def test_profiler_batch_window(self):
+        """r4: paddle.utils.{Profiler,ProfilerOptions,get_profiler}
+        (ref utils/profiler.py) — batch_range drives start/stop."""
+        opts = paddle.utils.ProfilerOptions({"batch_range": [1, 3]})
+        assert opts["profile_path"] is None  # 'none' maps to None
+        assert opts.with_state("CPU")["state"] == "CPU"
+        with paddle.utils.Profiler(enabled=True, options=opts) as prof:
+            for _ in range(4):
+                _ = paddle.to_tensor(np.ones(2)) + 1
+                prof.reset()
+        assert prof.batch_id == 4
+        assert paddle.utils.get_profiler() is not None
+        assert paddle.utils.OpLastCheckpointChecker().filter_updates(
+            "matmul") == []
+
+
+class TestRootAliases:
+    def test_root_attribute_surface(self):
+        assert paddle.ComplexTensor is paddle.Tensor \
+            or paddle.ComplexTensor.__name__ == "Tensor"
+        assert paddle.in_dynamic_mode() is True
+        out = paddle.reverse(paddle.to_tensor(np.array([1, 2, 3])), [0])
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [3, 2, 1])

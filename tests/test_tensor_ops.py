@@ -194,3 +194,18 @@ class TestCast:
         x = t([1.5, 2.5])
         assert x.astype("int32").dtype == paddle.int32
         assert x.astype(paddle.float64).dtype == paddle.float64
+
+
+class TestUniqueConsecutive:
+    def test_axis_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.array([[1, 1], [1, 1], [2, 3], [2, 3], [1, 1]])
+        out, inv, cnt = paddle.unique_consecutive(
+            t(x), return_inverse=True, return_counts=True, axis=0)
+        tout, tinv, tcnt = torch.unique_consecutive(
+            torch.tensor(x), return_inverse=True, return_counts=True,
+            dim=0)
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      tout.numpy())
+        np.testing.assert_array_equal(np.asarray(inv), tinv.numpy())
+        np.testing.assert_array_equal(np.asarray(cnt), tcnt.numpy())
