@@ -13,11 +13,11 @@ import numpy as np
 
 def main():
     import jax
-    if "cpu" not in (jax.config.jax_platforms or ""):
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    try:  # pin CPU outright: JAX picks the FIRST listed platform, so a
+        # substring check passes on "axon,cpu" yet runs the accelerator
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     import paddle_tpu as paddle

@@ -14,5 +14,6 @@ from .api import (  # noqa: F401
     data_parallel_shardings, replicate, shard_batch, shard_params_tp,
     sharded_train_step,
 )
-from .ring_attention import ring_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, zigzag_ring_attention, zigzag_ring_attention_sharded)
 from .ulysses import sp_attention, ulysses_attention  # noqa: F401

@@ -70,9 +70,20 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None,
 def sp_attention(q, k, v, axis_name="sp", causal=False, scale=None,
                  impl=None, interpret=None):
     """Sequence-parallel attention front door: impl = "ring" | "ulysses" |
-    None (auto: ulysses when every rank can own ≥1 head — one all-to-all
-    round beats n-1 ppermute rounds — else ring)."""
-    from .ring_attention import ring_attention
+    "zigzag" | None (auto: ulysses when every rank can own ≥1 head — one
+    all-to-all round beats n-1 ppermute rounds — else ring).
+
+    "zigzag" is the load-balanced causal ring: the caller must hold the
+    LOCAL shard in zigzag layout (rank i = global chunks i and 2n-1-i;
+    see ring_attention.zigzag_order) — it halves causal ring step cost
+    and is never auto-picked because of that layout contract."""
+    from .ring_attention import ring_attention, zigzag_ring_attention
+    if impl == "zigzag":
+        if not causal:
+            raise ValueError("zigzag layout only pays off under a causal "
+                             "mask; use ring/ulysses for bidirectional")
+        return zigzag_ring_attention(q, k, v, axis_name=axis_name,
+                                     scale=scale)
     if impl is None:
         n = jax.lax.axis_size(axis_name)
         impl = "ulysses" if q.shape[1] % n == 0 else "ring"

@@ -186,13 +186,14 @@ def main():
             print(f"{attr or 'paddle'} [attrs]: NAMESPACE MISSING")
             total_missing += len(names)
             continue
+        label = attr or "paddle"
         missing = [n for n in names if not hasattr(obj, n)]
         if missing:
             total_missing += len(missing)
-            print(f"{attr} [attrs]: {len(missing)}/{len(names)} missing: "
+            print(f"{label} [attrs]: {len(missing)}/{len(names)} missing: "
                   f"{missing[:16]}{'...' if len(missing) > 16 else ''}")
         else:
-            print(f"{attr} [attrs]: OK ({len(names)} attributes)")
+            print(f"{label} [attrs]: OK ({len(names)} attributes)")
     for rel, attr in PAIRS:
         names = ref_all(rel)
         if not names:
