@@ -28,17 +28,17 @@ class DatasetBase:
     def init(self, batch_size=1, thread_num=1, use_var=None,
              pipe_command=None, input_type=0, fs_name="", fs_ugi="",
              download_cmd="cat", **kwargs):
-        self.batch_size = batch_size
-        self.thread_num = thread_num
+        self.batch_size = int(batch_size)
+        self.thread_num = int(thread_num)
         self.use_vars = list(use_var or [])
         self.pipe_command = pipe_command
         return self
 
     def set_batch_size(self, batch_size):
-        self.batch_size = batch_size
+        self.batch_size = int(batch_size)
 
     def set_thread(self, thread_num):
-        self.thread_num = thread_num
+        self.thread_num = int(thread_num)
 
     def set_filelist(self, filelist):
         self.filelist = list(filelist)

@@ -16,6 +16,34 @@ from .base import (  # noqa: F401
     distributed_optimizer, distributed_model,
 )
 from .meta import apply_strategy, build_hybrid_train_step  # noqa: F401
+
+# module-level shortcuts onto the fleet singleton — the reference binds
+# every Fleet method as a fleet-module attribute (ref:
+# distributed/fleet/__init__.py:36-65); real user code calls
+# `fleet.init_worker()` etc. on the MODULE
+_final_strategy = fleet._final_strategy
+_get_applied_meta_list = fleet._get_applied_meta_list
+_get_applied_graph_list = fleet._get_applied_graph_list
+is_worker = fleet.is_worker
+worker_endpoints = fleet.worker_endpoints
+server_num = fleet.server_num
+server_index = fleet.server_index
+server_endpoints = fleet.server_endpoints
+is_server = fleet.is_server
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+save_inference_model = fleet.save_inference_model
+save_persistables = fleet.save_persistables
+minimize = fleet.minimize
+step = fleet.step
+clear_grad = fleet.clear_grad
+set_lr = fleet.set_lr
+get_lr = fleet.get_lr
+state_dict = fleet.state_dict
+set_state_dict = fleet.set_state_dict
 from .data_generator import (  # noqa: F401
     DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator)
 
@@ -52,3 +80,6 @@ class Role:
 
 
 from . import metrics  # noqa: E402,F401
+
+
+util = UtilBase()  # ref: fleet.util (util_factory singleton)

@@ -135,6 +135,24 @@ class Fleet:
         from ..collective import barrier
         barrier()
 
+    def worker_endpoints(self, to_string=False):
+        eps = getattr(self._role_maker, "worker_endpoints", None)
+        eps = eps() if callable(eps) else (eps or [])
+        return ",".join(eps) if to_string else list(eps)
+
+    def server_num(self):
+        f = getattr(self._role_maker, "server_num", None)
+        return f() if callable(f) else 0
+
+    def server_index(self):
+        f = getattr(self._role_maker, "server_index", None)
+        return f() if callable(f) else 0
+
+    def server_endpoints(self, to_string=False):
+        eps = getattr(self._role_maker, "server_endpoints", None)
+        eps = eps() if callable(eps) else (eps or [])
+        return ",".join(eps) if to_string else list(eps)
+
     # ---- training ----
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
@@ -151,6 +169,51 @@ class Fleet:
     @property
     def strategy(self):
         return self._strategy
+
+    # ---- optimizer passthroughs (ref: fleet_base.py — the fleet module
+    # IS the optimizer facade after distributed_optimizer) ----
+    def _user_opt(self):
+        if self._origin_optimizer is None:
+            raise RuntimeError(
+                "call fleet.distributed_optimizer(optimizer) first")
+        return self._origin_optimizer
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._user_opt().minimize(
+            loss, startup_program=startup_program, parameters=parameters,
+            no_grad_set=no_grad_set)
+
+    def step(self):
+        return self._user_opt().step()
+
+    def clear_grad(self):
+        return self._user_opt().clear_grad()
+
+    def set_lr(self, value):
+        return self._user_opt().set_lr(value)
+
+    def get_lr(self):
+        return self._user_opt().get_lr()
+
+    def state_dict(self):
+        return self._user_opt().state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self._user_opt().set_state_dict(state_dict)
+
+    # ---- introspection (ref: fleet_base _final_strategy and the
+    # meta/graph-optimizer lists; strategy lowering here is declarative,
+    # so the "applied" lists name the XLA mechanisms selected) ----
+    def _final_strategy(self):
+        return self._strategy
+
+    def _get_applied_meta_list(self):
+        from .meta import applied_mechanisms
+        return applied_mechanisms(self._strategy)
+
+    def _get_applied_graph_list(self):
+        return []  # graph-pass rewrites don't exist on the XLA stack
 
     # ---- io (worker-0 gated, ref: fleet_base save_persistables) ----
     def save_persistables(self, executor, dirname, main_program=None):

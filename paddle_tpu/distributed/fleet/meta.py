@@ -346,3 +346,31 @@ def _build_explicit_dp_step(strategy, loss_fn, optimizer, mesh):
     step.init_opt_state = init_opt_state
     step.mesh = mesh
     return step, mesh
+
+
+def applied_mechanisms(strategy):
+    """Which strategy flags are active and the XLA mechanism each lowers
+    to (ref: fleet_base._get_applied_meta_list naming the meta-optimizer
+    classes; here the mechanisms are declarative, not graph passes)."""
+    out = []
+    if strategy is None:
+        return out
+    if getattr(strategy, "amp", False):
+        out.append("AMPOptimizer->bf16_compute_policy")
+    if getattr(strategy, "recompute", False):
+        out.append("RecomputeOptimizer->jax.checkpoint")
+    if getattr(strategy, "sharding", False):
+        out.append("ShardingOptimizer->zero_param_sharding")
+    if getattr(strategy, "gradient_merge", False):
+        out.append("GradientMergeOptimizer->microbatch_scan")
+    if getattr(strategy, "pipeline", False):
+        out.append("PipelineOptimizer->pp_mesh_axis_gpipe")
+    if getattr(strategy, "localsgd", False):
+        out.append("LocalSGDOptimizer->periodic_psum_average")
+    if getattr(strategy, "dgc", False):
+        out.append("DGCMomentumOptimizer->topk_grad_compression")
+    if getattr(strategy, "lamb", False):
+        out.append("LambOptimizer->lamb_rule")
+    if getattr(strategy, "lars", False):
+        out.append("LarsOptimizer->lars_rule")
+    return out

@@ -77,30 +77,20 @@ class DataFeedDesc:
         return "\n".join(out) + "\n"
 
 
-class DatasetBase:
+from ..distributed.dataset import DatasetBase as _DistDatasetBase
+
+
+class DatasetBase(_DistDatasetBase):
+    """1.x text-contract dataset base: shares the config surface (init,
+    set_batch_size/thread/filelist/use_var/pipe_command,
+    set_data_generator) with distributed.dataset, and replaces the parse
+    path with the MultiSlot TEXT format + use_var-typed padded batching
+    the reference's C++ MultiSlotDataFeed implements."""
+
     def __init__(self):
-        self.batch_size = 1
-        self.thread_num = 1
-        self.filelist = []
-        self.use_vars = []
+        super().__init__()
         self.pipe_command = "cat"
         self.fea_eval = False
-
-    # -- configuration (ref: dataset.py DatasetBase setters) --
-    def set_batch_size(self, batch_size):
-        self.batch_size = int(batch_size)
-
-    def set_thread(self, thread_num):
-        self.thread_num = int(thread_num)
-
-    def set_filelist(self, filelist):
-        self.filelist = list(filelist)
-
-    def set_use_var(self, var_list):
-        self.use_vars = list(var_list)
-
-    def set_pipe_command(self, pipe_command):
-        self.pipe_command = pipe_command
 
     def set_hdfs_config(self, fs_name, fs_ugi):
         pass  # no remote FS on this stack; files are local paths
@@ -139,14 +129,22 @@ class DatasetBase:
                         capture_output=True, check=True)
                 for line in proc.stdout.decode().splitlines():
                     if line.strip():
-                        yield line
+                        yield line.rstrip("\n")
             else:
                 with open(path) as f:
                     for line in f:
                         if line.strip():
-                            yield line
+                            # strip the newline BEFORE any parse: string
+                            # slots via an attached generator must see the
+                            # same bytes distributed.dataset delivers
+                            yield line.rstrip("\n")
 
     def _parse_line(self, line, meta=None):
+        if self._generator is not None:
+            # attached-generator shortcut inherited from the shared base:
+            # the generator parses RAW lines (no MultiSlot text round
+            # trip), exactly like distributed.dataset
+            return super()._parse_line(line)
         toks = line.split()
         if meta is None:
             meta = self._slot_meta()
@@ -162,6 +160,16 @@ class DatasetBase:
         return out
 
     def _batches(self, samples):
+        if self._generator is not None:
+            buf = []
+            for s in samples:
+                buf.append(s)
+                if len(buf) == self.batch_size:
+                    yield self._batch(buf)
+                    buf = []
+            if buf:
+                yield self._batch(buf)
+            return
         meta = self._slot_meta()
         buf = []
         for s in samples:

@@ -2,6 +2,8 @@
 from __future__ import annotations
 
 from . import callbacks  # noqa: F401
+from . import logger  # noqa: F401
+from . import model_summary  # noqa: F401
 from .model import Model  # noqa: F401
 from .static_flops import flops  # noqa: F401
 

@@ -1,0 +1,11 @@
+"""hapi.model_summary — the reference's canonical home of `summary`
+(ref: python/paddle/hapi/model_summary.py); the implementation lives in
+the top-level paddle_tpu.summary, shared with Model.summary()."""
+from __future__ import annotations
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    import paddle_tpu
+    return paddle_tpu.summary(net, input_size, dtypes, input)

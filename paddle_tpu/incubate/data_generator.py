@@ -6,6 +6,12 @@ generator's `generate_sample(line)` yields `[(slot_name, [values]), ...]`;
 exact bytes fluid.dataset_feed's datasets (and the reference's C++
 MultiSlotDataFeed) parse. run_from_stdin/run_from_memory drive it as the
 `pipe_command` of a Dataset.
+
+Sibling API: distributed.fleet.data_generator carries the 2.x fleet
+variant of the same user contract — in-process `run_from_memory(lines)`
+returning parsed samples for `Dataset.set_data_generator` (no text round
+trip) and a counted protocol line. This module is the 1.x stdout-pipe
+protocol, byte-compatible with the reference's feed.
 """
 from __future__ import annotations
 

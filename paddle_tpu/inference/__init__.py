@@ -198,3 +198,68 @@ def create_predictor(config_or_model, example_inputs=None):
         translated = jit_load(config_or_model._prefix())
         return Predictor(translated)
     return Predictor(config_or_model, example_inputs)
+
+
+class DataType:
+    """Tensor element types of the predictor IO surface (ref:
+    fluid/inference DataType from paddle_infer_declare)."""
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    INT64 = "int64"
+    INT32 = "int32"
+    UINT8 = "uint8"
+    INT8 = "int8"
+
+
+class PlaceType:
+    """Where predictor tensors live. kTPU covers the accelerator; the
+    CUDA names are accepted for source compat and map to it."""
+    kHOST = kCPU = "cpu"
+    kGPU = kTPU = kXPU = "tpu"
+
+
+class PrecisionType:
+    """Serving precision request (ref: AnalysisConfig::Precision).
+    Float32 runs as-is; Half maps to bfloat16 (the TPU half type);
+    Int8 expects a slim-converted model (see paddle.slim
+    save_quantized_model)."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+
+
+def get_num_bytes_of_data_type(dtype):
+    import jax.numpy as jnp
+    return np.dtype(jnp.dtype(str(dtype))).itemsize
+
+
+def get_version():
+    from ..version import full_version
+    return f"paddle_tpu {full_version} (StableHLO artifact serving)"
+
+
+class PredictorPool:
+    """N predictors over ONE artifact (ref: fluid/inference
+    PredictorPool): the artifact is deserialized and its StableHLO
+    translated once, shared by every slot (XLA computations are
+    stateless); only the per-slot IO handles are private, so each pool
+    slot can serve a different thread without re-compiling or holding N
+    weight copies."""
+
+    def __init__(self, config, size=1):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        if isinstance(config, Config):
+            from ..jit import load as jit_load
+            shared = jit_load(config._prefix())  # one load+translate
+            self._preds = [Predictor(shared) for _ in range(size)]
+        else:
+            self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrive(self, idx):  # reference spelling
+        return self._preds[idx]
+
+    retrieve = retrive
+
+    def __len__(self):
+        return len(self._preds)

@@ -113,6 +113,16 @@ ATTR_PAIRS = [
     ("io", "io"),
     ("jit", "jit"),
     ("vision", "vision"),
+    ("distributed/fleet", "distributed.fleet"),
+    ("inference", "inference"),
+    ("hapi", "hapi"),
+    ("amp", "amp"),
+    ("metric", "metric"),
+    ("optimizer", "optimizer"),
+    ("text", "text"),
+    ("vision/models", "vision.models"),
+    ("vision/transforms", "vision.transforms"),
+    ("nn/functional", "nn.functional"),
 ]
 
 # import-bound names that are python machinery, not API surface

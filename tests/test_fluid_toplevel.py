@@ -283,3 +283,30 @@ def test_transpiler_and_misc_shims():
     wa.add(1.0, 1)
     wa.add(3.0, 1)
     assert wa.eval() == 2.0
+
+
+def test_fluid_dataset_with_attached_generator(tmp_path):
+    """r4 dedup: fluid datasets share the distributed.dataset base, so
+    set_data_generator (raw-line in-process parsing, no MultiSlot text
+    round trip) works on the fluid classes too."""
+    from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                toks = line.split(",")
+                yield ("a", [int(toks[0])])
+                yield ("b", [float(toks[1])])
+            return gen
+
+    p = tmp_path / "raw.csv"
+    p.write_text("1,0.5\n2,1.5\n3,2.5\n")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(2)
+    ds.set_filelist([str(p)])
+    ds.set_data_generator(G())
+    ds.load_into_memory()
+    batches = list(ds)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0]["a"], [[1], [2]])
+    np.testing.assert_array_equal(batches[1]["b"], [[2.5]])

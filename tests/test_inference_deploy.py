@@ -224,3 +224,31 @@ class TestQuantizedDeploy:
         loaded = paddle.jit.load(prefix)
         out = np.asarray(loaded(Tensor(jnp.asarray(x))).numpy())
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestInferenceAuxSurface:
+    def test_enums_helpers_and_pool(self, tmp_path):
+        """r4: DataType/PlaceType/PrecisionType, get_version,
+        get_num_bytes_of_data_type, PredictorPool (ref:
+        paddle/inference/__init__.py export list)."""
+        from paddle_tpu import inference as infer
+        assert infer.get_num_bytes_of_data_type("float32") == 4
+        assert infer.get_num_bytes_of_data_type("bfloat16") == 2
+        assert infer.get_num_bytes_of_data_type("int8") == 1
+        assert "paddle_tpu" in infer.get_version()
+        assert infer.PrecisionType.Int8 == 2
+        assert infer.DataType.FLOAT32 == "float32"
+        prefix, x, ref = _save_net(tmp_path)
+        pool = infer.PredictorPool(
+            infer.Config(prefix + ".pdmodel", prefix + ".pdiparams"), 2)
+        assert len(pool) == 2
+        for i in range(2):
+            p = pool.retrive(i)  # reference spelling
+            h = p.get_input_handle(p.get_input_names()[0])
+            h.copy_from_cpu(x)
+            p.run()
+            out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError):
+            infer.PredictorPool(
+                infer.Config(prefix + ".pdmodel", prefix + ".pdiparams"), 0)
