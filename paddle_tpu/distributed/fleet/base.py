@@ -159,7 +159,12 @@ class Fleet:
             self._strategy = strategy
         self._origin_optimizer = optimizer
         from .meta import wrap_optimizer
-        return wrap_optimizer(self, optimizer, self._strategy)
+        # the facade passthroughs (minimize/step/state_dict/...) must
+        # drive THIS wrapped optimizer — a lamb/lars strategy swaps the
+        # update rule, and the module-level fleet.step() has to see it
+        self._wrapped_optimizer = wrap_optimizer(self, optimizer,
+                                                 self._strategy)
+        return self._wrapped_optimizer
 
     def distributed_model(self, model):
         from ..parallel import DataParallel
@@ -173,6 +178,9 @@ class Fleet:
     # ---- optimizer passthroughs (ref: fleet_base.py — the fleet module
     # IS the optimizer facade after distributed_optimizer) ----
     def _user_opt(self):
+        wrapped = getattr(self, "_wrapped_optimizer", None)
+        if wrapped is not None:
+            return wrapped
         if self._origin_optimizer is None:
             raise RuntimeError(
                 "call fleet.distributed_optimizer(optimizer) first")

@@ -458,12 +458,39 @@ class mixed_precision:
     @staticmethod
     def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
                  use_dynamic_loss_scaling=True, **kw):
-        from ..amp import decorate as _dec
-        try:
-            return _dec(optimizer=optimizer,
-                        init_loss_scaling=init_loss_scaling)
-        except Exception:
-            return optimizer
+        """1.x: returns an optimizer whose backward/minimize run under
+        loss scaling (ref: contrib/mixed_precision/decorator.py). On
+        TPU the compute dtype is bf16 (f32 exponent range), so the
+        GradScaler this wraps is a numerically-safe no-op passthrough —
+        the wrapper preserves the 1.x call shape."""
+        from ..amp import GradScaler
+
+        class _AmpOptimizer:
+            def __init__(self, inner):
+                self._inner = inner
+                self._scaler = GradScaler(
+                    init_loss_scaling=init_loss_scaling,
+                    use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+            def backward(self, loss, **bkw):
+                scaled = self._scaler.scale(loss)
+                scaled.backward()
+                return scaled
+
+            def minimize(self, loss, **mkw):
+                self.backward(loss)
+                self._scaler.step(self._inner)
+                self._scaler.update()
+                return None, None
+
+            def step(self):
+                self._scaler.step(self._inner)
+                self._scaler.update()
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        return _AmpOptimizer(optimizer)
 
 
 class InitState:
@@ -577,3 +604,219 @@ class QuantizeTranspiler:
             "instead: paddle.slim.ImperativeQuantAware().quantize(model) "
             "for QAT or paddle.slim.PostTrainingQuantization for PTQ, "
             "then save_quantized_model() for the int8 artifact.")
+
+
+# ---- reference module-attribute surface of fluid.contrib (ref:
+# fluid/contrib/__init__.py import list) ----
+import sys as _sys
+
+layers = _sys.modules[__name__]  # contrib layer fns live flat, right here
+
+
+class AutoMixedPrecisionLists:
+    """Op allow/deny lists consulted by AMP decoration (ref:
+    contrib/mixed_precision/fp16_lists.py). The TPU AMP policy casts by
+    op category; custom lists extend/shrink the categories."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(custom_white_list or [])
+        self.black_list = set(custom_black_list or [])
+        self.black_varnames = set(custom_black_varnames or [])
+
+
+decorate = mixed_precision.decorate  # 1.x top-level spelling
+
+
+class decoder:  # ref: contrib/decoder/__init__
+    pass
+
+
+class beam_search_decoder:  # ref: contrib/decoder/beam_search_decoder.py
+    pass
+
+
+class quantize:  # ref: contrib/quantize/__init__
+    pass
+
+
+class extend_optimizer:  # ref: contrib/extend_optimizer/__init__
+    @staticmethod
+    def extend_with_decoupled_weight_decay(base_optimizer):
+        """Build <Base>WithDecoupledWeightDecay: the BASE update rule
+        plus weight decay applied directly to params, not to grads (ref:
+        contrib/extend_optimizer/extend_optimizer_with_weight_decay.py).
+        Adam maps onto the native AdamW; any other optimizer gets a
+        subclass that decays params before its own step."""
+        from ..optimizer import Adam, AdamW
+        if base_optimizer is Adam:
+            return AdamW
+
+        class OptimizerWithDecoupledWeightDecay(base_optimizer):
+            def __init__(self, *args, coeff=0.01, **kwargs):
+                super().__init__(*args, **kwargs)
+                self._wd_coeff = float(coeff)
+
+            def step(self):
+                lr = float(self.get_lr())
+                for p in self._parameter_list or []:
+                    if p is not None and p.trainable \
+                            and p.grad is not None:
+                        p._value = p._value * (1.0 - lr * self._wd_coeff)
+                super().step()
+
+        OptimizerWithDecoupledWeightDecay.__name__ = \
+            base_optimizer.__name__ + "WithDecoupledWeightDecay"
+        return OptimizerWithDecoupledWeightDecay
+
+
+def memory_usage(program, batch_size=1):
+    """Rough activation+param memory of a Program in MB (ref:
+    contrib/memory_usage_calc.py): sum of var numel × dtype width, batch
+    dim filled with `batch_size`."""
+    import numpy as np
+    total = 0
+    for var in program.global_block().vars.values():
+        shape = [batch_size if (s is None or s < 0) else s
+                 for s in (var.shape or ())]
+        width = 2 if "16" in str(var.dtype) else 8 \
+            if "64" in str(var.dtype) else 4
+        total += int(np.prod(shape)) * width if shape else width
+    return total / (1 << 20)
+
+
+class memory_usage_calc:
+    memory_usage = staticmethod(memory_usage)
+
+
+class model_stat:  # ref: contrib/model_stat.py (param/flops table)
+    @staticmethod
+    def summary(main_prog):
+        n_params = sum(
+            1 for v in main_prog.global_block().vars.values()
+            if getattr(v, "persistable", False))
+        print(f"Program: {n_params} persistable vars")
+
+
+def op_freq_statistic(program):
+    """Op-type frequency of a Program (ref: contrib/op_frequence.py)."""
+    from collections import Counter
+    uni = Counter(op.type for op in program.global_block().ops)
+    adj = Counter()
+    ops_ = program.global_block().ops
+    for a, b in zip(ops_, ops_[1:]):
+        adj[f"{a.type}->{b.type}"] += 1
+    return uni, adj
+
+
+class op_frequence:
+    op_freq_statistic = staticmethod(op_freq_statistic)
+
+
+class _QatModule:
+    """slim.quantization.imperative.qat — the 1.x import home of
+    ImperativeQuantAware (ref: contrib/slim/quantization/imperative/
+    qat.py); the implementation is paddle_tpu.slim."""
+
+
+class slim:  # ref: contrib/slim/__init__ — 1.x home of quantization
+    class quantization:
+        class imperative:
+            qat = _QatModule
+
+        @staticmethod
+        def _bind():
+            pass
+
+
+def _bind_slim():
+    from .. import slim as _slim_mod
+    slim.quantization.ImperativeQuantAware = _slim_mod.ImperativeQuantAware
+    slim.quantization.PostTrainingQuantization = \
+        _slim_mod.PostTrainingQuantization
+    slim.quantization.QuantizeTranspiler = QuantizeTranspiler
+    _QatModule.ImperativeQuantAware = _slim_mod.ImperativeQuantAware
+    decoder.InitState = InitState
+    decoder.StateCell = StateCell
+    decoder.TrainingDecoder = TrainingDecoder
+    decoder.BeamSearchDecoder = BeamSearchDecoder
+    decoder.beam_search_decoder = beam_search_decoder
+    beam_search_decoder.InitState = InitState
+    beam_search_decoder.StateCell = StateCell
+    beam_search_decoder.TrainingDecoder = TrainingDecoder
+    beam_search_decoder.BeamSearchDecoder = BeamSearchDecoder
+    quantize.QuantizeTranspiler = QuantizeTranspiler
+    from .. import optimizer as _opt
+    from .. import reader as _reader
+    globals()["optimizer"] = _opt
+    globals()["reader"] = _reader
+
+
+_bind_slim()
+
+
+class utils:
+    """contrib.utils (ref: contrib/utils/hdfs_utils.py). No HDFS exists
+    on this zero-egress stack; HDFSClient operates on LOCAL paths with
+    the same method surface so staging code runs against mounted
+    filesystems (a real cluster FS appears as a mount on TPU VMs)."""
+
+    class HDFSClient:
+        def __init__(self, hadoop_home=None, configs=None):
+            pass
+
+        def is_exist(self, path):
+            import os
+            return os.path.exists(path)
+
+        def is_dir(self, path):
+            import os
+            return os.path.isdir(path)
+
+        def ls(self, path):
+            import os
+            return sorted(os.path.join(path, f)
+                          for f in os.listdir(path))
+
+        def mkdirs(self, path):
+            import os
+            os.makedirs(path, exist_ok=True)
+
+        def delete(self, path):
+            import os
+            import shutil
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            elif os.path.exists(path):
+                os.remove(path)
+
+        def upload(self, hdfs_path, local_path, overwrite=True,
+                   retry_times=5):
+            import shutil
+            shutil.copy(local_path, hdfs_path)
+
+        def download(self, hdfs_path, local_path, overwrite=True):
+            import shutil
+            shutil.copy(hdfs_path, local_path)
+
+    @staticmethod
+    def multi_download(client, hdfs_path, local_path, trainer_id,
+                      trainers, file_cnt=None):
+        import os
+        files = client.ls(hdfs_path)
+        mine = [f for i, f in enumerate(sorted(files))
+                if i % trainers == trainer_id]
+        os.makedirs(local_path, exist_ok=True)
+        for f in mine:
+            client.download(f, os.path.join(local_path,
+                                            os.path.basename(f)))
+        return mine
+
+    @staticmethod
+    def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                     overwrite=False):
+        import os
+        client.mkdirs(hdfs_path)
+        for f in sorted(os.listdir(local_path)):
+            client.upload(os.path.join(hdfs_path, f),
+                          os.path.join(local_path, f))

@@ -159,16 +159,39 @@ class DatasetBase(_DistDatasetBase):
             out.append(np.asarray(vals, dtype=dtype))
         return out
 
+    @staticmethod
+    def _batch_padded(samples):
+        """Generator-parsed samples ([(name, values), ...]) collated with
+        ragged slots right-padded — the fluid MultiSlot batching
+        contract (the distributed base's _batch assumes equal lengths)."""
+        slots = {}
+        for sample in samples:
+            for name, vals in sample:
+                slots.setdefault(name, []).append(vals)
+        batch = {}
+        for name, rows in slots.items():
+            width = max(len(r) for r in rows)
+            first = np.asarray(rows[0])
+            if first.dtype.kind in ("U", "S"):
+                arr = np.full((len(rows), width), "", dtype=object)
+            else:
+                arr = np.zeros((len(rows), width), dtype=first.dtype)
+            for i, r in enumerate(rows):
+                arr[i, : len(r)] = r
+            batch[name] = arr if arr.dtype != object \
+                else arr.astype(str)
+        return batch
+
     def _batches(self, samples):
         if self._generator is not None:
             buf = []
             for s in samples:
                 buf.append(s)
                 if len(buf) == self.batch_size:
-                    yield self._batch(buf)
+                    yield self._batch_padded(buf)
                     buf = []
             if buf:
-                yield self._batch(buf)
+                yield self._batch_padded(buf)
             return
         meta = self._slot_meta()
         buf = []

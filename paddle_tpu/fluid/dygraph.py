@@ -250,3 +250,130 @@ class TreeConv(Layer):
                        (nodes_vector, edge_set, self.W, self.bias), {})
         from .. import ops as _ops2
         return getattr(_ops2, self.act)(out) if self.act else out
+
+
+# ---- submodule attribute surface of the reference package (ref:
+# fluid/dygraph/__init__.py binds base/checkpoint/container/... as
+# attributes; 1.x user code reaches e.g. fluid.dygraph.base.to_variable
+# and fluid.dygraph.learning_rate_scheduler.NoamDecay) ----
+import sys as _sys
+
+nn = _sys.modules[__name__]      # dygraph layer classes live right here
+layers = _sys.modules[__name__]  # Layer/sublayer defs (dygraph/layers.py)
+
+
+class base:  # ref: fluid/dygraph/base.py
+    from ..core.mode import in_dygraph_mode
+    in_dygraph_mode = staticmethod(in_dygraph_mode)
+    enabled = staticmethod(enabled)
+    to_variable = staticmethod(to_variable)
+    guard = staticmethod(guard)
+
+    @staticmethod
+    def in_declarative_mode():
+        from ..core import mode
+        return mode.in_static_mode()
+
+
+class checkpoint:  # ref: fluid/dygraph/checkpoint.py
+    save_dygraph = staticmethod(save_dygraph)
+    load_dygraph = staticmethod(load_dygraph)
+
+
+class container:  # ref: fluid/dygraph/container.py
+    @staticmethod
+    def _bind():
+        pass
+
+
+class rnn:  # ref: fluid/dygraph/rnn.py
+    @staticmethod
+    def _bind():
+        pass
+
+
+class learning_rate_scheduler:  # ref: fluid/dygraph/learning_rate_scheduler.py
+    @staticmethod
+    def _bind():
+        pass
+
+
+class tracer:  # ref: fluid/dygraph/tracer.py
+    class Tracer:
+        """The C++ imperative tracer is the eager vjp tape on this stack
+        (core/autograd.py); this shell satisfies isinstance checks and
+        the train/eval flag contract."""
+
+        def __init__(self):
+            self._train_mode = True
+
+        def train_mode(self):
+            self._train_mode = True
+
+        def eval_mode(self):
+            self._train_mode = False
+
+
+class StaticModelRunner:
+    """1.x: run a saved static inference model inside dygraph (ref:
+    fluid/dygraph/static_runner.py delegating to TranslatedLayer). Load
+    the artifact with jit.load and call it like a Layer."""
+
+    def __new__(cls, model_dir, model_filename=None, params_filename=None):
+        import os
+
+        from .. import jit as _jit
+        stem = (model_filename or "__model__").replace(".pdmodel", "")
+        if params_filename is not None:
+            pstem = params_filename.replace(".pdiparams", "")
+            if pstem != stem:
+                raise ValueError(
+                    f"artifact pair must share one prefix: model "
+                    f"'{stem}' vs params '{pstem}' — jit.save writes "
+                    "<prefix>.pdmodel + <prefix>.pdiparams")
+        prefix = os.path.join(model_dir, stem)
+        if not os.path.exists(prefix + ".pdmodel"):
+            raise FileNotFoundError(
+                f"no {prefix}.pdmodel; StaticModelRunner loads artifacts "
+                "written by paddle.jit.save(prefix) — pass "
+                "model_filename to pick a non-default prefix")
+        return _jit.load(prefix)
+
+
+def monkey_patch_math_varbase():
+    """Tensor operator patching happens at import on this stack; kept
+    callable for 1.x code invoking it explicitly."""
+
+
+def _late_bind():
+    # populated after import so the class namespaces can reference
+    # modules that import THIS module (container/rnn/lr/amp/parallel/io)
+    from .. import amp as _amp
+    from .. import jit as _jit
+    from ..distributed import parallel as _par
+    from ..nn import LayerList, ParameterList, Sequential
+    from ..nn import GRUCell, LSTMCell
+    from ..optimizer import lr as _lr
+    container.LayerList = LayerList
+    container.Sequential = Sequential
+    container.ParameterList = ParameterList
+    rnn.LSTMCell = LSTMCell
+    rnn.GRUCell = GRUCell
+    for _n in ("NoamDecay", "PiecewiseDecay", "NaturalExpDecay",
+               "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+               "CosineAnnealingDecay", "StepDecay", "MultiStepDecay",
+               "LambdaDecay", "LinearWarmup", "ReduceOnPlateau",
+               "ReduceLROnPlateau"):
+        if hasattr(_lr, _n):
+            setattr(learning_rate_scheduler, _n, getattr(_lr, _n))
+    learning_rate_scheduler.CosineDecay = getattr(
+        _lr, "CosineAnnealingDecay", None)
+    globals()["amp"] = _amp
+    globals()["jit"] = _jit
+    globals()["parallel"] = _par
+    globals()["io"] = _jit          # TranslatedLayer machinery
+    globals()["dygraph_to_static"] = _jit  # ProgramTranslator home
+    globals()["static_runner"] = _sys.modules[__name__]
+
+
+_late_bind()  # fluid.dygraph imports after nn/optimizer, so this is safe

@@ -164,3 +164,26 @@ def create_global_var(shape, value, dtype, persistable=False,
     t = Tensor(_np.full(tuple(shape), value, dtype=dtype))
     t.persistable = persistable
     return t
+
+
+# ---- reference submodule attribute surface (ref: fluid/layers/__init__
+# binds nn/tensor/ops/control_flow/io/detection/... as attributes; user
+# code reaches fluid.layers.nn.relu, fluid.layers.tensor.concat, ...).
+# The rebuild keeps ONE flat namespace, so each submodule name points at
+# it — a superset of every reference submodule's names.
+import sys as _sys
+
+nn = _sys.modules[__name__]
+ops = _sys.modules[__name__]
+tensor = _sys.modules[__name__]
+control_flow = _sys.modules[__name__]
+device = _sys.modules[__name__]
+io = _sys.modules[__name__]
+detection = _sys.modules[__name__]
+metric_op = _sys.modules[__name__]
+
+
+class math_op_patch:  # ref: fluid/layers/math_op_patch.py
+    @staticmethod
+    def monkey_patch_variable():
+        """Operator patching is applied at import on this stack."""

@@ -22,3 +22,6 @@ from ..core.autograd import grad, no_grad  # noqa: E402,F401
 from ..distributed.parallel import DataParallel  # noqa: E402,F401
 from ..nn.layer.layers import LayerList  # noqa: E402,F401
 from ..fluid.layers import create_parameter  # noqa: E402,F401
+from ..compat import ComplexVariable, VarBase  # noqa: E402,F401
+from ..fluid import core  # noqa: E402,F401
+from ..core import rng as random  # noqa: E402,F401,A004  (framework.random)

@@ -18,3 +18,5 @@ from .compat import (  # noqa: F401,E402
 from .core.tensor import is_tensor  # noqa: F401,E402
 from .fluid.layers import fill_constant  # noqa: F401,E402
 print_function = None  # __future__ artifact the reference re-exported
+
+from .compat import reverse  # noqa: E402,F401  (1.x flip alias at paddle.tensor)

@@ -241,3 +241,8 @@ class WMT16(WMT14):
 import sys as _sys  # noqa: E402
 
 datasets = _sys.modules[__name__]  # paddle.text.datasets alias
+
+# the reference's text/datasets also binds the 1.x reader modules as
+# attributes (ref: text/datasets/__init__.py import list)
+from ..dataset import (  # noqa: E402,F401
+    conll05, imdb, imikolov, movielens, uci_housing, wmt14, wmt16)

@@ -123,6 +123,19 @@ ATTR_PAIRS = [
     ("vision/models", "vision.models"),
     ("vision/transforms", "vision.transforms"),
     ("nn/functional", "nn.functional"),
+    ("tensor", "tensor"),
+    ("text/datasets", "text.datasets"),
+    ("framework", "framework"),
+    ("nn/initializer", "nn.initializer"),
+    ("static/nn", "static.nn"),
+    ("vision/datasets", "vision.datasets"),
+    ("fluid/dygraph", "fluid.dygraph"),
+    ("fluid/layers", "fluid.layers"),
+    ("fluid/contrib", "fluid.contrib"),
+    ("onnx", "onnx"),
+    # NOT audited for attributes: distribution.py / vision/ops.py are
+    # plain modules whose module-level imports are implementation helpers
+    # (check_dtype, LayerHelper, elementwise_*) rather than API surface.
 ]
 
 # import-bound names that are python machinery, not API surface
