@@ -14,6 +14,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -223,6 +224,98 @@ void arena_destroy(void* handle) {
         for (void* p : kv.second) ::operator delete(p);
     for (auto& kv : a->live) ::operator delete(kv.first);
     delete a;
+}
+
+// ---------------------------------------------------------------------------
+// MultiSlot text parser (reference role: the C++ MultiSlotDataFeed's line
+// parser — paddle/fluid/framework/data_feed.cc; behavior studied, code
+// re-designed). One sample per line, per slot "<n> v1 ... vn". Two-pass:
+// ms_scan counts samples + per-slot max width, the caller allocates padded
+// [n_samples, width] arrays, ms_fill parses values straight into them.
+// The buffer MUST be NUL-terminated (strtoll/strtof read past token ends).
+// ---------------------------------------------------------------------------
+
+static inline const char* ms_ws(const char* p, const char* end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    return p;
+}
+
+long long ms_scan(const char* buf, long long len, int n_slots,
+                  long long* max_widths) {
+    const char* p = buf;
+    const char* end = buf + len;
+    for (int s = 0; s < n_slots; ++s) max_widths[s] = 0;
+    long long n_samples = 0;
+    while (p < end) {
+        p = ms_ws(p, end);
+        if (p < end && *p == '\n') { ++p; continue; }
+        if (p >= end) break;
+        for (int s = 0; s < n_slots; ++s) {
+            p = ms_ws(p, end);
+            // strtoll would skip '\n' as whitespace and silently merge a
+            // short line with the next one — a missing slot must ERROR
+            if (p >= end || *p == '\n') return -1;
+            char* q;
+            long long n = strtoll(p, &q, 10);
+            if (q == p || n < 0) return -1;
+            p = q;
+            if (n > max_widths[s]) max_widths[s] = n;
+            for (long long i = 0; i < n; ++i) {
+                p = ms_ws(p, end);
+                const char* t = p;
+                while (p < end && *p != ' ' && *p != '\t' && *p != '\n'
+                       && *p != '\r') ++p;
+                if (p == t) return -1;  // fewer values than declared
+            }
+        }
+        p = ms_ws(p, end);
+        if (p < end) {
+            if (*p != '\n') return -1;  // trailing tokens: slot mismatch
+            ++p;
+        }
+        ++n_samples;
+    }
+    return n_samples;
+}
+
+int ms_fill(const char* buf, long long len, int n_slots,
+            const uint8_t* is_float, const long long* widths, void** outs) {
+    const char* p = buf;
+    const char* end = buf + len;
+    long long row = 0;
+    while (p < end) {
+        p = ms_ws(p, end);
+        if (p < end && *p == '\n') { ++p; continue; }
+        if (p >= end) break;
+        for (int s = 0; s < n_slots; ++s) {
+            p = ms_ws(p, end);
+            if (p >= end || *p == '\n') return -1;  // short line
+            char* q;
+            long long n = strtoll(p, &q, 10);
+            if (q == p || n < 0 || n > widths[s]) return -1;
+            p = q;
+            long long base = row * widths[s];
+            for (long long i = 0; i < n; ++i) {
+                p = ms_ws(p, end);
+                if (p >= end || *p == '\n') return -1;  // short line
+                char* r;
+                if (is_float[s]) {
+                    float v = strtof(p, &r);
+                    if (r == p) return -1;
+                    static_cast<float*>(outs[s])[base + i] = v;
+                } else {
+                    long long v = strtoll(p, &r, 10);
+                    if (r == p) return -1;
+                    static_cast<int64_t*>(outs[s])[base + i] = v;
+                }
+                p = r;
+            }
+        }
+        p = ms_ws(p, end);
+        if (p < end) ++p;  // consume '\n'
+        ++row;
+    }
+    return 0;
 }
 
 }  // extern "C"

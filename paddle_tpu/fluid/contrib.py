@@ -650,7 +650,13 @@ class extend_optimizer:  # ref: contrib/extend_optimizer/__init__
         subclass that decays params before its own step."""
         from ..optimizer import Adam, AdamW
         if base_optimizer is Adam:
-            return AdamW
+            class AdamWithDecoupledWeightDecay(AdamW):
+                def __init__(self, *args, coeff=0.01, **kwargs):
+                    # 1.x spells the decay strength `coeff`
+                    kwargs.setdefault("weight_decay", coeff)
+                    super().__init__(*args, **kwargs)
+
+            return AdamWithDecoupledWeightDecay
 
         class OptimizerWithDecoupledWeightDecay(base_optimizer):
             def __init__(self, *args, coeff=0.01, **kwargs):

@@ -171,11 +171,13 @@ class DatasetBase(_DistDatasetBase):
         batch = {}
         for name, rows in slots.items():
             width = max(len(r) for r in rows)
-            first = np.asarray(rows[0])
-            if first.dtype.kind in ("U", "S"):
+            dtypes = [np.asarray(r).dtype for r in rows]
+            if any(d.kind in ("U", "S") for d in dtypes):
                 arr = np.full((len(rows), width), "", dtype=object)
             else:
-                arr = np.zeros((len(rows), width), dtype=first.dtype)
+                # promote across rows: [1,2] then [0.5] must not truncate
+                arr = np.zeros((len(rows), width),
+                               dtype=np.result_type(*dtypes))
             for i, r in enumerate(rows):
                 arr[i, : len(r)] = r
             batch[name] = arr if arr.dtype != object \
@@ -235,16 +237,54 @@ class QueueDataset(DatasetBase):
 
 
 class InMemoryDataset(DatasetBase):
-    """Load-then-iterate with shuffle (ref: dataset.py InMemoryDataset)."""
+    """Load-then-iterate with shuffle (ref: dataset.py InMemoryDataset).
+
+    Text loads go through the native C++ MultiSlot parser when available
+    (csrc/native_runtime.cpp ms_scan/ms_fill — the reference parses this
+    format in C++ too): the whole filelist lands in padded [N, W] slot
+    arrays, shuffle permutes one index vector, and batches are row
+    slices. Falls back to the per-line Python parser (which also serves
+    attached-generator and pipe_command datasets)."""
 
     def __init__(self):
         super().__init__()
         self._samples = None
+        self._native = None   # {name: [N, W] array} fast path
+        self._order = None
 
     def load_into_memory(self):
+        self._native = None
+        if self._generator is None and self._load_native():
+            return
         meta = self._slot_meta()  # once, not per line
         self._samples = [self._parse_line(ln, meta)
                          for ln in self._iter_lines()]
+
+    def _load_native(self):
+        if self.pipe_command not in (None, "cat") or not self.use_vars:
+            return False
+        meta = self._slot_meta()
+        if any(fixed is None for _, _, fixed in meta):
+            # variable-width slots pad per BATCH on the Python path; the
+            # native bulk parse pads globally — keep one shape contract
+            # by restricting the fast path to fully-fixed slot widths
+            return False
+        try:
+            from ..io.native_loader import parse_multislot
+            buf = bytearray()
+            for path in self.filelist:
+                with open(path, "rb") as f:
+                    buf += f.read()
+                buf += b"\n"
+            self._native = parse_multislot(buf, meta)
+        except Exception:
+            return False  # no compiler / malformed: the Python parser
+            # runs next and raises with a per-line diagnostic if truly bad
+        n = next(iter(self._native.values())).shape[0] \
+            if self._native else 0
+        self._order = np.arange(n)
+        self._samples = True  # loaded marker for the shared guards
+        return True
 
     def preload_into_memory(self, thread_num=None):
         self.load_into_memory()
@@ -255,7 +295,10 @@ class InMemoryDataset(DatasetBase):
     def local_shuffle(self):
         if self._samples is None:
             raise RuntimeError("call load_into_memory() first")
-        random.shuffle(self._samples)
+        if self._native is not None:
+            np.random.shuffle(self._order)
+        else:
+            random.shuffle(self._samples)
 
     def global_shuffle(self, fleet=None, thread_num=None):
         # single-trainer semantics: global == local (multi-trainer sparse
@@ -264,8 +307,12 @@ class InMemoryDataset(DatasetBase):
 
     def release_memory(self):
         self._samples = None
+        self._native = None
+        self._order = None
 
     def get_memory_data_size(self, fleet=None):
+        if self._native is not None:
+            return int(len(self._order))
         return len(self._samples or [])
 
     def get_shuffle_data_size(self, fleet=None):
@@ -274,6 +321,14 @@ class InMemoryDataset(DatasetBase):
     def __iter__(self):
         if self._samples is None:
             raise RuntimeError("call load_into_memory() first")
+        if self._native is not None:
+            def gen():
+                n = len(self._order)
+                for i in range(0, n, self.batch_size):
+                    idx = self._order[i: i + self.batch_size]
+                    yield {name: arr[idx]
+                           for name, arr in self._native.items()}
+            return gen()
         return self._batches(iter(self._samples))
 
 

@@ -75,8 +75,59 @@ def get_lib():
         lib.arena_reserved_bytes.restype = ctypes.c_int64
         lib.arena_reserved_bytes.argtypes = [ctypes.c_void_p]
         lib.arena_destroy.argtypes = [ctypes.c_void_p]
+        lib.ms_scan.restype = ctypes.c_longlong
+        lib.ms_scan.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                                ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_longlong)]
+        lib.ms_fill.restype = ctypes.c_int
+        lib.ms_fill.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                                ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.POINTER(ctypes.c_longlong),
+                                ctypes.POINTER(ctypes.c_void_p)]
         _lib = lib
         return lib
+
+
+def parse_multislot(data, slot_meta):
+    """Parse a MultiSlot text buffer natively into padded per-slot arrays.
+
+    data: bytes of slot-formatted lines. slot_meta: [(name, np_dtype,
+    fixed_width_or_None), ...] as produced by fluid.dataset_feed's
+    _slot_meta. Returns {name: [n_samples, width] ndarray}; raises
+    ValueError on malformed input (same contract as the Python parser).
+    """
+    lib = get_lib()
+    n_slots = len(slot_meta)
+    if n_slots == 0:
+        raise ValueError("no slots configured (set_use_var first)")
+    data = bytes(data) + b"\0"  # strtol/strtof need a terminator
+    length = len(data) - 1
+    widths = (ctypes.c_longlong * n_slots)()
+    n = lib.ms_scan(data, length, n_slots, widths)
+    if n < 0:
+        raise ValueError("malformed MultiSlot data (token/slot mismatch)")
+    out = {}
+    ptrs = (ctypes.c_void_p * n_slots)()
+    is_float = (ctypes.c_uint8 * n_slots)()
+    final_w = (ctypes.c_longlong * n_slots)()
+    for s, (name, dtype, fixed) in enumerate(slot_meta):
+        w = int(widths[s])
+        if fixed:
+            w = max(w, int(fixed))  # parse buffer must hold every token
+        is_float[s] = 1 if np.dtype(dtype) == np.float32 else 0
+        arr = np.zeros((int(n), w),
+                       np.float32 if is_float[s] else np.int64)
+        out[name] = arr
+        final_w[s] = w
+        ptrs[s] = arr.ctypes.data_as(ctypes.c_void_p)
+    if n and lib.ms_fill(data, length, n_slots, is_float, final_w,
+                         ptrs) != 0:
+        raise ValueError("malformed MultiSlot data (value parse failed)")
+    for s, (name, dtype, fixed) in enumerate(slot_meta):
+        if fixed and out[name].shape[1] != int(fixed):
+            out[name] = out[name][:, : int(fixed)]
+    return out
 
 
 def _serialize_batch(batch):

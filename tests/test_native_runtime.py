@@ -79,3 +79,69 @@ class TestLoaderIntegration:
         for (x,) in loader:
             seen.extend(x.numpy().reshape(-1).tolist())
         assert sorted(seen) == list(range(40))
+
+
+class TestNativeMultiSlotParser:
+    """r4: the C++ MultiSlot parser (ms_scan/ms_fill) — the reference
+    parses this format in C++ (data_feed.cc) too; the Python line parser
+    is the fallback contract."""
+
+    def _meta(self):
+        return [("x", np.float32, None), ("y", np.int64, 1)]
+
+    def test_correctness_and_padding(self):
+        from paddle_tpu.io.native_loader import parse_multislot
+        out = parse_multislot(
+            b"4 0.5 1.5 2.5 3.5 1 1\n2 9.0 8.0 1 0\n", self._meta())
+        np.testing.assert_allclose(
+            out["x"], [[0.5, 1.5, 2.5, 3.5], [9.0, 8.0, 0, 0]])
+        np.testing.assert_array_equal(out["y"], [[1], [0]])
+
+    def test_malformed_raises(self):
+        from paddle_tpu.io.native_loader import parse_multislot
+        with pytest.raises(ValueError):
+            parse_multislot(b"3 1 2\n", [("a", np.int64, None)])
+        with pytest.raises(ValueError):  # trailing junk = slot mismatch
+            parse_multislot(b"1 5 junk extra\n",
+                            [("a", np.int64, None)])
+        with pytest.raises(ValueError):  # code-review r4: a short line
+            # must NOT silently merge with the next one (strtoll skips \n)
+            parse_multislot(b"1 7\n1 8\n",
+                            [("a", np.int64, 1), ("b", np.int64, 1)])
+
+    def test_dataset_native_path_matches_python(self, tmp_path):
+        from paddle_tpu import fluid
+        rs = np.random.RandomState(1)
+        lines = ["4 " + " ".join(f"{v:.4f}" for v in rs.rand(4))
+                 + f" 1 {rs.randint(2)}" for _ in range(200)]
+        p = tmp_path / "part"
+        p.write_text("\n".join(lines))
+
+        class V:
+            def __init__(self, name, dtype, shape):
+                self.name, self.dtype, self.shape = name, dtype, shape
+
+        def mk():
+            ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+            ds.set_use_var([V("x", "float32", [None, 4]),
+                            V("y", "int64", [None, 1])])
+            ds.set_batch_size(64)
+            ds.set_filelist([str(p)])
+            return ds
+
+        ds_native = mk()
+        ds_native.load_into_memory()
+        assert ds_native._native is not None  # fast path actually taken
+        assert ds_native.get_memory_data_size() == 200
+        ds_py = mk()
+        ds_py._load_native = lambda: False
+        ds_py.load_into_memory()
+        for bn, bp in zip(ds_native, ds_py):
+            np.testing.assert_allclose(bn["x"], bp["x"], rtol=1e-6)
+            np.testing.assert_array_equal(bn["y"], bp["y"])
+        # shuffle permutes rows, keeps the multiset of labels
+        ds_native.local_shuffle()
+        ys = np.concatenate([b["y"].ravel() for b in ds_native])
+        np.testing.assert_array_equal(
+            np.sort(ys), np.sort(np.concatenate(
+                [b["y"].ravel() for b in ds_py])))
