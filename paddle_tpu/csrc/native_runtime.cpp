@@ -278,8 +278,16 @@ long long ms_scan(const char* buf, long long len, int n_slots,
     return n_samples;
 }
 
+static inline bool ms_tok_end(const char* p, const char* end) {
+    // a parsed number must terminate at whitespace/newline/end; stopping
+    // mid-token ("2.0" under strtoll) would desync the line framing
+    return p >= end || *p == ' ' || *p == '\t' || *p == '\r'
+           || *p == '\n' || *p == '\0';
+}
+
 int ms_fill(const char* buf, long long len, int n_slots,
-            const uint8_t* is_float, const long long* widths, void** outs) {
+            const uint8_t* is_float, const long long* widths, void** outs,
+            long long n_samples) {
     const char* p = buf;
     const char* end = buf + len;
     long long row = 0;
@@ -287,12 +295,14 @@ int ms_fill(const char* buf, long long len, int n_slots,
         p = ms_ws(p, end);
         if (p < end && *p == '\n') { ++p; continue; }
         if (p >= end) break;
+        if (row >= n_samples) return -1;  // MUST match ms_scan's count
         for (int s = 0; s < n_slots; ++s) {
             p = ms_ws(p, end);
             if (p >= end || *p == '\n') return -1;  // short line
             char* q;
             long long n = strtoll(p, &q, 10);
-            if (q == p || n < 0 || n > widths[s]) return -1;
+            if (q == p || n < 0 || n > widths[s] || !ms_tok_end(q, end))
+                return -1;
             p = q;
             long long base = row * widths[s];
             for (long long i = 0; i < n; ++i) {
@@ -301,18 +311,21 @@ int ms_fill(const char* buf, long long len, int n_slots,
                 char* r;
                 if (is_float[s]) {
                     float v = strtof(p, &r);
-                    if (r == p) return -1;
+                    if (r == p || !ms_tok_end(r, end)) return -1;
                     static_cast<float*>(outs[s])[base + i] = v;
                 } else {
                     long long v = strtoll(p, &r, 10);
-                    if (r == p) return -1;
+                    if (r == p || !ms_tok_end(r, end)) return -1;
                     static_cast<int64_t*>(outs[s])[base + i] = v;
                 }
                 p = r;
             }
         }
         p = ms_ws(p, end);
-        if (p < end) ++p;  // consume '\n'
+        if (p < end) {
+            if (*p != '\n' && *p != '\0') return -1;  // trailing junk
+            ++p;
+        }
         ++row;
     }
     return 0;

@@ -295,10 +295,13 @@ class InMemoryDataset(DatasetBase):
     def local_shuffle(self):
         if self._samples is None:
             raise RuntimeError("call load_into_memory() first")
+        # ONE rng for both paths: shuffle order must not depend on
+        # whether the native parser compiled
+        perm = np.random.permutation(self.get_memory_data_size())
         if self._native is not None:
-            np.random.shuffle(self._order)
+            self._order = self._order[perm]
         else:
-            random.shuffle(self._samples)
+            self._samples = [self._samples[i] for i in perm]
 
     def global_shuffle(self, fleet=None, thread_num=None):
         # single-trainer semantics: global == local (multi-trainer sparse

@@ -76,15 +76,16 @@ def get_lib():
         lib.arena_reserved_bytes.argtypes = [ctypes.c_void_p]
         lib.arena_destroy.argtypes = [ctypes.c_void_p]
         lib.ms_scan.restype = ctypes.c_longlong
-        lib.ms_scan.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
-                                ctypes.c_int,
+        lib.ms_scan.argtypes = [ctypes.POINTER(ctypes.c_char),
+                                ctypes.c_longlong, ctypes.c_int,
                                 ctypes.POINTER(ctypes.c_longlong)]
         lib.ms_fill.restype = ctypes.c_int
-        lib.ms_fill.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
-                                ctypes.c_int,
+        lib.ms_fill.argtypes = [ctypes.POINTER(ctypes.c_char),
+                                ctypes.c_longlong, ctypes.c_int,
                                 ctypes.POINTER(ctypes.c_uint8),
                                 ctypes.POINTER(ctypes.c_longlong),
-                                ctypes.POINTER(ctypes.c_void_p)]
+                                ctypes.POINTER(ctypes.c_void_p),
+                                ctypes.c_longlong]
         _lib = lib
         return lib
 
@@ -101,10 +102,18 @@ def parse_multislot(data, slot_meta):
     n_slots = len(slot_meta)
     if n_slots == 0:
         raise ValueError("no slots configured (set_use_var first)")
-    data = bytes(data) + b"\0"  # strtol/strtof need a terminator
+    # zero-copy when handed a bytearray: terminate IN PLACE (strtol/
+    # strtof need it) instead of materializing a second dataset-sized
+    # buffer
+    if isinstance(data, bytearray):
+        if not data.endswith(b"\0"):
+            data.append(0)
+    else:
+        data = bytearray(data) + b"\0"
     length = len(data) - 1
+    cbuf = (ctypes.c_char * len(data)).from_buffer(data)
     widths = (ctypes.c_longlong * n_slots)()
-    n = lib.ms_scan(data, length, n_slots, widths)
+    n = lib.ms_scan(cbuf, length, n_slots, widths)
     if n < 0:
         raise ValueError("malformed MultiSlot data (token/slot mismatch)")
     out = {}
@@ -121,8 +130,8 @@ def parse_multislot(data, slot_meta):
         out[name] = arr
         final_w[s] = w
         ptrs[s] = arr.ctypes.data_as(ctypes.c_void_p)
-    if n and lib.ms_fill(data, length, n_slots, is_float, final_w,
-                         ptrs) != 0:
+    if n and lib.ms_fill(cbuf, length, n_slots, is_float, final_w,
+                         ptrs, n) != 0:
         raise ValueError("malformed MultiSlot data (value parse failed)")
     for s, (name, dtype, fixed) in enumerate(slot_meta):
         if fixed and out[name].shape[1] != int(fixed):

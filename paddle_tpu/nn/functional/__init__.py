@@ -13,7 +13,7 @@ from ...ops import (  # noqa: F401
     cross_entropy, ctc_loss, dropout, dropout2d, dropout3d, elu, embedding,
     gelu, glu, grid_sample, group_norm, gumbel_softmax, hardshrink,
     hardsigmoid, hardswish, hardtanh, hinge_loss, instance_norm,
-    interpolate, kl_div, l1_loss, label_smooth, layer_norm, leaky_relu,
+    interpolate, kl_div, l1_loss, label_smooth, leaky_relu,
     linear, local_response_norm, log_loss, log_sigmoid, log_softmax,
     margin_ranking_loss, max_pool1d, max_pool2d, max_pool3d, maxout, mish,
     mse_loss, nll_loss, normalize, npair_loss, one_hot, pad,
@@ -137,3 +137,28 @@ norm = _self
 pooling = _self
 vision = _self
 input = _self  # noqa: A001
+
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon=1e-05, name=None, **kw):
+    """Reference signature (functional.layer_norm(x, normalized_shape,
+    weight, bias)): normalized_shape is positional there; the internal op
+    infers it from ndim. Both call shapes are accepted — a Tensor in the
+    second slot means the caller used the internal (x, weight, bias,
+    epsilon, ...) order, whose arguments are shifted back into place."""
+    from ...ops.nn_ops import layer_norm as _impl
+    if normalized_shape is not None and not isinstance(
+            normalized_shape, (int, tuple, list)):
+        # internal order: second slot is the weight, third the bias, and
+        # a NUMBER in the fourth slot is the epsilon — nothing dropped
+        real_w, real_b = normalized_shape, weight
+        if bias is not None and isinstance(bias, (int, float)):
+            real_eps = float(bias)
+        else:
+            real_b = real_b if real_b is not None else bias
+            real_eps = epsilon
+        return _impl(x, real_w, real_b, epsilon=real_eps, **kw)
+    ndim = 1 if normalized_shape is None else (
+        1 if isinstance(normalized_shape, int) else len(normalized_shape))
+    return _impl(x, weight, bias, epsilon=epsilon, normalized_ndim=ndim,
+                 **kw)

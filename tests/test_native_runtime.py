@@ -145,3 +145,14 @@ class TestNativeMultiSlotParser:
         np.testing.assert_array_equal(
             np.sort(ys), np.sort(np.concatenate(
                 [b["y"].ravel() for b in ds_py])))
+
+    def test_type_mismatch_cannot_desync(self):
+        """code-review r4: a float token under an int64 slot once desynced
+        ms_fill from ms_scan's framing and wrote past the output arrays
+        (heap corruption). Must raise ValueError instead."""
+        from paddle_tpu.io.native_loader import parse_multislot
+        with pytest.raises(ValueError):
+            parse_multislot(b"1 2.0\n2 7 8\n", [("a", np.int64, 2)])
+        # and a float slot still accepts decimals
+        out = parse_multislot(b"2 0.5 1.5\n", [("x", np.float32, 2)])
+        np.testing.assert_allclose(out["x"], [[0.5, 1.5]])
