@@ -227,3 +227,85 @@ class TestIndexingGrads:
     def test_pad(self):
         check_grad(lambda x: P.nn.functional.pad(x, [1, 1, 0, 1]),
                    _any((1, 1, 2, 3)))
+
+
+class TestDoubleGrads:
+    """Second-order: d/dx of (d loss/dx · v) vs finite differences of the
+    first-order grad — exercises grad-of-grad through the recorded
+    pullbacks (ref: the reference's *_double_grad kernels)."""
+
+    @pytest.mark.parametrize("op,mk", [
+        (lambda t: P.tanh(t), lambda: _any((2, 3))),
+        (lambda t: P.exp(t), lambda: _any((2, 3))),
+        (lambda t: P.square(t), lambda: _any((2, 3))),
+        (lambda t: P.nn.functional.sigmoid(t), lambda: _any((2, 3))),
+        (lambda t: P.log(t), lambda: _pos((2, 3))),
+    ])
+    def test_hvp(self, op, mk):
+        import jax
+        import jax.numpy as jnp
+        a = mk()
+        v = _any(a.shape, 13).astype(np.float64)
+
+        def grad_np(arr):
+            t = paddle.to_tensor(arr.astype(np.float32),
+                                 stop_gradient=False)
+            loss = op(t).sum()
+            loss.backward()
+            return np.asarray(t.grad.numpy(), np.float64)
+
+        # analytic HVP via the tape's grad-of-grad
+        t = paddle.to_tensor(a, stop_gradient=False)
+        out = op(t).sum()
+        (g,) = paddle.grad([out], [t], create_graph=True)
+        inner = (g * paddle.to_tensor(v.astype(np.float32))).sum()
+        inner.backward()
+        hvp = np.asarray(t.grad.numpy(), np.float64)
+        # numeric HVP: (grad(x + eps v) - grad(x - eps v)) / 2eps
+        num = (grad_np(a + EPS * v.astype(np.float32))
+               - grad_np(a - EPS * v.astype(np.float32))) / (2 * EPS)
+        np.testing.assert_allclose(hvp, num, rtol=RTOL, atol=2e-2)
+
+
+class TestEagerStaticParity:
+    """Same computation eager vs whole-Program executor (SURVEY §4
+    static-vs-dygraph parity): identical inputs and seeded params must
+    produce identical outputs through both execution paths."""
+
+    @pytest.mark.parametrize("build", [
+        lambda x: paddle.static.nn.fc(x, size=5, activation="relu"),
+        lambda x: paddle.nn.functional.softmax(
+            paddle.static.nn.fc(x, size=4), axis=-1),
+    ])
+    def test_parity(self, build):
+        from paddle_tpu import fluid
+        paddle.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                xv = fluid.data(name="x", shape=[None, 6],
+                                dtype="float32")
+                out = build(xv)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            x = _any((3, 6), 21)
+            static_out = exe.run(main, feed={"x": x},
+                                 fetch_list=[out])[0]
+            # rebuild the same math eagerly with the Program's params
+            params = {p.name: np.asarray(
+                fluid.global_scope().find_var(p.name))
+                for p in main.all_parameters()}
+        finally:
+            paddle.disable_static()
+        names = sorted(params)
+        w, b = params[names[1]], params[names[0]]
+        if w.ndim == 1:
+            w, b = b, w
+        h = x @ w + b
+        if static_out.shape[-1] == 5:  # relu fc case
+            expected = np.maximum(h, 0)
+        else:
+            e = np.exp(h - h.max(-1, keepdims=True))
+            expected = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(static_out, expected,
+                                   rtol=1e-5, atol=1e-5)
