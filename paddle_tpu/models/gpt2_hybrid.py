@@ -14,7 +14,11 @@ shard_map over the (dp, pp, mp, sp) mesh and XLA emits the ICI collectives:
        with one psum per half-block. QKV is stored [E, H, 3, d] so the mp
        split on H keeps each rank's q/k/v for its own heads contiguous.
   sp — ring attention over the sequence shards (parallel/ring_attention.py,
-       Pallas flash kernels inside each ring step when shapes allow).
+       Pallas flash kernels inside each ring step when shapes allow);
+       ring_impl="zigzag" selects the load-balanced causal ring (the
+       caller feeds the batch in zigzag_order layout; position embeddings
+       follow the permutation inside inner()), "ulysses" the all-to-all
+       mode.
 
 Params are a flat dict of jnp arrays; per-stage leaves are stacked
 [pp, L/pp, ...] so the pp axis shards stages and a lax.scan walks the
@@ -132,6 +136,12 @@ def _stage_fn(stage, x, *, sp_axis, mp_axis, ring_impl):
                 from ..parallel.ulysses import ulysses_attention
                 o = ulysses_attention(q, k, v, axis_name=sp_axis,
                                       causal=True)
+            elif ring_impl == "zigzag":
+                # load-balanced causal ring: the batch (and positions —
+                # see inner()) are in zigzag layout, every rank does
+                # equal work per ring step
+                from ..parallel.ring_attention import zigzag_ring_attention
+                o = zigzag_ring_attention(q, k, v, axis_name=sp_axis)
             else:
                 o = ring_attention(q, k, v, axis_name=sp_axis, causal=True,
                                    impl=ring_impl)
@@ -182,7 +192,17 @@ def build_hybrid_gpt2_loss(mesh, num_microbatches=2, ring_impl=None,
     def inner(params, ids, labels):
         sp_idx = jax.lax.axis_index("sp") if sp_axis else 0
         s_l = ids.shape[1]
-        pos = sp_idx * s_l + jnp.arange(s_l)
+        if ring_impl == "zigzag" and sp_axis is not None:
+            # zigzag layout: this rank holds global chunks (i, 2n-1-i) of
+            # 2n — position embeddings must follow the SAME permutation
+            # the caller applied to the batch (zigzag_order)
+            n_sp = jax.lax.axis_size(sp_axis)
+            half = s_l // 2
+            pos = jnp.concatenate(
+                [sp_idx * half + jnp.arange(half),
+                 (2 * n_sp - 1 - sp_idx) * half + jnp.arange(half)])
+        else:
+            pos = sp_idx * s_l + jnp.arange(s_l)
         wte = params["wte"]  # mp-local shard: [V_pad/mp, E]
         v_loc = wte.shape[0]
         if mp_axis:
