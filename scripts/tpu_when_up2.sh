@@ -22,6 +22,9 @@ run() {  # run <timeout> <label> <cmd...>
 for i in $(seq 1 600); do
   if probe; then
     echo "=== tunnel up after $i probes $(date) ===" | tee -a "$LOG"
+    # HEADLINE FIRST: if the window is short, BENCH_r04's number is the
+    # one measurement that must land; diagnostics follow
+    run 1200 "bench: gpt2s headline" python bench.py
     run 1200 "raw op envelope (GEMM ceiling, exp, HBM, embed A/B)" \
         python scripts/raw_ops_bench.py
     run 1200 "per-op profile, fused step batch 16" \
@@ -29,7 +32,6 @@ for i in $(seq 1 600); do
     run 1500 "attention ablation (flash/xla/identity)" \
         python scripts/perf_sweep.py --section ablate
     run 1200 "attn compare (dtype-correct)" python scripts/attn_compare.py
-    run 1200 "bench: gpt2s headline" python bench.py
     run 1500 "bench: bert_large" python bench.py bert_large
     run 1500 "bench: resnet50" python bench.py resnet50
     run 1200 "bench: decode gpt2s_gen" python bench.py gpt2s_gen
