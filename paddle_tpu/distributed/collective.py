@@ -367,3 +367,55 @@ def wait(tensor, group=None, use_calc_stream=True):
 def split(x, num_or_sections, axis=0):
     from .. import ops
     return ops.split(x, num_or_sections, axis)
+
+
+def quantized_all_reduce(x, axis_name, bits=8, block=256):
+    """Bandwidth-compressed gradient all-reduce (EQuARX pattern,
+    arXiv:2506.17615 — public technique; code original): int8 blockwise-
+    quantized reduce-scatter + all-gather moves ~1/4 of the f32 bytes over
+    ICI/DCN. Call INSIDE shard_map over `axis_name`, like jax.lax.psum.
+
+    Decomposition: split x into n per-rank chunks; each rank quantizes
+    every chunk with a per-block scale and all_to_alls them so rank j
+    receives all n copies of chunk j; summation happens dequantized in
+    f32 (one quantization error per hop, not log(n)); the reduced chunk
+    is requantized once and all_gathered. Worst-case relative error per
+    element ~1/2^(bits-1) of the block max — gradient-noise scale, the
+    same regime DGC/bf16-allreduce target."""
+    from ..slim import dequantize, quantize_symmetric
+    n = jax.lax.axis_size(axis_name)
+    if x.size < n * block:
+        # tiny leaves (biases, norm scales): padding to n*block would SEND
+        # more bytes than the plain f32 psum saves — don't compress them
+        return jax.lax.psum(x, axis_name)
+    orig_shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.size) % (n * block)
+    flat = jnp.pad(flat, (0, pad))
+    # [n, chunk_blocks, block]
+    chunks = flat.reshape(n, -1, block)
+
+    def quant(v):  # per-block symmetric codes (shared slim scheme: the
+        # scale is the block abs-max, codes are int8/int16 by `bits`)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(v), axis=-1, keepdims=True), 1e-30)
+        return quantize_symmetric(v, scale, bits), scale
+
+    def dequant(q, scale):
+        return dequantize(q, scale, bits)
+
+    q, s = quant(chunks)
+    # all_to_all: rank r sends its quantized chunk j to rank j; afterwards
+    # axis 0 holds the n ranks' versions of MY chunk
+    q_t = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    s_t = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    reduced = jnp.sum(dequant(q_t, s_t), axis=0)  # f32 accumulate
+    rq, rs = quant(reduced)
+    gq = jax.lax.all_gather(rq, axis_name)
+    gs = jax.lax.all_gather(rs, axis_name)
+    out = dequant(gq, gs).reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(orig_shape).astype(x.dtype)

@@ -35,6 +35,10 @@ class DistributedStrategy:
         self.lars = False
         self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005}
         self.fp16_allreduce = False
+        # TPU-first extension (EQuARX pattern): int8 blockwise-quantized
+        # gradient all-reduce — ~1/4 the ICI/DCN bytes of f32; lowers via
+        # the explicit-dp step (meta.py)
+        self.int8_allreduce = False
         self.nccl_comm_num = 1
         self.hybrid_configs = {"dp_degree": -1, "mp_degree": 1,
                                "pp_degree": 1, "sp_degree": 1}

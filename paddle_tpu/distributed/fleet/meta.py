@@ -131,7 +131,8 @@ def build_hybrid_train_step(strategy, loss_fn, optimizer, mesh=None,
             labels = batch.get("labels", batch.get("y"))
             return pl_loss(params, batch["x"], labels)
 
-    if strategy.localsgd or strategy.dgc:
+    if strategy.localsgd or strategy.dgc \
+            or getattr(strategy, "int8_allreduce", False):
         return _build_explicit_dp_step(strategy, loss_fn, optimizer, mesh)
 
     wrapped_loss = apply_strategy(strategy, loss_fn)
@@ -275,6 +276,16 @@ def _build_explicit_dp_step(strategy, loss_fn, optimizer, mesh):
                                                    [p[1] for p in pairs])
             grads = jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(g, "dp") / dp, grads)
+        elif getattr(strategy, "int8_allreduce", False) \
+                and not use_localsgd:
+            # (localsgd defines its OWN communication schedule — the
+            # periodic param average — so int8_allreduce must not
+            # reintroduce per-step grad sync under it)
+            # EQuARX-pattern compressed gradient sync: int8 blockwise
+            # reduce-scatter + all-gather in place of the f32 psum
+            from ..collective import quantized_all_reduce
+            grads = jax.tree_util.tree_map(
+                lambda g: quantized_all_reduce(g, "dp") / dp, grads)
         elif not use_localsgd:
             grads = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, "dp"), grads)
@@ -369,6 +380,8 @@ def applied_mechanisms(strategy):
         out.append("LocalSGDOptimizer->periodic_psum_average")
     if getattr(strategy, "dgc", False):
         out.append("DGCMomentumOptimizer->topk_grad_compression")
+    if getattr(strategy, "int8_allreduce", False):
+        out.append("Int8AllReduce->quantized_reduce_scatter_all_gather")
     if getattr(strategy, "lamb", False):
         out.append("LambOptimizer->lamb_rule")
     if getattr(strategy, "lars", False):

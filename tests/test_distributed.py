@@ -962,3 +962,94 @@ class TestFleetModuleFacade:
         assert any("bf16" in a for a in applied)
         assert any("checkpoint" in a for a in applied)
         assert fleet._get_applied_graph_list() == []
+
+
+class TestQuantizedAllReduce:
+    """r4: EQuARX-pattern int8 blockwise-quantized gradient all-reduce —
+    ~1/4 the wire bytes of f32 (quantized reduce-scatter + all-gather);
+    one quantization error per phase, not per hop."""
+
+    def test_matches_psum_within_quant_error(self):
+        from jax.experimental.shard_map import shard_map
+
+        from paddle_tpu.distributed.collective import quantized_all_reduce
+        n = 8
+        mesh = make_mesh(dp=n)
+        rs = np.random.RandomState(0)
+        for size in (1000, 777):  # even and padded sizes
+            g = jnp.asarray(rs.randn(n, size).astype(np.float32))
+
+            def body(gl):
+                return quantized_all_reduce(gl[0], "dp")[None]
+
+            out = np.asarray(shard_map(
+                body, mesh=mesh, in_specs=P("dp", None),
+                out_specs=P("dp", None), check_rep=False)(g))
+            exact = np.asarray(g).sum(0)
+            # result replicated across ranks
+            for r in range(1, n):
+                np.testing.assert_array_equal(out[r], out[0])
+            rel = np.abs(out[0] - exact).max() / np.abs(exact).max()
+            assert rel < 2e-2, rel
+
+    def test_strategy_flag_trains(self):
+        import paddle_tpu.optimizer as opt
+        strategy = fleet.DistributedStrategy()
+        strategy.int8_allreduce = True
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                                   "pp_degree": 1, "sp_degree": 1}
+
+        def loss_fn(params, batch, key):
+            return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+        optimizer = opt.SGD(learning_rate=0.05)
+        step, mesh = fleet.build_hybrid_train_step(strategy, loss_fn,
+                                                   optimizer)
+        params = {"w": jnp.ones((4, 1), jnp.float32)}
+        params, opt_state = step.init_opt_state(params)
+        rs = np.random.RandomState(0)
+        batch = {"x": rs.rand(32, 4).astype(np.float32),
+                 "y": rs.rand(32, 1).astype(np.float32)}
+        jitted = step.compile_for(params, batch)
+        l0 = None
+        for _ in range(25):
+            loss, params, opt_state = jitted(params, opt_state, batch,
+                                             jax.random.key(0))
+            l0 = l0 if l0 is not None else float(loss)
+        assert float(loss) < l0 * 0.6, (l0, float(loss))
+        from paddle_tpu.distributed.fleet.meta import applied_mechanisms
+        assert any("Int8AllReduce" in m
+                   for m in applied_mechanisms(strategy))
+
+    def test_small_leaf_falls_back_to_psum_and_bits16(self):
+        """code-review r4: leaves below n*block must use plain psum (no
+        padding blow-up), and bits=16 must produce int16 codes, not int8
+        wraparound."""
+        from jax.experimental.shard_map import shard_map
+
+        from paddle_tpu.distributed.collective import quantized_all_reduce
+        n = 8
+        mesh = make_mesh(dp=n)
+        rs = np.random.RandomState(1)
+        small = jnp.asarray(rs.randn(n, 4).astype(np.float32))  # < n*block
+
+        def body(gl):
+            return quantized_all_reduce(gl[0], "dp")[None]
+
+        out = np.asarray(shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                                   out_specs=P("dp", None),
+                                   check_rep=False)(small))
+        np.testing.assert_allclose(out[0], np.asarray(small).sum(0),
+                                   rtol=1e-6)  # exact: psum path
+        big = jnp.asarray((rs.randn(n, 4096) * 100).astype(np.float32))
+
+        def body16(gl):
+            return quantized_all_reduce(gl[0], "dp", bits=16)[None]
+
+        out16 = np.asarray(shard_map(body16, mesh=mesh,
+                                     in_specs=P("dp", None),
+                                     out_specs=P("dp", None),
+                                     check_rep=False)(big))
+        exact = np.asarray(big).sum(0)
+        rel = np.abs(out16[0] - exact).max() / np.abs(exact).max()
+        assert rel < 1e-4, rel  # 16-bit codes: ~256x tighter than int8
