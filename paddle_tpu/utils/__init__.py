@@ -34,6 +34,9 @@ def deprecated(since=None, update_to=None, reason=None):
     return deco
 
 
+from . import download as download_module  # noqa: E402
+
+
 def download(url, path=None, md5sum=None, **kw):
     """ref: python/paddle/utils/download.py — no network egress here; callers
     must point datasets at local files."""

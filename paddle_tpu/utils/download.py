@@ -1,0 +1,44 @@
+"""paddle.utils.download (ref: python/paddle/utils/download.py).
+
+The reference downloads weights to ~/.cache/paddle/hapi/weights; this
+environment has no network egress, so the module resolves from the LOCAL
+weights directory the vision zoo documents ($PADDLE_TPU_PRETRAINED_DIR,
+falling back to ~/.cache/paddle_tpu/hub) and raises with staging guidance
+when a file is absent — never silently returning garbage.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hub")
+
+
+def _weights_dir():
+    return os.environ.get("PADDLE_TPU_PRETRAINED_DIR", WEIGHTS_HOME)
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Resolve the LOCAL path a reference-era weights URL maps to (the
+    file's basename inside the weights dir); raises FileNotFoundError
+    with staging instructions when absent."""
+    fname = os.path.basename(str(url).split("?")[0])
+    path = os.path.join(_weights_dir(), fname)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"weights '{fname}' not found at {path}. This environment "
+            "cannot download; place the file there (or set "
+            "$PADDLE_TPU_PRETRAINED_DIR to the directory holding it).")
+    if md5sum is not None:
+        import hashlib
+        with open(path, "rb") as f:
+            got = hashlib.md5(f.read()).hexdigest()
+        if got != md5sum:
+            raise ValueError(
+                f"md5 mismatch for {path}: expected {md5sum}, got {got}")
+    return path
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    return get_weights_path_from_url(url, md5sum)

@@ -104,3 +104,26 @@ class TestRootAliases:
         assert paddle.in_dynamic_mode() is True
         out = paddle.reverse(paddle.to_tensor(np.array([1, 2, 3])), [0])
         np.testing.assert_array_equal(np.asarray(out.numpy()), [3, 2, 1])
+
+
+class TestUtilsDownloadModule:
+    def test_local_resolution(self, tmp_path, monkeypatch):
+        """r4: paddle.utils.download module (ref utils/download.py) —
+        get_weights_path_from_url resolves from the documented local
+        weights dir and raises with guidance when absent."""
+        from paddle_tpu.utils.download import get_weights_path_from_url
+        monkeypatch.setenv("PADDLE_TPU_PRETRAINED_DIR", str(tmp_path))
+        (tmp_path / "bert.pdparams").write_bytes(b"w")
+        p = get_weights_path_from_url(
+            "https://host/models/bert.pdparams?download=1")
+        assert p == str(tmp_path / "bert.pdparams")
+        with pytest.raises(FileNotFoundError,
+                           match="PADDLE_TPU_PRETRAINED_DIR"):
+            get_weights_path_from_url("https://host/m/absent.pdparams")
+        import hashlib
+        md5 = hashlib.md5(b"w").hexdigest()
+        assert get_weights_path_from_url("https://h/bert.pdparams",
+                                         md5sum=md5) == p
+        with pytest.raises(ValueError, match="md5"):
+            get_weights_path_from_url("https://h/bert.pdparams",
+                                      md5sum="0" * 32)
