@@ -34,15 +34,9 @@ def deprecated(since=None, update_to=None, reason=None):
     return deco
 
 
-from . import download as download_module  # noqa: E402
-
-
-def download(url, path=None, md5sum=None, **kw):
-    """ref: python/paddle/utils/download.py — no network egress here; callers
-    must point datasets at local files."""
-    raise RuntimeError(
-        "network downloads are unavailable in this environment; pass "
-        "data_file= pointing at a local copy instead")
+from . import download  # noqa: E402,F401  (the reference binds the
+# MODULE at paddle.utils.download — paddle.utils.download.get_path_from_url
+# is attribute-style in real zoo code)
 
 
 def dump_config(config, path=None):

@@ -10,35 +10,42 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["get_weights_path_from_url"]
+# ONE source of truth for the staging dir: the vision zoo's pretrained
+# loader defines it (vision/models/_weights.py)
+from ..vision.models._weights import _DEFAULT_DIR as WEIGHTS_HOME
+from ..vision.models._weights import PRETRAINED_DIR_ENV
 
-WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hub")
+__all__ = ["get_weights_path_from_url"]
 
 
 def _weights_dir():
-    return os.environ.get("PADDLE_TPU_PRETRAINED_DIR", WEIGHTS_HOME)
+    return os.environ.get(PRETRAINED_DIR_ENV, WEIGHTS_HOME)
 
 
-def get_weights_path_from_url(url, md5sum=None):
-    """Resolve the LOCAL path a reference-era weights URL maps to (the
-    file's basename inside the weights dir); raises FileNotFoundError
-    with staging instructions when absent."""
+def _resolve(url, md5sum, root_dir=None):
     fname = os.path.basename(str(url).split("?")[0])
-    path = os.path.join(_weights_dir(), fname)
+    path = os.path.join(root_dir or _weights_dir(), fname)
     if not os.path.exists(path):
         raise FileNotFoundError(
             f"weights '{fname}' not found at {path}. This environment "
             "cannot download; place the file there (or set "
-            "$PADDLE_TPU_PRETRAINED_DIR to the directory holding it).")
+            f"${PRETRAINED_DIR_ENV} to the directory holding it).")
     if md5sum is not None:
-        import hashlib
-        with open(path, "rb") as f:
-            got = hashlib.md5(f.read()).hexdigest()
+        from ..dataset.common import md5file  # chunked: no whole-file RAM
+        got = md5file(path)
         if got != md5sum:
             raise ValueError(
                 f"md5 mismatch for {path}: expected {md5sum}, got {got}")
     return path
 
 
+def get_weights_path_from_url(url, md5sum=None):
+    """Resolve the LOCAL path a reference-era weights URL maps to (the
+    file's basename inside the weights dir); raises FileNotFoundError
+    with staging instructions when absent."""
+    return _resolve(url, md5sum)
+
+
 def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
-    return get_weights_path_from_url(url, md5sum)
+    """ref signature: root_dir overrides the default staging dir."""
+    return _resolve(url, md5sum, root_dir=root_dir)
