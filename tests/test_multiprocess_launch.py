@@ -56,10 +56,31 @@ _WORKER = textwrap.dedent("""
     got = float(np.asarray(
         multihost_utils.process_allgather(out, tiled=True))[0])
     assert got == 3.0, got  # 1 + 2 summed across processes
+
+    # quantized gradient all-reduce across REAL processes (the multi-host
+    # DCN path this collective exists for — r4)
+    from paddle_tpu.distributed.collective import quantized_all_reduce
+    rs = np.random.RandomState(pid)
+    gl = jnp.asarray(rs.randn(1, 4096).astype(np.float32))
+    gq = jax.make_array_from_single_device_arrays(
+        (2, 4096), NamedSharding(mesh, P("dp", None)),
+        [jax.device_put(gl, jax.local_devices()[0])])
+    qout = jax.jit(
+        shard_map(lambda x: quantized_all_reduce(x[0], "dp")[None],
+                  mesh=mesh, in_specs=P("dp", None),
+                  out_specs=P("dp", None), check_rep=False),
+        out_shardings=NamedSharding(mesh, P("dp", None)))(gq)
+    mine = np.asarray(
+        multihost_utils.process_allgather(qout, tiled=True))[pid]
+    exact = (np.random.RandomState(0).randn(1, 4096)
+             + np.random.RandomState(1).randn(1, 4096))[0]
+    qrel = float(np.abs(mine - exact).max() / np.abs(exact).max())
+    assert qrel < 2e-2, qrel
+
     out_dir = os.environ["TEST_OUT_DIR"]
     with open(os.path.join(out_dir, f"ok_{pid}.txt"), "w") as f:
         f.write(f"psum={got}")
-    print("WORKER_OK", pid)
+    print("WORKER_OK", pid, "qar_rel", qrel)
 """)
 
 
