@@ -133,8 +133,21 @@ class StaticFunction:
                 saved_p, saved_b = layer.functional_state()
                 layer.load_functional_state(params, buffers)
                 try:
+                    # run the Layer.__call__ hook protocol: pre-forward
+                    # hooks (weight_norm's reparameterization, user
+                    # hooks) must see the TRACED params, not go stale —
+                    # __call__ itself can't be used (layer.forward IS
+                    # this StaticFunction)
+                    for hook in layer._forward_pre_hooks.values():
+                        hout = hook(layer, a)
+                        if hout is not None:
+                            a = hout if isinstance(hout, tuple) else (hout,)
                     out = fn(layer, *a, **k) if not hasattr(fn, "__self__") \
                         else fn(*a, **k)
+                    for hook in layer._forward_post_hooks.values():
+                        hout = hook(layer, a, out)
+                        if hout is not None:
+                            out = hout
                     out_raw = jax.tree_util.tree_map(
                         _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
                     _, new_bufs = layer.functional_state()

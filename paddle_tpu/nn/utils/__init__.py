@@ -19,3 +19,98 @@ def vector_to_parameters(vec, parameters):
         n = int(np.prod(p.shape)) if p.shape else 1
         p.set_value(v[offset:offset + n].reshape(p.shape))
         offset += n
+
+
+def _norm_except_dim_t(v, dim):
+    """Tensor-level ||v|| over every axis except `dim` (keeping dims) —
+    built from tape-recorded ops so gradients flow to v."""
+    from ... import ops
+    if dim is None or dim == -1:
+        return ops.sqrt(ops.sum(ops.multiply(v, v)))
+    axes = [i for i in range(len(v.shape)) if i != dim]
+    return ops.sqrt(ops.sum(ops.multiply(v, v), axis=axes, keepdim=True))
+
+
+class _WeightNormHook:
+    """w = g * v / ||v|| recomputed before every forward (ref:
+    nn/utils/weight_norm_hook.py WeightNorm; arXiv:1602.07868)."""
+
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        # TAPE-LEVEL math (Tensor ops, not raw jnp): the derived weight
+        # must carry vjp nodes back to g and v, or eager backward would
+        # silently deposit the gradient on a disconnected leaf and the
+        # optimizer (grad-None skip) would never train them
+        from ... import ops
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        t = ops.multiply(v, ops.divide(g, _norm_except_dim_t(v, self.dim)))
+        t.name = self.name
+        return t
+
+    def __call__(self, layer, inputs):
+        # non-Parameter attribute: the reparameterized weight is DERIVED
+        # state — only weight_g / weight_v are trainable
+        object.__setattr__(layer, self.name, self.compute(layer))
+        return inputs
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.name` as magnitude (`name_g`) × direction
+    (`name_v`/||v||), recomputed by a pre-forward hook so optimizers act
+    on g and v (ref: nn/utils/weight_norm_hook.py weight_norm)."""
+    from ...core.tensor import Parameter
+    if hasattr(layer, "_weight_norm_hooks") \
+            and name in layer._weight_norm_hooks:
+        raise ValueError(f"weight_norm already applied to '{name}'")
+    w = getattr(layer, name)
+    wv = w._value
+    if dim is not None and not (-1 <= dim <= wv.ndim - 1):
+        raise ValueError(
+            f"dim must be in [-1, {wv.ndim - 1}] for a {wv.ndim}-D "
+            f"weight, got {dim}")
+    hook = _WeightNormHook(name, dim)
+    import jax.numpy as jnp
+    import numpy as np
+    if dim is None or dim == -1:
+        g0 = jnp.sqrt(jnp.sum(wv * wv))
+    else:
+        axes = tuple(i for i in range(wv.ndim) if i != dim)
+        g0 = jnp.sqrt(jnp.sum(wv * wv, axis=axes, keepdims=True))
+    g = Parameter(np.asarray(g0))
+    v = Parameter(np.asarray(wv))
+    # drop the original parameter, register g/v
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    remover = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        layer._weight_norm_hooks = {}
+    layer._weight_norm_hooks[name] = (hook, remover)
+    object.__setattr__(layer, name, hook.compute(layer))
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g·v/||v|| back into a single `name` Parameter and drop the
+    hook (ref: remove_weight_norm)."""
+    from ...core.tensor import Parameter
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to '{name}'")
+    hook, remover = hooks.pop(name)
+    import numpy as np
+    w = Parameter(np.asarray(hook.compute(layer)._value))
+    remover.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    # drop the derived instance-dict entry: it would SHADOW the restored
+    # parameter (instance __dict__ wins over Layer.__getattr__), making
+    # later reassignment or checkpoint loads silently invisible
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, w)
+    return layer
+

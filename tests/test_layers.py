@@ -216,3 +216,55 @@ class TestLayersNumerics:
         loss.backward()
         for p in net.parameters():
             assert p.grad is not None
+
+
+class TestWeightNorm:
+    def test_reparam_train_fold(self):
+        """r4: nn.utils.weight_norm/remove_weight_norm (ref:
+        nn/utils/weight_norm_hook.py) — exact at init, trains through
+        g/v, folds back losslessly, and composes with to_static."""
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        weight_norm(lin, "weight", dim=0)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names \
+            and "weight" not in names
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(2, 4).astype(np.float32))
+        ref = x.numpy() @ w0 + np.asarray(lin.bias.numpy())
+        np.testing.assert_allclose(np.asarray(lin(x).numpy()), ref,
+                                   rtol=1e-5, atol=1e-6)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        g0 = np.asarray(lin.weight_g.numpy()).copy()
+        v0 = np.asarray(lin.weight_v.numpy()).copy()
+        for _ in range(5):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            # the derived weight must be tape-linked: g and v get grads
+            assert lin.weight_g.grad is not None
+            assert lin.weight_v.grad is not None
+            opt.step()
+            opt.clear_grad()
+        assert not np.allclose(g0, np.asarray(lin.weight_g.numpy()))
+        assert not np.allclose(v0, np.asarray(lin.weight_v.numpy()))
+        out_trained = np.asarray(lin(x).numpy())
+        jitted = paddle.jit.to_static(lin)
+        np.testing.assert_allclose(np.asarray(jitted(x).numpy()),
+                                   out_trained, rtol=1e-5, atol=1e-5)
+        # the jitted function must read LIVE g/v (hook runs under trace),
+        # not a weight constant baked at trace time
+        lin.weight_g.set_value(np.asarray(lin.weight_g.numpy()) * 2.0)
+        assert not np.allclose(np.asarray(jitted(x).numpy()),
+                               out_trained)
+        lin.weight_g.set_value(np.asarray(lin.weight_g.numpy()) / 2.0)
+        remove_weight_norm(lin, "weight")
+        assert "weight" in [n for n, _ in lin.named_parameters()]
+        np.testing.assert_allclose(np.asarray(lin(x).numpy()),
+                                   out_trained, rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError):
+            remove_weight_norm(lin, "weight")
+        with pytest.raises(ValueError, match="dim"):
+            weight_norm(nn.Linear(4, 3), "weight", dim=2)
