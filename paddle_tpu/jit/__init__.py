@@ -34,6 +34,10 @@ class StaticFunction:
     passed functionally so weight updates between calls don't retrigger
     compilation (they're inputs, not constants)."""
 
+    # Layer.__call__ must NOT run the hook protocol eagerly around this —
+    # the traced body runs it (with traced params); see pure()
+    _runs_layer_hooks = True
+
     def __init__(self, fn, layer=None, input_spec=None, donate_params=False):
         self._fn = fn
         self._layer = layer
@@ -168,6 +172,14 @@ class StaticFunction:
         out, new_bufs = self._compiled(params, buffers, raw_args, raw_kw,
                                        rng.next_key())
         layer.load_functional_state(None, new_bufs)
+        # derived attributes written by hooks during the trace (e.g.
+        # weight_norm's reparameterized weight) hold dead tracers now;
+        # ask their hooks to recompute from the live concrete params
+        for sub in layer.sublayers(include_self=True):
+            for h in sub._forward_pre_hooks.values():
+                refresh = getattr(h, "refresh_after_trace", None)
+                if refresh is not None:
+                    refresh(sub)
         return jax.tree_util.tree_map(_wrap, out)
 
     @property

@@ -206,6 +206,11 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        # a to_static forward runs the hook protocol INSIDE its trace
+        # (with traced params); running it here too would double-apply
+        # input-transforming hooks
+        if getattr(self.forward, "_runs_layer_hooks", False):
+            return self.forward(*inputs, **kwargs)
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:

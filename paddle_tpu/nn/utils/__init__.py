@@ -57,6 +57,12 @@ class _WeightNormHook:
         object.__setattr__(layer, self.name, self.compute(layer))
         return inputs
 
+    def refresh_after_trace(self, layer):
+        """Called by the jit layer path after a trace: the derived weight
+        written under trace holds dead tracers; recompute from the
+        restored concrete g/v."""
+        object.__setattr__(layer, self.name, self.compute(layer))
+
 
 def weight_norm(layer, name="weight", dim=0):
     """Reparameterize `layer.name` as magnitude (`name_g`) × direction

@@ -1,0 +1,123 @@
+"""Chunked-vocab softmax cross-entropy: the LM loss without the logits.
+
+A causal-LM step's single largest tensor is the logits, [B*S, V] (GPT-2:
+8*1024 x 50257 ~ 0.8 GB in bf16, more in f32 softmax temporaries) — it is
+written by the head matmul, read by the softmax, and read again by the
+backward. This loss scans the vocabulary in chunks with an online
+logsumexp (the flash-attention trick applied to the classifier axis, the
+same statistics the Megatron vocab-parallel CE in models/gpt2_hybrid.py
+psums across mp ranks — here the "ranks" are sequential chunks on one
+chip): peak live logits memory drops from [N, V] to [N, V/chunks], and
+the backward recomputes each chunk's logits instead of re-reading them
+from HBM.
+
+Candidate perf lever for the measured step-time gap (PERF.md round-3:
+~1/3 of the 6N ideal, cause unattributed): OFF by default, enabled by
+PADDLE_TPU_CHUNKED_CE=<n_chunks>, A/B'd on-chip by the recovery runner.
+Numerics are parity-tested against the plain cross-entropy on CPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _pad_vocab(w, n_chunks):
+    v = w.shape[0]
+    v_pad = -(-v // n_chunks) * n_chunks
+    if v_pad != v:
+        w = jnp.concatenate(
+            [w, jnp.zeros((v_pad - v, w.shape[1]), w.dtype)], axis=0)
+    return w, v_pad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_softmax_xent(x, w, labels, n_chunks, ignore_index=-100):
+    """mean over VALID i of [ logsumexp_v(x_i . w_v) - x_i . w_{labels_i} ].
+
+    x: [N, E] final hidden states; w: [V, E] tied embedding / head
+    weight; labels: [N] int. Equivalent to
+    cross_entropy(x @ w.T, labels) — including the ignore_index
+    contract (ignored rows contribute no loss and no gradient; the mean
+    divides by the valid count) — with peak logits memory [N, V/chunks].
+    """
+    loss, _ = _fwd_stats(x, w, labels, n_chunks, ignore_index)
+    return loss
+
+
+def _fwd_stats(x, w, labels, n_chunks, ignore_index):
+    n, e = x.shape
+    v_true = w.shape[0]
+    wp, v_pad = _pad_vocab(w, n_chunks)
+    vc = v_pad // n_chunks
+    wc = wp.reshape(n_chunks, vc, e)
+    xf = x.astype(jnp.float32)
+
+    def body(carry, c):
+        m, s, tgt = carry
+        logits = (xf @ wc[c].reshape(vc, e).T.astype(jnp.float32))
+        col = c * vc + jnp.arange(vc)
+        logits = jnp.where(col[None, :] < v_true, logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        lid = labels - c * vc
+        ok = (lid >= 0) & (lid < vc)
+        t = jnp.take_along_axis(
+            logits, jnp.clip(lid, 0, vc - 1)[:, None], axis=1)[:, 0]
+        tgt = tgt + jnp.where(ok, t, 0.0)
+        return (m_new, s, tgt), None
+
+    m0 = jnp.full((n,), NEG_INF, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    (m, s, tgt), _ = jax.lax.scan(body, (m0, s0, s0),
+                                  jnp.arange(n_chunks))
+    valid = (labels != ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    nll = (jnp.log(s) + m - tgt) * valid
+    return jnp.sum(nll) / count, (m, s)
+
+
+def _fwd(x, w, labels, n_chunks, ignore_index):
+    loss, (m, s) = _fwd_stats(x, w, labels, n_chunks, ignore_index)
+    return loss, (x, w, labels, m, s)
+
+
+def _bwd(n_chunks, ignore_index, res, g):
+    x, w, labels, m, s = res
+    n, e = x.shape
+    v_true = w.shape[0]
+    wp, v_pad = _pad_vocab(w, n_chunks)
+    vc = v_pad // n_chunks
+    wc = wp.reshape(n_chunks, vc, e)
+    xf = x.astype(jnp.float32)
+    valid = (labels != ignore_index)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    # ignored rows: zero weight in the mean AND zero softmax gradient
+    row_scale = (g / count) * valid.astype(jnp.float32)
+
+    def body(dx, c):
+        wcf = wc[c].reshape(vc, e).astype(jnp.float32)
+        logits = xf @ wcf.T
+        col = c * vc + jnp.arange(vc)
+        logits = jnp.where(col[None, :] < v_true, logits, NEG_INF)
+        p = jnp.exp(logits - m[:, None]) / s[:, None]
+        lid = labels - c * vc
+        ok = (lid >= 0) & (lid < vc)
+        onehot = (jnp.arange(vc)[None, :] == lid[:, None]) & ok[:, None]
+        d = (p - onehot.astype(jnp.float32)) * row_scale[:, None]
+        dx = dx + d @ wcf
+        dw_c = d.T @ xf  # [Vc, E]
+        return dx, dw_c
+
+    dx, dwc = jax.lax.scan(body, jnp.zeros((n, e), jnp.float32),
+                           jnp.arange(n_chunks))
+    dw = dwc.reshape(v_pad, e)[:v_true]
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+chunked_softmax_xent.defvjp(_fwd, _bwd)
