@@ -52,6 +52,28 @@ def gpt2_amp_setup():
     return cfg, params0, amp_loss, make_data
 
 
+def scan_time_args(step, carry0, args, inner=20, reps=3):
+    """scan_time with large operands threaded as EXPLICIT jit arguments.
+    Closure-captured arrays lower as literal constants in the serialized
+    HLO, and model-sized pytrees blow the axon remote_compile request cap
+    (HTTP 413, observed on-chip) — pass them here instead.
+    step: (carry, args) -> carry."""
+
+    @jax.jit
+    def many(c0, a):
+        c, _ = jax.lax.scan(lambda c, _: (step(c, a), None), c0,
+                            None, length=inner)
+        return c
+
+    sync(many(carry0, args))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(many(carry0, args))
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
 def scan_time(step_of_carry, carry0, inner=20, reps=3):
     """Best per-iteration wall time of `inner` chained iterations in one
     dispatch. step_of_carry: carry -> carry (make the compute depend on

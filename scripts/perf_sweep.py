@@ -21,7 +21,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from _bench_util import scan_time as _scan_timer, sync as _sync  # noqa: E402
+from _bench_util import scan_time as _scan_timer, scan_time_args as _scan_timer_args, sync as _sync  # noqa: E402
 
 
 def section_model(batch_sizes=(8, 16, 24)):
@@ -201,26 +201,27 @@ def section_ablate(batch=16):
     variants = [("flash", sdpa), ("xla", xla_attn),
                 ("identity", identity_attn)]
     orig = P_ops.scaled_dot_product_attention
+    z = jnp.zeros((), jnp.float32)
     try:
         for name, impl in variants:
             P_ops.scaled_dot_product_attention = impl
 
-            def fwd_step(c):
-                p2 = dict(params0)
-                k0 = next(iter(p2))
+            def fwd_step(c, p):
+                k0 = next(iter(p))
+                p2 = dict(p)
                 p2[k0] = p2[k0] + (c * 1e-30).astype(p2[k0].dtype)
-                return amp_loss(p2, data, key)
+                return amp_loss(p2, data, key).astype(jnp.float32)
 
-            t_f = _scan_timer(fwd_step, jnp.zeros((), jnp.float32))
+            t_f = _scan_timer_args(fwd_step, z, params0)
 
-            def bwd_step(c):
-                p2 = dict(params0)
-                k0 = next(iter(p2))
+            def bwd_step(c, p):
+                k0 = next(iter(p))
+                p2 = dict(p)
                 p2[k0] = p2[k0] + (c * 1e-30).astype(p2[k0].dtype)
                 _, g = jax.value_and_grad(amp_loss)(p2, data, key)
                 return g[k0].astype(jnp.float32).mean()
 
-            t_b = _scan_timer(bwd_step, jnp.zeros((), jnp.float32))
+            t_b = _scan_timer_args(bwd_step, z, params0)
             print(f"ablate[{name}] batch={batch}: fwd={t_f*1e3:.1f}ms "
                   f"fwd+bwd={t_b*1e3:.1f}ms", flush=True)
     finally:

@@ -15,18 +15,70 @@ from _bench_util import scan_time
 def main():
     key = jax.random.key(0)
     z = jnp.zeros((), jnp.float32)
+    t_start = time.time()
 
-    # 1. big square GEMM bf16: the MXU ceiling
+    def mark(label):
+        print(f"  [t+{time.time()-t_start:.0f}s after {label}]", flush=True)
+
+    # 1. big square GEMM: the MXU ceiling. The carry must be cast to the
+    # operand dtype — a f32 0-d array is NOT weakly typed, so `a + c*1e-30`
+    # silently promotes the whole GEMM to f32 (the r3 attn_compare bug).
     a = jax.random.normal(key, (4096, 4096), jnp.bfloat16)
     b = jax.random.normal(jax.random.fold_in(key, 1), (4096, 4096),
                           jnp.bfloat16)
 
     def gemm(c):
-        return ((a + c * 1e-30) @ b).astype(jnp.float32).mean()
+        ab = a + c.astype(jnp.bfloat16) * 1e-30
+        assert ab.dtype == jnp.bfloat16
+        return (ab @ b).astype(jnp.float32).mean()
 
-    t = scan_time(gemm, z)
     fl = 2 * 4096**3
+    t = scan_time(gemm, z)
     print(f"gemm 4096^3 bf16: {t*1e3:.3f}ms {fl/t/1e12:.0f}TF/s", flush=True)
+
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+
+    def gemmf(c):
+        # HIGHEST = true f32-equivalent multi-pass path; default precision
+        # would run bf16 passes and mislabel the f32 ceiling
+        return jnp.matmul(af + c * 1e-30, bf,
+                          precision=jax.lax.Precision.HIGHEST).mean()
+
+    t = scan_time(gemmf, z)
+    print(f"gemm 4096^3 f32(highest): {t*1e3:.3f}ms {fl/t/1e12:.0f}TF/s",
+          flush=True)
+
+    ai = (a * 16).astype(jnp.int8)
+    bi = (b * 16).astype(jnp.int8)
+
+    def gemmi(c):
+        # int8 zero-add keeps the dot carry-dependent (else XLA hoists the
+        # loop-invariant dot out of the scan). v5e book rate is 2x bf16.
+        aa = ai + (c * 0).astype(jnp.int8)
+        s = jax.lax.dot_general(
+            aa, bi, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return s.astype(jnp.float32).mean()
+
+    t = scan_time(gemmi, z)
+    print(f"gemm 4096^3 int8: {t*1e3:.3f}ms {fl/t/1e12:.0f}TOP/s", flush=True)
+    mark("square gemms")
+
+    # 1b. the model's biggest single GEMM: head matmul [B*S,768]@[768,50257]
+    hx = jax.random.normal(key, (16384, 768), jnp.bfloat16)
+    hw = jax.random.normal(jax.random.fold_in(key, 9), (768, 50257),
+                           jnp.bfloat16)
+
+    def headmm(c):
+        s = (hx + c.astype(jnp.bfloat16) * 1e-30) @ hw
+        return s.astype(jnp.float32).mean()
+
+    t = scan_time(headmm, z, inner=5)
+    fl2 = 2 * 16384 * 768 * 50257
+    print(f"gemm 16384x768x50257 bf16 (head): {t*1e3:.3f}ms "
+          f"{fl2/t/1e12:.0f}TF/s", flush=True)
+    mark("head gemm")
 
     # 2. attention-shaped batch GEMM: [96,1024,64]x[96,64,1024]
     q = jax.random.normal(key, (96, 1024, 64), jnp.bfloat16)
@@ -34,7 +86,7 @@ def main():
                           jnp.bfloat16)
 
     def bmm(c):
-        s = jnp.einsum("bqd,bkd->bqk", q + c * 1e-30, k,
+        s = jnp.einsum("bqd,bkd->bqk", q + c.astype(jnp.bfloat16) * 1e-30, k,
                        preferred_element_type=jnp.float32)
         return s.mean()
 
@@ -45,12 +97,13 @@ def main():
 
     # 2b. same but bf16 out (halves the HBM write)
     def bmm16(c):
-        s = jnp.einsum("bqd,bkd->bqk", q + c * 1e-30, k)
+        s = jnp.einsum("bqd,bkd->bqk", q + c.astype(jnp.bfloat16) * 1e-30, k)
         return s.astype(jnp.float32).mean()
 
     t = scan_time(bmm16, z)
     print(f"bmm  96x1024x64x1024 (bf16 out): {t*1e3:.3f}ms "
           f"{fl/t/1e12:.0f}TF/s", flush=True)
+    mark("bmms")
 
     # 3. exp throughput on the score-matrix volume
     x = jax.random.normal(key, (96, 1024, 1024), jnp.float32)
@@ -70,6 +123,7 @@ def main():
 
     t = scan_time(expb, z)
     print(f"exp  bf16: {t*1e3:.3f}ms {n/t/1e9:.0f}Gexp/s", flush=True)
+    mark("exp")
 
     # 4. full softmax on scores
     def sm(c):
@@ -86,6 +140,7 @@ def main():
     byts = n * 4 * 2
     print(f"add+reduce f32 402MB: {t*1e3:.3f}ms "
           f"~{byts/t/1e9:.0f}GB/s", flush=True)
+    mark("hbm")
 
     # 6. embedding bwd: gather+scatter-add vs one-hot matmul at GPT-2-small
     # shapes (16384 tokens, vocab 50257, d 768). XLA TPU scatter can be
@@ -119,6 +174,7 @@ def main():
     t = scan_time(embed_onehot, z, inner=5)
     print(f"embed bwd onehot  [16384 of 50257x768]: {t*1e3:.3f}ms",
           flush=True)
+    mark("embed")
 
 
 if __name__ == "__main__":

@@ -42,15 +42,15 @@ for i in $(seq 1 600); do
     run 1500 "model batch sweep" \
         python scripts/perf_sweep.py --section model --batches 8,16,24
     echo "=== flag A/Bs on the headline ===" | tee -a "$LOG"
-    PADDLE_TPU_CHUNKED_CE=8 run 1200 "A/B chunked-vocab CE (8 chunks)" \
-        python bench.py
-    PADDLE_TPU_CHUNKED_CE=16 run 1200 "A/B chunked-vocab CE (16)" \
-        python bench.py
-    PADDLE_TPU_EMBED_ONEHOT_VJP=1 run 1200 "A/B onehot-embed-vjp" \
-        python bench.py
-    PADDLE_TPU_FA_LANES=1 run 1200 "A/B fa-lanes" python bench.py
-    PADDLE_TPU_EMBED_ONEHOT_VJP=1 PADDLE_TPU_FA_LANES=1 \
-        run 1200 "A/B both" python bench.py
+    run 1200 "A/B chunked-vocab CE (8 chunks)" \
+        env PADDLE_TPU_CHUNKED_CE=8 python bench.py
+    run 1200 "A/B chunked-vocab CE (16)" \
+        env PADDLE_TPU_CHUNKED_CE=16 python bench.py
+    run 1200 "A/B onehot-embed-vjp" \
+        env PADDLE_TPU_EMBED_ONEHOT_VJP=1 python bench.py
+    run 1200 "A/B fa-lanes" env PADDLE_TPU_FA_LANES=1 python bench.py
+    run 1200 "A/B both" \
+        env PADDLE_TPU_EMBED_ONEHOT_VJP=1 PADDLE_TPU_FA_LANES=1 python bench.py
     echo "=== done $(date) ===" | tee -a "$LOG"
     exit 0
   fi
