@@ -22,7 +22,7 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from scripts._bench_util import scan_time
+    from scripts._bench_util import scan_time_args
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -50,10 +50,18 @@ def main():
     x = rng.randn(batch, d).astype(np.float32)
 
     def timed_forward(model, xv):
-        def step(carry):
-            out = model(Tensor(xv + carry * 1e-30))._value
+        # weights travel as explicit jit args (closure arrays lower as HLO
+        # literals and 268MB of f32 Linears blows the axon remote_compile
+        # request cap — HTTP 413 observed on-chip). The frozen int8 codes
+        # (_wq, ~67MB) are plain attributes and still ride the closure,
+        # comfortably under the cap.
+        p, b = model.functional_state()
+
+        def step(carry, pb):
+            out = model.functional_call(
+                pb[0], pb[1], Tensor(xv + carry * 1e-30))._value
             return jnp.sum(out).astype(jnp.float32)
-        return scan_time(step, jnp.float32(0.0), inner=inner)
+        return scan_time_args(step, jnp.float32(0.0), (p, b), inner=inner)
 
     flops = 2.0 * batch * d * d * depth  # MACs*2 per forward
 

@@ -9,7 +9,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-from _bench_util import scan_time
+from _bench_util import scan_time, scan_time_args
 
 
 def main():
@@ -105,38 +105,39 @@ def main():
           f"{fl/t/1e12:.0f}TF/s", flush=True)
     mark("bmms")
 
-    # 3. exp throughput on the score-matrix volume
+    # 3. exp throughput on the score-matrix volume. x is 402MB — it must
+    # ride as an explicit jit arg, not closure (remote_compile 413 cap).
     x = jax.random.normal(key, (96, 1024, 1024), jnp.float32)
 
-    def expf(c):
-        return jnp.exp(x + c).mean()
+    def expf(c, xx):
+        return jnp.exp(xx + c).mean()
 
-    t = scan_time(expf, z)
+    t = scan_time_args(expf, z, x)
     n = 96 * 1024 * 1024
     print(f"exp  f32 {n/1e6:.0f}M elems: {t*1e3:.3f}ms "
           f"{n/t/1e9:.0f}Gexp/s", flush=True)
 
     xb = x.astype(jnp.bfloat16)
 
-    def expb(c):
-        return jnp.exp(xb + c.astype(jnp.bfloat16)).astype(jnp.float32).mean()
+    def expb(c, xx):
+        return jnp.exp(xx + c.astype(jnp.bfloat16)).astype(jnp.float32).mean()
 
-    t = scan_time(expb, z)
+    t = scan_time_args(expb, z, xb)
     print(f"exp  bf16: {t*1e3:.3f}ms {n/t/1e9:.0f}Gexp/s", flush=True)
     mark("exp")
 
     # 4. full softmax on scores
-    def sm(c):
-        return jax.nn.softmax(x + c, axis=-1).mean()
+    def sm(c, xx):
+        return jax.nn.softmax(xx + c, axis=-1).mean()
 
-    t = scan_time(sm, z)
+    t = scan_time_args(sm, z, x)
     print(f"softmax f32 [96,1024,1024]: {t*1e3:.3f}ms", flush=True)
 
     # 5. HBM bandwidth probe: copy 402MB
-    def cp(c):
-        return (x + c).mean()
+    def cp(c, xx):
+        return (xx + c).mean()
 
-    t = scan_time(cp, z)
+    t = scan_time_args(cp, z, x)
     byts = n * 4 * 2
     print(f"add+reduce f32 402MB: {t*1e3:.3f}ms "
           f"~{byts/t/1e9:.0f}GB/s", flush=True)
@@ -155,23 +156,23 @@ def main():
     # (paddle_tpu.ops.nn_ops._embed_mm_vjp, the flagged model path)
     from paddle_tpu.ops import nn_ops
 
-    def embed_gather(c):
-        w = wte + c.astype(jnp.bfloat16)
+    def embed_gather(c, wt):
+        w = wt + c.astype(jnp.bfloat16)
         g = jax.grad(lambda ww: jnp.take(ww, ids, axis=0).astype(
             jnp.float32).sum())(w)
         return g.astype(jnp.float32).mean()
 
-    t = scan_time(embed_gather, z, inner=5)
+    t = scan_time_args(embed_gather, z, wte, inner=5)
     print(f"embed bwd scatter [16384 of 50257x768]: {t*1e3:.3f}ms",
           flush=True)
 
-    def embed_onehot(c):
-        w = wte + c.astype(jnp.bfloat16)
+    def embed_onehot(c, wt):
+        w = wt + c.astype(jnp.bfloat16)
         g = jax.grad(lambda ww: nn_ops._embed_mm_vjp(ww, ids).astype(
             jnp.float32).sum())(w)
         return g.astype(jnp.float32).mean()
 
-    t = scan_time(embed_onehot, z, inner=5)
+    t = scan_time_args(embed_onehot, z, wte, inner=5)
     print(f"embed bwd onehot  [16384 of 50257x768]: {t*1e3:.3f}ms",
           flush=True)
     mark("embed")

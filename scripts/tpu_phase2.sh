@@ -1,0 +1,20 @@
+#!/bin/bash
+# Phase-2 rerun: waits for the main tpu_when_up2.sh queue to drain, then
+# re-runs the sections that failed or were mismeasured in phase 1:
+#   - raw_ops_bench: carry-dtype fix (the bf16 GEMM ceiling was measured
+#     with f32-promoted operands) + explicit-arg big closures
+#   - perf_sweep --section ablate: params as jit args (HTTP 413 fix)
+#   - int8_bench: functional-state weights as jit args (HTTP 413 fix)
+cd /root/repo
+LOG=${1:-/root/repo/tpu_recovery_r4.log}
+while pgrep -f "tpu_when_up2.sh" > /dev/null; do sleep 30; done
+run() {
+  local t=$1 label=$2; shift 2
+  echo "=== phase2: $label $(date -u +%H:%M:%S) ===" | tee -a "$LOG"
+  timeout "$t" "$@" 2>&1 | grep -v WARNING | tee -a "$LOG"
+}
+run 1500 "raw op envelope (dtype-correct)" python scripts/raw_ops_bench.py
+run 1500 "attention ablation (413-fixed)" \
+    python scripts/perf_sweep.py --section ablate
+run 1200 "int8 vs bf16 inference (413-fixed)" python scripts/int8_bench.py
+echo "=== phase2 done $(date) ===" | tee -a "$LOG"
