@@ -103,11 +103,17 @@ class Bert(nn.Layer):
         return seq, pooled
 
     def mlm_logits(self, seq):
-        h = ops.gelu(self.mlm_transform(seq))
-        h = self.mlm_norm(h)
-        logits = ops.matmul(h, self.embeddings.word_embeddings.weight,
-                            transpose_y=True) + self.mlm_bias
-        return logits
+        # vocab matmul on [B*S, E]: a 3-D head dot picks a sequence-minor
+        # output layout on TPU and the loss's flatten then costs a full
+        # [B,S,V] relayout copy (same fix as GPT2.forward); the flatten and
+        # unflatten around the 2-D dot are layout-free bitcasts
+        lead = seq.shape[:-1]
+        h2 = ops.reshape(seq, [-1, seq.shape[-1]])
+        h2 = ops.gelu(self.mlm_transform(h2))
+        h2 = self.mlm_norm(h2)
+        logits2 = ops.matmul(h2, self.embeddings.word_embeddings.weight,
+                             transpose_y=True) + self.mlm_bias
+        return ops.reshape(logits2, list(lead) + [self.cfg.vocab_size])
 
     def pretraining_loss(self, input_ids, labels, next_sentence_label=None,
                          token_type_ids=None, attention_mask=None):

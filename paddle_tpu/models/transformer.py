@@ -81,7 +81,14 @@ class TransformerModel(nn.Layer):
             m = ops.unsqueeze(src_pad_mask.astype("float32"), [1, 2])
             src_mask = (1.0 - m) * -1e30
         out = self.transformer(src, tgt, src_mask=src_mask, tgt_mask=tgt_mask)
-        return self.generator(out)
+        # generator matmul on [B*S, E]: a 3-D head dot picks a sequence-minor
+        # output layout on TPU and the loss's flatten then costs a [B,S,V]
+        # relayout copy (same fix as GPT2.forward); both reshapes are
+        # layout-free bitcasts
+        b, s = out.shape[0], out.shape[1]
+        out2 = ops.reshape(out, [-1, self.cfg.d_model])
+        return ops.reshape(self.generator(out2),
+                           [b, s, self.cfg.tgt_vocab_size])
 
     def loss(self, src_ids, tgt_in, tgt_out, label_smoothing=0.1):
         logits = self(src_ids, tgt_in)

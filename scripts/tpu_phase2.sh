@@ -7,6 +7,13 @@
 #   - int8_bench: functional-state weights as jit args (HTTP 413 fix)
 cd /root/repo
 LOG=${1:-/root/repo/tpu_recovery_r4.log}
+# wait for the main queue to APPEAR first (launching phase 2 a moment
+# before phase 1 would otherwise pass the gate and contend on the chip),
+# then wait for it to drain; if it never appears, assume it already ran
+for i in $(seq 1 10); do
+  pgrep -f "tpu_when_up2.sh" > /dev/null && break
+  sleep 3
+done
 while pgrep -f "tpu_when_up2.sh" > /dev/null; do sleep 30; done
 run() {
   local t=$1 label=$2; shift 2
