@@ -104,7 +104,7 @@ def main():
         from paddle_tpu.core.tensor import Tensor as PTensor
         img = 224 if on_tpu else 32
         batch_candidates, seq = ((256, 128, 64) if on_tpu else (4,)), img
-        inner = 10 if on_tpu else 2
+        inner = 30 if on_tpu else 2
         model = resnet50(num_classes=1000)
         model.train()
 
@@ -130,7 +130,7 @@ def main():
         if on_tpu:
             cfg = BertConfig.large()
             batch_candidates, seq = (16, 8, 4), 512
-            inner = 10
+            inner = 30
         else:
             cfg = BertConfig.tiny()
             batch_candidates, seq = (4,), 128
@@ -145,7 +145,7 @@ def main():
             # per-chip batch stops paying once the GEMMs saturate; order the
             # candidates by measured throughput, not size
             batch_candidates, seq = (16, 8), 1024
-            inner = 10  # steps per dispatch (lax.scan)
+            inner = 30  # steps per dispatch (lax.scan)
         else:  # CI/smoke fallback
             cfg = GPT2Config.tiny()
             batch_candidates, seq = (4,), 128
@@ -217,10 +217,12 @@ def main():
         opt_state = optimizer.functional_init(params)
         params, opt_state, loss = train_n(params, opt_state)  # compile+warm
         float(jax.device_get(loss))
-        t0 = time.perf_counter()
-        params, opt_state, loss = train_n(params, opt_state)
-        float(jax.device_get(loss))
-        dt = (time.perf_counter() - t0) / inner
+        dt = float("inf")
+        for _ in range(2):  # best-of-2: the tunnel floor jitters
+            t0 = time.perf_counter()
+            params, opt_state, loss = train_n(params, opt_state)
+            float(jax.device_get(loss))
+            dt = min(dt, (time.perf_counter() - t0) / inner)
         return dt, float(loss)
 
     batch = dt = loss = None
@@ -293,6 +295,24 @@ def main():
           f"{jax.default_backend()}", file=sys.stderr)
 
 
+def _dispatch_floor():
+    """Measured round-trip cost of ONE empty dispatch through the axon
+    tunnel (observed 8ms..64ms depending on tunnel state). Subtracted from
+    the decode measurement (192 tokens would otherwise carry a 5-40%
+    phantom tax) and printed for provenance on every run."""
+    import jax
+    import jax.numpy as jnp
+    f = jax.jit(lambda c: c + 1.0)
+    z = jnp.zeros((), jnp.float32)
+    float(jax.device_get(f(z)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jax.device_get(f(z)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def _bench_decode(on_tpu):
     import jax
     import numpy as np
@@ -314,10 +334,17 @@ def _bench_decode(on_tpu):
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
     model.generate(ids, new).numpy()  # compile + completion barrier
-    t0 = time.perf_counter()
-    out = model.generate(ids, new)
-    out.numpy()  # fetch = completion barrier through the tunnel
-    dt = time.perf_counter() - t0
+    floor = _dispatch_floor()
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = model.generate(ids, new)
+        out.numpy()  # fetch = completion barrier through the tunnel
+        dt = min(dt, time.perf_counter() - t0)
+    # device decode time: one generate() is ONE dispatch; remove the
+    # measured tunnel round-trip so the number is per-token device
+    # throughput, not tunnel latency (provenance printed below)
+    dt = max(dt - floor, 1e-9)
     toks = batch * new
     tok_s = toks / dt
     # decode is HBM-bound: each token streams all params once -> the
@@ -339,6 +366,7 @@ def _bench_decode(on_tpu):
     if not on_tpu:
         record["degraded"] = True
     print(json.dumps(record))
+    print(f"# dispatch_floor={floor*1e3:.1f}ms (subtracted)", file=sys.stderr)
     print(f"# decode batch={batch} prompt={prompt} new={new} "
           f"step={dt/new*1000:.2f}ms/token params={n_params/1e6:.1f}M "
           f"hbm_util~{util:.3f} backend={jax.default_backend()}",
