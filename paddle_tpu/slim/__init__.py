@@ -287,7 +287,8 @@ class _QuantedBase(nn.Layer):
                 args.append(self.inner.bias)
             return apply_op(core, f"quanted_{self._kind}", tuple(args), {})
         # int8 inference path
-        a_scale = self._a_scale_frozen or self._calib_scale()
+        a_scale = self._a_scale_frozen if self._a_scale_frozen is not None \
+            else self._calib_scale()  # `or` would bool() a traced array
         xq = quantize_symmetric(xv, a_scale, self.activation_bits)
         acc = self._contract(xq, self._wq, preferred=jnp.int32)
         w_rescale = self._per_channel_acc_scale(
@@ -296,6 +297,11 @@ class _QuantedBase(nn.Layer):
             ((a_scale / _qmax(self.activation_bits)) * w_rescale)
         if self.inner.bias is not None:
             y = self._add_bias(y, self.inner.bias)
+        # serve in the caller's precision: a bf16 pipeline gets bf16 back
+        # (halves the epilogue HBM write and every downstream read); f32
+        # callers see unchanged behavior
+        if xv.dtype == jnp.bfloat16:
+            y = y.astype(jnp.bfloat16)
         return Tensor(y)
 
 
