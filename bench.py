@@ -291,8 +291,10 @@ def main():
     print(f"# loss={float(loss):.4f} params={n_params/1e6:.1f}M "
           f"mfu={mfu:.3f}"
           + (f" mfu_attn_incl={mfu_attn:.3f}" if mfu_attn is not None else "")
-          + f" step={dt*1000:.1f}ms batch={batch} backend="
-          f"{jax.default_backend()}", file=sys.stderr)
+          + f" step={dt*1000:.1f}ms batch={batch}"
+          + f" dispatch_floor={_dispatch_floor()*1e3:.1f}ms/{inner}steps"
+          " (not subtracted)"
+          + f" backend={jax.default_backend()}", file=sys.stderr)
 
 
 def _dispatch_floor():

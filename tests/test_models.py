@@ -236,3 +236,50 @@ class TestFlashAttention:
         ref = _reference_attention(q, k, v, d ** -0.5, True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestHeadMatmulLayout:
+    """The language-model heads must contract on RANK-2 operands: a 3-D
+    head dot picks a sequence-minor output layout on TPU and the loss's
+    flatten then costs a full [B,S,V] relayout copy (r4 per-op profile,
+    %copy.578, 4.9ms/step at batch 16). Guard the lowered module shape so
+    the fix can't silently regress."""
+
+    @staticmethod
+    def _rank2_head_dot_only(fn, args, vocab):
+        import re
+
+        import jax
+        txt = jax.jit(fn).lower(*args).as_text()
+        # any dot producing [..., S, V] with rank >= 3 is the regression
+        pat = re.compile(r"dot_general.*tensor<([0-9x]+)x%d[^0-9]" % vocab)
+        bad = [m.group(1) for m in pat.finditer(txt)
+               if m.group(1).count("x") >= 1]
+        return bad
+
+    def test_gpt2_loss_head_dot_is_rank2(self):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+        m = GPT2(GPT2Config.tiny())
+        m.eval()
+        ids = np.zeros((2, 16), np.int32)
+
+        bad = self._rank2_head_dot_only(
+            lambda i, l: m.loss(Tensor(i), Tensor(l))._value,
+            (ids, np.zeros((2, 16), np.int32)), m.cfg.vocab_size)
+        assert bad == [], f"3-D head dot reappeared: {bad}"
+
+    def test_bert_mlm_head_dot_is_rank2(self):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.models.bert import Bert, BertConfig
+
+        bm = Bert(BertConfig.tiny())
+        bm.eval()
+        ids = np.zeros((2, 12), np.int32)
+        lbl = np.full((2, 12), -100, np.int32)
+
+        bad = self._rank2_head_dot_only(
+            lambda i, l: bm.pretraining_loss(Tensor(i), Tensor(l))._value,
+            (ids, lbl), bm.cfg.vocab_size)
+        assert bad == [], f"3-D mlm head dot reappeared: {bad}"
