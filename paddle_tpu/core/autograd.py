@@ -46,6 +46,36 @@ def grad_enabled() -> bool:
     return _grad_enabled
 
 
+# contextvar, not a module global: one thread's functional trace must not
+# disable the eager tape for a concurrent thread running dygraph backward()
+# (the jit trace snapshot is threading.local for the same reason)
+import contextvars as _contextvars
+
+_functional_trace = _contextvars.ContextVar("functional_trace", default=False)
+
+
+def functional_trace_enabled() -> bool:
+    return _functional_trace.get()
+
+
+@contextlib.contextmanager
+def functional_trace():
+    """Marks a region where framework ops execute inside an OUTER jax
+    transform that owns differentiation (build_train_step losses,
+    Layer.functional_call, the static executor lowering, to_static).
+    Inside it, ops with tracer operands skip the eager-tape jax.vjp and
+    are called directly — the outer AD differentiates the primal and
+    sees kernel custom_vjp rules natively (an inner jax.vjp would
+    consume them: the pallas flash backward was silently lost this way).
+    Eager code and user-managed traces that rely on Tensor.backward()
+    (e.g. dygraph DataParallel inside shard_map) are unaffected."""
+    token = _functional_trace.set(True)
+    try:
+        yield
+    finally:
+        _functional_trace.reset(token)
+
+
 @contextlib.contextmanager
 def no_grad():
     global _grad_enabled

@@ -77,8 +77,11 @@ class StaticFunction:
                         for t in list(live.parameters(
                             include_sublayers=False))
                         + list(live.buffers(include_sublayers=False))]
+                    from ..core.autograd import functional_trace
+                    with functional_trace():
+                        out = self._fn(*a, **k)
                     return jax.tree_util.tree_map(
-                        _unwrap, self._fn(*a, **k),
+                        _unwrap, out,
                         is_leaf=lambda x: isinstance(x, Tensor))
                 self._compiled = jax.jit(_traced_free)
             raw_args = jax.tree_util.tree_map(
@@ -142,16 +145,19 @@ class StaticFunction:
                     # hooks) must see the TRACED params, not go stale —
                     # __call__ itself can't be used (layer.forward IS
                     # this StaticFunction)
-                    for hook in layer._forward_pre_hooks.values():
-                        hout = hook(layer, a)
-                        if hout is not None:
-                            a = hout if isinstance(hout, tuple) else (hout,)
-                    out = fn(layer, *a, **k) if not hasattr(fn, "__self__") \
-                        else fn(*a, **k)
-                    for hook in layer._forward_post_hooks.values():
-                        hout = hook(layer, a, out)
-                        if hout is not None:
-                            out = hout
+                    from ..core.autograd import functional_trace
+                    with functional_trace():
+                        for hook in layer._forward_pre_hooks.values():
+                            hout = hook(layer, a)
+                            if hout is not None:
+                                a = hout if isinstance(hout, tuple) \
+                                    else (hout,)
+                        out = fn(layer, *a, **k) \
+                            if not hasattr(fn, "__self__") else fn(*a, **k)
+                        for hook in layer._forward_post_hooks.values():
+                            hout = hook(layer, a, out)
+                            if hout is not None:
+                                out = hout
                     out_raw = jax.tree_util.tree_map(
                         _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
                     _, new_bufs = layer.functional_state()

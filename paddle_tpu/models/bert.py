@@ -202,9 +202,11 @@ def build_train_step(cfg: BertConfig, remat=False):
         rng_mod._default_generator._count = 0
         model.load_functional_state(params, None)
         try:
-            loss = model.pretraining_loss(
-                Tensor(batch["input_ids"]), Tensor(batch["labels"]),
-                next_sentence_label=None)
+            from ..core.autograd import functional_trace
+            with functional_trace():
+                loss = model.pretraining_loss(
+                    Tensor(batch["input_ids"]), Tensor(batch["labels"]),
+                    next_sentence_label=None)
             return loss._value
         finally:
             model.load_functional_state(saved_p, saved_b)

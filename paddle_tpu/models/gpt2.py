@@ -470,8 +470,10 @@ def build_train_step(cfg: GPT2Config, remat=False, dtype="float32"):
         rng_mod._default_generator._count = 0
         model.load_functional_state(params, None)
         try:
+            from ..core.autograd import functional_trace
             input_ids, labels = batch["input_ids"], batch["labels"]
-            loss = model.loss(Tensor(input_ids), Tensor(labels))
+            with functional_trace():
+                loss = model.loss(Tensor(input_ids), Tensor(labels))
             return loss._value
         finally:
             model.load_functional_state(saved_p, saved_b)

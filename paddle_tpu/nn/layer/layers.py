@@ -276,10 +276,12 @@ class Layer:
         live state afterwards — the jit-safe way to trace a Layer as a
         pure function of its state (tracers never leak into the module;
         pair with `functional_state()` for the inputs)."""
+        from ...core.autograd import functional_trace
         saved_p, saved_b = self.functional_state()
         self.load_functional_state(params, buffers)
         try:
-            return self(*args, **kwargs)
+            with functional_trace():
+                return self(*args, **kwargs)
         finally:
             self.load_functional_state(saved_p, saved_b)
 

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import mode, rng
-from ..core.autograd import Node, grad_enabled
+from ..core.autograd import Node, functional_trace_enabled, grad_enabled
 from ..core.tensor import Tensor
 
 OPS: dict = {}
@@ -37,10 +37,11 @@ def _flatten(args, kwargs):
 
 
 def _wrap_outputs(res, record_node, name, diff_tensors, vjp_fn,
-                  pure_fn=None):
+                  pure_fn=None, keep_grad=False):
     multi = isinstance(res, (tuple, list))
     outs_raw = list(res) if multi else [res]
-    outs = [None if o is None else Tensor(o, stop_gradient=not record_node)
+    sg = not (record_node or keep_grad)
+    outs = [None if o is None else Tensor(o, stop_gradient=sg)
             for o in outs_raw]
     if record_node:
         live = [o for o in outs if o is not None]
@@ -114,6 +115,23 @@ def apply_op(fn, name, args, kwargs, nondiff=False, stochastic=False):
         a2, k2 = jax.tree_util.tree_unflatten(treedef, vals)
         res = fn(*a2, **k2)
         return _wrap_outputs(res, False, name, [], None)
+
+    if functional_trace_enabled() and any(
+            isinstance(leaves[i]._value, jax.core.Tracer) for i in diff_idx):
+        # Executing under an outer jax transform that owns differentiation
+        # (functional_trace regions: train-step builders, functional_call,
+        # executor lowering, to_static): the eager tape is dead weight —
+        # the outer AD differentiates the primal ops directly. Recording
+        # would also BREAK kernels with custom_vjp rules: the inner
+        # jax.vjp consumes the rule, so an outer grad then differentiates
+        # the raw forward (pallas flash has no jvp rule → silent XLA
+        # fallback for three rounds, r4 finding). Call the op directly;
+        # outputs keep stop_gradient=False so dispatch semantics hold.
+        # (Outside functional_trace — e.g. dygraph backward() inside a
+        # user shard_map — the tape still records as before.)
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, vals)
+        res = fn(*a2, **k2)
+        return _wrap_outputs(res, False, name, [], None, keep_grad=True)
 
     diff_tensors = [leaves[i] for i in diff_idx]
 

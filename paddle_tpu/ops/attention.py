@@ -23,6 +23,21 @@ def _on_tpu():
         return False
 
 
+_flash_fallback_seen = set()
+
+
+def _warn_flash_fallback(e):
+    """A silent flash→XLA fallback hid a dead kernel path for three rounds;
+    warn once per exception type so it can never hide again."""
+    key = type(e).__name__
+    if key not in _flash_fallback_seen:
+        _flash_fallback_seen.add(key)
+        import warnings
+        warnings.warn(
+            f"flash attention fell back to XLA attention: {key}: "
+            f"{str(e)[:200]}", RuntimeWarning, stacklevel=3)
+
+
 def _xla_attention(q, k, v, mask=None, scale=None, causal=False):
     # q: [B, H, Sq, D]; k/v: [B, H, Sk, D]
     scale = (q.shape[-1] ** -0.5) if scale is None else scale
@@ -60,11 +75,18 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             # score tile in fwd + bwd recompute (S^2-proportional VPU work);
             # the chain rule through the prescale restores dq's scale
             sc = (q.shape[-1] ** -0.5) if scale is None else scale
-            out = flash_attention((q * sc).astype(q.dtype), k, v,
+            # pallas_call abstractification rejects Tensor wrappers (JAX
+            # dropped __jax_array__ support there), while plain jnp ops
+            # accept them — unwrap, or the grad trace silently loses the
+            # kernel (it did for three rounds: fwd had 12 tpu_custom_calls,
+            # fwd+bwd had ZERO)
+            from ._registry import raw
+            qv, kv, vv = raw(q), raw(k), raw(v)
+            out = flash_attention((qv * sc).astype(qv.dtype), kv, vv,
                                   causal=is_causal, scale=1.0)
             return out, None
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            _warn_flash_fallback(e)
     out, w = _xla_attention(q, k, v, attn_mask, scale, is_causal)
     if dropout_p > 0.0:
         keep = jax.random.bernoulli(key, 1.0 - dropout_p, w.shape)
