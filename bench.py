@@ -136,6 +136,19 @@ def main():
             batch_candidates, seq = (4,), 128
             inner = 3
         metric_name = "bert_large_train_tokens_per_sec_per_chip"
+    elif model_name == "gpt2m":
+        # BASELINE.json's GPT-2 config is MEDIUM ("GPT-2 medium with
+        # fused_attention_op -> Pallas flash-attn"); single-chip train
+        from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
+        if on_tpu:
+            cfg = GPT2Config.medium()  # 355M params
+            batch_candidates, seq = (8, 4), 1024
+            inner = 20
+        else:
+            cfg = GPT2Config.tiny()
+            batch_candidates, seq = (4,), 128
+            inner = 3
+        metric_name = "gpt2m_train_tokens_per_sec_per_chip"
     else:
         from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
         if on_tpu:
