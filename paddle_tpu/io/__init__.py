@@ -238,6 +238,21 @@ def default_collate_fn(batch):
     return Tensor(arr)
 
 
+_loader_fallback_seen = set()
+
+
+def _warn_loader_fallback(what, e):
+    """A silent perf-path downgrade hid the dead flash backward for three
+    rounds (r4 finding) — loader fallbacks warn once per (path, error)."""
+    key = (what, type(e).__name__)
+    if key not in _loader_fallback_seen:
+        _loader_fallback_seen.add(key)
+        import warnings
+        warnings.warn(f"DataLoader fell back from {what}: "
+                      f"{type(e).__name__}: {str(e)[:160]}", RuntimeWarning,
+                      stacklevel=3)
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -310,13 +325,21 @@ class DataLoader:
                     self.use_shared_memory,
                     iterable_batch_size=self.batch_size,
                     iterable_drop_last=self.drop_last)
-            else:
+        except Exception as e:  # construction only: a mid-stream failure
+            # must NOT restart iteration (duplicate batches); and a silent
+            # perf downgrade hid a dead kernel path for rounds — warn.
+            _warn_loader_fallback("worker processes", e)
+            yield from self._prefetch_iter()
+            return
+        try:
+            if not self._iterable_mode:
                 it = MultiprocessLoaderIter(
                     self.dataset, self.collate_fn,
                     list(self.batch_sampler), self.num_workers,
                     self.prefetch_factor, self.timeout, self.worker_init_fn,
                     self.use_shared_memory)
-        except Exception:
+        except Exception as e:
+            _warn_loader_fallback("worker processes", e)
             yield from self._prefetch_iter()
             return
         yield from it
@@ -324,15 +347,17 @@ class DataLoader:
     def _prefetch_iter(self):
         """Single-process background prefetch: native C++ ring buffer when
         available, otherwise a Python thread."""
+        prefetcher = None
         try:
             from .native_loader import NativePrefetcher
             prefetcher = NativePrefetcher(self._iter_batches(),
                                           depth=self.num_workers *
                                           self.prefetch_factor)
+        except Exception as e:  # construction only — see worker fallback
+            _warn_loader_fallback("native C++ prefetcher", e)
+        if prefetcher is not None:
             yield from prefetcher
             return
-        except Exception:
-            pass
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
 
