@@ -267,13 +267,8 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
-        if persistent_workers and num_workers > 0:
-            import warnings
-            warnings.warn(
-                "persistent_workers is accepted for API compatibility but "
-                "is a no-op here: workers are forked per epoch, which is "
-                "milliseconds under the fork start method (no interpreter "
-                "re-import)", stacklevel=2)
+        self.persistent_workers = persistent_workers and num_workers > 0
+        self._pool = None  # live PersistentLoaderPool when enabled
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -290,6 +285,18 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
+
+    def close(self):
+        """Release the persistent worker pool (no-op otherwise)."""
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
+
+    def __del__(self):  # pragma: no cover - gc path
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _iter_batches(self):
         if self._iterable_mode:
@@ -317,6 +324,28 @@ class DataLoader:
         memory into the C++ byte-queue. Falls back to the single-process
         thread prefetcher if process spawn fails (e.g. sandboxed)."""
         from .worker import MultiprocessLoaderIter
+        if self.persistent_workers:
+            try:
+                if self._pool is None or self._pool._shutdown:
+                    self._pool = MultiprocessLoaderIter(
+                        self.dataset, self.collate_fn, None,
+                        self.num_workers, self.prefetch_factor,
+                        self.timeout, self.worker_init_fn,
+                        self.use_shared_memory,
+                        iterable_batch_size=(self.batch_size
+                                             if self._iterable_mode
+                                             else None),
+                        iterable_drop_last=(self.drop_last
+                                            if self._iterable_mode
+                                            else False),
+                        persistent=True)
+            except Exception as e:
+                _warn_loader_fallback("persistent worker pool", e)
+                yield from self._prefetch_iter()
+                return
+            yield from self._pool.epoch(
+                None if self._iterable_mode else list(self.batch_sampler))
+            return
         try:
             if self._iterable_mode:
                 it = MultiprocessLoaderIter(

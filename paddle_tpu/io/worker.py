@@ -53,10 +53,14 @@ def _seed_worker(worker_id, base_seed):
 
 def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
                  num_workers, base_seed, worker_init_fn, use_shared_memory,
-                 iterable_batch_size, iterable_drop_last):
+                 iterable_batch_size, iterable_drop_last, persistent=False):
     """Target of each worker process. Map-style: pops (batch_idx, indices)
     tasks. Iterable-style: iterates its own dataset copy (the dataset uses
-    get_worker_info() to shard itself) and emits (-1, batch) results."""
+    get_worker_info() to shard itself) and emits (-1, batch) results.
+
+    persistent: map-style needs no change (the parent simply withholds the
+    None sentinel until loader shutdown); iterable-style waits for an
+    epoch token per epoch instead of exiting after one pass."""
     global _worker_info
     _worker_info = WorkerInfo(id=worker_id, num_workers=num_workers,
                               seed=base_seed + worker_id, dataset=dataset)
@@ -82,15 +86,21 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
 
     try:
         if iterable_batch_size is not None:  # iterable mode
-            it = iter(dataset)
             while True:
-                batch = list(itertools.islice(it, iterable_batch_size))
-                if not batch or (len(batch) < iterable_batch_size
-                                 and iterable_drop_last):
-                    break
-                emit(-1, collate_fn(batch))
-            result_queue.put(("done", worker_id, None))
-            return
+                if persistent:
+                    tok = index_queue.get()
+                    if tok is None:  # shutdown
+                        return
+                it = iter(dataset)
+                while True:
+                    batch = list(itertools.islice(it, iterable_batch_size))
+                    if not batch or (len(batch) < iterable_batch_size
+                                     and iterable_drop_last):
+                        break
+                    emit(-1, collate_fn(batch))
+                result_queue.put(("done", worker_id, None))
+                if not persistent:
+                    return
         while True:
             task = index_queue.get()
             if task is None:
@@ -190,14 +200,16 @@ class MultiprocessLoaderIter:
     def __init__(self, dataset, collate_fn, batches, num_workers,
                  prefetch_factor=2, timeout=0, worker_init_fn=None,
                  use_shared_memory=True, iterable_batch_size=None,
-                 iterable_drop_last=False, base_seed=None):
+                 iterable_drop_last=False, base_seed=None, persistent=False):
         ctx = _mp_context()
         self.timeout = timeout or None
         self.num_workers = num_workers
         self._iterable = iterable_batch_size is not None
         self._batches = list(batches) if batches is not None else None
+        self._persistent = persistent
         self._result_queue = ctx.Queue()
-        self._index_queue = ctx.Queue() if not self._iterable else None
+        self._index_queue = ctx.Queue() \
+            if (not self._iterable or persistent) else None
         depth = max(2, num_workers * prefetch_factor)
         self._chan = _ByteChannel(depth)
         self._shutdown = False
@@ -211,11 +223,13 @@ class MultiprocessLoaderIter:
                 args=(dataset, collate_fn, self._index_queue,
                       self._result_queue, wid, num_workers, base_seed,
                       worker_init_fn, use_shared_memory,
-                      iterable_batch_size, iterable_drop_last),
+                      iterable_batch_size, iterable_drop_last, persistent),
                 daemon=True)
             w.start()
             self._workers.append(w)
 
+        if persistent:
+            return  # epochs armed explicitly via reset()/epoch()
         if not self._iterable:
             self._n_batches = len(self._batches)
             for task in enumerate(self._batches):
@@ -224,6 +238,52 @@ class MultiprocessLoaderIter:
                 self._index_queue.put(None)
         self._feeder = threading.Thread(target=self._feed, daemon=True)
         self._feeder.start()
+
+    # -- persistent-workers protocol (ref: persistent_workers=True) -------
+    def reset(self, batches=None):
+        """Arm one epoch on the live worker pool: push the epoch's tasks
+        (map) or one epoch token per worker (iterable) and start a fresh
+        feeder. Workers stay alive across epochs; worker_init_fn ran once
+        at spawn (reference persistent_workers semantics)."""
+        assert self._persistent and not self._shutdown
+        if self._iterable:
+            for _ in range(self.num_workers):
+                self._index_queue.put(True)
+        else:
+            self._batches = list(batches)
+            self._n_batches = len(self._batches)
+            for task in enumerate(self._batches):
+                self._index_queue.put(task)
+        self._feeder = threading.Thread(target=self._feed, daemon=True)
+        self._feeder.start()
+
+    def epoch(self, batches=None):
+        """One epoch's batch stream off the persistent pool; the pool
+        survives the END marker (shutdown only on error or close())."""
+        from .native_loader import _deserialize_batch
+        self.reset(batches)
+        while True:
+            got = self._chan.pop(timeout=self.timeout)
+            if got is None:
+                self._shutdown_workers()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self.timeout}s")
+            tag, payload = got
+            if tag == _TAG_END:
+                return
+            if tag == _TAG_ERR:
+                self._shutdown_workers()
+                raise RuntimeError("DataLoader worker failed:\n"
+                                   + pickle.loads(bytes(payload)))
+            yield _deserialize_batch(payload)
+
+    def close(self):
+        """Persistent-pool shutdown: release the workers via sentinels."""
+        if self._shutdown:
+            return
+        for _ in range(self.num_workers):
+            self._index_queue.put(None)
+        self._shutdown_workers()
 
     # -- feeder thread: result_queue -> (reorder) -> byte channel ---------
     def _feed(self):
@@ -262,7 +322,8 @@ class MultiprocessLoaderIter:
             except Exception:
                 pass
         finally:
-            self._chan.close()
+            if not self._persistent:
+                self._chan.close()
 
     def _get_result(self):
         try:

@@ -172,3 +172,52 @@ class TestMultiprocessDataLoader:
         xs = np.asarray(out[0]["x"].numpy() if hasattr(out[0]["x"], "numpy")
                         else out[0]["x"])
         np.testing.assert_allclose(xs[:, 0], [0, 1, 2, 3])
+
+
+class TestPersistentWorkers:
+    def test_map_style_pool_survives_epochs(self):
+        import paddle_tpu.io as io
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+        dl = io.DataLoader(DS(), batch_size=4, num_workers=2,
+                           persistent_workers=True, shuffle=False)
+        seen = []
+        for _epoch in range(3):
+            vals = sorted(float(b.numpy()[i, 0])
+                          for b in dl for i in range(b.shape[0]))
+            assert vals == [float(i) for i in range(12)]
+            assert dl._pool is not None
+            seen.append(id(dl._pool))
+            assert all(w.is_alive() for w in dl._pool._workers), \
+                "persistent workers died between epochs"
+        assert len(set(seen)) == 1, "pool was rebuilt per epoch"
+        pids = [w.pid for w in dl._pool._workers]
+        dl.close()
+        assert dl._pool is None
+        assert len(set(pids)) == 2
+
+    def test_iterable_pool_survives_epochs(self):
+        import paddle_tpu.io as io
+
+        class IS(io.IterableDataset):
+            def __iter__(self):
+                info = io.get_worker_info()
+                wid = info.id if info else 0
+                nw = info.num_workers if info else 1
+                for i in range(wid, 8, nw):
+                    yield np.full((2,), i, np.float32)
+
+        dl = io.DataLoader(IS(), batch_size=2, num_workers=2,
+                           persistent_workers=True)
+        for _epoch in range(2):
+            vals = sorted(float(b.numpy()[i, 0])
+                          for b in dl for i in range(b.shape[0]))
+            assert vals == [float(i) for i in range(8)]
+            assert all(w.is_alive() for w in dl._pool._workers)
+        dl.close()
