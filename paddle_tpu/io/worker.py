@@ -246,6 +246,19 @@ class MultiprocessLoaderIter:
         feeder. Workers stay alive across epochs; worker_init_fn ran once
         at spawn (reference persistent_workers semantics)."""
         assert self._persistent and not self._shutdown
+        # a previous epoch abandoned mid-iteration (consumer broke out of
+        # epoch()) leaves its feeder running and frames in the channel —
+        # let the workers drain the already-queued tasks, then discard the
+        # stale frames, or they would leak into this epoch's stream
+        feeder = getattr(self, "_feeder", None)
+        if feeder is not None and feeder.is_alive():
+            feeder.join()
+        if getattr(self, "_epoch_open", False):
+            while True:
+                got = self._chan.pop(timeout=0.05)
+                if got is None or got[0] == _TAG_END:
+                    break
+        self._epoch_open = True
         if self._iterable:
             for _ in range(self.num_workers):
                 self._index_queue.put(True)
@@ -270,6 +283,7 @@ class MultiprocessLoaderIter:
                     f"DataLoader timed out after {self.timeout}s")
             tag, payload = got
             if tag == _TAG_END:
+                self._epoch_open = False
                 return
             if tag == _TAG_ERR:
                 self._shutdown_workers()

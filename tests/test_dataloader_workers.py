@@ -221,3 +221,23 @@ class TestPersistentWorkers:
             assert vals == [float(i) for i in range(8)]
             assert all(w.is_alive() for w in dl._pool._workers)
         dl.close()
+
+    def test_abandoned_epoch_does_not_leak_into_next(self):
+        import paddle_tpu.io as io
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+        dl = io.DataLoader(DS(), batch_size=4, num_workers=2,
+                           persistent_workers=True, shuffle=False)
+        for b in dl:  # consume ONE batch, then abandon the epoch
+            break
+        vals = sorted(float(b.numpy()[i, 0])
+                      for b in dl for i in range(b.shape[0]))
+        assert vals == [float(i) for i in range(12)], \
+            "stale frames from the abandoned epoch leaked into the next"
+        dl.close()
