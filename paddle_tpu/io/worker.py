@@ -126,7 +126,16 @@ class _ByteChannel:
             self._lib = get_lib()
             self._q = self._lib.ptq_create(depth, capacity_mb << 20)
             self._py = None
-        except Exception:
+        except Exception as e:
+            # same warn-once policy as the DataLoader fallbacks: a silent
+            # native->python downgrade is a hidden perf cliff
+            import warnings
+            if not getattr(_ByteChannel, "_warned", False):
+                _ByteChannel._warned = True
+                warnings.warn(
+                    "native C++ byte-queue unavailable, using a Python "
+                    f"queue: {type(e).__name__}: {str(e)[:120]}",
+                    RuntimeWarning, stacklevel=2)
             self._lib = None
             self._py = pyqueue.Queue(maxsize=depth)
 
