@@ -80,6 +80,7 @@ class Role:
 
 
 from . import metrics  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
 
 
 util = UtilBase()  # ref: fleet.util (util_factory singleton)

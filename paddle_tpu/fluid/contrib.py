@@ -411,7 +411,9 @@ def _cluster_only(name, why):
     return fn
 
 
-HDFSClient = _cluster_only("HDFSClient", "HDFS file transfer")
+from ..distributed.fleet.utils.fs import HDFSClient  # noqa: E402 — real
+# hadoop-CLI client (fleet.utils.fs); raises ExecuteError with guidance
+# when no hadoop install is present
 multi_download = _cluster_only("multi_download", "HDFS file transfer")
 multi_upload = _cluster_only("multi_upload", "HDFS file transfer")
 _pull_box_extended_sparse = _cluster_only("_pull_box_extended_sparse",

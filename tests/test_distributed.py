@@ -1053,3 +1053,57 @@ class TestQuantizedAllReduce:
         exact = np.asarray(big).sum(0)
         rel = np.abs(out16[0] - exact).max() / np.abs(exact).max()
         assert rel < 1e-4, rel  # 16-bit codes: ~256x tighter than int8
+
+
+class TestFleetUtils:
+    def test_local_fs_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+
+        fs = LocalFS()
+        d = str(tmp_path / "ckpt")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / "ckpt" / "model.pdparams")
+        fs.touch(f)
+        assert fs.is_file(f)
+        fs.upload(f, str(tmp_path / "up.bin"))
+        assert fs.is_file(str(tmp_path / "up.bin"))
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert "ckpt" in dirs and "up.bin" in files
+        assert fs.list_dirs(str(tmp_path)) == dirs
+        fs.mv(f, str(tmp_path / "moved.bin"))
+        assert not fs.is_exist(f)
+        fs.delete(d)
+        assert not fs.is_exist(d)
+        assert fs.need_upload_download() is False
+
+    def test_hdfs_client_raises_clearly_without_hadoop(self):
+        from paddle_tpu.distributed.fleet.utils import ExecuteError, \
+            HDFSClient
+
+        client = HDFSClient(hadoop_home=None)
+        import os
+        os.environ.pop("HADOOP_HOME", None)
+        client._hadoop_home = None
+        import pytest as _pytest
+        with _pytest.raises(ExecuteError, match="hadoop"):
+            client.is_exist("/x")
+        assert client.need_upload_download() is True
+
+    def test_kv_server_rendezvous(self):
+        from paddle_tpu.distributed.fleet.utils import KVClient, KVServer
+
+        srv = KVServer(0, size={"worker": 2})
+        srv.start()
+        try:
+            c = KVClient(f"127.0.0.1:{srv.port}")
+            assert c.put("/worker/0", "host0:8888")
+            assert c.put("/worker/1", "host1:8888")
+            assert c.get("/worker/0") == "host0:8888"
+            assert c.get("/missing") == ""
+            assert not srv.should_stop()
+            c.delete("/worker/0")
+            c.delete("/worker/1")
+            assert srv.should_stop()
+        finally:
+            srv.stop()

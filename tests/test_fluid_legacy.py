@@ -236,8 +236,14 @@ class TestContrib:
 
     def test_cluster_only_pieces_raise(self):
         from paddle_tpu.fluid import contrib as C
-        with pytest.raises(NotImplementedError, match="SURVEY"):
-            C.HDFSClient()
+        # HDFSClient is REAL now (fleet.utils.fs hadoop-CLI client, r4):
+        # constructible, and raises ExecuteError with guidance when no
+        # hadoop install exists
+        from paddle_tpu.distributed.fleet.utils import ExecuteError
+        cl = C.HDFSClient(hadoop_home=None)
+        cl._hadoop_home = None
+        with pytest.raises(ExecuteError, match="hadoop"):
+            cl.is_exist("/x")
         with pytest.raises(NotImplementedError, match="SURVEY"):
             C.distributed_batch_reader(None)
 
