@@ -163,26 +163,4 @@ from .version import commit, full_version  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
 
 
-class _OnnxShim:
-    """paddle.onnx namespace (ref: python/paddle/onnx/).
-
-    DESIGN DECISION (recorded in SURVEY.md §2 #39): ONNX export is
-    deliberately dropped. The reference's paddle.onnx.export exists to
-    escape into third-party inference runtimes; this framework's
-    deployment artifact is the serialized StableHLO module from jit.save
-    (.pdmodel) — portable across XLA platforms (cpu/tpu), versioned, and
-    loadable with no Python model class. Emitting ONNX here would mean
-    hand-writing protobufs with no `onnx` package in the image to even
-    validate them (zero-egress), for a runtime (CUDA/ORT) this stack
-    doesn't target. Raises with that guidance when used."""
-
-    @staticmethod
-    def export(*a, **kw):
-        raise NotImplementedError(
-            "ONNX export is intentionally not supported (SURVEY.md §2 #39):"
-            " the deployment artifact is the StableHLO .pdmodel from "
-            "paddle_tpu.jit.save (portable across XLA platforms, loadable "
-            "without model classes via inference.create_predictor).")
-
-
-onnx = _OnnxShim()
+from . import onnx  # noqa: E402,F401 — raising-by-design package (SURVEY §2 #39)

@@ -1,0 +1,4 @@
+"""paddle.amp.grad_scaler module path (ref: amp/grad_scaler.py)."""
+from . import GradScaler  # noqa: F401
+
+__all__ = ["GradScaler"]

@@ -1,0 +1,28 @@
+"""paddle.device module path (ref: python/paddle/device.py) — binds the
+device-management API that also lives on the paddle root."""
+from .core.place import (  # noqa: F401
+    CPUPlace, TPUPlace, get_device, is_compiled_with_cuda,
+    is_compiled_with_tpu, is_compiled_with_xpu, set_device,
+)
+
+
+def get_cudnn_version():
+    """No cuDNN on this stack (ref parity: None when absent)."""
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+__all__ = ["get_cudnn_version", "set_device", "get_device",
+           "is_compiled_with_xpu", "is_compiled_with_cinn",
+           "is_compiled_with_npu"]

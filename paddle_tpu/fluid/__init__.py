@@ -25,6 +25,7 @@ from ..nn import initializer  # noqa: F401
 from ..nn import clip  # noqa: F401
 from .. import regularizer  # noqa: F401
 from . import contrib  # noqa: F401
+from .reader import PyReader  # noqa: F401
 from . import core  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import layers  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.hapi.dynamic_flops module path (ref: hapi/dynamic_flops.py) —
+binds the flops counter (static_flops implements the shared logic)."""
+from .static_flops import flops  # noqa: F401
+
+__all__ = ["flops"]

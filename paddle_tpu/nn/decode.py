@@ -1,0 +1,4 @@
+"""paddle.nn.decode module path (ref: nn/decode.py)."""
+from .layer.legacy import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401,E501
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]

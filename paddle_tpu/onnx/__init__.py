@@ -1,0 +1,14 @@
+"""paddle.onnx namespace (ref: python/paddle/onnx/).
+
+DESIGN DECISION (recorded in SURVEY.md §2 #39): ONNX export is
+deliberately dropped. The reference's paddle.onnx.export exists to
+escape into third-party inference runtimes; this framework's deployment
+artifact is the serialized StableHLO module from jit.save (.pdmodel) —
+portable across XLA platforms, versioned, loadable with no Python model
+class. `export` raises with that guidance. This is a real package so
+both `paddle.onnx.export(...)` and `from paddle.onnx.export import
+export` (the reference's module path) resolve before raising.
+"""
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

@@ -1,0 +1,12 @@
+"""paddle.onnx.export module path (ref: onnx/export.py)."""
+
+
+def export(*a, **kw):
+    raise NotImplementedError(
+        "ONNX export is intentionally not supported (SURVEY.md §2 #39):"
+        " the deployment artifact is the StableHLO .pdmodel from "
+        "paddle_tpu.jit.save (portable across XLA platforms, loadable "
+        "without model classes via inference.create_predictor).")
+
+
+__all__ = ["export"]

@@ -122,6 +122,9 @@ def _patch_tensor():
         "less_than": math.less_than, "less_equal": math.less_equal,
         "greater_than": math.greater_than, "greater_equal": math.greater_equal,
         "equal_all": math.equal_all, "allclose": math.allclose,
+        "is_complex": math.is_complex,
+        "is_floating_point": math.is_floating_point,
+        "is_integer": math.is_integer,
         "isclose": math.isclose, "logical_and": math.logical_and,
         "logical_or": math.logical_or, "logical_not": math.logical_not,
         "logical_xor": math.logical_xor, "scale": math.scale,

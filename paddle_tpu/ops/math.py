@@ -244,6 +244,26 @@ def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
     return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
+# dtype predicates (ref: python/paddle/tensor/attribute.py) — host-side
+# answers about the Tensor's dtype, not traced ops
+def is_complex(x):
+    import jax.numpy as _jnp
+    dt = x.dtype if hasattr(x, "dtype") else _jnp.asarray(x).dtype
+    return _jnp.issubdtype(dt, _jnp.complexfloating)
+
+
+def is_floating_point(x):
+    import jax.numpy as _jnp
+    dt = x.dtype if hasattr(x, "dtype") else _jnp.asarray(x).dtype
+    return _jnp.issubdtype(dt, _jnp.floating)
+
+
+def is_integer(x):
+    import jax.numpy as _jnp
+    dt = x.dtype if hasattr(x, "dtype") else _jnp.asarray(x).dtype
+    return _jnp.issubdtype(dt, _jnp.integer)
+
+
 # ------------------------------------------------------------ reductions ----
 
 def _norm_axis(axis):

@@ -1,0 +1,4 @@
+"""paddle.static.input module path (ref: static/input.py)."""
+from . import InputSpec, data  # noqa: F401
+
+__all__ = ["data", "InputSpec"]

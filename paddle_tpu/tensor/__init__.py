@@ -2,11 +2,11 @@
 (ref: python/paddle/tensor/__init__.py)."""
 from __future__ import annotations
 
-from .ops import *  # noqa: F401,F403
-from .core.tensor import Tensor, to_tensor  # noqa: F401
+from ..ops import *  # noqa: F401,F403
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
 
 # legacy fluid-era names the reference's paddle.tensor also re-exports
-from .compat import (  # noqa: F401,E402
+from ..compat import (  # noqa: F401,E402
     ComplexVariable, LoDTensor, LoDTensorArray, VarBase, addcmul,
     broadcast_shape, crop_tensor, elementwise_add, elementwise_div,
     elementwise_floordiv, elementwise_max, elementwise_min, elementwise_mod,
@@ -15,8 +15,8 @@ from .compat import (  # noqa: F401,E402
     numel, rank, reduce_all, reduce_any, reduce_max, reduce_mean, reduce_min,
     reduce_prod, reduce_sum, set_printoptions, shape, tensordot,
 )
-from .core.tensor import is_tensor  # noqa: F401,E402
-from .fluid.layers import fill_constant  # noqa: F401,E402
+from ..core.tensor import is_tensor  # noqa: F401,E402
+from ..fluid.layers import fill_constant  # noqa: F401,E402
 print_function = None  # __future__ artifact the reference re-exported
 
-from .compat import reverse  # noqa: E402,F401  (1.x flip alias at paddle.tensor)
+from ..compat import reverse  # noqa: E402,F401  (1.x flip alias at paddle.tensor)

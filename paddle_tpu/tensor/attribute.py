@@ -1,0 +1,6 @@
+"""paddle.tensor.attribute module path (ref: tensor/attribute.py)."""
+from ..compat import rank, shape  # noqa: F401
+from ..ops import imag, is_complex, is_floating_point, is_integer, real  # noqa: F401,E501
+
+__all__ = ["rank", "shape", "real", "imag", "is_complex", "is_integer",
+           "is_floating_point"]

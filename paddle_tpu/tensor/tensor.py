@@ -1,0 +1,4 @@
+"""paddle.tensor.tensor module path (ref: tensor/tensor.py)."""
+from ..core.tensor import Tensor  # noqa: F401
+
+__all__ = ["Tensor"]

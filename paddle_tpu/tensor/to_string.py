@@ -1,0 +1,4 @@
+"""paddle.tensor.to_string module path (ref: tensor/to_string.py)."""
+from ..compat import set_printoptions  # noqa: F401
+
+__all__ = ["set_printoptions"]

@@ -1,0 +1,4 @@
+"""paddle.utils.install_check module path (ref: utils/install_check.py)."""
+from . import run_check  # noqa: F401
+
+__all__ = ["run_check"]
