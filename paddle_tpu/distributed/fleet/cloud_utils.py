@@ -1,0 +1,6 @@
+"""paddle.distributed.fleet.cloud_utils module path (ref:
+fleet/cloud_utils.py) — same cloud-env cluster derivation as
+paddle.distributed.cloud_utils."""
+from ..cloud_utils import get_cloud_cluster, get_cluster_and_pod  # noqa: F401,E501
+
+__all__ = ["get_cloud_cluster", "get_cluster_and_pod"]

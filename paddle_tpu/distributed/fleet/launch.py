@@ -1,0 +1,11 @@
+"""paddle.distributed.fleet.launch module path (ref: fleet/launch.py).
+
+`python -m paddle.distributed.fleet.launch train.py` is the reference's
+multi-process entry point; on this stack it delegates to the jax.distributed
+launcher (`paddle_tpu.distributed.launch`), which boots the coordinator and
+per-process ranks the same way.
+"""
+from ..launch import main  # noqa: F401
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+"""paddle.distributed.fleet.launch_utils module path (ref:
+fleet/launch_utils.py) — the launcher-support helpers live in
+distributed.utils on this stack."""
+from ..utils import (  # noqa: F401
+    Cluster, Pod, Trainer, add_arguments, find_free_ports, get_cluster,
+    get_host_name_ip, get_logger, terminate_local_procs,
+)
+
+__all__ = ["get_cluster", "get_host_name_ip", "find_free_ports",
+           "terminate_local_procs", "get_logger", "add_arguments",
+           "Cluster", "Pod", "Trainer"]

@@ -1,0 +1,7 @@
+"""paddle.nn.functional.transformer module path (ref:
+nn/functional/transformer.py)."""
+from ...ops import scaled_dot_product_attention  # noqa: F401
+from ...ops.attention import fused_feedforward, fused_multi_head_attention  # noqa: F401,E501
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "scaled_dot_product_attention"]

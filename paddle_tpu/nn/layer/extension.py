@@ -1,0 +1,4 @@
+"""paddle.nn.layer.extension module path (ref: nn/layer/extension.py)."""
+from .legacy import RowConv  # noqa: F401
+
+__all__ = ["RowConv"]

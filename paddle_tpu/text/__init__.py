@@ -246,3 +246,13 @@ datasets = _sys.modules[__name__]  # paddle.text.datasets alias
 # attributes (ref: text/datasets/__init__.py import list)
 from ..dataset import (  # noqa: E402,F401
     conll05, imdb, imikolov, movielens, uci_housing, wmt14, wmt16)
+
+# register the alias and its corpus leaves as IMPORTABLE module paths so
+# `import paddle.text.datasets.imdb` (the reference's layout) resolves,
+# not just attribute access (r4 module-path parity)
+_sys.modules[__name__ + ".datasets"] = datasets
+for _n, _m in (("conll05", conll05), ("imdb", imdb),
+               ("imikolov", imikolov), ("movielens", movielens),
+               ("uci_housing", uci_housing), ("wmt14", wmt14),
+               ("wmt16", wmt16)):
+    _sys.modules[f"{__name__}.datasets.{_n}"] = _m

@@ -256,6 +256,26 @@ _INTERNAL_MODULES = {
     "fluid.net_drawer", "fluid.op", "fluid.trainer_factory",
     "fluid.wrapped_decorator", "utils.image_util", "utils.lazy_import",
     "utils.op_version",
+    # depth-3 internals: reference plumbing, not user import surface
+    "fluid.dataloader.dataloader_iter", "fluid.dataloader.fetcher",
+    "fluid.distributed.downpour", "fluid.distributed.fleet",
+    "fluid.distributed.helper", "fluid.distributed.node",
+    "fluid.distributed.ps_instance", "fluid.distributed.ps_pb2",
+    "fluid.dygraph.layer_object_helper", "fluid.dygraph.math_op_patch",
+    "fluid.dygraph.parallel_helper", "fluid.dygraph.profiler",
+    "fluid.dygraph.varbase_patch_methods", "fluid.inference.wrapper",
+    "fluid.layers.collective", "fluid.layers.distributions",
+    "fluid.layers.layer_function_generator",
+    "fluid.layers.learning_rate_scheduler", "fluid.layers.sequence_lod",
+    "fluid.layers.utils", "fluid.transpiler.collective",
+    "fluid.transpiler.geo_sgd_transpiler",
+    "fluid.transpiler.memory_optimization_transpiler",
+    "fluid.transpiler.ps_dispatcher", "incubate.complex.helper",
+    "incubate.complex.tensor_op_patch", "jit.dy2static.convert_call_func",
+    "jit.dy2static.convert_operators", "jit.dy2static.variable_trans_func",
+    "static.nn.common", "vision.transforms.functional_cv2",
+    "vision.transforms.functional_pil",
+    "vision.transforms.functional_tensor",
 }
 
 
@@ -276,6 +296,9 @@ def audit_module_paths():
     for p in ref.glob("*/*.py"):
         if not p.name.startswith("_") and "test" not in p.parts[-2]:
             mods.add(f"{p.parts[-2]}.{p.stem}")
+    for p in ref.glob("*/*/*.py"):
+        if not p.name.startswith("_") and "test" not in str(p):
+            mods.add(f"{p.parts[-3]}.{p.parts[-2]}.{p.stem}")
     for mod in sorted(mods):
         if mod in _INTERNAL_MODULES or mod.endswith(".version") \
                 or "setup" in mod:

@@ -32,6 +32,14 @@ class TestModulePaths:
             "onnx.export",
             "hapi.progressbar", "hapi.dynamic_flops",
             "distributed.fleet.utils", "distributed.fleet.utils.fs",
+            "nn.layer.distance", "nn.layer.extension", "nn.layer.vision",
+            "nn.utils.weight_norm_hook", "nn.functional.transformer",
+            "distributed.fleet.cloud_utils",
+            "distributed.fleet.launch_utils", "distributed.fleet.launch",
+            "fluid.dataloader", "fluid.dataloader.dataset",
+            "fluid.dataloader.sampler", "fluid.dataloader.batch_sampler",
+            "fluid.transpiler", "fluid.transpiler.distribute_transpiler",
+            "text.datasets.imdb", "text.datasets.wmt16",
         ]:
             importlib.import_module(f"paddle_tpu.{mod}")
 
