@@ -26,6 +26,19 @@ from ..nn import clip  # noqa: F401
 from .. import regularizer  # noqa: F401
 from . import contrib  # noqa: F401
 from .reader import PyReader  # noqa: F401
+
+# register fluid.layers.utils as an importable MODULE PATH: fluid.layers
+# is a module (not a package) on this stack, but the reference exposes
+# `from paddle.fluid.layers.utils import map_structure` — the sys.modules
+# pre-registration makes that import resolve (r4 module-path parity)
+import sys as _sys  # noqa: E402
+
+from . import layers_utils as _layers_utils  # noqa: E402
+
+_sys.modules[__name__ + ".layers.utils"] = _layers_utils
+from . import layers as _layers_mod  # noqa: E402
+
+_layers_mod.utils = _layers_utils
 from . import core  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import layers  # noqa: F401

@@ -1,0 +1,34 @@
+"""fluid.layers.utils module path (ref: fluid/layers/utils.py) — the
+nest utilities (flatten / pack_sequence_as / map_structure) that 1.x
+RNN/decoder user code imports directly. TPU-native: implemented over
+jax pytrees, which define the same nesting semantics.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def flatten(nest):
+    """Flatten a nested structure into a list of leaves (ref:
+    utils.py flatten)."""
+    return jax.tree_util.tree_leaves(
+        nest, is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+
+
+def pack_sequence_as(structure, flat_sequence):
+    """Pack a flat list back into `structure`'s shape (ref:
+    utils.py:167)."""
+    treedef = jax.tree_util.tree_structure(
+        structure, is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+    return jax.tree_util.tree_unflatten(treedef, list(flat_sequence))
+
+
+def map_structure(func, *structures):
+    """Apply func leaf-wise across parallel structures (ref:
+    utils.py:189)."""
+    return jax.tree_util.tree_map(
+        func, *structures,
+        is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+
+
+__all__ = ["flatten", "pack_sequence_as", "map_structure"]

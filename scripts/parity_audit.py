@@ -267,7 +267,7 @@ _INTERNAL_MODULES = {
     "fluid.layers.collective", "fluid.layers.distributions",
     "fluid.layers.layer_function_generator",
     "fluid.layers.learning_rate_scheduler", "fluid.layers.sequence_lod",
-    "fluid.layers.utils", "fluid.transpiler.collective",
+    "fluid.transpiler.collective",
     "fluid.transpiler.geo_sgd_transpiler",
     "fluid.transpiler.memory_optimization_transpiler",
     "fluid.transpiler.ps_dispatcher", "incubate.complex.helper",

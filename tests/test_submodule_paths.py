@@ -40,6 +40,7 @@ class TestModulePaths:
             "fluid.dataloader.sampler", "fluid.dataloader.batch_sampler",
             "fluid.transpiler", "fluid.transpiler.distribute_transpiler",
             "text.datasets.imdb", "text.datasets.wmt16",
+            "fluid.layers.utils",
         ]:
             importlib.import_module(f"paddle_tpu.{mod}")
 
@@ -50,6 +51,17 @@ class TestModulePaths:
         from paddle_tpu.optimizer.adam import Adam  # noqa: F401
         from paddle_tpu.tensor.stat import mean  # noqa: F401
         assert isinstance(get_device(), str)
+
+    def test_nest_utils(self):
+        from paddle_tpu.fluid.layers.utils import flatten, map_structure, \
+            pack_sequence_as
+
+        s = {"a": [1, 2], "b": (3,)}
+        fl = flatten(s)
+        assert fl == [1, 2, 3]
+        assert pack_sequence_as(s, [x * 2 for x in fl]) == \
+            {"a": [2, 4], "b": (6,)}
+        assert map_structure(lambda x: x + 1, s)["b"] == (4,)
 
     def test_dtype_predicates(self):
         t = paddle.to_tensor(np.ones(3, np.float32))
