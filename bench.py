@@ -381,6 +381,19 @@ def _bench_decode(on_tpu):
     if not on_tpu:
         record["degraded"] = True
     print(json.dumps(record))
+    # weight-only int8 decode (W8A16): the serving-side lever — measure
+    # alongside, keep the recorded metric bf16 for cross-round comparability
+    if on_tpu:
+        model.generate(ids, new, weight_quant="int8").numpy()  # quant+compile
+        dt8 = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            model.generate(ids, new, weight_quant="int8").numpy()
+            dt8 = min(dt8, time.perf_counter() - t0)
+        dt8 = max(dt8 - floor, 1e-9)
+        print(f"# w8a16 decode: {toks/dt8:,.0f} tok/s "
+              f"({dt8/new*1e3:.2f} ms/token-step, "
+              f"{dt/dt8:.2f}x vs bf16 at this batch)", file=sys.stderr)
     print(f"# dispatch_floor={floor*1e3:.1f}ms (subtracted)", file=sys.stderr)
     print(f"# decode batch={batch} prompt={prompt} new={new} "
           f"step={dt/new*1000:.2f}ms/token params={n_params/1e6:.1f}M "

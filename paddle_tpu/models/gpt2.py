@@ -154,7 +154,7 @@ class GPT2(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens, temperature=0.0,
                  eos_token_id=None, seed=0, top_k=0, top_p=1.0,
-                 pad_token_id=None):
+                 pad_token_id=None, weight_quant=None):
         """Autoregressive decoding with a KV cache (serving path; ref
         capability: fluid beam_search/sampling decode ops). TPU-first:
         static shapes throughout — prefill compiles once per prompt shape,
@@ -189,6 +189,24 @@ class GPT2(nn.Layer):
                     "prompts must be LEFT-padded (pad tokens only at the "
                     "start of each row)")
         params, _ = self.functional_state()
+        if weight_quant == "int8":
+            # weight-only int8 (W8A16): decode is weight-STREAM bound, and
+            # the int8->bf16 dequant fuses into the dot's operand pipeline
+            # (measured ~1.9x on the streaming path, PERF.md) — halve the
+            # per-token parameter stream, keep activations bf16. The
+            # quantization itself is ~250 device ops over 124M params, so
+            # it is cached per weight version (serving calls generate in
+            # a loop).
+            marker = id(self.wte.weight._value)
+            cached = getattr(self, "_w8_cache", None)
+            if cached is None or cached[0] != marker:
+                cached = (marker,
+                          _quantize_decode_weights_int8(params, self.cfg))
+                self._w8_cache = cached
+            params = cached[1]
+        elif weight_quant is not None:
+            raise ValueError(f"unknown weight_quant {weight_quant!r} "
+                             "(supported: 'int8')")
         out = _generate_jit(self.cfg, params, ids, max_new_tokens,
                             temperature,
                             -1 if eos_token_id is None else int(eos_token_id),
@@ -196,6 +214,36 @@ class GPT2(nn.Layer):
                             min(int(top_k), self.cfg.vocab_size), top_p,
                             -1 if pad_token_id is None else int(pad_token_id))
         return Tensor(out, stop_gradient=True)
+
+
+def _quantize_decode_weights_int8(params, cfg):
+    """Per-channel symmetric int8 for the decode path's big 2-D weights.
+    Each quantized entry replaces `name` with `name + "::w8"` holding
+    (codes int8, scale bf16); the decode fn detects the key at trace time
+    and applies the scale AFTER the contraction (epilogue-fused). wte is
+    quantized per-ROW so both the embedding gather and the tied head
+    share one scale vector."""
+    import jax.numpy as jnp
+
+    out = dict(params)
+
+    def quant(name, axis):
+        w = out.pop(name)
+        amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis,
+                       keepdims=True)
+        scale = (jnp.maximum(amax, 1e-12) / 127.0)
+        codes = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                         -127, 127).astype(jnp.int8)
+        out[name + "::w8"] = (codes,
+                              scale.squeeze(axis).astype(jnp.bfloat16))
+
+    quant("wte.weight", 1)  # per-row: shared by gather and tied head
+    if not cfg.tie_embeddings:
+        quant("lm_head.weight", 0)
+    for i in range(cfg.num_layers):
+        for part in ("qkv_proj", "out_proj", "fc1", "fc2"):
+            quant(f"h.{i}.{part}.weight", 0)  # per-output-column
+    return out
 
 
 def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed,
@@ -242,14 +290,28 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
         var = jnp.var(x, axis=-1, keepdims=True)
         return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
 
+    def matw(p, name, x, dt):
+        # weight-only int8 (W8A16): `name::w8` holds (codes, per-out-col
+        # scale); the int8->dt convert fuses into the dot's operand
+        # pipeline (halves the weight stream — decode is stream-bound)
+        # and the scale multiplies the [.., N] OUTPUT (epilogue-fused)
+        q = p.get(name + "::w8")
+        if q is None:
+            return x @ p[name]
+        codes, sc = q
+        return (x @ codes.astype(dt)) * sc.astype(dt)
+
     def mlp(p, i, x):
-        hdn = jax.nn.gelu(x @ p[f"h.{i}.fc1.weight"] + p[f"h.{i}.fc1.bias"],
-                          approximate=True)
-        return hdn @ p[f"h.{i}.fc2.weight"] + p[f"h.{i}.fc2.bias"]
+        dt = x.dtype
+        hdn = jax.nn.gelu(
+            matw(p, f"h.{i}.fc1.weight", x, dt) + p[f"h.{i}.fc1.bias"],
+            approximate=True)
+        return matw(p, f"h.{i}.fc2.weight", hdn, dt) + p[f"h.{i}.fc2.bias"]
 
     def qkv_split(p, i, a):
         # a: [..., E] -> q, k, v each [..., H, Dh]
-        qkv = a @ p[f"h.{i}.qkv_proj.weight"] + p[f"h.{i}.qkv_proj.bias"]
+        qkv = matw(p, f"h.{i}.qkv_proj.weight", a, a.dtype) \
+            + p[f"h.{i}.qkv_proj.bias"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         new = q.shape[:-1] + (H, Dh)
         return q.reshape(new), k.reshape(new), v.reshape(new)
@@ -257,13 +319,29 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
     def step_fn(params, ids, key0, temp, eos, top_p, pad):
         B, S0 = ids.shape
         S = S0 + max_new
-        wte = params["wte.weight"]
         wpe = params["wpe.weight"]
-        dt = wte.dtype
+        dt = params["ln_f.weight"].dtype
+        w8 = params.get("wte.weight::w8")
+        if w8 is None:
+            wte_full = params["wte.weight"]
+
+            def embed(t):
+                return wte_full[t]
+        else:
+            wte_codes, wte_rs = w8  # [V, E] int8, [V] per-row scale
+
+            def embed(t):
+                return wte_codes[t].astype(dt) * wte_rs[t][..., None] \
+                    .astype(dt)
 
         def head(xf):
-            w = wte.T if tied else params["lm_head.weight"]
-            return (xf @ w).astype(jnp.float32)
+            if tied:
+                if w8 is None:
+                    return (xf @ wte_full.T).astype(jnp.float32)
+                return ((xf @ wte_codes.T.astype(dt))
+                        * wte_rs[None, :].astype(dt)).astype(jnp.float32)
+            return matw(params, "lm_head.weight", xf,
+                        dt).astype(jnp.float32)
 
         # LEFT-padding support: pad is a traced token id (-1 = no padding,
         # valid everywhere). Pad keys are masked out of attention, pad
@@ -274,7 +352,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
         n_valid = valid.sum(axis=1)                  # [B]
 
         # ---- prefill over the prompt (causal full attention) ----
-        x = wte[ids] + wpe[pos]
+        x = embed(ids) + wpe[pos]
         ck = jnp.zeros((L, B, H, S, Dh), dt)
         cv = jnp.zeros((L, B, H, S, Dh), dt)
         causal = jnp.tril(jnp.ones((S0, S0), bool))
@@ -292,7 +370,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
             w = jax.nn.softmax(s, axis=-1).astype(dt)
             o = jnp.einsum("bhqk,bhkd->bhqd", w, v)
             o = o.transpose(0, 2, 1, 3).reshape(B, S0, E)
-            x = x + o @ params[f"h.{i}.out_proj.weight"] \
+            x = x + matw(params, f"h.{i}.out_proj.weight", o, dt) \
                 + params[f"h.{i}.out_proj.bias"]
             m = ln(x, params[f"h.{i}.ln_2.weight"],
                    params[f"h.{i}.ln_2.bias"])
@@ -337,7 +415,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
         def body(carry, step):
             tok, done, ck, cv, key = carry
             t = S0 + step  # absolute cache slot of `tok`
-            x = wte[tok] + wpe[n_valid + step]      # per-row position
+            x = embed(tok) + wpe[n_valid + step]    # per-row position
             for i in range(L):
                 a = ln(x, params[f"h.{i}.ln_1.weight"],
                        params[f"h.{i}.ln_1.bias"])
@@ -350,7 +428,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
                               & vfull[:, None, :], s, -1e30)
                 w = jax.nn.softmax(s, axis=-1).astype(dt)
                 o = jnp.einsum("bhs,bhsd->bhd", w, cv[i]).reshape(B, E)
-                x = x + o @ params[f"h.{i}.out_proj.weight"] \
+                x = x + matw(params, f"h.{i}.out_proj.weight", o, dt) \
                     + params[f"h.{i}.out_proj.bias"]
                 m = ln(x, params[f"h.{i}.ln_2.weight"],
                        params[f"h.{i}.ln_2.bias"])
