@@ -234,8 +234,12 @@ def _quantize_decode_weights_int8(params, cfg):
         scale = (jnp.maximum(amax, 1e-12) / 127.0)
         codes = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
                          -127, 127).astype(jnp.int8)
-        out[name + "::w8"] = (codes,
-                              scale.squeeze(axis).astype(jnp.bfloat16))
+        # FLAT keys (not tuples) so the dict serializes through the
+        # standard .pdiparams npz artifact unchanged; scales in the
+        # compute dtype (bf16) for the eager path — export converts them
+        # to f32 for the npz, which cannot round-trip bf16 (|V2 descr)
+        out[name + "::w8c"] = codes
+        out[name + "::w8s"] = scale.squeeze(axis).astype(w.dtype)
 
     quant("wte.weight", 1)  # per-row: shared by gather and tied head
     if not cfg.tie_embeddings:
@@ -295,11 +299,10 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
         # scale); the int8->dt convert fuses into the dot's operand
         # pipeline (halves the weight stream — decode is stream-bound)
         # and the scale multiplies the [.., N] OUTPUT (epilogue-fused)
-        q = p.get(name + "::w8")
-        if q is None:
+        codes = p.get(name + "::w8c")
+        if codes is None:
             return x @ p[name]
-        codes, sc = q
-        return (x @ codes.astype(dt)) * sc.astype(dt)
+        return (x @ codes.astype(dt)) * p[name + "::w8s"].astype(dt)
 
     def mlp(p, i, x):
         dt = x.dtype
@@ -321,14 +324,14 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
         S = S0 + max_new
         wpe = params["wpe.weight"]
         dt = params["ln_f.weight"].dtype
-        w8 = params.get("wte.weight::w8")
-        if w8 is None:
+        wte_codes = params.get("wte.weight::w8c")
+        if wte_codes is None:
             wte_full = params["wte.weight"]
 
             def embed(t):
                 return wte_full[t]
         else:
-            wte_codes, wte_rs = w8  # [V, E] int8, [V] per-row scale
+            wte_rs = params["wte.weight::w8s"]  # [V] per-row scale
 
             def embed(t):
                 return wte_codes[t].astype(dt) * wte_rs[t][..., None] \
@@ -336,7 +339,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
 
         def head(xf):
             if tied:
-                if w8 is None:
+                if wte_codes is None:
                     return (xf @ wte_full.T).astype(jnp.float32)
                 return ((xf @ wte_codes.T.astype(dt))
                         * wte_rs[None, :].astype(dt)).astype(jnp.float32)
@@ -455,7 +458,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
 
 def export_generator(model: "GPT2", path_prefix, prompt_len,
                      max_new_tokens, top_k=0, top_p_enabled=False,
-                     batch_size=None):
+                     batch_size=None, weight_quant=None):
     """Serialize the KV-cache decode program as the standard deployment
     artifact (.pdmodel StableHLO + .pdiparams npz) so text generation runs
     in a serving process with NO Python model class:
@@ -492,6 +495,19 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
                       pad)
 
     params, _ = model.functional_state()
+    if weight_quant == "int8":
+        # W8A16 artifact: the served program streams int8 weights
+        # (1.8-2.7x decode tokens/s at small batch, PERF.md); codes and
+        # scales ride the standard npz as flat keys. Scales are stored
+        # f32 (npz cannot round-trip bf16); the traced matw casts them
+        # to the compute dtype.
+        import jax.numpy as _jnp
+        params = _quantize_decode_weights_int8(params, cfg)
+        params = {k: (v.astype(_jnp.float32) if k.endswith("::w8s")
+                      else v) for k, v in params.items()}
+    elif weight_quant is not None:
+        raise ValueError(f"unknown weight_quant {weight_quant!r} "
+                         "(supported: 'int8')")
     if batch_size is None:
         (bdim,) = jit_mod._symbolic_dims(1)
     else:
@@ -512,7 +528,8 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
             p_specs, {}, *args)
     except Exception:
         exported = jexport.export(jf)(p_specs, {}, *args)
-    meta = {"kind": "gpt2_generator", "prompt_len": int(prompt_len),
+    meta = {"kind": "gpt2_generator", "weight_quant": weight_quant,
+            "prompt_len": int(prompt_len),
             "max_new_tokens": int(max_new_tokens), "top_k": int(top_k),
             "top_p_enabled": bool(top_p_enabled),
             "inputs": ["ids[int32]", "seed[uint32]",

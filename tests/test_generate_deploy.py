@@ -50,3 +50,26 @@ def test_exported_generator_sampling_reproducible(tmp_path):
                np.float32(1.0), np.int32(-1)).numpy()
     np.testing.assert_array_equal(a, b)
     assert a.shape == (1, 9)
+
+
+def test_w8a16_artifact_roundtrip(tmp_path):
+    """Weight-only int8 decode artifact: int8 codes + f32 scales ride the
+    standard npz; the served program matches eager int8 greedy exactly."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config, export_generator
+
+    paddle.seed(0)
+    m = GPT2(GPT2Config.tiny())
+    m.eval()
+    ids = np.random.RandomState(0).randint(5, 200, (2, 10)).astype(np.int32)
+    ref = m.generate(ids, 8, weight_quant="int8").numpy()
+    prefix = str(tmp_path / "gen8")
+    export_generator(m, prefix, prompt_len=10, max_new_tokens=8,
+                     batch_size=2, weight_quant="int8")
+    served = paddle.jit.load(prefix)
+    out = np.asarray(served(ids, np.uint32(0), np.float32(0.0),
+                            np.int32(-1), np.float32(1.0), np.int32(-1)))
+    assert (out == ref).all()
+    z = np.load(prefix + ".pdiparams")
+    assert sum(1 for k in z.files if z[k].dtype == np.int8) > 0, \
+        "artifact should carry int8 weight codes"
