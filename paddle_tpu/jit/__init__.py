@@ -271,10 +271,24 @@ def write_artifact(path_prefix, exported, params, bufs, meta):
         f.write(header)
         f.write(blob)
     arrays = {}
+
+    def put(key, v):
+        a = np.asarray(v)
+        if a.dtype.isbuiltin != 1:
+            # npz writes extension dtypes (bfloat16, float8_*) with a raw
+            # '|V' descr that np.load cannot interpret — a bf16 artifact
+            # (the recommended SERVING dtype) then fails at Exported.call.
+            # Store a bit-preserving uint8 view plus a dtype sidecar and
+            # view back on load.
+            arrays["dt:" + key] = np.frombuffer(
+                a.dtype.name.encode(), dtype=np.uint8)
+            a = a.view(np.uint8).reshape(a.shape + (a.dtype.itemsize,))
+        arrays[key] = a
+
     for k, v in (params or {}).items():
-        arrays["p:" + k] = np.asarray(v)
+        put("p:" + k, v)
     for k, v in (bufs or {}).items():
-        arrays["b:" + k] = np.asarray(v)
+        put("b:" + k, v)
     buf = _io.BytesIO()
     np.savez(buf, **arrays)
     with open(path_prefix + ".pdiparams", "wb") as f:
@@ -301,8 +315,19 @@ def read_artifact(path_prefix):
     exported = jexport.deserialize(blob)
     with open(path_prefix + ".pdiparams", "rb") as f:
         npz = np.load(f, allow_pickle=False)
-        params = {k[2:]: npz[k] for k in npz.files if k.startswith("p:")}
-        bufs = {k[2:]: npz[k] for k in npz.files if k.startswith("b:")}
+
+        def get(k):
+            a = npz[k]
+            dk = "dt:" + k
+            if dk in npz.files:
+                import ml_dtypes  # noqa: F401 — registers extension dtypes
+                dt = np.dtype(bytes(npz[dk]).decode())
+                a = a.view(dt).reshape(a.shape[:-1])
+            return a
+
+        params = {k[2:]: get(k) for k in npz.files
+                  if k.startswith("p:")}
+        bufs = {k[2:]: get(k) for k in npz.files if k.startswith("b:")}
     return exported, params, bufs, meta
 
 

@@ -252,3 +252,30 @@ class TestInferenceAuxSurface:
         with pytest.raises(ValueError):
             infer.PredictorPool(
                 infer.Config(prefix + ".pdmodel", prefix + ".pdiparams"), 0)
+
+
+def test_bf16_artifact_roundtrip(tmp_path):
+    """jit.save/load of a BF16 model — the recommended serving dtype.
+    npz writes extension dtypes as raw '|V2' void; the artifact stores a
+    bit-preserving view + dtype sidecar and views back on load (this was
+    broken before r4: Exported.call rejected the void arrays)."""
+    import ml_dtypes
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    net.to(dtype="bfloat16")
+    prefix = str(tmp_path / "m_bf16")
+    jit.save(net, prefix,
+             input_spec=[paddle.static.InputSpec([-1, 4], "bfloat16")])
+    served = jit.load(prefix)
+    x = np.ones((2, 4), np.float32).astype(ml_dtypes.bfloat16)
+    out = np.asarray(served(x)._value if hasattr(served(x), "_value")
+                     else served(x))
+    ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+    assert out.astype(np.float32) == pytest.approx(
+        ref.astype(np.float32), abs=1e-2)
