@@ -154,7 +154,7 @@ class GPT2(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens, temperature=0.0,
                  eos_token_id=None, seed=0, top_k=0, top_p=1.0,
-                 pad_token_id=None, weight_quant=None):
+                 pad_token_id=None, weight_quant=None, kv_quant=None):
         """Autoregressive decoding with a KV cache (serving path; ref
         capability: fluid beam_search/sampling decode ops). TPU-first:
         static shapes throughout — prefill compiles once per prompt shape,
@@ -207,12 +207,16 @@ class GPT2(nn.Layer):
         elif weight_quant is not None:
             raise ValueError(f"unknown weight_quant {weight_quant!r} "
                              "(supported: 'int8')")
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"unknown kv_quant {kv_quant!r} "
+                             "(supported: 'int8')")
         out = _generate_jit(self.cfg, params, ids, max_new_tokens,
                             temperature,
                             -1 if eos_token_id is None else int(eos_token_id),
                             int(seed),
                             min(int(top_k), self.cfg.vocab_size), top_p,
-                            -1 if pad_token_id is None else int(pad_token_id))
+                            -1 if pad_token_id is None else int(pad_token_id),
+                            kv_quant == "int8")
         return Tensor(out, stop_gradient=True)
 
 
@@ -251,14 +255,14 @@ def _quantize_decode_weights_int8(params, cfg):
 
 
 def _generate_jit(cfg: GPT2Config, params, ids, max_new, temp, eos, seed,
-                  top_k=0, top_p=1.0, pad=-1):
+                  top_k=0, top_p=1.0, pad=-1, kv_quant=False):
     import jax
     import jax.numpy as jnp
 
     spec = (cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
             cfg.layer_norm_epsilon, cfg.tie_embeddings)
-    fn = _generate_impl(spec, max_new, top_k, top_p < 1.0)
+    fn = _generate_impl(spec, max_new, top_k, top_p < 1.0, bool(kv_quant))
     # key/temperature/eos/top_p/pad are traced arguments: new values reuse
     # the compiled program (static: max_new — the scan length — top_k,
     # which fixes the lax.top_k output shape, and WHETHER nucleus
@@ -273,12 +277,14 @@ import functools as _functools  # noqa: E402
 
 
 @_functools.lru_cache(maxsize=16)
-def _generate_impl(spec, max_new, top_k=0, nucleus=False):
+def _generate_impl(spec, max_new, top_k=0, nucleus=False, kv_quant=False):
     import jax
-    return jax.jit(_build_decode_fn(spec, max_new, top_k, nucleus))
+    return jax.jit(_build_decode_fn(spec, max_new, top_k, nucleus,
+                                    kv_quant))
 
 
-def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
+def _build_decode_fn(spec, max_new, top_k=0, nucleus=False,
+                     kv_quant=False):
     """Build the raw (params, ids, key, temp, eos, top_p) -> tokens decode
     function for one static configuration. Two XLA computations total: a
     prefill over the prompt and a lax.scan of single-token steps against a
@@ -356,8 +362,30 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
 
         # ---- prefill over the prompt (causal full attention) ----
         x = embed(ids) + wpe[pos]
-        ck = jnp.zeros((L, B, H, S, Dh), dt)
-        cv = jnp.zeros((L, B, H, S, Dh), dt)
+        if kv_quant:
+            # int8 KV cache, per-(position) vector scales: at large batch
+            # the decode becomes cache-READ bound and halving the KV
+            # stream is the remaining lever (weights: see ::w8c)
+            ck = jnp.zeros((L, B, H, S, Dh), jnp.int8)
+            cv = jnp.zeros((L, B, H, S, Dh), jnp.int8)
+            ksc = jnp.zeros((L, B, H, S), dt)
+            vsc = jnp.zeros((L, B, H, S), dt)
+        else:
+            ck = jnp.zeros((L, B, H, S, Dh), dt)
+            cv = jnp.zeros((L, B, H, S, Dh), dt)
+            ksc = vsc = jnp.zeros((0,), dt)
+
+        def kv_enc(t):
+            # [..., Dh] -> (int8 codes, per-vector scale [...])
+            amax = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1)
+            sc = jnp.maximum(amax, 1e-12) / 127.0
+            codes = jnp.clip(jnp.round(t.astype(jnp.float32)
+                                       / sc[..., None]),
+                             -127, 127).astype(jnp.int8)
+            return codes, sc.astype(dt)
+
+        def kv_dec(codes, sc):
+            return codes.astype(dt) * sc[..., None]
         causal = jnp.tril(jnp.ones((S0, S0), bool))
         kmask = causal[None, None] & valid[:, None, None, :]
         for i in range(L):
@@ -365,8 +393,16 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
                    params[f"h.{i}.ln_1.bias"])
             q, k, v = qkv_split(params, i, a)       # [B, S0, H, Dh]
             q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-            ck = ck.at[i, :, :, :S0].set(k)
-            cv = cv.at[i, :, :, :S0].set(v)
+            if kv_quant:
+                kc, ks = kv_enc(k)
+                vc, vs = kv_enc(v)
+                ck = ck.at[i, :, :, :S0].set(kc)
+                cv = cv.at[i, :, :, :S0].set(vc)
+                ksc = ksc.at[i, :, :, :S0].set(ks)
+                vsc = vsc.at[i, :, :, :S0].set(vs)
+            else:
+                ck = ck.at[i, :, :, :S0].set(k)
+                cv = cv.at[i, :, :, :S0].set(v)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
                 jnp.float32) * scale
             s = jnp.where(kmask, s, -1e30)
@@ -416,21 +452,32 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
             [valid, jnp.ones((B, max_new), bool)], axis=1)  # [B, S]
 
         def body(carry, step):
-            tok, done, ck, cv, key = carry
+            tok, done, ck, cv, ksc, vsc, key = carry
             t = S0 + step  # absolute cache slot of `tok`
             x = embed(tok) + wpe[n_valid + step]    # per-row position
             for i in range(L):
                 a = ln(x, params[f"h.{i}.ln_1.weight"],
                        params[f"h.{i}.ln_1.bias"])
                 q, k, v = qkv_split(params, i, a)   # [B, H, Dh]
-                ck = ck.at[i, :, :, t].set(k)
-                cv = cv.at[i, :, :, t].set(v)
-                s = jnp.einsum("bhd,bhsd->bhs", q, ck[i]).astype(
+                if kv_quant:
+                    kc, ks = kv_enc(k)
+                    vc, vs = kv_enc(v)
+                    ck = ck.at[i, :, :, t].set(kc)
+                    cv = cv.at[i, :, :, t].set(vc)
+                    ksc = ksc.at[i, :, :, t].set(ks)
+                    vsc = vsc.at[i, :, :, t].set(vs)
+                    kd = kv_dec(ck[i], ksc[i])
+                    vd = kv_dec(cv[i], vsc[i])
+                else:
+                    ck = ck.at[i, :, :, t].set(k)
+                    cv = cv.at[i, :, :, t].set(v)
+                    kd, vd = ck[i], cv[i]
+                s = jnp.einsum("bhd,bhsd->bhs", q, kd).astype(
                     jnp.float32) * scale
                 s = jnp.where((jnp.arange(s.shape[-1]) <= t)[None, None]
                               & vfull[:, None, :], s, -1e30)
                 w = jax.nn.softmax(s, axis=-1).astype(dt)
-                o = jnp.einsum("bhs,bhsd->bhd", w, cv[i]).reshape(B, E)
+                o = jnp.einsum("bhs,bhsd->bhd", w, vd).reshape(B, E)
                 x = x + matw(params, f"h.{i}.out_proj.weight", o, dt) \
                     + params[f"h.{i}.out_proj.bias"]
                 m = ln(x, params[f"h.{i}.ln_2.weight"],
@@ -443,12 +490,12 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False):
             # eos is traced (-1 disables): once done, keep emitting eos
             nxt = jnp.where(done, eos, nxt)
             done = done | ((nxt == eos) & (eos >= 0))
-            return (nxt, done, ck, cv, key), tok
+            return (nxt, done, ck, cv, ksc, vsc, key), tok
 
-        (last, _, _, _, _), toks = jax.lax.scan(
-            body, (tok0, done0, ck, cv, key0),
+        (last, *_), toks = jax.lax.scan(
+            body, (tok0, done0, ck, cv, ksc, vsc, key0),
             jnp.arange(max_new - 1)) if max_new > 1 else \
-            ((tok0, None, None, None, None), jnp.zeros((0, B), jnp.int32))
+            ((tok0,), jnp.zeros((0, B), jnp.int32))
         seq = jnp.concatenate([ids, toks.T.astype(jnp.int32),
                                last[:, None]], axis=1)
         return seq

@@ -190,3 +190,44 @@ class TestWeightOnlyInt8Decode:
         m.eval()
         with pytest.raises(ValueError, match="int8"):
             m.generate(np.zeros((1, 8), np.int32), 2, weight_quant="int4")
+
+
+class TestInt8KVCache:
+    def test_kv8_greedy_parity(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+        paddle.seed(0)
+        m = GPT2(GPT2Config.tiny())
+        m.eval()
+        ids = np.random.RandomState(5).randint(5, 200, (2, 12)).astype(
+            np.int32)
+        a = m.generate(ids, 16).numpy()
+        b = m.generate(ids, 16, kv_quant="int8").numpy()
+        assert (a == b).mean() > 0.9
+        # stacks with weight-only int8
+        c = m.generate(ids, 16, kv_quant="int8",
+                       weight_quant="int8").numpy()
+        assert (c[:, :12] == ids).all()
+
+    def test_kv8_left_padded(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+        paddle.seed(1)
+        m = GPT2(GPT2Config.tiny())
+        m.eval()
+        p = np.array([[0, 0, 7, 9], [3, 5, 7, 9]], np.int32)
+        o = m.generate(p, 6, kv_quant="int8", pad_token_id=0).numpy()
+        assert o.shape == (2, 10) and (o[:, :4] == p).all()
+
+    def test_unknown_kv_quant_raises(self):
+        import pytest
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+        m = GPT2(GPT2Config.tiny())
+        m.eval()
+        with pytest.raises(ValueError, match="int8"):
+            m.generate(np.zeros((1, 8), np.int32), 2, kv_quant="fp4")
