@@ -384,8 +384,6 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False,
                              -127, 127).astype(jnp.int8)
             return codes, sc.astype(dt)
 
-        def kv_dec(codes, sc):
-            return codes.astype(dt) * sc[..., None]
         causal = jnp.tril(jnp.ones((S0, S0), bool))
         kmask = causal[None, None] & valid[:, None, None, :]
         for i in range(L):
@@ -466,18 +464,27 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False,
                     cv = cv.at[i, :, :, t].set(vc)
                     ksc = ksc.at[i, :, :, t].set(ks)
                     vsc = vsc.at[i, :, :, t].set(vs)
-                    kd = kv_dec(ck[i], ksc[i])
-                    vd = kv_dec(cv[i], vsc[i])
+                    # fold the per-vector scales into the SMALL tensors so
+                    # the big cache is consumed as raw int8 codes (the
+                    # convert fuses into the einsum operand like the
+                    # weight dot): scores scale per position AFTER the
+                    # contraction; v's scale rides the [B,H,S] probs
+                    s = jnp.einsum("bhd,bhsd->bhs", q,
+                                   ck[i].astype(dt)).astype(jnp.float32) \
+                        * ksc[i].astype(jnp.float32) * scale
                 else:
                     ck = ck.at[i, :, :, t].set(k)
                     cv = cv.at[i, :, :, t].set(v)
-                    kd, vd = ck[i], cv[i]
-                s = jnp.einsum("bhd,bhsd->bhs", q, kd).astype(
-                    jnp.float32) * scale
+                    s = jnp.einsum("bhd,bhsd->bhs", q, ck[i]).astype(
+                        jnp.float32) * scale
                 s = jnp.where((jnp.arange(s.shape[-1]) <= t)[None, None]
                               & vfull[:, None, :], s, -1e30)
                 w = jax.nn.softmax(s, axis=-1).astype(dt)
-                o = jnp.einsum("bhs,bhsd->bhd", w, vd).reshape(B, E)
+                if kv_quant:
+                    o = jnp.einsum("bhs,bhsd->bhd", w * vsc[i],
+                                   cv[i].astype(dt)).reshape(B, E)
+                else:
+                    o = jnp.einsum("bhs,bhsd->bhd", w, cv[i]).reshape(B, E)
                 x = x + matw(params, f"h.{i}.out_proj.weight", o, dt) \
                     + params[f"h.{i}.out_proj.bias"]
                 m = ln(x, params[f"h.{i}.ln_2.weight"],
