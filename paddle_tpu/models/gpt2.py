@@ -512,7 +512,7 @@ def _build_decode_fn(spec, max_new, top_k=0, nucleus=False,
 
 def export_generator(model: "GPT2", path_prefix, prompt_len,
                      max_new_tokens, top_k=0, top_p_enabled=False,
-                     batch_size=None, weight_quant=None):
+                     batch_size=None, weight_quant=None, kv_quant=None):
     """Serialize the KV-cache decode program as the standard deployment
     artifact (.pdmodel StableHLO + .pdiparams npz) so text generation runs
     in a serving process with NO Python model class:
@@ -539,9 +539,12 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
     spec = (cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
             cfg.layer_norm_epsilon, cfg.tie_embeddings)
+    if kv_quant not in (None, "int8"):
+        raise ValueError(f"unknown kv_quant {kv_quant!r} "
+                         "(supported: 'int8')")
     decode = _build_decode_fn(spec, int(max_new_tokens),
                               min(int(top_k), cfg.vocab_size),
-                              bool(top_p_enabled))
+                              bool(top_p_enabled), kv_quant == "int8")
 
     def serving_fn(params, bufs, ids, seed, temp, eos, top_p, pad):
         del bufs  # GPT-2 has no buffers; kept for the artifact convention
@@ -580,7 +583,7 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
     except Exception:
         exported = jexport.export(jf)(p_specs, {}, *args)
     meta = {"kind": "gpt2_generator", "weight_quant": weight_quant,
-            "prompt_len": int(prompt_len),
+            "kv_quant": kv_quant, "prompt_len": int(prompt_len),
             "max_new_tokens": int(max_new_tokens), "top_k": int(top_k),
             "top_p_enabled": bool(top_p_enabled),
             "inputs": ["ids[int32]", "seed[uint32]",

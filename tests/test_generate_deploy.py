@@ -73,3 +73,22 @@ def test_w8a16_artifact_roundtrip(tmp_path):
     z = np.load(prefix + ".pdiparams")
     assert sum(1 for k in z.files if z[k].dtype == np.int8) > 0, \
         "artifact should carry int8 weight codes"
+
+
+def test_kv8_w8_artifact_roundtrip(tmp_path):
+    """Peak-throughput serving artifact: int8 KV cache + int8 weights."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config, export_generator
+
+    paddle.seed(0)
+    m = GPT2(GPT2Config.tiny())
+    m.eval()
+    ids = np.random.RandomState(1).randint(5, 200, (2, 10)).astype(np.int32)
+    ref = m.generate(ids, 8, weight_quant="int8", kv_quant="int8").numpy()
+    prefix = str(tmp_path / "gen8kv")
+    export_generator(m, prefix, prompt_len=10, max_new_tokens=8,
+                     batch_size=2, weight_quant="int8", kv_quant="int8")
+    served = paddle.jit.load(prefix)
+    out = np.asarray(served(ids, np.uint32(0), np.float32(0.0),
+                            np.int32(-1), np.float32(1.0), np.int32(-1)))
+    assert (out == ref).all()
