@@ -97,16 +97,42 @@ class TransformerModel(nn.Layer):
             ops.reshape(tgt_out, [-1]),
             label_smoothing=label_smoothing)
 
-    def greedy_decode(self, src_ids, max_len=32):
-        """Greedy generation (host loop; inside each step the forward jits)."""
-        import jax.numpy as jnp
+    def greedy_decode(self, src_ids, max_len=32, use_cache=True):
+        """Greedy generation. use_cache=True (default) encodes the source
+        ONCE and runs the decoder incrementally against the layer-level
+        KV caches (MultiHeadAttention.Cache for self-attention,
+        StaticCache for the cross-attention K/V) — O(S) decoder work per
+        token instead of re-running the full decoder stack
+        (ref capability: the fluid decode loop's cache tensors).
+        use_cache=False keeps the full re-forward path; both produce
+        identical tokens (parity-tested)."""
         b = src_ids.shape[0]
-        tgt = Tensor(np.full((b, 1), self.cfg.bos_id, np.int32))
-        for _ in range(max_len - 1):
-            logits = self(src_ids, tgt)
-            nxt = ops.argmax(logits[:, -1], axis=-1).astype("int32")
-            tgt = ops.concat([tgt, ops.unsqueeze(nxt, 1)], axis=1)
-        return tgt
+        bos = self.cfg.bos_id
+        if not use_cache:
+            tgt = Tensor(np.full((b, 1), bos, np.int32))
+            for _ in range(max_len - 1):
+                logits = self(src_ids, tgt)
+                nxt = ops.argmax(logits[:, -1], axis=-1).astype("int32")
+                tgt = ops.concat([tgt, ops.unsqueeze(nxt, 1)], axis=1)
+            return tgt
+        src = self._embed(self.src_embed, src_ids)
+        memory = self.transformer.encoder(src, None)
+        caches = self.transformer.decoder.gen_cache(memory)
+        tok = Tensor(np.full((b, 1), bos, np.int32))
+        toks = [tok]
+        for step in range(max_len - 1):
+            # one-token embed at absolute position `step` (the host loop
+            # owns the position; _embed's pos_enc slice starts at 0)
+            t = self.dropout(self.tgt_embed(tok) * self.scale
+                             + self.pos_enc[step:step + 1])
+            out, caches = self.transformer.decoder(
+                t, memory, None, None, caches)
+            logits = self.generator(out[:, -1])
+            nxt = ops.unsqueeze(
+                ops.argmax(logits, axis=-1).astype("int32"), 1)
+            toks.append(nxt)
+            tok = nxt
+        return ops.concat(toks, axis=1)
 
     def beam_search_decode(self, src_ids, beam_size=4, max_len=32,
                            length_penalty=0.6):

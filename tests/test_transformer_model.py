@@ -66,3 +66,24 @@ class TestNamespaces:
                      "incubate", "parallel", "text", "linalg", "fluid",
                      "models", "distribution"]:
             assert hasattr(paddle, name), name
+
+
+def test_cached_greedy_decode_matches_full_reforward():
+    """use_cache=True runs the decoder incrementally against the
+    layer-level KV caches (Cache + StaticCache); tokens must match the
+    full-re-forward path exactly."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.transformer import TransformerConfig, \
+        TransformerModel
+
+    paddle.seed(0)
+    cfg = TransformerConfig(src_vocab_size=120, tgt_vocab_size=130,
+                            d_model=32, nhead=4, num_encoder_layers=2,
+                            num_decoder_layers=2, dim_feedforward=64,
+                            dropout=0.0)
+    m = TransformerModel(cfg)
+    m.eval()
+    src = np.random.RandomState(0).randint(4, 100, (3, 9)).astype(np.int32)
+    full = m.greedy_decode(src, max_len=12, use_cache=False).numpy()
+    cached = m.greedy_decode(src, max_len=12, use_cache=True).numpy()
+    assert (full == cached).all()
