@@ -156,8 +156,21 @@ class TransformerModel(nn.Layer):
             (B, 1))                                          # [B, K]
         finished = jnp.zeros((B, K), bool)
         row = jnp.arange(B)[:, None]
+        # encode ONCE; decode incrementally against layer caches, which
+        # are reordered by the winning beam index each step (the fluid
+        # decode loop's cache-gather, done with a pytree gather here)
+        src_e = self._embed(self.src_embed, Tensor(srcK))
+        memory = self.transformer.encoder(src_e, None)
+        caches = self.transformer.decoder.gen_cache(memory)
+        step_tok = Tensor(tgt[:, -1:])
+        pos = 0
         for _ in range(max_len - 1):
-            logits = self(Tensor(srcK), Tensor(tgt))._value[:, -1]
+            t = self.dropout(self.tgt_embed(step_tok) * self.scale
+                             + self.pos_enc[pos:pos + 1])
+            out, caches = self.transformer.decoder(t, memory, None, None,
+                                                   caches)
+            pos += 1
+            logits = self.generator(out[:, -1])._value
             logp = jax.nn.log_softmax(
                 logits.astype(jnp.float32), -1).reshape(B, K, V)
             eos_only = jnp.where(jnp.arange(V)[None, None, :] == eos,
@@ -169,6 +182,11 @@ class TransformerModel(nn.Layer):
             tok = (top_i % V).astype(jnp.int32)
             gather = (row * K + beam_idx).reshape(-1)
             tgt = jnp.concatenate([tgt[gather], tok.reshape(-1, 1)], 1)
+            # reorder every cache row to follow its winning beam
+            caches = jax.tree_util.tree_map(
+                lambda c: Tensor(c._value[gather])
+                if isinstance(c, Tensor) else c[gather], caches)
+            step_tok = Tensor(tok.reshape(-1, 1))
             finished = finished[row, beam_idx] | (tok == eos)
             scores = top_s
             if bool(finished.all()):
