@@ -99,6 +99,25 @@ class TestElementwiseGrads:
         ("sigmoid", (_any((2, 3)),)),
         ("maximum", (_spread((2, 3)), _spread((2, 3), 9))),
         ("minimum", (_spread((2, 3)), _spread((2, 3), 10))),
+        # r4 widening: transcendental/cumulative/shape ops
+        ("logsumexp", (_any((2, 3)),)),
+        ("cumsum", (_any((2, 3)),)),
+        ("cumprod", (_pos((2, 3)),)),
+        ("softplus", (_any((2, 3)),)),
+        ("expm1", (_any((2, 3)),)),
+        ("log1p", (_pos((2, 3)),)),
+        ("log2", (_pos((2, 3)),)),
+        ("log10", (_pos((2, 3)),)),
+        ("atan", (_any((2, 3)),)),
+        ("sinh", (_any((2, 3)),)),
+        ("cosh", (_any((2, 3)),)),
+        ("tan", (_any((2, 3), 11),)),
+        ("asinh", (_any((2, 3)),)),
+        ("softsign", (_any((2, 3)),)),
+        ("celu", (_any((2, 3)),)),
+        ("trace", (_any((3, 3)),)),
+        ("outer", (_any((3,)), _any((4,), 12))),
+        ("kron", (_any((2, 2)), _any((2, 3), 13))),
     ])
     def test_grad(self, op, args):
         fn = getattr(P, op) if hasattr(P, op) \
