@@ -64,13 +64,25 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     # per-step residency is a few blocks regardless of sequence length —
     # no VMEM-driven length cap. (The fused one-pass backward, which does
     # pin full Q/dO, self-gates on sq in _fa_bwd.)
-    use_flash = (_on_tpu() and attn_mask is None and dropout_p == 0.0
+    # a [B,1,1,Sk] additive mask (the padding-mask form every BERT-class
+    # encoder builds) is a PER-KEY bias the kernel streams natively
+    mask_v = attn_mask
+    if mask_v is not None and hasattr(mask_v, "_value"):
+        mask_v = mask_v._value
+    key_bias = None
+    if mask_v is not None and getattr(mask_v, "ndim", 0) == 4 \
+            and mask_v.shape[1] == 1 and mask_v.shape[2] == 1:
+        key_bias = mask_v[:, 0, 0, :]
+    use_flash = (_on_tpu()
+                 and (attn_mask is None or key_bias is not None)
+                 and dropout_p == 0.0
                  and not return_weights and q.shape[-2] >= 128
                  and q.shape[-1] in (32, 64, 128, 256)
                  and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0)
     if use_flash:
         try:
-            from .pallas.flash_attention import flash_attention
+            from .pallas.flash_attention import (flash_attention,
+                                                 flash_attention_bias)
             # prescale Q once ([B,H,S,D] pass) instead of scaling every
             # score tile in fwd + bwd recompute (S^2-proportional VPU work);
             # the chain rule through the prescale restores dq's scale
@@ -82,8 +94,13 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             # fwd+bwd had ZERO)
             from ._registry import raw
             qv, kv, vv = raw(q), raw(k), raw(v)
-            out = flash_attention((qv * sc).astype(qv.dtype), kv, vv,
-                                  causal=is_causal, scale=1.0)
+            if key_bias is None:
+                out = flash_attention((qv * sc).astype(qv.dtype), kv, vv,
+                                      causal=is_causal, scale=1.0)
+            else:
+                out = flash_attention_bias(
+                    (qv * sc).astype(qv.dtype), kv, vv, raw(key_bias),
+                    causal=is_causal, scale=1.0)
             return out, None
         except Exception as e:  # noqa: BLE001
             _warn_flash_fallback(e)

@@ -49,8 +49,13 @@ LSE_LANES = 8  # lse/delta rows are broadcast over 8 sublanes to satisfy
                # the TPU (8, 128)-tile layout for non-vector shapes
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, nk,
+                has_bias=False):
+    if has_bias:
+        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        bias_ref = None
     # Streaming layout: grid = (b*h, nq, nk), K/V blocks arrive one per grid
     # step on the innermost ("arbitrary") dim — nothing larger than a block
     # is ever resident in VMEM, so sequence length is unbounded. Online
@@ -82,6 +87,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                                 preferred_element_type=jnp.float32)
         if scale != 1.0:
             s = s * scale
+        if bias_ref is not None:
+            # per-key additive bias (padding masks, ALiBi-style): one
+            # [8, bk] sublane-broadcast tile per k block (TPU blocks need
+            # 8x128-aligned shapes); row 0 broadcasts over the q rows
+            s = s + bias_ref[0:1, :].astype(jnp.float32)
         if apply_mask:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -215,7 +225,8 @@ def _block_sizes(sq, sk, block_q, block_k):
     return bq, bk
 
 
-def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret,
+                   bias=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = _block_sizes(sq, sk, block_q, block_k)
@@ -224,27 +235,38 @@ def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
     v3 = v.reshape(b * h, sk, d)
     nk = sk // bk
     grid = (b * h, sq // bq, nk)
-    use_lanes = _FA_LANES and bk % 128 == 0 and d <= 128
+    has_bias = bias is not None
+    use_lanes = _FA_LANES and bk % 128 == 0 and d <= 128 and not has_bias
     kernel = functools.partial(
         _fwd_kernel_lanes if use_lanes else _fwd_kernel,
         scale=scale, causal=causal, nk=nk)
+    if not use_lanes:
+        kernel = functools.partial(kernel, has_bias=has_bias)
     ml_lanes = 128 if use_lanes else LSE_LANES
     mem_kwargs = {}
     if _HAS_TPU_PALLAS and not interpret:
         mem_kwargs = {"memory_space": pltpu.VMEM}
+    in_specs = [
+        pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0),
+                     **mem_kwargs),
+        pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0),
+                     **mem_kwargs),
+        pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0),
+                     **mem_kwargs),
+    ]
+    operands = [q3, k3, v3]
+    if has_bias:
+        # per-key additive bias, pre-tiled to [b*h, sk] f32
+        in_specs.append(pl.BlockSpec((None, 8, bk),
+                                     lambda i, j, kk: (i, 0, kk),
+                                     **mem_kwargs))
+        operands.append(bias)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
                    jax.ShapeDtypeStruct((b * h, sq, LSE_LANES), jnp.float32)),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0),
-                         **mem_kwargs),
-            pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0),
-                         **mem_kwargs),
-            pl.BlockSpec((None, bk, d), lambda i, j, kk: (i, kk, 0),
-                         **mem_kwargs),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((None, bq, d), lambda i, j, kk: (i, j, 0),
                          **mem_kwargs),
@@ -256,12 +278,17 @@ def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
                         pltpu.VMEM((bq, ml_lanes), jnp.float32)],
         interpret=interpret,
         **_compiler_params(("parallel", "parallel", "arbitrary")),
-    )(q3, k3, v3)
+    )(*operands)
     return out.reshape(b, h, sq, d), lse
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, nk):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                   scale, causal, nk, has_bias=False):
+    if has_bias:
+        bias_ref, dq_ref, dq_acc = refs
+    else:
+        dq_ref, dq_acc = refs
+        bias_ref = None
     # Streaming: grid = (b*h, nq, nk); dq_i = scale * sum_j ds_ij @ k_j
     # accumulated in VMEM scratch across the k steps, flushed on the last.
     bq, d = q_ref.shape
@@ -284,7 +311,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[:]
         v = v_ref[:]
         p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
-                           qi * bq, ki * bk)
+                           qi * bq, ki * bk,
+                           None if bias_ref is None else bias_ref[:])
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -295,7 +323,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[:] = acc.astype(dq_ref.dtype)
 
 
-def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0):
+def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0,
+               bias=None):
     """Shared backward tile math: recompute probabilities from the stored LSE
     and form ds = p * (dO·v^T - delta). Used by all three backward kernels so
     masking/lse/dtype fixes land in exactly one place. Returns (p, ds) with
@@ -305,6 +334,8 @@ def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0):
                             preferred_element_type=jnp.float32)
     if scale != 1.0:
         s = s * scale
+    if bias is not None:
+        s = s + bias[0:1, :].astype(jnp.float32)
     if causal:
         q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -316,8 +347,13 @@ def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0):
     return p.astype(do.dtype), ds
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, nq):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                    scale, causal, nq, has_bias=False):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
+        bias_ref = None
     # Streaming: grid = (b*h, nk, nq); Q/dO blocks arrive on the innermost
     # dim; dk_j / dv_j accumulate in VMEM scratch, flushed on the last step.
     bk, d = k_ref.shape
@@ -342,7 +378,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[:, 0:1]
         delta = delta_ref[:, 0:1]
         p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
-                           qi * bq, ki * bk)
+                           qi * bq, ki * bk,
+                           None if bias_ref is None else bias_ref[:])
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -358,8 +395,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, dq_acc, *, scale, causal,
-                      block_q, sq, nk):
+                      *refs, scale, causal, block_q, sq, nk,
+                      has_bias=False):
+    if has_bias:
+        bias_ref, dq_ref, dk_ref, dv_ref, dq_acc = refs
+    else:
+        dq_ref, dk_ref, dv_ref, dq_acc = refs
+        bias_ref = None
     """One-pass backward: grid over k-blocks (sequential per (b,h) row), q
     streamed inside. Computes p = exp(s - lse) ONCE per (i,j) tile and feeds
     all three grads: dv_j += p^T dO_i, dk_j += ds^T q_i, and dq_i accumulated
@@ -384,7 +426,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
         delta = delta_ref[pl.ds(i * block_q, block_q), 0:1]
         p, ds = _tile_p_ds(q, k, v, do, lse, delta, scale, causal,
-                           i * block_q, ki * bk)
+                           i * block_q, ki * bk,
+                           None if bias_ref is None else bias_ref[:])
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -442,7 +485,7 @@ def _delta_rows(o3, do3, interpret):
 
 
 def _flash_bwd_fused(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-                     interpret):
+                     interpret, bias=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = _block_sizes(sq, sk, block_q, block_k)
@@ -458,25 +501,32 @@ def _flash_bwd_fused(q, k, v, o, lse, g, scale, causal, block_q, block_k,
     kcol = pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0), **mem_kwargs)
     vec_full = pl.BlockSpec((None, sq, LSE_LANES), lambda i, j: (i, 0, 0),
                             **mem_kwargs)
+    in_specs = [qfull, kcol, kcol, qfull, vec_full, vec_full]
+    operands = [q3, k3, v3, do3, lse, delta3]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((None, 8, bk), lambda i, j: (i, 0, j),
+                                     **mem_kwargs))
+        operands.append(bias)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          block_q=bq, sq=sq, nk=sk // bk),
+                          block_q=bq, sq=sq, nk=sk // bk,
+                          has_bias=bias is not None),
         out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
                    jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
         grid=(b * h, sk // bk),
-        in_specs=[qfull, kcol, kcol, qfull, vec_full, vec_full],
+        in_specs=in_specs,
         out_specs=(qfull, kcol, kcol),
         scratch_shapes=scratch,
         interpret=interpret,
         **_compiler_params(("parallel", "arbitrary")),
-    )(q3, k3, v3, do3, lse, delta3)
+    )(*operands)
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
             dv.reshape(b, h, sk, d))
 
 
 def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-               interpret):
+               interpret, bias=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = _block_sizes(sq, sk, block_q, block_k)
@@ -496,16 +546,24 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
                            **mem_kwargs)
     vec_row = pl.BlockSpec((None, bq, LSE_LANES), lambda i, j, kk: (i, j, 0),
                            **mem_kwargs)
+    dq_specs = [qrow, kstream, kstream, qrow, vec_row, vec_row]
+    dq_ops = [q3, k3, v3, do3, lse3, delta3]
+    if bias is not None:
+        dq_specs.append(pl.BlockSpec((None, 8, bk),
+                                      lambda i, j, kk: (i, 0, kk),
+                                      **mem_kwargs))
+        dq_ops.append(bias)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, nk=nk),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, nk=nk,
+                          has_bias=bias is not None),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         grid=(b * h, nq, nk),
-        in_specs=[qrow, kstream, kstream, qrow, vec_row, vec_row],
+        in_specs=dq_specs,
         out_specs=qrow,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
         **_compiler_params(("parallel", "parallel", "arbitrary")),
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(*dq_ops)
 
     # dkv pass: grid (bh, nk, nq) — k/v column pinned per j, q/dO streamed
     kcol = pl.BlockSpec((None, bk, d), lambda i, j, qq: (i, j, 0),
@@ -514,18 +572,26 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
                            **mem_kwargs)
     vec_stream = pl.BlockSpec((None, bq, LSE_LANES),
                               lambda i, j, qq: (i, qq, 0), **mem_kwargs)
+    dkv_specs = [qstream, kcol, kcol, qstream, vec_stream, vec_stream]
+    dkv_ops = [q3, k3, v3, do3, lse3, delta3]
+    if bias is not None:
+        dkv_specs.append(pl.BlockSpec((None, 8, bk),
+                                      lambda i, j, qq: (i, 0, j),
+                                      **mem_kwargs))
+        dkv_ops.append(bias)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq,
+                          has_bias=bias is not None),
         out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
         grid=(b * h, nk, nq),
-        in_specs=[qstream, kcol, kcol, qstream, vec_stream, vec_stream],
+        in_specs=dkv_specs,
         out_specs=(kcol, kcol),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
         **_compiler_params(("parallel", "parallel", "arbitrary")),
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(*dkv_ops)
 
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
             dv.reshape(b, h, sk, d))
@@ -580,3 +646,53 @@ def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def _tile_bias(bias, b, h):
+    """[B, Sk] f32 -> [b*h, 8, Sk]: head-tiled with an 8-sublane broadcast
+    so the per-k-block tile is a TPU-aligned [8, bk] block."""
+    sk = bias.shape[-1]
+    return jnp.broadcast_to(bias.astype(jnp.float32)[:, None, None, :],
+                            (b, h, 8, sk)).reshape(b * h, 8, sk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention_bias(q, k, v, bias, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         interpret=False):
+    """Flash attention with a PER-KEY additive bias [B, Sk] f32 — covers
+    padding masks (any pattern) and ALiBi-style per-key biases, the
+    [B,1,1,S] additive-mask form BERT-class encoders build. The bias is
+    tiled over heads and streamed to the kernels one k-block at a time;
+    its cotangent is zero (padding masks are not trained)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bias3 = _tile_bias(bias, q.shape[0], q.shape[1])
+    out, _ = _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k,
+                            interpret, bias3)
+    return out
+
+
+def _fab_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bias3 = _tile_bias(bias, q.shape[0], q.shape[1])
+    out, lse = _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k,
+                              interpret, bias3)
+    return out, (q, k, v, bias, bias3, out, lse)
+
+
+def _fab_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, bias, bias3, out, lse = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if _HAS_TPU_PALLAS and q.shape[2] * q.shape[3] * 10 <= 8 * 1024 * 1024:
+        dq, dk, dv = _flash_bwd_fused(q, k, v, out, lse, g, scale, causal,
+                                      block_q, block_k, interpret, bias3)
+    else:
+        dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal,
+                                block_q, block_k, interpret, bias3)
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+flash_attention_bias.defvjp(_fab_fwd, _fab_bwd)
