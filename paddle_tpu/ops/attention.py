@@ -71,8 +71,13 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         mask_v = mask_v._value
     key_bias = None
     if mask_v is not None and getattr(mask_v, "ndim", 0) == 4 \
-            and mask_v.shape[1] == 1 and mask_v.shape[2] == 1:
+            and mask_v.shape[1] == 1 and mask_v.shape[2] == 1 \
+            and mask_v.shape[0] in (1, q.shape[0]):
         key_bias = mask_v[:, 0, 0, :]
+        if mask_v.shape[0] == 1 and q.shape[0] != 1:  # broadcast batch
+            import jax.numpy as _jnp
+            key_bias = _jnp.broadcast_to(key_bias,
+                                         (q.shape[0], key_bias.shape[-1]))
     use_flash = (_on_tpu()
                  and (attn_mask is None or key_bias is not None)
                  and dropout_p == 0.0

@@ -660,11 +660,12 @@ def _tile_bias(bias, b, h):
 def flash_attention_bias(q, k, v, bias, causal=False, scale=None,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                          interpret=False):
-    """Flash attention with a PER-KEY additive bias [B, Sk] f32 — covers
-    padding masks (any pattern) and ALiBi-style per-key biases, the
-    [B,1,1,S] additive-mask form BERT-class encoders build. The bias is
-    tiled over heads and streamed to the kernels one k-block at a time;
-    its cotangent is zero (padding masks are not trained)."""
+    """Flash attention with a PER-KEY additive bias [B, Sk] f32 — the
+    [B,1,1,S] additive-mask form BERT-class encoders build (padding in
+    any pattern, per-key score offsets). Per-QUERY-relative biases
+    (ALiBi's -m*|q-k|) are NOT expressible per-key and take the XLA
+    path. The bias is tiled over heads and streamed to the kernels one
+    k-block at a time; its cotangent is zero (masks are not trained)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     bias3 = _tile_bias(bias, q.shape[0], q.shape[1])
