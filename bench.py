@@ -1,10 +1,18 @@
-"""Benchmark: GPT-2 small causal-LM training throughput on one chip.
+"""Benchmark: every BASELINE axis on one chip, machine-readably.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"baseline"}. vs_baseline = achieved MFU / 0.40 (A100-class reference MFU
-target for transformer pretraining, SURVEY.md §6 — BASELINE.json publishes
-no absolute numbers this round); "baseline" records that denominator's
-provenance so the ratio can't be mistaken for a driver-published bar.
+Default run measures each BASELINE config (gpt2s, bert_large, resnet50,
+gpt2m, bert_base, ernie) plus decode (bf16 / W8A16 / int8-KV peak) under
+a global time budget, printing ONE JSON line per axis as it lands:
+{"metric", "value", "unit", "vs_baseline", "baseline"}; the final line
+repeats the headline (gpt2s train) with a "parsed_all" list carrying all
+records so the driver's single-parse capture records the full measured
+state (VERDICT r4 next #3). `python bench.py <axis>` runs one axis.
+
+vs_baseline for train axes = achieved MFU / 0.40 (A100-class reference
+MFU target for transformer pretraining, SURVEY.md §6 — BASELINE.json
+publishes no absolute numbers this round); "baseline" records that
+denominator's provenance so the ratio can't be mistaken for a
+driver-published bar. Decode axes report HBM-roofline utilization.
 """
 from __future__ import annotations
 
@@ -16,6 +24,17 @@ import sys
 import time
 
 import numpy as np
+
+# priority order: headline first (guaranteed to land), then the two axes
+# BASELINE.json names (BERT-large, ResNet-50), then the rest
+AXES = ("gpt2s", "bert_large", "resnet50", "gpt2m", "bert_base", "ernie",
+        "decode")
+_BUDGET_S = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "520"))
+_T0 = time.time()
+
+
+def _remaining():
+    return _BUDGET_S - (time.time() - _T0)
 
 
 def _device_probe_ok(attempts=2, timeout=100, backoff=20):
@@ -53,52 +72,17 @@ def _device_probe_ok(attempts=2, timeout=100, backoff=20):
     return False
 
 
-def main():
-    if os.environ.get("PADDLE_TPU_BENCH_PROBED") != "1":
-        if not _device_probe_ok():
-            # re-exec on CPU so the driver still gets a JSON line — marked
-            # degraded, with a renamed metric (a CPU number is NOT the
-            # per-chip throughput this bench normally reports)
-            print("# bench probe: TPU unreachable after all attempts — "
-                  "falling back to CPU smoke mode (degraded)",
-                  file=sys.stderr)
-            env = dict(os.environ, PADDLE_TPU_BENCH_PROBED="1",
-                       PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
-            # keep argv: the selected workload (gpt2s_gen/resnet50/...)
-            # must survive the re-exec
-            os.execve(sys.executable,
-                      [sys.executable, __file__] + sys.argv[1:], env)
-        os.environ["PADDLE_TPU_BENCH_PROBED"] = "1"
+def _bench_train(model_name, on_tpu):
+    """Measure one training axis; returns its record dict."""
     import jax
     import jax.numpy as jnp
 
-    # persistent XLA compilation cache: a bench run right after a
-    # warm-up run (scripts/tpu_when_up.sh) skips the 20-40s compiles
-    try:
-        os.makedirs("/root/repo/.jax_cache", exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir",
-                          "/root/repo/.jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
-
-    import paddle_tpu  # noqa: F401
     from paddle_tpu import optimizer as opt_mod
 
-    # secondary workloads selectable via env/argv (default: the headline
-    # GPT-2 small config the driver records); bert_large covers the
-    # BASELINE "BERT-large samples/sec/chip" axis when run manually
-    model_name = (sys.argv[1] if len(sys.argv) > 1
-                  else os.environ.get("PADDLE_TPU_BENCH_MODEL", "gpt2s"))
-    on_tpu = jax.default_backend() not in ("cpu",)
-    if model_name == "gpt2s_gen":
-        # serving-side decode throughput: greedy tokens/s through the
-        # KV-cache generate path (secondary manual mode; the training
-        # number stays the headline)
-        return _bench_decode(on_tpu)
     if model_name == "resnet50":
         # BASELINE.json's first axis is "samples/sec/chip ... ResNet-50";
-        # conv FLOPs counted analytically below (6N is meaningless for convs)
+        # conv FLOPs counted from XLA's cost model below (6N is
+        # meaningless for convs)
         from paddle_tpu.vision.models import resnet50
         from paddle_tpu import ops as P_ops
         from paddle_tpu.core.tensor import Tensor as PTensor
@@ -124,18 +108,24 @@ def main():
             finally:
                 model.load_functional_state(saved_p, saved_b)
 
+        cfg = None
         metric_name = "resnet50_train_samples_per_sec_per_chip"
-    elif model_name == "bert_large":
-        from paddle_tpu.models.bert import BertConfig, build_train_step
+    elif model_name in ("bert_large", "bert_base", "ernie"):
+        from paddle_tpu.models.bert import (BertConfig, ErnieConfig,
+                                            build_train_step)
         if on_tpu:
-            cfg = BertConfig.large()
-            batch_candidates, seq = (16, 8, 4), 512
-            inner = 30
+            if model_name == "bert_large":
+                cfg, batch_candidates = BertConfig.large(), (16, 8, 4)
+            elif model_name == "bert_base":
+                cfg, batch_candidates = BertConfig.base(), (32, 16, 8)
+            else:
+                cfg, batch_candidates = ErnieConfig.large(), (16, 8, 4)
+            seq, inner = 512, 30
         else:
             cfg = BertConfig.tiny()
             batch_candidates, seq = (4,), 128
             inner = 3
-        metric_name = "bert_large_train_tokens_per_sec_per_chip"
+        metric_name = f"{model_name}_train_tokens_per_sec_per_chip"
     elif model_name == "gpt2m":
         # BASELINE.json's GPT-2 config is MEDIUM ("GPT-2 medium with
         # fused_attention_op -> Pallas flash-attn"); single-chip train
@@ -280,7 +270,7 @@ def main():
         # per token fwd+bwd, /2 only for causal models (GPT); BERT is
         # bidirectional — reported for honesty, the headline mfu keeps the
         # 6N convention for round-over-round comparison
-        causal_discount = 0.5 if model_name != "bert_large" else 1.0
+        causal_discount = 0.5 if model_name.startswith("gpt2") else 1.0
         attn_ft = 12 * cfg.num_layers * seq * cfg.hidden_size \
             * causal_discount
         mfu_attn = units_per_sec * (flops_per_unit + attn_ft) / peak
@@ -297,17 +287,18 @@ def main():
         # driver-published number
         "baseline": ("self-set 0.40 MFU stand-in" if on_tpu
                      else "n/a (CPU_DEGRADED)"),
+        "mfu": round(mfu, 4),
     }
     if not on_tpu:
         record["degraded"] = True  # TPU probe failed; see stderr probe log
-    print(json.dumps(record))
-    print(f"# loss={float(loss):.4f} params={n_params/1e6:.1f}M "
-          f"mfu={mfu:.3f}"
+    print(f"# [{model_name}] loss={float(loss):.4f} "
+          f"params={n_params/1e6:.1f}M mfu={mfu:.3f}"
           + (f" mfu_attn_incl={mfu_attn:.3f}" if mfu_attn is not None else "")
           + f" step={dt*1000:.1f}ms batch={batch}"
           + f" dispatch_floor={_dispatch_floor()*1e3:.1f}ms/{inner}steps"
           " (not subtracted)"
           + f" backend={jax.default_backend()}", file=sys.stderr)
+    return record
 
 
 def _dispatch_floor():
@@ -329,8 +320,10 @@ def _dispatch_floor():
 
 
 def _bench_decode(on_tpu):
+    """Serving-side decode: bf16, W8A16 and the int8-KV peak config, each
+    as its own record (the r4 bench only printed W8/peak to stderr;
+    VERDICT r4 missing #4). Returns the record list."""
     import jax
-    import numpy as np
 
     from paddle_tpu.models.gpt2 import GPT2, GPT2Config
 
@@ -347,28 +340,33 @@ def _bench_decode(on_tpu):
     n_params = sum(int(np.prod(p.shape))
                    for p in model.functional_state()[0].values())
     rng = np.random.RandomState(0)
+    bw = 819e9 if on_tpu else 50e9
+
+    def timed(ids, n_new, **kw):
+        model.generate(ids, n_new, **kw).numpy()  # compile + barrier
+        floor = _dispatch_floor()
+        dt = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            model.generate(ids, n_new, **kw).numpy()
+            dt = min(dt, time.perf_counter() - t0)
+        # one generate() is ONE dispatch; remove the measured tunnel
+        # round-trip so the number is device throughput, not tunnel latency
+        return max(dt - floor, 1e-9), floor
+
+    def hbm_util(dt, n_new, bytes_per_param):
+        # decode is HBM-bound: each token-STEP streams all params once ->
+        # the roofline is bandwidth, not FLOPs; utilization is
+        # (steps/sec) * bytes-per-step / bandwidth, batch-independent
+        return (n_new / dt) * n_params * bytes_per_param / bw
+
+    records = []
     ids = rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
-    model.generate(ids, new).numpy()  # compile + completion barrier
-    floor = _dispatch_floor()
-    dt = float("inf")
-    for _ in range(2):
-        t0 = time.perf_counter()
-        out = model.generate(ids, new)
-        out.numpy()  # fetch = completion barrier through the tunnel
-        dt = min(dt, time.perf_counter() - t0)
-    # device decode time: one generate() is ONE dispatch; remove the
-    # measured tunnel round-trip so the number is per-token device
-    # throughput, not tunnel latency (provenance printed below)
-    dt = max(dt - floor, 1e-9)
+    dt, floor = timed(ids, new)
     toks = batch * new
     tok_s = toks / dt
-    # decode is HBM-bound: each token streams all params once -> the
-    # roofline is bandwidth, not FLOPs; report bandwidth utilization as
-    # the baseline ratio (v5e ~819 GB/s; bf16 params on TPU)
-    bw = 819e9 if on_tpu else 50e9
-    bytes_per_param = 2 if on_tpu else 4
-    util = (tok_s / batch) * n_params * bytes_per_param / bw
-    record = {
+    util = hbm_util(dt, new, 2 if on_tpu else 4)
+    rec = {
         "metric": ("gpt2s_decode_tokens_per_sec_per_chip" if on_tpu
                    else "gpt2s_tiny_decode_CPU_DEGRADED"),
         "value": round(tok_s, 1),
@@ -379,40 +377,139 @@ def _bench_decode(on_tpu):
                      else "n/a (CPU_DEGRADED)"),
     }
     if not on_tpu:
-        record["degraded"] = True
-    print(json.dumps(record))
-    # weight-only int8 decode (W8A16): the serving-side lever — measure
-    # alongside, keep the recorded metric bf16 for cross-round comparability
-    if on_tpu:
-        model.generate(ids, new, weight_quant="int8").numpy()  # quant+compile
-        dt8 = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            model.generate(ids, new, weight_quant="int8").numpy()
-            dt8 = min(dt8, time.perf_counter() - t0)
-        dt8 = max(dt8 - floor, 1e-9)
-        print(f"# w8a16 decode: {toks/dt8:,.0f} tok/s "
-              f"({dt8/new*1e3:.2f} ms/token-step, "
-              f"{dt/dt8:.2f}x vs bf16 at this batch)", file=sys.stderr)
-        # peak-throughput config: int8 KV + int8 weights at batch 32
-        ids32 = rng.randint(0, cfg.vocab_size, (32, prompt)).astype(np.int32)
-        model.generate(ids32, new, weight_quant="int8",
-                       kv_quant="int8").numpy()
-        dtp = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            model.generate(ids32, new, weight_quant="int8",
-                           kv_quant="int8").numpy()
-            dtp = min(dtp, time.perf_counter() - t0)
-        dtp = max(dtp - floor, 1e-9)
-        print(f"# kv8+w8 batch=32 decode: {32*new/dtp:,.0f} tok/s "
-              f"({dtp/new*1e3:.2f} ms/token-step) — peak-throughput config",
-              file=sys.stderr)
-    print(f"# dispatch_floor={floor*1e3:.1f}ms (subtracted)", file=sys.stderr)
+        rec["degraded"] = True
+    records.append(rec)
+    print(json.dumps(rec))
     print(f"# decode batch={batch} prompt={prompt} new={new} "
           f"step={dt/new*1000:.2f}ms/token params={n_params/1e6:.1f}M "
-          f"hbm_util~{util:.3f} backend={jax.default_backend()}",
-          file=sys.stderr)
+          f"hbm_util~{util:.3f} floor={floor*1e3:.1f}ms (subtracted) "
+          f"backend={jax.default_backend()}", file=sys.stderr)
+    if not on_tpu:
+        return records
+
+    # weight-only int8 (W8A16): the serving-side lever
+    dt8, _ = timed(ids, new, weight_quant="int8")
+    util8 = hbm_util(dt8, new, 1)
+    rec8 = {
+        "metric": "gpt2s_decode_w8a16_tokens_per_sec_per_chip",
+        "value": round(toks / dt8, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(util8, 4),
+        "baseline": "v5e 819GB/s HBM roofline (int8 weight stream)",
+    }
+    records.append(rec8)
+    print(json.dumps(rec8))
+    print(f"# w8a16 decode: {toks/dt8:,.0f} tok/s "
+          f"({dt8/new*1e3:.2f} ms/token-step, "
+          f"{dt/dt8:.2f}x vs bf16 at this batch)", file=sys.stderr)
+
+    # peak-throughput config: int8 KV + int8 weights at batch 40
+    # (PERF.md r4: 28.1k tok/s; batch 32 fallback if 40 OOMs)
+    for bpeak in (40, 32):
+        try:
+            idsp = rng.randint(0, cfg.vocab_size,
+                               (bpeak, prompt)).astype(np.int32)
+            dtp, _ = timed(idsp, new, weight_quant="int8", kv_quant="int8")
+            utilp = hbm_util(dtp, new, 1)
+            recp = {
+                "metric": "gpt2s_decode_peak_w8_kv8_tokens_per_sec_per_chip",
+                "value": round(bpeak * new / dtp, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(utilp, 4),
+                "baseline": "v5e 819GB/s HBM roofline (int8 streams)",
+                "batch": bpeak,
+            }
+            records.append(recp)
+            print(json.dumps(recp))
+            print(f"# kv8+w8 batch={bpeak} decode: "
+                  f"{bpeak*new/dtp:,.0f} tok/s "
+                  f"({dtp/new*1e3:.2f} ms/token-step) — peak config",
+                  file=sys.stderr)
+            break
+        except Exception as e:  # noqa: BLE001
+            print(f"# bench decode peak batch={bpeak} failed: "
+                  f"{str(e)[:120]}", file=sys.stderr)
+    return records
+
+
+def main():
+    if os.environ.get("PADDLE_TPU_BENCH_PROBED") != "1":
+        if not _device_probe_ok():
+            # re-exec on CPU so the driver still gets a JSON line — marked
+            # degraded, with a renamed metric (a CPU number is NOT the
+            # per-chip throughput this bench normally reports)
+            print("# bench probe: TPU unreachable after all attempts — "
+                  "falling back to CPU smoke mode (degraded)",
+                  file=sys.stderr)
+            env = dict(os.environ, PADDLE_TPU_BENCH_PROBED="1",
+                       PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+            # keep argv: a selected single axis must survive the re-exec
+            os.execve(sys.executable,
+                      [sys.executable, __file__] + sys.argv[1:], env)
+        os.environ["PADDLE_TPU_BENCH_PROBED"] = "1"
+    import jax
+
+    # persistent XLA compilation cache: a bench run right after a
+    # warm-up run skips the 20-40s compiles
+    try:
+        os.makedirs("/root/repo/.jax_cache", exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir",
+                          "/root/repo/.jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+
+    import paddle_tpu  # noqa: F401
+
+    axis = (sys.argv[1] if len(sys.argv) > 1
+            else os.environ.get("PADDLE_TPU_BENCH_MODEL"))
+    on_tpu = jax.default_backend() not in ("cpu",)
+
+    if axis:  # single-axis mode (manual runs / tests)
+        if axis in ("decode", "gpt2s_gen"):
+            _bench_decode(on_tpu)
+            return
+        print(json.dumps(_bench_train(axis, on_tpu)))
+        return
+
+    if not on_tpu:
+        # CPU-degraded: one tiny smoke record, same shape as before
+        print(json.dumps(_bench_train("gpt2s", on_tpu)))
+        return
+
+    # Multi-axis default: run each BASELINE config under the global
+    # budget, headline first; skip (and say so) when the window closes.
+    records, skipped = [], []
+    for name in AXES:
+        # decode compiles 3 programs (~3x a train axis when cold)
+        need = 150 if name == "decode" else (60 if records else 0)
+        if _remaining() < need:
+            skipped.append(name)
+            continue
+        t0 = time.time()
+        try:
+            if name == "decode":
+                records.extend(_bench_decode(on_tpu))
+            else:
+                rec = _bench_train(name, on_tpu)
+                records.append(rec)
+                print(json.dumps(rec))
+            print(f"# bench axis {name} took {time.time() - t0:.0f}s "
+                  f"({_remaining():.0f}s budget left)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — isolate axis failures
+            print(f"# bench axis {name} FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}", file=sys.stderr)
+    if skipped:
+        print(f"# bench: skipped {skipped} (budget "
+              f"{_BUDGET_S:.0f}s exhausted; set PADDLE_TPU_BENCH_BUDGET_S "
+              "to widen)", file=sys.stderr)
+    if not records:
+        raise RuntimeError("no bench axis produced a record")
+    # final line: the headline record again, carrying every axis — the
+    # driver's JSON-line capture gets the full measured state either way
+    headline = dict(records[0])
+    headline["parsed_all"] = records
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

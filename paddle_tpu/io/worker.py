@@ -139,9 +139,12 @@ class _ByteChannel:
             self._lib = None
             self._py = pyqueue.Queue(maxsize=depth)
 
+    _closed = False
+
     def push(self, tag, payload):
         if self._lib is None:
-            self._py.put(tag + payload)
+            if not self._closed:  # closed: drop, like the native queue's -1
+                self._py.put(tag + payload)
             return
         buf = (self._ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
         self._lib.ptq_push_tagged(self._q, tag[0], buf, len(payload))
@@ -150,7 +153,8 @@ class _ByteChannel:
         """Copy straight out of shared memory into the C++ queue — the
         memcpy runs inside ptq_push_tagged with the GIL released."""
         if self._lib is None:
-            self._py.put(tag + bytes(shm_buf[:nbytes]))
+            if not self._closed:
+                self._py.put(tag + bytes(shm_buf[:nbytes]))
             return
         buf = (self._ctypes.c_uint8 * nbytes).from_buffer(shm_buf)
         self._lib.ptq_push_tagged(self._q, tag[0], buf, nbytes)
@@ -184,6 +188,11 @@ class _ByteChannel:
     def close(self):
         if self._lib is not None:
             self._lib.ptq_close(self._q)
+            return
+        # python fallback: new pushes drop from now on (a put() already
+        # blocked on the full queue still needs a consumer pop to finish —
+        # _shutdown_workers pops while joining the feeder for that)
+        self._closed = True
 
     def destroy(self):
         if self._lib is not None:
@@ -217,8 +226,13 @@ class MultiprocessLoaderIter:
         self._batches = list(batches) if batches is not None else None
         self._persistent = persistent
         self._result_queue = ctx.Queue()
-        self._index_queue = ctx.Queue() \
-            if (not self._iterable or persistent) else None
+        self._index_queue = ctx.Queue() if not self._iterable else None
+        # iterable+persistent: epoch tokens must be PER-WORKER queues — in
+        # a shared queue a fast worker pops both tokens, runs its shard
+        # twice and the feeder's done-count closes the epoch while the
+        # starved worker's shard never arrives (flaky dup/drop)
+        self._index_queues = [ctx.Queue() for _ in range(num_workers)] \
+            if (self._iterable and persistent) else None
         depth = max(2, num_workers * prefetch_factor)
         self._chan = _ByteChannel(depth)
         self._shutdown = False
@@ -229,7 +243,9 @@ class MultiprocessLoaderIter:
         for wid in range(num_workers):
             w = ctx.Process(
                 target=_worker_loop,
-                args=(dataset, collate_fn, self._index_queue,
+                args=(dataset, collate_fn,
+                      self._index_queues[wid] if self._index_queues
+                      else self._index_queue,
                       self._result_queue, wid, num_workers, base_seed,
                       worker_init_fn, use_shared_memory,
                       iterable_batch_size, iterable_drop_last, persistent),
@@ -261,7 +277,28 @@ class MultiprocessLoaderIter:
         # stale frames, or they would leak into this epoch's stream
         feeder = getattr(self, "_feeder", None)
         if feeder is not None and feeder.is_alive():
-            feeder.join()
+            # the feeder may be BLOCKED pushing into the full bounded
+            # channel — joining first would deadlock. Drain concurrently
+            # until it exits (every pop frees a slot for its next push; the
+            # workers finish the old epoch's queued tasks, so the feeder's
+            # receive loop terminates), then discard whatever is left.
+            deadline = 600  # empty-pop polls; a dead worker would spin here
+            while feeder.is_alive() and deadline > 0:
+                # tight drain: pop until the channel is momentarily empty,
+                # then check the feeder — only empty polls charge the
+                # deadline, so epoch size never bounds this loop. Stop on
+                # an END frame too: a CLOSED channel's pop returns END
+                # forever, never None.
+                got = self._chan.pop(timeout=0.02)
+                while got is not None and got[0] != _TAG_END:
+                    got = self._chan.pop(timeout=0.02)
+                feeder.join(timeout=0.05)
+                deadline -= 1
+            if feeder.is_alive():
+                self._shutdown_workers()
+                raise RuntimeError(
+                    "persistent DataLoader could not finish the abandoned "
+                    "previous epoch (worker dead or stalled)")
         if getattr(self, "_epoch_open", False):
             while True:
                 got = self._chan.pop(timeout=0.05)
@@ -269,8 +306,8 @@ class MultiprocessLoaderIter:
                     break
         self._epoch_open = True
         if self._iterable:
-            for _ in range(self.num_workers):
-                self._index_queue.put(True)
+            for q in self._index_queues:
+                q.put(True)  # exactly one epoch token per worker
         else:
             self._batches = list(batches)
             self._n_batches = len(self._batches)
@@ -304,8 +341,12 @@ class MultiprocessLoaderIter:
         """Persistent-pool shutdown: release the workers via sentinels."""
         if self._shutdown:
             return
-        for _ in range(self.num_workers):
-            self._index_queue.put(None)
+        if self._index_queues is not None:
+            for q in self._index_queues:
+                q.put(None)
+        else:
+            for _ in range(self.num_workers):
+                self._index_queue.put(None)
         self._shutdown_workers()
 
     # -- feeder thread: result_queue -> (reorder) -> byte channel ---------
@@ -403,12 +444,53 @@ class MultiprocessLoaderIter:
         if self._shutdown:
             return
         self._shutdown = True
+        # close first: a feeder blocked in the native queue's push wakes
+        # with -1 (closed) instead of being destroyed under mid-wait, and
+        # consumer pops see END. Then join the feeder, join/terminate the
+        # workers, and unlink any shm segments still parked in the result
+        # queue — TWICE, because a worker mid-emit can enqueue after the
+        # first drain (abandoned-epoch shutdown would leak them).
+        self._chan.close()
+        feeder = getattr(self, "_feeder", None)
+        if feeder is not None and feeder.is_alive():
+            # native queue: push now returns "closed" and the feeder exits
+            # on its own. Python fallback: a put() already blocked on the
+            # full queue needs pops to complete — drain while joining.
+            deadline = 200
+            while feeder.is_alive() and deadline > 0:
+                self._chan.pop(timeout=0.02)
+                feeder.join(timeout=0.05)
+                deadline -= 1
+
+        def _drain_shm():
+            while True:
+                try:
+                    msg = self._result_queue.get_nowait()
+                except pyqueue.Empty:
+                    break
+                except Exception:  # pragma: no cover - closed queue
+                    break
+                if msg and msg[0] == "shm":
+                    from multiprocessing import shared_memory
+                    try:
+                        shm = shared_memory.SharedMemory(name=msg[2])
+                        shm.close()
+                        shm.unlink()
+                    except Exception:
+                        pass
+
+        _drain_shm()
         for w in self._workers:
             w.join(timeout=5)
         for w in self._workers:
             if w.is_alive():  # pragma: no cover - stuck worker
                 w.terminate()
-        self._chan.destroy()
+        _drain_shm()
+        if feeder is None or not feeder.is_alive():
+            self._chan.destroy()
+        # else: deliberately LEAK the (closed, near-empty) queue — freeing
+        # it under a wedged daemon feeder would be a use-after-free; the
+        # allocation is a few KB and the thread dies with the process
 
     def __del__(self):  # pragma: no cover - gc path
         try:

@@ -197,13 +197,27 @@ class GPT2(nn.Layer):
             # quantization itself is ~250 device ops over 124M params, so
             # it is cached per weight version (serving calls generate in
             # a loop).
-            marker = id(self.wte.weight._value)
+            # cache key: weak refs to EVERY source array (identity, not
+            # id() — ids are recycled after GC and could serve stale
+            # quantized weights; weakrefs also notice any param changing,
+            # not just wte). A dead or mismatched ref is a miss.
+            import weakref
+
+            def _wref(v):
+                try:
+                    return weakref.ref(v)
+                except TypeError:  # non-weakrefable leaf: pin it instead
+                    return (lambda strong=v: strong)
             cached = getattr(self, "_w8_cache", None)
-            if cached is None or cached[0] != marker:
-                cached = (marker,
+            names = sorted(params)
+            hit = (cached is not None and cached[0] == names
+                   and all(r() is params[n]
+                           for n, r in zip(names, cached[1])))
+            if not hit:
+                cached = (names, [_wref(params[n]) for n in names],
                           _quantize_decode_weights_int8(params, self.cfg))
                 self._w8_cache = cached
-            params = cached[1]
+            params = cached[2]
         elif weight_quant is not None:
             raise ValueError(f"unknown weight_quant {weight_quant!r} "
                              "(supported: 'int8')")

@@ -72,7 +72,8 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     key_bias = None
     if mask_v is not None and getattr(mask_v, "ndim", 0) == 4 \
             and mask_v.shape[1] == 1 and mask_v.shape[2] == 1 \
-            and mask_v.shape[0] in (1, q.shape[0]):
+            and mask_v.shape[0] in (1, q.shape[0]) \
+            and mask_v.shape[-1] == k.shape[-2]:
         key_bias = mask_v[:, 0, 0, :]
         if mask_v.shape[0] == 1 and q.shape[0] != 1:  # broadcast batch
             import jax.numpy as _jnp

@@ -350,10 +350,11 @@ def _tile_p_ds(q, k, v, do, lse, delta, scale, causal, q_pos0, k_pos0,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                     scale, causal, nq, has_bias=False):
     if has_bias:
-        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+        (bias_ref, dk_ref, dv_ref, dbias_ref,
+         dk_acc, dv_acc, db_acc) = refs
     else:
         dk_ref, dv_ref, dk_acc, dv_acc = refs
-        bias_ref = None
+        bias_ref = dbias_ref = db_acc = None
     # Streaming: grid = (b*h, nk, nq); Q/dO blocks arrive on the innermost
     # dim; dk_j / dv_j accumulate in VMEM scratch, flushed on the last step.
     bk, d = k_ref.shape
@@ -365,6 +366,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
+        if db_acc is not None:
+            db_acc[:] = jnp.zeros_like(db_acc)
 
     # causal: q blocks strictly before the diagonal see nothing of this k blk
     run = ((qi + 1) * bq > ki * bk) if causal else (qi >= 0)
@@ -386,22 +389,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if db_acc is not None:
+            # dL/dbias_k = sum over q rows of ds (bias enters s additively,
+            # after the scale) — accumulated across streamed q blocks
+            col = jnp.sum(ds.astype(jnp.float32), axis=0)
+            db_acc[:] += jnp.broadcast_to(col[None, :], db_acc.shape)
 
     @pl.when(qi == nq - 1)
     def _flush():
         acc = dk_acc[:] * scale if scale != 1.0 else dk_acc[:]
         dk_ref[:] = acc.astype(dk_ref.dtype)
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+        if db_acc is not None:
+            dbias_ref[:] = db_acc[:]
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *refs, scale, causal, block_q, sq, nk,
                       has_bias=False):
     if has_bias:
-        bias_ref, dq_ref, dk_ref, dv_ref, dq_acc = refs
+        bias_ref, dq_ref, dk_ref, dv_ref, dbias_ref, dq_acc = refs
     else:
         dq_ref, dk_ref, dv_ref, dq_acc = refs
-        bias_ref = None
+        bias_ref = dbias_ref = None
     """One-pass backward: grid over k-blocks (sequential per (b,h) row), q
     streamed inside. Computes p = exp(s - lse) ONCE per (i,j) tile and feeds
     all three grads: dv_j += p^T dO_i, dk_j += ds^T q_i, and dq_i accumulated
@@ -420,7 +430,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     first_q = (ki * bk) // block_q if causal else 0
 
     def body(i, carry):
-        dk_acc, dv_acc = carry
+        dk_acc, dv_acc, db_acc = carry
         q = q_ref[pl.ds(i * block_q, block_q), :]
         do = do_ref[pl.ds(i * block_q, block_q), :]
         lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
@@ -434,17 +444,24 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc = dk_acc + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dbias_ref is not None:
+            col = jnp.sum(ds.astype(jnp.float32), axis=0, keepdims=True)
+            db_acc = db_acc + jnp.broadcast_to(col, db_acc.shape)
         dq_tile = jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dq_acc[pl.ds(i * block_q, block_q), :] += dq_tile
-        return dk_acc, dv_acc
+        return dk_acc, dv_acc, db_acc
 
     z = jnp.zeros((bk, d), jnp.float32)
-    dk_acc, dv_acc = jax.lax.fori_loop(first_q, nq, body, (z, z))
+    zb = jnp.zeros((8, bk), jnp.float32)
+    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(first_q, nq, body, (z, z, zb))
     dk_ref[:] = ((dk_acc * scale) if scale != 1.0 else dk_acc) \
         .astype(dk_ref.dtype)
     dv_ref[:] = dv_acc.astype(dv_ref.dtype)
+    if dbias_ref is not None:
+        # dL/dbias for this k block: sum of ds over all q rows
+        dbias_ref[:] = db_acc
 
     @pl.when(ki == nk - 1)
     def _flush():
@@ -503,26 +520,33 @@ def _flash_bwd_fused(q, k, v, o, lse, g, scale, causal, block_q, block_k,
                             **mem_kwargs)
     in_specs = [qfull, kcol, kcol, qfull, vec_full, vec_full]
     operands = [q3, k3, v3, do3, lse, delta3]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                 jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                 jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)]
+    biascol = pl.BlockSpec((None, 8, bk), lambda i, j: (i, 0, j),
+                           **mem_kwargs)
+    out_specs = [qfull, kcol, kcol]
     if bias is not None:
-        in_specs.append(pl.BlockSpec((None, 8, bk), lambda i, j: (i, 0, j),
-                                     **mem_kwargs))
+        in_specs.append(biascol)
         operands.append(bias)
-    dq, dk, dv = pl.pallas_call(
+        out_shape.append(jax.ShapeDtypeStruct((b * h, 8, sk), jnp.float32))
+        out_specs.append(biascol)
+    outs = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
                           block_q=bq, sq=sq, nk=sk // bk,
                           has_bias=bias is not None),
-        out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        out_shape=tuple(out_shape),
         grid=(b * h, sk // bk),
         in_specs=in_specs,
-        out_specs=(qfull, kcol, kcol),
+        out_specs=tuple(out_specs),
         scratch_shapes=scratch,
         interpret=interpret,
         **_compiler_params(("parallel", "arbitrary")),
     )(*operands)
+    dq, dk, dv = outs[:3]
+    dbias3 = outs[3] if bias is not None else None
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+            dv.reshape(b, h, sk, d), dbias3)
 
 
 def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
@@ -574,27 +598,36 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
                               lambda i, j, qq: (i, qq, 0), **mem_kwargs)
     dkv_specs = [qstream, kcol, kcol, qstream, vec_stream, vec_stream]
     dkv_ops = [q3, k3, v3, do3, lse3, delta3]
+    dkv_out_shape = [jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                     jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)]
+    dkv_out_specs = [kcol, kcol]
+    dkv_scratch = [pltpu.VMEM((bk, d), jnp.float32),
+                   pltpu.VMEM((bk, d), jnp.float32)]
     if bias is not None:
-        dkv_specs.append(pl.BlockSpec((None, 8, bk),
-                                      lambda i, j, qq: (i, 0, j),
-                                      **mem_kwargs))
+        biascol = pl.BlockSpec((None, 8, bk), lambda i, j, qq: (i, 0, j),
+                               **mem_kwargs)
+        dkv_specs.append(biascol)
         dkv_ops.append(bias)
-    dk, dv = pl.pallas_call(
+        dkv_out_shape.append(
+            jax.ShapeDtypeStruct((b * h, 8, sk), jnp.float32))
+        dkv_out_specs.append(biascol)
+        dkv_scratch.append(pltpu.VMEM((8, bk), jnp.float32))
+    outs = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq,
                           has_bias=bias is not None),
-        out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        out_shape=tuple(dkv_out_shape),
         grid=(b * h, nk, nq),
         in_specs=dkv_specs,
-        out_specs=(kcol, kcol),
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
+        out_specs=tuple(dkv_out_specs),
+        scratch_shapes=dkv_scratch,
         interpret=interpret,
         **_compiler_params(("parallel", "parallel", "arbitrary")),
     )(*dkv_ops)
+    dk, dv = outs[:2]
+    dbias3 = outs[2] if bias is not None else None
 
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+            dv.reshape(b, h, sk, d), dbias3)
 
 
 def _reference_attention(q, k, v, scale, causal):
@@ -640,9 +673,9 @@ def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
     # two-kernel path whose dkv pass pins only q/dO (no f32 accumulator).
     if _HAS_TPU_PALLAS and q.shape[2] * q.shape[3] * 10 <= 8 * 1024 * 1024:
         return _flash_bwd_fused(q, k, v, out, lse, g, scale, causal, block_q,
-                                block_k, interpret)
+                                block_k, interpret)[:3]
     return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-                      interpret)
+                      interpret)[:3]
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -665,7 +698,9 @@ def flash_attention_bias(q, k, v, bias, causal=False, scale=None,
     any pattern, per-key score offsets). Per-QUERY-relative biases
     (ALiBi's -m*|q-k|) are NOT expressible per-key and take the XLA
     path. The bias is tiled over heads and streamed to the kernels one
-    k-block at a time; its cotangent is zero (masks are not trained)."""
+    k-block at a time; its cotangent is the true per-key gradient
+    (sum of dS over q rows and heads, accumulated in the backward
+    kernels), so trainable biases match the XLA path's grad."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     bias3 = _tile_bias(bias, q.shape[0], q.shape[1])
@@ -688,12 +723,22 @@ def _fab_bwd(causal, scale, block_q, block_k, interpret, res, g):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if _HAS_TPU_PALLAS and q.shape[2] * q.shape[3] * 10 <= 8 * 1024 * 1024:
-        dq, dk, dv = _flash_bwd_fused(q, k, v, out, lse, g, scale, causal,
-                                      block_q, block_k, interpret, bias3)
+        dq, dk, dv, db3 = _flash_bwd_fused(q, k, v, out, lse, g, scale,
+                                           causal, block_q, block_k,
+                                           interpret, bias3)
     else:
-        dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal,
-                                block_q, block_k, interpret, bias3)
-    return dq, dk, dv, jnp.zeros_like(bias)
+        dq, dk, dv, db3 = _flash_bwd(q, k, v, out, lse, g, scale, causal,
+                                     block_q, block_k, interpret, bias3)
+    # kernels emit per-(b,h) column sums [b*h, 8, sk] (8 identical sublane
+    # rows); the [B, Sk] bias broadcast over heads, so its cotangent sums
+    # over h. This is the TRUE gradient — a trainable per-key bias (e.g.
+    # learned ALiBi-style offsets) now matches the XLA path's grad.
+    b, h = q.shape[0], q.shape[1]
+    sk = k.shape[2]
+    dbias = db3.reshape(b, h, 8, sk)[:, :, 0, :].sum(axis=1)
+    if bias.shape[0] == 1 and b > 1:  # broadcast batch: sum its cotangent
+        dbias = dbias.sum(axis=0, keepdims=True)
+    return dq, dk, dv, dbias.astype(bias.dtype)
 
 
 flash_attention_bias.defvjp(_fab_fwd, _fab_bwd)

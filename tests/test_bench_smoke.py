@@ -22,3 +22,23 @@ def test_bench_cpu_smoke_emits_json_line():
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["value"] > 0
     assert rec["degraded"] is True  # CPU path must self-mark
+
+
+def test_bench_single_axis_modes_cpu():
+    """Every named axis (r5: one parsed record per BASELINE config) must
+    run standalone — a bitrotted secondary axis would silently vanish
+    from the multi-axis default."""
+    env = dict(os.environ)
+    env.update({"PADDLE_TPU_BENCH_PROBED": "1", "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": ""})
+    env.pop("XLA_FLAGS", None)
+    for axis in ("bert_base", "decode"):
+        r = subprocess.run([sys.executable, "bench.py", axis], env=env,
+                           capture_output=True, text=True, timeout=600,
+                           cwd="/root/repo")
+        assert r.returncode == 0, (axis, r.stderr[-3000:])
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        assert lines, (axis, r.stdout)
+        rec = json.loads(lines[0])
+        assert rec["value"] > 0
+        assert rec.get("degraded") is True

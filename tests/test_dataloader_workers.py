@@ -241,3 +241,37 @@ class TestPersistentWorkers:
         assert vals == [float(i) for i in range(12)], \
             "stale frames from the abandoned epoch leaked into the next"
         dl.close()
+
+    def test_abandoned_epoch_with_blocked_feeder(self):
+        """r4 advisor HIGH: when the abandoned epoch has MORE batches than
+        the bounded channel's depth, the feeder is still blocked pushing
+        when reset() runs — joining it without draining deadlocked. Guard
+        with an alarm so a regression fails instead of hanging CI."""
+        import signal
+
+        import paddle_tpu.io as io
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 200  # 100 batches >> channel depth (4)
+
+            def __getitem__(self, i):
+                return np.full((64,), i, np.float32)
+
+        def _alarm(signum, frame):
+            raise TimeoutError("persistent-worker reset deadlocked")
+
+        old = signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(120)
+        try:
+            dl = io.DataLoader(DS(), batch_size=2, num_workers=2,
+                               persistent_workers=True, shuffle=False)
+            for b in dl:  # one batch, abandon: feeder still mid-epoch
+                break
+            vals = sorted(float(b.numpy()[i, 0])
+                          for b in dl for i in range(b.shape[0]))
+            assert vals == [float(i) for i in range(200)]
+            dl.close()
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)

@@ -175,11 +175,22 @@ class TestWeightOnlyInt8Decode:
         m.eval()
         ids = np.zeros((1, 8), np.int32)
         m.generate(ids, 4, weight_quant="int8")
-        marker1 = m._w8_cache[0]
+        quant1 = m._w8_cache[-1]
+        m.generate(ids, 4, weight_quant="int8")
+        assert m._w8_cache[-1] is quant1, \
+            "cache missed although no weight changed"
         m.to(dtype="bfloat16")  # new weight arrays
         m.generate(ids, 4, weight_quant="int8")
-        assert m._w8_cache[0] != marker1, \
+        assert m._w8_cache[-1] is not quant1, \
             "stale quantized weights reused after weights changed"
+        # change ONE non-wte parameter in place: the id()-keyed r4 cache
+        # missed this class entirely (advisor finding)
+        quant2 = m._w8_cache[-1]
+        p = dict(m.named_parameters())["h.0.fc1.weight"]
+        p.set_value(np.asarray(p.numpy()) * 0 + 1)
+        m.generate(ids, 4, weight_quant="int8")
+        assert m._w8_cache[-1] is not quant2, \
+            "cache ignored a non-wte parameter change"
 
     def test_unknown_weight_quant_raises(self):
         import pytest

@@ -209,8 +209,8 @@ class TestFlashAttention:
         g = jnp.asarray(np.random.rand(b, h, s, d).astype(np.float32))
         for causal in (False, True):
             out, lse = _flash_fwd_lse(q, k, v, scale, causal, 64, 64, True)
-            dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal,
-                                    64, 64, True)
+            dq, dk, dv, _ = _flash_bwd(q, k, v, out, lse, g, scale, causal,
+                                       64, 64, True)
             ref = jax.vjp(
                 lambda q, k, v: _reference_attention(q, k, v, scale, causal),
                 q, k, v)[1](g)
