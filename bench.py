@@ -469,6 +469,10 @@ def main():
         if axis in ("decode", "gpt2s_gen"):
             _bench_decode(on_tpu)
             return
+        if axis not in AXES:  # a typo must not silently bench gpt2s
+            raise SystemExit(
+                f"unknown bench axis {axis!r}; choose from "
+                f"{AXES + ('gpt2s_gen',)}")
         print(json.dumps(_bench_train(axis, on_tpu)))
         return
 

@@ -282,18 +282,25 @@ class MultiprocessLoaderIter:
             # until it exits (every pop frees a slot for its next push; the
             # workers finish the old epoch's queued tasks, so the feeder's
             # receive loop terminates), then discard whatever is left.
-            deadline = 600  # empty-pop polls; a dead worker would spin here
-            while feeder.is_alive() and deadline > 0:
-                # tight drain: pop until the channel is momentarily empty,
-                # then check the feeder — only empty polls charge the
-                # deadline, so epoch size never bounds this loop. Stop on
-                # an END frame too: a CLOSED channel's pop returns END
-                # forever, never None.
+            # Drain until the feeder exits. The stall guard is PROGRESS
+            # based, not iteration based: as long as frames keep arriving
+            # the workers are healthy (however slow), matching the
+            # loader's own timeout semantics (self.timeout, None = wait
+            # forever → a generous stall default applies only here).
+            import time as _time
+            stall_limit = self.timeout or 300.0
+            last_progress = _time.time()
+            while feeder.is_alive():
+                # tight drain: pop until the channel is momentarily empty.
+                # Stop on an END frame too: a CLOSED channel's pop returns
+                # END forever, never None.
                 got = self._chan.pop(timeout=0.02)
                 while got is not None and got[0] != _TAG_END:
+                    last_progress = _time.time()
                     got = self._chan.pop(timeout=0.02)
                 feeder.join(timeout=0.05)
-                deadline -= 1
+                if _time.time() - last_progress > stall_limit:
+                    break
             if feeder.is_alive():
                 self._shutdown_workers()
                 raise RuntimeError(

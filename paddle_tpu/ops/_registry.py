@@ -50,7 +50,7 @@ def _wrap_outputs(res, record_node, name, diff_tensors, vjp_fn,
         for o in live:
             o._node = node
     if multi:
-        return type(res)(outs) if isinstance(res, tuple) else outs
+        return tuple(outs) if isinstance(res, tuple) else outs
     return outs[0]
 
 
@@ -76,6 +76,19 @@ def _amp_cast_fn(fn, name):
             if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating)
             and o.dtype == dt else o, out)
     return wrapped
+
+
+def _plain_tuple(res):
+    """NamedTuple results (jnp.linalg.svd/qr/slogdet...) are normalized to
+    plain tuples: jax.vjp's cotangent structure must match the primal
+    output pytree, and the tape feeds plain-tuple cotangents (the
+    reference's svd/qr return plain tuples too)."""
+    return tuple(res) if isinstance(res, tuple) and hasattr(res, "_fields") \
+        else res
+
+
+def _call_plain(fn, *a, **k):
+    return _plain_tuple(fn(*a, **k))
 
 
 def apply_op(fn, name, args, kwargs, nondiff=False, stochastic=False):
@@ -150,8 +163,12 @@ def defop(name=None, nondiff=False, stochastic=False):
     """Register a pure JAX function as a framework op."""
     def deco(fn):
         opname = name or fn.__name__
+        # normalize namedtuple returns ONCE at registration (not per call:
+        # eager dispatch is the hot path); only linalg-style ops ever
+        # return them
+        fn = functools.partial(_call_plain, fn)
 
-        @functools.wraps(fn)
+        @functools.wraps(fn.func)
         def wrapper(*args, **kwargs):
             return apply_op(fn, opname, args, kwargs, nondiff, stochastic)
 

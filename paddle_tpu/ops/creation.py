@@ -111,8 +111,10 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     return jnp.transpose(out, perm)
 
 
-@defop(nondiff=True)
+@defop()
 def meshgrid(*xs):
+    # differentiable (ref: paddle.meshgrid backpropagates to its inputs —
+    # the grad-autosweep caught the earlier nondiff registration)
     xs = xs[0] if len(xs) == 1 and isinstance(xs[0], (list, tuple)) else xs
     return tuple(jnp.meshgrid(*xs, indexing="ij"))
 
