@@ -125,7 +125,13 @@ def build_hybrid_train_step(strategy, loss_fn, optimizer, mesh=None,
                 "device annotation; the TPU rebuild takes the per-stage fn)")
         from ...parallel.pipeline import make_pipeline_loss
         m = strategy.pipeline_configs.get("accumulate_steps", 1)
-        pl_loss = make_pipeline_loss(stage_fn, loss_head, mesh, m, "pp")
+        # schedule: "gpipe" (default) or "interleaved" (circular, each
+        # rank holds `num_virtual` non-adjacent chunks; bubble shrinks
+        # from (S-1)/(M+S-1) to (S-1)/(V*M+S-1))
+        sched = strategy.pipeline_configs.get("schedule", "gpipe")
+        v = strategy.pipeline_configs.get("num_virtual", 1)
+        pl_loss = make_pipeline_loss(stage_fn, loss_head, mesh, m, "pp",
+                                     schedule=sched, num_virtual=v)
 
         def loss_fn(params, batch, key):  # noqa: F811
             labels = batch.get("labels", batch.get("y"))

@@ -169,7 +169,8 @@ def _stage_fn(stage, x, *, sp_axis, mp_axis, ring_impl):
 
 
 def build_hybrid_gpt2_loss(mesh, num_microbatches=2, ring_impl=None,
-                           vocab_size=None):
+                           vocab_size=None, pp_schedule="gpipe",
+                           num_virtual=1):
     """Pure loss_fn(params, batch) running dp×pp×mp×sp on `mesh`.
 
     batch: {"input_ids": [B, S] int32, "labels": [B, S] int32} — B sharded
@@ -179,13 +180,19 @@ def build_hybrid_gpt2_loss(mesh, num_microbatches=2, ring_impl=None,
     `vocab_size`: the TRUE vocab size when the embedding is padded for the
     mp split (pad_vocab); padded logit columns are masked out of the
     softmax statistics.
+    `pp_schedule`: "gpipe" or "interleaved" (circular; each pp rank holds
+    `num_virtual` non-adjacent layer chunks — parallel/pipeline.py).
     """
     from jax.experimental.shard_map import shard_map
 
-    from ..parallel.pipeline import pipeline_apply
+    from ..parallel.pipeline import (pipeline_apply,
+                                     pipeline_apply_interleaved)
 
     axes = dict(mesh.shape)
     use_pp = axes.get("pp", 1) > 1
+    if pp_schedule not in ("gpipe", "interleaved"):
+        raise ValueError(f"unknown pipeline schedule {pp_schedule!r}")
+    interleaved = use_pp and pp_schedule == "interleaved" and num_virtual > 1
     sp_axis = "sp" if axes.get("sp", 1) > 1 else None
     mp_axis = "mp" if axes.get("mp", 1) > 1 else None
 
@@ -219,14 +226,26 @@ def build_hybrid_gpt2_loss(mesh, num_microbatches=2, ring_impl=None,
         x = x + params["wpe"][pos][None]
         stage_fn = functools.partial(_stage_fn, sp_axis=sp_axis,
                                      mp_axis=mp_axis, ring_impl=ring_impl)
-        stage = {k: (v[0] if k.startswith("blk.") else v)
-                 for k, v in params.items()}  # local pp slice: [1, L/pp,...]
-        if use_pp:
+        if interleaved:
+            # pass ONLY the chunk-stacked blk leaves (the schedule indexes
+            # every leaf's leading V dim); blk arrive [V, 1, nblk, ...]
+            # with dim 1 pp-sharded
+            chunks = {k: v[:, 0] for k, v in params.items()
+                      if k.startswith("blk.")}
+            m = num_microbatches
+            mbs = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+            outs = pipeline_apply_interleaved(stage_fn, chunks, mbs, "pp")
+            y = outs.reshape((x.shape[0],) + outs.shape[2:])
+        elif use_pp:
+            stage = {k: (v[0] if k.startswith("blk.") else v)
+                     for k, v in params.items()}  # local: [1, L/pp, ...]
             m = num_microbatches
             mbs = x.reshape((m, x.shape[0] // m) + x.shape[1:])
             outs = pipeline_apply(stage_fn, stage, mbs, "pp")
             y = outs.reshape((x.shape[0],) + outs.shape[2:])
         else:
+            stage = {k: (v[0] if k.startswith("blk.") else v)
+                     for k, v in params.items()}
             y = stage_fn(stage, x)
         y = _ln(y, params["ln_f.w"], params["ln_f.b"])
         # logits stay vocab-sharded: [B, S_l, V_pad/mp] per rank
@@ -267,11 +286,43 @@ def build_hybrid_gpt2_loss(mesh, num_microbatches=2, ring_impl=None,
     def loss_fn(params, batch):
         specs = hybrid_param_specs(params)
         data_spec = P("dp", "sp")
+        params_in = params
+        if interleaved:
+            # blk [pp, lps, ...] is layer order p*lps + i; flatten to [L]
+            # and regroup [V, S, nblk, ...] — sharding dim 1 on pp gives
+            # rank r chunks {l*S + r}, the circular placement
+            s_pp = axes["pp"]
+
+            def regroup(k, v):
+                if not k.startswith("blk."):
+                    return v
+                L = v.shape[0] * v.shape[1]
+                if L % (num_virtual * s_pp):
+                    raise ValueError(
+                        f"interleaved schedule needs num_layers ({L}) "
+                        f"divisible by num_virtual*pp "
+                        f"({num_virtual}*{s_pp})")
+                nblk = L // (num_virtual * s_pp)
+                return v.reshape((L,) + v.shape[2:]).reshape(
+                    (num_virtual, s_pp, nblk) + v.shape[2:])
+
+            params_in = {k: regroup(k, v) for k, v in params.items()}
+
+            def respec(k):
+                if not k.startswith("blk."):
+                    return specs[k]
+                # (pp, lps_spec, rest...) -> (None_V, pp_S, None_nblk,
+                # rest...): TP dims keep their mp sharding
+                rest = tuple(specs[k])[2:]
+                return P(*((None, "pp", None) + rest))
+
+            specs = {k: respec(k) for k in specs}
         return shard_map(
             inner, mesh=mesh,
             in_specs=(specs, data_spec, data_spec),
             out_specs=P(),
-            check_rep=False)(params, batch["input_ids"], batch["labels"])
+            check_rep=False)(params_in, batch["input_ids"],
+                             batch["labels"])
 
     return loss_fn
 

@@ -42,9 +42,11 @@ def _merge_block_params(stacked, other):
 
 
 def build_pp_train_step(cfg: GPT2Config, mesh, num_microbatches=4,
-                        pp_axis="pp"):
-    """Returns (loss_fn(stacked, other, batch), init()) where loss_fn runs the
-    GPipe schedule over `pp_axis` of `mesh`."""
+                        pp_axis="pp", schedule="gpipe", num_virtual=1):
+    """Returns (loss_fn(stacked, other, batch), init()) where loss_fn runs
+    the selected pipeline schedule over `pp_axis` of `mesh` ("gpipe", or
+    "interleaved" with `num_virtual` chunks per rank — see
+    parallel/pipeline.py for the schedules and their bubble fractions)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental.shard_map import shard_map
@@ -52,13 +54,20 @@ def build_pp_train_step(cfg: GPT2Config, mesh, num_microbatches=4,
 
     from ..core import rng as rng_mod
     from ..core.tensor import Tensor
-    from ..parallel.pipeline import pipeline_apply
+    from ..parallel.pipeline import (pipeline_apply,
+                                     pipeline_apply_interleaved)
 
     model = GPT2(cfg)
     model.train()
     assert cfg.dropout == 0.0, "pp step: disable dropout (rng is per-trace)"
     s_pp = mesh.shape[pp_axis]
     assert cfg.num_layers % s_pp == 0
+    if schedule not in ("gpipe", "interleaved"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    interleaved = schedule == "interleaved" and num_virtual > 1
+    if interleaved:
+        assert cfg.num_layers % (s_pp * num_virtual) == 0
+        assert num_microbatches % s_pp == 0
 
     block0 = model.h[0]
 
@@ -111,18 +120,38 @@ def build_pp_train_step(cfg: GPT2Config, mesh, num_microbatches=4,
         x0 = embed(other, batch["input_ids"])
 
         def inner(stacked_local, x0, labels):
-            stage_tree = stacked_local  # leaves already [L/S, ...] local shard
             m = num_microbatches
             mbs = x0.reshape((m, x0.shape[0] // m) + x0.shape[1:])
-            outs = pipeline_apply(stage_fn, stage_tree, mbs, pp_axis)
+            if interleaved:
+                # leaves [V, 1, L/(V*S), ...]: this rank's V chunks
+                chunk_tree = jax.tree_util.tree_map(
+                    lambda p: p[:, 0], stacked_local)
+                outs = pipeline_apply_interleaved(stage_fn, chunk_tree,
+                                                  mbs, pp_axis)
+            else:
+                stage_tree = stacked_local  # leaves [L/S, ...] local
+                outs = pipeline_apply(stage_fn, stage_tree, mbs, pp_axis)
             h = outs.reshape((x0.shape[0],) + outs.shape[2:])
             return h
 
-        spec_stk = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked)
+        if interleaved:
+            # [L, ...] layer order -> [V, S, L/(V*S), ...]; shard dim 1 on
+            # pp: rank r holds chunks {l*S + r} of consecutive layers —
+            # the circular placement (layers l*S*(L/VS) + r*(L/VS) ...)
+            nblk = cfg.num_layers // (s_pp * num_virtual)
+            stacked_in = jax.tree_util.tree_map(
+                lambda p: p.reshape((num_virtual, s_pp, nblk)
+                                    + p.shape[1:]),
+                stacked)
+            spec_stk = jax.tree_util.tree_map(
+                lambda _: P(None, pp_axis), stacked_in)
+        else:
+            stacked_in = stacked
+            spec_stk = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked)
         h = shard_map(inner, mesh=mesh,
                       in_specs=(spec_stk, P(), P()),
                       out_specs=P(), check_rep=False)(
-            stacked, x0, batch["labels"])
+            stacked_in, x0, batch["labels"])
         return head_loss(other, h, batch["labels"])
 
     return loss_fn, init

@@ -108,6 +108,24 @@ g_z = jax.grad(zz_loss)(host_params, zz_batch)
 dz = float(jnp.max(jnp.abs(g_z["wte"] - g_r["wte"])))
 assert dz / scale < 5e-3, (dz, scale)
 print("ZIGZAG_OK", zz, ref2)
+
+# circular-interleaved pipeline schedule inside the SAME 4D composition
+# (VERDICT r4 next #5): num_layers=4, pp=2 -> V=2 chunks/rank; exact
+# parity vs the meshless reference AND the GPipe loss, fwd + wte grads
+il_loss = build_hybrid_gpt2_loss(mesh, num_microbatches=2,
+                                 vocab_size=VOCAB,
+                                 pp_schedule="interleaved", num_virtual=2)
+il = float(jax.jit(il_loss)(host_params, batch))
+assert abs(il - ref2) < 1e-3 * max(1.0, abs(ref2)), (il, ref2)
+g_i = jax.grad(il_loss)(host_params, batch)
+di = float(jnp.max(jnp.abs(g_i["wte"] - g_r["wte"])))
+assert di / scale < 5e-3, (di, scale)
+# block-param grads must match too (the interleaved regroup reshapes
+# them; a placement bug would show here, not in wte)
+db = float(jnp.max(jnp.abs(g_i["blk.w1"] - g_r["blk.w1"])))
+sb = float(jnp.max(jnp.abs(g_r["blk.w1"]))) + 1e-9
+assert db / sb < 5e-3, (db, sb)
+print("INTERLEAVED_OK", il, ref2)
 """
 
 
@@ -124,3 +142,4 @@ def test_4d_hybrid_parity_and_training():
     assert "TRAIN_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
     assert "GRAD_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
     assert "ZIGZAG_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
+    assert "INTERLEAVED_OK" in r.stdout, r.stdout + "\n" + r.stderr[-4000:]
