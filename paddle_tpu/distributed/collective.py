@@ -419,3 +419,74 @@ def quantized_all_reduce(x, axis_name, bits=8, block=256):
     if pad:
         out = out[:-pad]
     return out.reshape(orig_shape).astype(x.dtype)
+
+
+def quantized_allreduce_wire_bytes(size, n, bits=8, block=256):
+    """Per-rank wire bytes of `quantized_all_reduce` vs the f32 ring
+    all-reduce it replaces, for a `size`-element f32 tensor over n ranks.
+    Instrumentation for the byte-savings claim (VERDICT r4 next #8) —
+    the same block/padding arithmetic as the collective itself.
+
+    Compressed: the all_to_all sends each rank's (n-1)/n foreign chunks
+    once (codes + per-block scales), the all_gather sends the reduced
+    local chunk to the other n-1 ranks. f32 ring: reduce-scatter +
+    all-gather each move size*4*(n-1)/n bytes per rank.
+    """
+    f32 = 2 * size * 4 * (n - 1) // n
+    if size < n * block:
+        # mirrors the collective's small-tensor fallback: plain f32 psum,
+        # no savings (bucket small leaves to compress them)
+        return f32, f32
+    code_bytes = bits // 8
+    padded = size + (-size) % (n * block)
+    chunk = padded // n
+    scale_bytes = (chunk // block) * 4
+    a2a = (n - 1) * (chunk * code_bytes + scale_bytes)
+    ag = (n - 1) * (chunk * code_bytes + scale_bytes)
+    return a2a + ag, f32
+
+
+def bucketed_quantized_all_reduce(grads, axis_name, bucket_bytes=1 << 25,
+                                  bits=8, block=256):
+    """Gradient sync in fixed-size buckets of concatenated leaves (ref:
+    the imperative reducer's bucketed NCCL all-reduce overlapping the
+    backward). Two effects vs per-leaf quantized_all_reduce: (a) small
+    leaves (biases, norms) ride the compressed path inside a bucket
+    instead of falling back to plain f32 psum, and (b) each bucket is an
+    INDEPENDENT collective depending only on its own leaves' grads, so
+    XLA's scheduler can start bucket i's all_to_all while the backward
+    for earlier layers (later buckets) is still computing — the overlap
+    the reference gets from its reducer thread. Call inside shard_map
+    over `axis_name`. Returns the summed tree (divide by n for mean).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    buckets, cur, cur_bytes = [], [], 0
+    for i, leaf in enumerate(leaves):
+        cur.append(i)
+        cur_bytes += leaf.size * 4
+        if cur_bytes >= bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    out = [None] * len(leaves)
+
+    def _blockpad(i):
+        # each leaf padded to a BLOCK boundary: a tiny bias grad must not
+        # share a block abs-max scale with a neighboring weight grad (a
+        # shared O(1) scale quantizes an O(1e-4) bias to pure noise)
+        v = leaves[i].reshape(-1).astype(jnp.float32)
+        pad = (-v.size) % block
+        return jnp.pad(v, (0, pad)) if pad else v, v.size + pad
+
+    for idx in buckets:
+        padded = [_blockpad(i) for i in idx]
+        flat = jnp.concatenate([p[0] for p in padded])
+        red = quantized_all_reduce(flat, axis_name, bits=bits, block=block)
+        off = 0
+        for i, (_, n_pad) in zip(idx, padded):
+            n_el = leaves[i].size
+            out[i] = red[off:off + n_el].reshape(
+                leaves[i].shape).astype(leaves[i].dtype)
+            off += n_pad
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -288,10 +288,14 @@ def _build_explicit_dp_step(strategy, loss_fn, optimizer, mesh):
             # periodic param average — so int8_allreduce must not
             # reintroduce per-step grad sync under it)
             # EQuARX-pattern compressed gradient sync: int8 blockwise
-            # reduce-scatter + all-gather in place of the f32 psum
-            from ..collective import quantized_all_reduce
+            # reduce-scatter + all-gather in place of the f32 psum —
+            # BUCKETED (r5): small leaves ride the compressed path and
+            # each bucket is an independent collective the scheduler can
+            # overlap with the rest of the backward (reference reducer)
+            from ..collective import bucketed_quantized_all_reduce
             grads = jax.tree_util.tree_map(
-                lambda g: quantized_all_reduce(g, "dp") / dp, grads)
+                lambda g: g / dp,
+                bucketed_quantized_all_reduce(grads, "dp"))
         elif not use_localsgd:
             grads = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, "dp"), grads)

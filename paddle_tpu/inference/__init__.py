@@ -263,3 +263,6 @@ class PredictorPool:
 
     def __len__(self):
         return len(self._preds)
+
+
+from .serving import GenerationServer, measure_offered_load  # noqa: E402

@@ -600,6 +600,8 @@ def export_generator(model: "GPT2", path_prefix, prompt_len,
             "kv_quant": kv_quant, "prompt_len": int(prompt_len),
             "max_new_tokens": int(max_new_tokens), "top_k": int(top_k),
             "top_p_enabled": bool(top_p_enabled),
+            # None = batch-polymorphic (serving layers pick their own B)
+            "batch_size": None if batch_size is None else int(batch_size),
             "inputs": ["ids[int32]", "seed[uint32]",
                        "temperature[f32]", "eos[int32]", "top_p[f32]",
                        "pad[int32] (-1 disables left-pad masking)"]}
