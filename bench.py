@@ -89,7 +89,14 @@ def _bench_train(model_name, on_tpu):
         img = 224 if on_tpu else 32
         batch_candidates, seq = ((256, 128, 64) if on_tpu else (4,)), img
         inner = 30 if on_tpu else 2
-        model = resnet50(num_classes=1000)
+        nhwc = os.environ.get("PADDLE_TPU_RESNET_NHWC") == "1"
+        if nhwc:  # r5 lever A/B: channels on the lane dim
+            from paddle_tpu.vision.models.resnet import (BottleneckBlock,
+                                                         ResNet)
+            model = ResNet(BottleneckBlock, 50, num_classes=1000,
+                           data_format="NHWC")
+        else:
+            model = resnet50(num_classes=1000)
         model.train()
 
         def init_params():
@@ -154,6 +161,10 @@ def _bench_train(model_name, on_tpu):
             batch_candidates, seq = (4,), 128
             inner = 3
         metric_name = "gpt2s_train_tokens_per_sec_per_chip"
+    if os.environ.get("PADDLE_TPU_BENCH_BATCHES"):
+        batch_candidates = tuple(
+            int(b) for b in
+            os.environ["PADDLE_TPU_BENCH_BATCHES"].split(","))
     if model_name != "resnet50":
         cfg.dropout = 0.0
         loss_fn, init_params, model = build_train_step(cfg, remat=False)
@@ -178,12 +189,13 @@ def _bench_train(model_name, on_tpu):
 
     def make_data(batch):
         if model_name == "resnet50":
+            img_shape = (batch, seq, seq, 3) if nhwc else (batch, 3, seq,
+                                                           seq)
             return {
                 # bf16 images: a f32 image against bf16 conv weights would
                 # promote the whole conv to f32 (quarter MXU rate)
                 "images": jnp.asarray(rng.rand(
-                    batch, 3, seq, seq).astype(np.float32)).astype(
-                        jnp.bfloat16),
+                    *img_shape).astype(np.float32)).astype(jnp.bfloat16),
                 "labels": jnp.asarray(rng.randint(
                     0, 1000, (batch,)).astype(np.int32)),
             }

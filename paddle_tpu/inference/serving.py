@@ -191,6 +191,11 @@ class GenerationServer:
             defaults = list(self._defaults)
             if not any(r.padded for r in batch):
                 defaults[-1] = np.int32(-1)
+            # per-batch seed: with temperature > 0 a FIXED seed would
+            # draw identical sampling noise for every batch (identical
+            # prompts -> identical completions, forever)
+            defaults[0] = np.uint32(
+                (int(self._defaults[0]) + self._batches) & 0xFFFFFFFF)
             try:
                 out = self._program(ids, *defaults)
                 out = np.asarray(getattr(out, "numpy", lambda: out)())

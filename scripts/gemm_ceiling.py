@@ -15,14 +15,14 @@ Prints one line per config; run on the real chip.
 """
 from __future__ import annotations
 
+import os
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, "/root/repo/scripts")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _bench_util import scan_time_args  # noqa: E402
 
 

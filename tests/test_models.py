@@ -283,3 +283,23 @@ class TestHeadMatmulLayout:
             lambda i, l: bm.pretraining_loss(Tensor(i), Tensor(l))._value,
             (ids, lbl), bm.cfg.vocab_size)
         assert bad == [], f"3-D mlm head dot reappeared: {bad}"
+
+
+class TestResNetNHWC:
+    def test_nhwc_matches_nchw_exactly(self):
+        """data_format="NHWC" (r5: channels on the TPU lane dim) must be
+        numerically identical to NCHW with the same seeded weights."""
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import resnet18
+        from paddle_tpu.vision.models.resnet import BasicBlock, ResNet
+
+        paddle.seed(0)
+        m1 = resnet18(num_classes=10)
+        paddle.seed(0)
+        m2 = ResNet(BasicBlock, 18, num_classes=10, data_format="NHWC")
+        x = np.random.RandomState(0).rand(2, 3, 32, 32).astype(np.float32)
+        m1.eval()
+        m2.eval()
+        o1 = m1(paddle.to_tensor(x)).numpy()
+        o2 = m2(paddle.to_tensor(x.transpose(0, 2, 3, 1))).numpy()
+        np.testing.assert_array_equal(o1, o2)
