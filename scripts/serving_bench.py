@@ -1,0 +1,93 @@
+"""Serving throughput/latency vs offered load (VERDICT r4 next #7).
+
+Exports the GPT-2-small decode program in the measured peak config
+(W8A16 weights + int8 KV, batch 40) plus a latency config (bf16,
+batch 8), then drives each through GenerationServer at increasing
+offered request rates and prints a tokens/s + p50/p99 table — the
+serving-process numbers the r4 decode wins only implied.
+
+Run on the real chip: python scripts/serving_bench.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import GenerationServer, measure_offered_load
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config, export_generator
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/root/repo/.jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          2.0)
+    except Exception:
+        pass
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg, prompt, new = GPT2Config(), 64, 128
+        configs = [("peak_w8_kv8_b40", dict(batch_size=40,
+                                            weight_quant="int8",
+                                            kv_quant="int8")),
+                   ("latency_bf16_b8", dict(batch_size=8))]
+        rates = (5, 15, 40, 80)
+        dur = 20.0
+    else:  # smoke
+        cfg, prompt, new = GPT2Config.tiny(), 8, 8
+        configs = [("tiny_b4", dict(batch_size=4))]
+        rates = (20,)
+        dur = 2.0
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    if on_tpu:
+        model.to(dtype="bfloat16")
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (prompt,)).astype(np.int32)
+               for _ in range(64)]
+
+    for name, kw in configs:
+        prefix = os.path.join(tempfile.mkdtemp(), name)
+        export_generator(model, prefix, prompt_len=prompt,
+                         max_new_tokens=new, **kw)
+        served = paddle.jit.load(prefix)
+        print(f"\n## {name} (prompt={prompt} new={new} "
+              f"B={kw.get('batch_size')})", flush=True)
+        print(f"{'offered rps':>12} {'achieved':>9} {'tok/s':>9} "
+              f"{'fill':>5} {'p50 ms':>8} {'p90 ms':>8} {'p99 ms':>8}",
+              flush=True)
+        for rps in rates:
+            srv = GenerationServer(served, pad_token_id=0,
+                                   max_wait_ms=30.0).start()
+            # warm the compiled program before the timed window
+            srv.submit(prompts[0]).result(timeout=600)
+            srv._lat.clear()
+            srv._tokens_out = 0
+            srv._batches = srv._rows = 0
+            import time
+            srv._t0 = time.perf_counter()
+            out = measure_offered_load(srv, prompts, rps, dur)
+            srv.stop()
+            print(f"{rps:>12} {out['achieved_rps']:>9.1f} "
+                  f"{out['tokens_per_sec']:>9.0f} "
+                  f"{out['batch_fill']:>5.2f} {out['p50_ms']:>8.0f} "
+                  f"{out['p90_ms']:>8.0f} {out['p99_ms']:>8.0f}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
