@@ -461,15 +461,8 @@ def main():
         os.environ["PADDLE_TPU_BENCH_PROBED"] = "1"
     import jax
 
-    # persistent XLA compilation cache: a bench run right after a
-    # warm-up run skips the 20-40s compiles
-    try:
-        os.makedirs("/root/repo/.jax_cache", exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir",
-                          "/root/repo/.jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
+    from paddle_tpu.utils import enable_persistent_compilation_cache
+    enable_persistent_compilation_cache()
 
     import paddle_tpu  # noqa: F401
 

@@ -91,6 +91,7 @@ class GenerationServer:
         self._lat = []
         self._tokens_out = 0
         self._batches = 0
+        self._batches_at_reset = 0
         self._rows = 0
         self._t0 = None
 
@@ -136,18 +137,28 @@ class GenerationServer:
                 req.future.set_exception(RuntimeError("server stopped"))
             self._queue.clear()
 
+    def reset_stats(self):
+        """Zero the latency/throughput counters (benchmark windows); the
+        batch counter keeps advancing so sampling seeds never repeat."""
+        with self._lock:
+            self._lat.clear()
+            self._tokens_out = 0
+            self._rows = 0
+            self._batches_at_reset = self._batches
+            self._t0 = time.perf_counter()
+
     def stats(self):
         """Throughput and latency of everything served so far."""
         with self._lock:
             lat = sorted(self._lat)
             dt = (time.perf_counter() - self._t0) if self._t0 else 0.0
             n = len(lat)
+            nb = self._batches - self._batches_at_reset
             pct = (lambda p: lat[min(n - 1, int(p * n))] if n else 0.0)
             return {
                 "requests": n,
-                "batches": self._batches,
-                "batch_fill": (self._rows / (self._batches or 1)
-                               / self.batch_size),
+                "batches": nb,
+                "batch_fill": self._rows / ((nb or 1) * self.batch_size),
                 "new_tokens": self._tokens_out,
                 "tokens_per_sec": self._tokens_out / dt if dt else 0.0,
                 "p50_ms": pct(0.50) * 1e3,

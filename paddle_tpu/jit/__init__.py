@@ -226,6 +226,8 @@ class TranslatedLayer(Layer):
     def __init__(self, exported, params, bufs, meta):
         super().__init__()
         self._exported = exported
+        # weights arrive device-committed from read_artifact (one
+        # transfer at load; host numpy here would re-ship them per call)
         self._params = params
         self._bufs = bufs
         self._meta = meta
@@ -325,9 +327,16 @@ def read_artifact(path_prefix):
                 a = a.view(dt).reshape(a.shape[:-1])
             return a
 
-        params = {k[2:]: get(k) for k in npz.files
+        import jax.numpy as jnp
+        # COMMIT weights to device HERE, once, for every artifact
+        # consumer (TranslatedLayer, static LoadedProgram, predictor):
+        # host numpy params make jit re-transfer them on EVERY call —
+        # ~130MB/call on the exported decode artifact, 8x slower than
+        # in-process (r5 serving A/B: 3,460ms -> 172ms per call)
+        params = {k[2:]: jnp.asarray(get(k)) for k in npz.files
                   if k.startswith("p:")}
-        bufs = {k[2:]: get(k) for k in npz.files if k.startswith("b:")}
+        bufs = {k[2:]: jnp.asarray(get(k)) for k in npz.files
+                if k.startswith("b:")}
     return exported, params, bufs, meta
 
 

@@ -176,3 +176,24 @@ class OpLastCheckpointChecker:
 
     def filter_updates(self, op_name, type=None, key=""):  # noqa: A002
         return []
+
+
+def enable_persistent_compilation_cache(path=None):
+    """Point jax at the repo-local persistent XLA compile cache so a
+    warm-up run skips the 20-40s TPU compiles. One definition for
+    bench.py and the perf/endurance scripts."""
+    import os as _os
+
+    import jax as _jax
+    if path is None:
+        path = _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        _os.makedirs(path, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", path)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           2.0)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
+    return path

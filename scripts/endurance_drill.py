@@ -84,13 +84,8 @@ def _build(args):
     from paddle_tpu import optimizer as opt_mod
     from paddle_tpu.models.gpt2 import GPT2Config, build_train_step
 
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          "/root/repo/.jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          2.0)
-    except Exception:
-        pass
+    from paddle_tpu.utils import enable_persistent_compilation_cache
+    enable_persistent_compilation_cache()
 
     cfg = GPT2Config.tiny() if TINY else GPT2Config()
     cfg.dropout = 0.0
@@ -193,15 +188,21 @@ def orchestrate(args):
     base = [sys.executable, os.path.abspath(__file__),
             "--dir", args.dir]
     print("== phase 1: run until SIGKILL ==", flush=True)
-    r1 = subprocess.run(base + ["--phase", "run",
-                                "--run-s", str(args.phase1_s + 600),
-                                "--kill-after-s", str(args.phase1_s)])
-    print(f"phase1 rc={r1.returncode} (expect -9)", flush=True)
-    assert r1.returncode == -signal.SIGKILL, r1.returncode
-    # SIGKILL skips atexit, so phase 1's DataLoader worker processes
-    # outlive it (they also hold inherited stdout open) — reap them
-    subprocess.run(["pkill", "-9", "-f",
-                    f"--dir {args.dir} --phase run"], check=False)
+    # own process group: spawn-started DataLoader workers carry a
+    # spawn_main argv (a pkill -f on OUR argv would never match them),
+    # but they inherit phase 1's pgid — killpg reaps the whole family
+    # after the SIGKILL (which skips atexit, orphaning them otherwise)
+    p1 = subprocess.Popen(base + ["--phase", "run",
+                                  "--run-s", str(args.phase1_s + 600),
+                                  "--kill-after-s", str(args.phase1_s)],
+                          start_new_session=True)
+    rc1 = p1.wait()
+    print(f"phase1 rc={rc1} (expect -9)", flush=True)
+    assert rc1 == -signal.SIGKILL, rc1
+    try:
+        os.killpg(p1.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
     time.sleep(2)
     print("== phase 2: resume ==", flush=True)
     r2 = subprocess.run(base + ["--phase", "resume",
@@ -213,7 +214,10 @@ def orchestrate(args):
             open(os.path.join(args.dir, "loss_log.jsonl"))]
     run = [r for r in recs if r["phase"] == "run"]
     res = [r for r in recs if r["phase"] == "resume"]
-    assert run and res, (len(run), len(res))
+    assert len(run) >= 3 and len(res) >= 3, (
+        f"too few dispatches to verify continuity (run={len(run)}, "
+        f"resume={len(res)}): lengthen --phase1-s/--phase2-s past the "
+        f"compile time")
     resume_step0 = res[0]["step"]
     ckpt_step = resume_step0 - INNER
     # (a) resume restarted from a checkpointed step, not from zero

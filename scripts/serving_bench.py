@@ -27,13 +27,8 @@ def main():
     from paddle_tpu.inference import GenerationServer, measure_offered_load
     from paddle_tpu.models.gpt2 import GPT2, GPT2Config, export_generator
 
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          "/root/repo/.jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          2.0)
-    except Exception:
-        pass
+    from paddle_tpu.utils import enable_persistent_compilation_cache
+    enable_persistent_compilation_cache()
 
     on_tpu = jax.default_backend() not in ("cpu",)
     paddle.seed(0)
@@ -43,7 +38,7 @@ def main():
                                             weight_quant="int8",
                                             kv_quant="int8")),
                    ("latency_bf16_b8", dict(batch_size=8))]
-        rates = (5, 15, 40, 80)
+        rates = (15, 40, 80, 120, 160)
         dur = 20.0
     else:  # smoke
         cfg, prompt, new = GPT2Config.tiny(), 8, 8
@@ -75,11 +70,7 @@ def main():
                                    max_wait_ms=30.0).start()
             # warm the compiled program before the timed window
             srv.submit(prompts[0]).result(timeout=600)
-            srv._lat.clear()
-            srv._tokens_out = 0
-            srv._batches = srv._rows = 0
-            import time
-            srv._t0 = time.perf_counter()
+            srv.reset_stats()
             out = measure_offered_load(srv, prompts, rps, dur)
             srv.stop()
             print(f"{rps:>12} {out['achieved_rps']:>9.1f} "

@@ -279,3 +279,24 @@ def test_bf16_artifact_roundtrip(tmp_path):
     ref = np.asarray(net(paddle.to_tensor(x)).numpy())
     assert out.astype(np.float32) == pytest.approx(
         ref.astype(np.float32), abs=1e-2)
+
+
+def test_loaded_artifact_weights_are_device_committed(tmp_path):
+    """r5 serving find: jit.load must commit the npz weights to device
+    ONCE — host numpy params make jit re-transfer them on EVERY call
+    (measured 8x on the exported decode artifact over the tunnel)."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix, input_spec=[
+        paddle.static.InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(prefix)
+    leaves = jax.tree_util.tree_leaves(loaded._params)
+    assert leaves, "no params in artifact"
+    for v in leaves:
+        assert isinstance(v, jax.Array), type(v)
