@@ -148,7 +148,8 @@ class GenerationServer:
             self._t0 = time.perf_counter()
 
     def stats(self):
-        """Throughput and latency of everything served so far."""
+        """Throughput and latency of the current measurement WINDOW —
+        everything since start() or the last reset_stats() call."""
         with self._lock:
             lat = sorted(self._lat)
             dt = (time.perf_counter() - self._t0) if self._t0 else 0.0

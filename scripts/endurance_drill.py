@@ -179,14 +179,22 @@ def run_phase(args):
             print(f"# ckpt @ step {step} ({time.time()-t0:.1f}s) "
                   f"loss {loss:.4f}", flush=True)
     wd.stop()
-    loader.close() if hasattr(loader, "close") else None
+    loader.close()
     print(f"# phase {args.phase} done: steps {step0}->{step}, "
-          f"watchdog trips={wd._fired}", flush=True)
+          f"watchdog trips={wd.fired}", flush=True)
 
 
 def orchestrate(args):
     base = [sys.executable, os.path.abspath(__file__),
             "--dir", args.dir]
+    # a reused --dir would append to the old loss log and resume from the
+    # old checkpoints — the verification would then read STALE records
+    for leftover in ("loss_log.jsonl", "ckpt"):
+        path = os.path.join(args.dir, leftover)
+        if os.path.exists(path):
+            raise SystemExit(
+                f"{path} exists: pass a fresh --dir per drill (the "
+                f"continuity check must only see this drill's records)")
     print("== phase 1: run until SIGKILL ==", flush=True)
     # own process group: spawn-started DataLoader workers carry a
     # spawn_main argv (a pkill -f on OUR argv would never match them),
