@@ -265,4 +265,6 @@ class PredictorPool:
         return len(self._preds)
 
 
-from .serving import GenerationServer, measure_offered_load  # noqa: E402
+from .kv_cache import BlockPoolExhausted, PagedKVCache  # noqa: E402
+from .serving import (GenerationServer, PagedGenerationServer,  # noqa: E402
+                      measure_offered_load)

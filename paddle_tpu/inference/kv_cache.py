@@ -1,0 +1,174 @@
+"""Paged KV cache — block-pool cache for the continuous-batching server.
+
+Reference direction: Ragged Paged Attention (arXiv:2604.15464) — the
+TPU-native answer to the static-cache serving loop. Instead of one
+contiguous [B, S_max] cache slab per batch (which pins every slot to the
+longest possible sequence), K/V live in a pool of fixed-size blocks:
+
+    k_blocks, v_blocks: [L, num_blocks, block_size, H, Dh]
+
+Each sequence owns an ordered *block table* (a list of block ids); token
+`t` of a sequence lives at (table[t // block_size], t % block_size).
+Attention gathers keys by block table, masked by the sequence's true
+length — no pad-token-value matching anywhere, so a prompt that
+legitimately contains `pad_token_id` can never be corrupted.
+
+Block 0 is a reserved *trash* block: it is never allocated, and jitted
+writers route masked-out lanes (padding tail of a prefill bucket,
+inactive decode slots) into it so a scatter always has a legal target.
+Block tables are padded with 0 for the same reason — gathered trash
+positions are masked by length before the softmax.
+
+The pool itself is host-side bookkeeping (allocate/ensure/free on Python
+ints); the device arrays are functional — jitted prefill/step functions
+take them as inputs and return the updated arrays, and the cache swaps
+them in via `swap_arrays`.
+"""
+from __future__ import annotations
+
+
+class BlockPoolExhausted(RuntimeError):
+    """Raised when an allocation needs more free blocks than the pool has."""
+
+
+def blocks_for(num_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold `num_tokens` tokens."""
+    return max(0, -(-int(num_tokens) // int(block_size)))
+
+
+class PagedKVCache:
+    """Block-pool KV cache: fixed-size blocks, per-sequence block tables.
+
+    num_layers/num_heads/head_dim: transformer shape (GPT-2 layout).
+    block_size: tokens per block. 128 keeps the Pallas ragged-decode
+        kernel's lane alignment on TPU; smaller (8/16) wastes less on CPU
+        smokes and short sequences.
+    num_blocks: pool size INCLUDING the reserved trash block 0, so the
+        usable capacity is (num_blocks - 1) * block_size tokens.
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, *, block_size=128,
+                 num_blocks=64, dtype=None):
+        import jax.numpy as jnp
+
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved trash block)")
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        dt = jnp.float32 if dtype is None else dtype
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k_blocks = jnp.zeros(shape, dt)
+        self.v_blocks = jnp.zeros(shape, dt)
+        # block 0 reserved: free list starts at 1
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._tables: dict[object, list[int]] = {}
+        self._lens: dict[object, int] = {}
+        self._peak_blocks = 0
+
+    # ---- pool bookkeeping (host-side) ---------------------------------
+    @property
+    def free_block_count(self):
+        return len(self._free)
+
+    @property
+    def capacity_tokens(self):
+        return (self.num_blocks - 1) * self.block_size
+
+    def _take_blocks(self, n):
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"need {n} blocks, only {len(self._free)} free "
+                f"(pool {self.num_blocks - 1})")
+        taken = [self._free.pop() for _ in range(n)]
+        used = self.num_blocks - 1 - len(self._free)
+        self._peak_blocks = max(self._peak_blocks, used)
+        return taken
+
+    def allocate(self, seq_id, num_tokens):
+        """Start a new sequence holding `num_tokens` tokens; returns its
+        block table. Raises BlockPoolExhausted without side effects."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        table = self._take_blocks(blocks_for(num_tokens, self.block_size))
+        self._tables[seq_id] = table
+        self._lens[seq_id] = int(num_tokens)
+        return list(table)
+
+    def ensure(self, seq_id, num_tokens):
+        """Grow `seq_id` so positions [0, num_tokens) have backing blocks
+        (length is also advanced to num_tokens if it grew)."""
+        table = self._tables[seq_id]
+        need = blocks_for(num_tokens, self.block_size) - len(table)
+        if need > 0:
+            table.extend(self._take_blocks(need))
+        self._lens[seq_id] = max(self._lens[seq_id], int(num_tokens))
+        return list(table)
+
+    def append(self, seq_id, n=1):
+        """Reserve room for `n` more tokens; returns the (possibly grown)
+        block table."""
+        return self.ensure(seq_id, self._lens[seq_id] + int(n))
+
+    def free(self, seq_id):
+        """Return a sequence's blocks to the pool; returns how many."""
+        table = self._tables.pop(seq_id)
+        del self._lens[seq_id]
+        self._free.extend(reversed(table))
+        return len(table)
+
+    def seq_len(self, seq_id):
+        return self._lens[seq_id]
+
+    def block_table(self, seq_id):
+        return list(self._tables[seq_id])
+
+    def blocks_held(self, seq_id):
+        """Blocks currently backing seq_id (0 if not yet allocated)."""
+        return len(self._tables.get(seq_id, ()))
+
+    def table_array(self, seq_ids, width=None):
+        """Dense int32 [len(seq_ids), width] block-table matrix for the
+        jitted step; unused entries point at trash block 0. A seq_id of
+        None yields an all-trash row (an idle server slot)."""
+        import numpy as np
+
+        rows = [self._tables.get(s, []) if s is not None else []
+                for s in seq_ids]
+        if width is None:
+            width = max((len(r) for r in rows), default=1) or 1
+        out = np.zeros((len(rows), int(width)), np.int32)
+        for i, r in enumerate(rows):
+            if len(r) > width:
+                raise ValueError(f"block table of {seq_ids[i]!r} "
+                                 f"({len(r)}) exceeds width {width}")
+            out[i, :len(r)] = r
+        return out
+
+    def swap_arrays(self, k_blocks, v_blocks):
+        """Install the updated device arrays a jitted prefill/step
+        returned (the functional write-back half of the cycle)."""
+        self.k_blocks = k_blocks
+        self.v_blocks = v_blocks
+
+    def stats(self):
+        used = self.num_blocks - 1 - len(self._free)
+        held = sum(self._lens.values())
+        return {
+            "block_size": self.block_size,
+            "num_blocks": self.num_blocks - 1,  # usable (trash excluded)
+            "used_blocks": used,
+            "free_blocks": len(self._free),
+            "peak_used_blocks": self._peak_blocks,
+            "sequences": len(self._tables),
+            "held_tokens": held,
+            # fraction of usable pool tokens occupied by live tokens
+            "utilization": held / (self.capacity_tokens or 1),
+            # live tokens per allocated slot (internal fragmentation:
+            # 1.0 = every allocated block byte holds a real token)
+            "block_fill": held / ((used * self.block_size) or 1),
+        }

@@ -227,6 +227,362 @@ class GenerationServer:
                 r.future.set_result(out[i])
 
 
+class PagedGenerationServer:
+    """Continuous-batching server over the paged KV cache.
+
+    Where `GenerationServer` pads every request to one global prompt_len
+    and holds its slot for the full max_new even after EOS, this server
+    runs the PagedDecoder engine directly against a `PagedKVCache`:
+
+      * per-slot sequence lengths — a 70-token prompt costs 70 cache
+        positions, not prompt_len;
+      * every decode step, finished slots (EOS or the request's token
+        budget) resolve their futures, free their blocks, and are
+        REFILLED from the queue before the next step — new requests join
+        mid-flight instead of waiting for the whole batch to drain;
+      * masking is by length, so a prompt that legitimately contains
+        pad_token_id can never be corrupted (the dense server's
+        value-equality caveat does not exist here).
+
+    Admission is reservation-based: a request is admitted only when the
+    pool can cover its worst case (ceil((len + max_new)/block_size)
+    blocks) on top of every active slot's outstanding worst case, so
+    mid-flight block exhaustion is impossible. Blocks are still
+    allocated lazily (`cache.append`) as sequences grow — the
+    reservation is accounting, not allocation.
+
+    model: a GPT2 (or same-layout) module; its params are snapshotted at
+    construction (weight_quant="int8" serves W8A16). Prefill pads each
+    prompt to a power-of-two bucket so the number of compiled prefill
+    programs stays logarithmic in max_prompt_len.
+
+    steps_per_dispatch > 1 turns on multi-step scheduling: that many
+    decode tokens run as ONE jitted lax.scan dispatch, amortizing the
+    per-dispatch floor (8-70ms through the dev tunnel, PERF.md) that
+    would otherwise bound a token-per-dispatch loop. The cost is
+    granularity: EOS/budget is only observed every k tokens, so up to
+    k-1 tokens per request are decoded and discarded, and slot refill
+    waits for the scan to return. k=1 is exact continuous batching.
+    """
+
+    def __init__(self, model, *, max_slots=4, block_size=16,
+                 max_prompt_len=None, max_new_tokens=32, num_blocks=None,
+                 eos_token_id=None, temperature=0.0, seed=0,
+                 weight_quant=None, steps_per_dispatch=1):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.decode import PagedDecoder
+        from .kv_cache import PagedKVCache, blocks_for
+
+        self._jnp, self._jax = jnp, jax
+        cfg = model.cfg
+        self.max_new = int(max_new_tokens)
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        slack = self.steps_per_dispatch - 1  # post-EOS overrun horizon
+        self.max_prompt_len = int(
+            max_prompt_len or cfg.max_position - self.max_new - slack)
+        if self.max_prompt_len + self.max_new + slack > cfg.max_position:
+            raise ValueError(
+                f"max_prompt_len ({self.max_prompt_len}) + max_new_tokens "
+                f"({self.max_new}) + steps_per_dispatch slack ({slack}) "
+                f"exceeds max_position ({cfg.max_position})")
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.eos = -1 if eos_token_id is None else int(eos_token_id)
+        self.temperature = float(temperature)
+        params, _ = model.functional_state()
+        if weight_quant == "int8":
+            params = model._w8_params(params)
+        elif weight_quant is not None:
+            raise ValueError(f"unknown weight_quant {weight_quant!r} "
+                             "(supported: 'int8')")
+        self._params = params
+        dt = params["ln_f.weight"].dtype
+        self._m_width = blocks_for(
+            self.max_prompt_len + self.max_new + slack, self.block_size)
+        if num_blocks is None:  # worst case: every slot at full horizon
+            num_blocks = self.max_slots * self._m_width + 1
+        self.cache = PagedKVCache(
+            cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, block_size=self.block_size,
+            num_blocks=int(num_blocks), dtype=dt)
+        self._blocks_for = blocks_for
+        self._decoder = PagedDecoder.for_config(cfg, self.block_size)
+        self._mstep = (self._decoder.multistep(self.steps_per_dispatch)
+                       if self.steps_per_dispatch > 1 else None)
+        self._key = jax.random.key(int(seed))
+        self._rng_calls = 0
+        # slot state: None (idle) or dict(seq, req, toks, pos, budget)
+        self._slots = [None] * self.max_slots
+        self._worst: dict[int, int] = {}  # seq -> worst-case block count
+        self._seq_counter = 0
+        self._lock = threading.Condition()
+        self._queue: list[_Req] = []
+        self._stop = False
+        self._thread = None
+        # stats window
+        self._lat = []
+        self._tokens_out = 0
+        self._requests_done = 0
+        self._steps = 0
+        self._prefills = 0
+        self._active_integral = 0
+        self._fill_integral = 0.0
+        self._t0 = None
+
+    # ---- client API ----------------------------------------------------
+    def submit(self, ids, max_new_tokens=None):
+        """Enqueue one prompt (any length <= max_prompt_len; NO padding
+        needed). Returns a Future resolving to the UNPADDED
+        [len + generated] int32 sequence (generation stops at EOS or the
+        token budget)."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if ids.size == 0 or ids.size > self.max_prompt_len:
+            raise ValueError(f"prompt length {ids.size} not in "
+                             f"[1, {self.max_prompt_len}]")
+        budget = self.max_new if max_new_tokens is None \
+            else int(max_new_tokens)
+        if not 1 <= budget <= self.max_new:
+            raise ValueError(f"max_new_tokens {budget} not in "
+                             f"[1, {self.max_new}]")
+        req = _Req(ids=ids, future=Future(),
+                   t_submit=time.perf_counter())
+        req.budget = budget
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("server stopped")
+            self._queue.append(req)
+            self._lock.notify()
+        return req.future
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        if self._stop:
+            raise RuntimeError(
+                "server was stopped; build a new PagedGenerationServer")
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=120)
+            self._thread = None
+        with self._lock:
+            for req in self._queue:
+                req.future.set_exception(RuntimeError("server stopped"))
+            self._queue.clear()
+
+    def reset_stats(self):
+        with self._lock:
+            self._lat.clear()
+            self._tokens_out = 0
+            self._requests_done = 0
+            self._steps = 0
+            self._prefills = 0
+            self._active_integral = 0
+            self._fill_integral = 0.0
+            self._t0 = time.perf_counter()
+
+    def stats(self):
+        with self._lock:
+            lat = sorted(self._lat)
+            dt = (time.perf_counter() - self._t0) if self._t0 else 0.0
+            n = len(lat)
+            pct = (lambda p: lat[min(n - 1, int(p * n))] if n else 0.0)
+            out = {
+                "requests": n,
+                "new_tokens": self._tokens_out,
+                "tokens_per_sec": self._tokens_out / dt if dt else 0.0,
+                "p50_ms": pct(0.50) * 1e3,
+                "p90_ms": pct(0.90) * 1e3,
+                "p99_ms": pct(0.99) * 1e3,
+                "decode_steps": self._steps,
+                "prefills": self._prefills,
+                # mean busy slots per decode step: the continuous-batching
+                # analogue of the dense server's batch_fill
+                "slot_fill": (self._active_integral
+                              / ((self._steps or 1) * self.max_slots)),
+                # mean internal fragmentation of ALLOCATED blocks while
+                # decoding (sampled per dispatch; end-of-window cache
+                # stats read 0 once everything is freed)
+                "kv_block_fill": (self._fill_integral
+                                  / (self._steps or 1)),
+                "wall_s": dt,
+            }
+            out["kv_cache"] = self.cache.stats()
+            return out
+
+    # ---- engine --------------------------------------------------------
+    def _next_key(self):
+        self._rng_calls += 1
+        return self._jax.random.fold_in(self._key, self._rng_calls)
+
+    def _outstanding_blocks(self):
+        """Blocks the active slots may still demand in the worst case."""
+        total = 0
+        for slot in self._slots:
+            if slot is not None:  # a just-picked slot holds 0 until its
+                held = self.cache.blocks_held(slot["seq"])  # prefill runs
+                total += max(0, self._worst[slot["seq"]] - held)
+        return total
+
+    def _bucket(self, n):
+        """Power-of-two prefill bucket: one compiled prefill program per
+        bucket, so compile count stays logarithmic in max_prompt_len
+        (n <= max_prompt_len is validated at submit)."""
+        b = max(self.block_size, 8)
+        while b < n:
+            b *= 2
+        return min(b, self.max_prompt_len)
+
+    def _admit_locked(self):
+        """Fill idle slots from the queue while the pool can cover each
+        request's worst case; runs prefill OUTSIDE the lock? No — prefill
+        here is called with the lock released by the loop; this method
+        only picks (slot, req) pairs."""
+        picked = []
+        for i, slot in enumerate(self._slots):
+            if slot is not None or not self._queue:
+                continue
+            req = self._queue[0]
+            # worst case includes the multi-step overrun slack: a scan may
+            # write up to steps_per_dispatch-1 discarded tokens past the
+            # budget before the host sees the EOS
+            worst = self._blocks_for(
+                req.ids.size + req.budget + self.steps_per_dispatch - 1,
+                self.block_size)
+            if self.cache.free_block_count - self._outstanding_blocks() \
+                    < worst:
+                break  # head-of-line: keep arrival order under pressure
+            self._queue.pop(0)
+            seq = self._seq_counter
+            self._seq_counter += 1
+            self._worst[seq] = worst
+            self._slots[i] = {"seq": seq, "req": req, "toks": [],
+                              "pos": req.ids.size, "budget": req.budget}
+            picked.append((i, req, seq))
+        return picked
+
+    def _prefill(self, slot_idx, req, seq):
+        jnp = self._jnp
+        n = int(req.ids.size)
+        self.cache.allocate(seq, n)
+        bucket = self._bucket(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.ids
+        tables = jnp.asarray(self.cache.table_array([seq], self._m_width))
+        tok, kc, vc = self._decoder.prefill(
+            self._params, jnp.asarray(ids), jnp.asarray([n]), tables,
+            self.cache.k_blocks, self.cache.v_blocks, self._next_key(),
+            jnp.float32(self.temperature))
+        self.cache.swap_arrays(kc, vc)
+        with self._lock:
+            self._prefills += 1
+        self._slot_token(slot_idx, int(np.asarray(tok)[0]))
+
+    def _slot_token(self, i, tok):
+        """Record one generated token for slot i; completes the request
+        on EOS or budget exhaustion (slot freed for refill)."""
+        slot = self._slots[i]
+        slot["toks"].append(tok)
+        hit_eos = (self.eos >= 0 and tok == self.eos)
+        if hit_eos or len(slot["toks"]) >= slot["budget"]:
+            seq, req = slot["seq"], slot["req"]
+            out = np.concatenate([req.ids,
+                                  np.asarray(slot["toks"], np.int32)])
+            self.cache.free(seq)
+            del self._worst[seq]
+            self._slots[i] = None
+            t_done = time.perf_counter()
+            with self._lock:
+                self._lat.append(t_done - req.t_submit)
+                self._tokens_out += len(slot["toks"])
+                self._requests_done += 1
+            req.future.set_result(out)
+
+    def _loop(self):
+        jnp = self._jnp
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                picked = self._admit_locked()
+                if not picked and all(s is None for s in self._slots):
+                    self._lock.wait(timeout=0.1)
+                    continue
+            for i, req, seq in picked:
+                try:
+                    self._prefill(i, req, seq)
+                except Exception as e:  # noqa: BLE001 — fail one request
+                    if seq in self.cache._tables:
+                        self.cache.free(seq)
+                    self._worst.pop(seq, None)
+                    self._slots[i] = None
+                    req.future.set_exception(e)
+            active_idx = [i for i, s in enumerate(self._slots)
+                          if s is not None]
+            if not active_idx:
+                continue
+            k = self.steps_per_dispatch
+            # grow tables for the incoming token(s) BEFORE the step
+            # writes them (k tokens starting at the feed position)
+            for i in active_idx:
+                s = self._slots[i]
+                self.cache.ensure(s["seq"],
+                                  s["pos"] + len(s["toks"]) - 1 + k)
+            tok = np.zeros((self.max_slots,), np.int32)
+            pos = np.zeros((self.max_slots,), np.int32)
+            act = np.zeros((self.max_slots,), bool)
+            for i in active_idx:
+                s = self._slots[i]
+                tok[i] = s["toks"][-1]
+                pos[i] = s["pos"] + len(s["toks"]) - 1
+                act[i] = True
+            tables = jnp.asarray(self.cache.table_array(
+                [s["seq"] if s is not None else None
+                 for s in self._slots], self._m_width))
+            try:
+                if self._mstep is None:
+                    nxt, kc, vc = self._decoder.step(
+                        self._params, jnp.asarray(tok), jnp.asarray(pos),
+                        jnp.asarray(act), tables, self.cache.k_blocks,
+                        self.cache.v_blocks, self._next_key(),
+                        jnp.float32(self.temperature))
+                    toks = np.asarray(nxt)[None]       # [1, S]
+                else:
+                    toks, kc, vc = self._mstep(
+                        self._params, jnp.asarray(tok), jnp.asarray(pos),
+                        jnp.asarray(act), tables, self.cache.k_blocks,
+                        self.cache.v_blocks, self._next_key(),
+                        jnp.float32(self.temperature))
+                    toks = np.asarray(toks)            # [k, S]
+            except Exception as e:  # noqa: BLE001 — fan out, drop slots
+                for i in active_idx:
+                    s = self._slots[i]
+                    self.cache.free(s["seq"])
+                    del self._worst[s["seq"]]
+                    s["req"].future.set_exception(e)
+                    self._slots[i] = None
+                continue
+            self.cache.swap_arrays(kc, vc)
+            with self._lock:
+                self._steps += 1
+                self._active_integral += len(active_idx)
+                self._fill_integral += self.cache.stats()["block_fill"]
+            for i in active_idx:
+                for j in range(toks.shape[0]):
+                    self._slot_token(i, int(toks[j, i]))
+                    if self._slots[i] is None:  # finished mid-scan: the
+                        break  # remaining scan tokens are discarded
+
+
 def measure_offered_load(server, prompts, offered_rps, duration_s):
     """Drive `server` at a target request rate for `duration_s`; returns
     the server stats plus achieved rate. `prompts`: pool of int lists,

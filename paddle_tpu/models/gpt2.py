@@ -152,15 +152,53 @@ class GPT2(nn.Layer):
             ops.reshape(logits, [-1, self.cfg.vocab_size]),
             ops.reshape(labels, [-1]))
 
+    def _w8_params(self, params):
+        """Weight-only int8 (W8A16) params for the decode path, cached per
+        weight version. Cache key: weak refs to EVERY source array
+        (identity, not id() — ids are recycled after GC and could serve
+        stale quantized weights; weakrefs also notice any param changing,
+        not just wte). A dead or mismatched ref is a miss."""
+        import weakref
+
+        def _wref(v):
+            try:
+                return weakref.ref(v)
+            except TypeError:  # non-weakrefable leaf: pin it instead
+                return (lambda strong=v: strong)
+        cached = getattr(self, "_w8_cache", None)
+        names = sorted(params)
+        hit = (cached is not None and cached[0] == names
+               and all(r() is params[n]
+                       for n, r in zip(names, cached[1])))
+        if not hit:
+            # drop the stale entry BEFORE building the new one: its key
+            # list can hold strong-ref closures (non-weakrefable leaves)
+            # that would otherwise pin the replaced arrays alive inside
+            # the dead tuple (ADVICE r5)
+            self._w8_cache = None
+            cached = (names, [_wref(params[n]) for n in names],
+                      _quantize_decode_weights_int8(params, self.cfg))
+            self._w8_cache = cached
+        return cached[2]
+
     def generate(self, input_ids, max_new_tokens, temperature=0.0,
                  eos_token_id=None, seed=0, top_k=0, top_p=1.0,
-                 pad_token_id=None, weight_quant=None, kv_quant=None):
+                 pad_token_id=None, weight_quant=None, kv_quant=None,
+                 kv_cache="dense", prompt_lens=None, block_size=16):
         """Autoregressive decoding with a KV cache (serving path; ref
         capability: fluid beam_search/sampling decode ops). TPU-first:
         static shapes throughout — prefill compiles once per prompt shape,
         then a `lax.scan` emits one token per step against a fixed-size
         cache, so the whole generate is two XLA computations regardless of
-        token count. temperature=0 is greedy; >0 samples."""
+        token count. temperature=0 is greedy; >0 samples.
+
+        kv_cache="dense" (default) is the contiguous-cache fast path
+        above. kv_cache="paged" decodes against the block-pool
+        PagedKVCache (inference/kv_cache.py): prompts are RIGHT-padded
+        with per-row `prompt_lens` (no pad-value matching), block_size
+        sets the pool granularity, and the step loop runs host-side —
+        it is the engine the continuous-batching server drives, exposed
+        here for parity testing and offline use."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -173,6 +211,22 @@ class GPT2(nn.Layer):
             raise ValueError("max_new_tokens must be >= 0")
         if max_new_tokens == 0:
             return Tensor(ids, stop_gradient=True)
+        if kv_cache not in ("dense", "paged"):
+            raise ValueError(f"unknown kv_cache {kv_cache!r} "
+                             "(supported: 'dense', 'paged')")
+        if kv_cache == "paged":
+            if top_k or top_p < 1.0 or kv_quant is not None:
+                raise ValueError(
+                    "kv_cache='paged' supports greedy/temperature "
+                    "sampling with bf16/f32 or W8A16 weights (no "
+                    "top_k/top_p/kv_quant yet)")
+            return self._generate_paged(
+                ids, max_new_tokens, temperature, eos_token_id, seed,
+                pad_token_id, prompt_lens, block_size, weight_quant)
+        if prompt_lens is not None:
+            raise ValueError("prompt_lens is only meaningful with "
+                             "kv_cache='paged' (the dense path derives "
+                             "lengths from LEFT padding)")
         if ids.shape[1] + max_new_tokens > self.cfg.max_position:
             raise ValueError(
                 f"prompt ({ids.shape[1]}) + max_new_tokens "
@@ -197,27 +251,7 @@ class GPT2(nn.Layer):
             # quantization itself is ~250 device ops over 124M params, so
             # it is cached per weight version (serving calls generate in
             # a loop).
-            # cache key: weak refs to EVERY source array (identity, not
-            # id() — ids are recycled after GC and could serve stale
-            # quantized weights; weakrefs also notice any param changing,
-            # not just wte). A dead or mismatched ref is a miss.
-            import weakref
-
-            def _wref(v):
-                try:
-                    return weakref.ref(v)
-                except TypeError:  # non-weakrefable leaf: pin it instead
-                    return (lambda strong=v: strong)
-            cached = getattr(self, "_w8_cache", None)
-            names = sorted(params)
-            hit = (cached is not None and cached[0] == names
-                   and all(r() is params[n]
-                           for n, r in zip(names, cached[1])))
-            if not hit:
-                cached = (names, [_wref(params[n]) for n in names],
-                          _quantize_decode_weights_int8(params, self.cfg))
-                self._w8_cache = cached
-            params = cached[2]
+            params = self._w8_params(params)
         elif weight_quant is not None:
             raise ValueError(f"unknown weight_quant {weight_quant!r} "
                              "(supported: 'int8')")
@@ -232,6 +266,93 @@ class GPT2(nn.Layer):
                             -1 if pad_token_id is None else int(pad_token_id),
                             kv_quant == "int8")
         return Tensor(out, stop_gradient=True)
+
+    def _generate_paged(self, ids, max_new, temp, eos_token_id, seed,
+                        pad_token_id, prompt_lens, block_size,
+                        weight_quant):
+        """Paged-cache decode: RIGHT-padded prompts + per-row lengths,
+        host-side step loop over the jitted PagedDecoder (the same
+        engine the continuous-batching server drives). Output rows are
+        [prompt, generated, fill]: generated tokens start at each row's
+        true length; eos padding continues after a hit like the dense
+        path; the tail past len+max_new is filled with pad_token_id
+        (else eos, else 0)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        from ..inference.kv_cache import PagedKVCache, blocks_for
+        from ..nn.decode import PagedDecoder
+
+        ids = np.asarray(ids).astype(np.int32)
+        B, S0 = ids.shape
+        if prompt_lens is None:
+            lens = np.full((B,), S0, np.int32)
+        else:
+            lens = np.asarray(prompt_lens).astype(np.int32).reshape(-1)
+            if lens.shape[0] != B:
+                raise ValueError("prompt_lens must have one entry per row")
+            if (lens < 1).any() or (lens > S0).any():
+                raise ValueError(f"prompt_lens must be in [1, {S0}]")
+        if S0 > self.cfg.max_position or \
+                int(lens.max()) + max_new > self.cfg.max_position:
+            raise ValueError(
+                f"prompt ({int(lens.max())}) + max_new_tokens ({max_new}) "
+                f"exceeds max_position ({self.cfg.max_position})")
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        params, _ = self.functional_state()
+        if weight_quant == "int8":
+            params = self._w8_params(params)
+        elif weight_quant is not None:
+            raise ValueError(f"unknown weight_quant {weight_quant!r} "
+                             "(supported: 'int8')")
+        dt = params["ln_f.weight"].dtype
+        bs = int(block_size)
+        m_width = blocks_for(max(S0, int(lens.max()) + max_new), bs)
+        total_blocks = sum(blocks_for(int(n) + max_new, bs) for n in lens)
+        cache = PagedKVCache(self.cfg.num_layers, self.cfg.num_heads,
+                             self.cfg.hidden_size // self.cfg.num_heads,
+                             block_size=bs, num_blocks=total_blocks + 1,
+                             dtype=dt)
+        for b in range(B):  # offline batch: reserve the full horizon
+            cache.allocate(b, int(lens[b]) + max_new)
+        tables = jnp.asarray(cache.table_array(range(B), m_width))
+        dec = PagedDecoder.for_config(self.cfg, bs)
+        key = jax.random.key(int(seed))
+        key, sub = jax.random.split(key)
+        temp_t = jnp.float32(temp)
+        lens_j = jnp.asarray(lens)
+        active = jnp.ones((B,), bool)
+        tok, kc, vc = dec.prefill(params, jnp.asarray(ids), lens_j, tables,
+                                  cache.k_blocks, cache.v_blocks, sub,
+                                  temp_t)
+        cache.swap_arrays(kc, vc)
+        tok = np.asarray(tok)
+        done = (tok == eos) & (eos >= 0)
+        out_toks = [tok]
+        pos = lens.copy()
+        for _ in range(max_new - 1):
+            key, sub = jax.random.split(key)
+            nxt, kc, vc = dec.step(params, jnp.asarray(out_toks[-1]),
+                                   jnp.asarray(pos), active, tables, kc,
+                                   vc, sub, temp_t)
+            cache.swap_arrays(kc, vc)
+            nxt = np.asarray(nxt)
+            if eos >= 0:  # dense-path semantics: keep emitting eos
+                nxt = np.where(done, eos, nxt)
+                done = done | (nxt == eos)
+            out_toks.append(nxt)
+            pos = pos + 1
+        gen = np.stack(out_toks, axis=1)             # [B, max_new]
+        fill = pad_token_id if pad_token_id is not None \
+            else (eos if eos >= 0 else 0)
+        out = np.full((B, S0 + max_new), fill, np.int32)
+        for b in range(B):
+            n = int(lens[b])
+            out[b, :n] = ids[b, :n]
+            out[b, n:n + max_new] = gen[b]
+        return Tensor(jnp.asarray(out), stop_gradient=True)
 
 
 def _quantize_decode_weights_int8(params, cfg):

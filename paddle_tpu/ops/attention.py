@@ -118,6 +118,50 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     return out, (w if return_weights else None)
 
 
+def paged_decode_attention(q, k_blocks, v_blocks, block_tables, ctx_lens,
+                           scale=None):
+    """Single-token decode attention over a PAGED KV cache (the
+    gather-by-block-table read half of inference/kv_cache.py).
+
+    q: [B, H, Dh] — one new token per sequence.
+    k_blocks/v_blocks: [N, BS, H, Dh] — ONE layer's block pool.
+    block_tables: [B, M] int32 — block ids per sequence, 0-padded.
+    ctx_lens: [B] int32 — tokens (cache positions) visible to each query;
+        everything at position >= ctx_len is masked by LENGTH, never by
+        pad-token value.
+
+    Returns [B, H, Dh] in q's dtype. Dispatches to the Pallas ragged
+    kernel on TPU when shapes allow (head_dim lane-sized, block_size a
+    lane multiple, heads sublane-aligned); otherwise runs the XLA gather
+    path, which materializes the [B, M*BS] gathered keys — correct
+    everywhere, but it reads the padded table width instead of streaming
+    exactly the live blocks."""
+    B, H, Dh = q.shape
+    _, BS, _, _ = k_blocks.shape
+    M = block_tables.shape[1]
+    sc = (Dh ** -0.5) if scale is None else scale
+    if _on_tpu():
+        try:
+            from .pallas.paged_attention import (paged_decode_attention_kernel,
+                                                 supported_shapes)
+            if supported_shapes(Dh, BS, H):
+                return paged_decode_attention_kernel(
+                    q, k_blocks, v_blocks, block_tables, ctx_lens,
+                    scale=float(sc))
+        except Exception as e:  # noqa: BLE001
+            _warn_flash_fallback(e)
+    # XLA gather path: [B, M, BS, H, Dh] -> [B, H, M*BS, Dh]
+    k = jnp.transpose(k_blocks[block_tables], (0, 3, 1, 2, 4)) \
+        .reshape(B, H, M * BS, Dh)
+    v = jnp.transpose(v_blocks[block_tables], (0, 3, 1, 2, 4)) \
+        .reshape(B, H, M * BS, Dh)
+    s = jnp.einsum("bhd,bhsd->bhs", q, k).astype(jnp.float32) * sc
+    valid = jnp.arange(M * BS)[None, :] < ctx_lens[:, None]  # [B, M*BS]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bhsd->bhd", w, v)
+
+
 @defop()
 def fused_multi_head_attention(x, qkv_weight, qkv_bias, out_weight, out_bias,
                                num_heads, attn_mask=None, dropout_p=0.0,

@@ -20,6 +20,13 @@ jax.devices()  # force CPU backend init before anything else can
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: slow marks the bench-sized tests
+    # (served-traffic sweep etc.) that only manual/chip sessions run
+    config.addinivalue_line(
+        "markers", "slow: bench-sized test; tier-1 skips via -m 'not slow'")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle
