@@ -52,6 +52,7 @@ from . import inference  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from .hapi.model import Model  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import parallel  # noqa: E402,F401
 from . import text  # noqa: E402,F401
@@ -148,7 +149,8 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
     trainable = builtins.sum(int(_np.prod(p.shape))
                              for p in net.parameters() if p.trainable)
     info = {"total_params": total, "trainable_params": trainable}
-    print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+    print(f"Total params: {total:,}\n"  # cli-print: summary() report
+          f"Trainable params: {trainable:,}")
     return info
 
 

@@ -163,5 +163,6 @@ def run_check():
     loss = (lin(x) ** 2).mean()
     loss.backward()
     opt.step()
-    print(f"Your Paddle works well on {jax.devices()[0].platform.upper()}.")
-    print("Your Paddle is installed successfully!")
+    print(f"Your Paddle works well on "  # cli-print: install check
+          f"{jax.devices()[0].platform.upper()}.")
+    print("Your Paddle is installed successfully!")  # cli-print

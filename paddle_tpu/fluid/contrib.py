@@ -703,7 +703,7 @@ class model_stat:  # ref: contrib/model_stat.py (param/flops table)
         n_params = sum(
             1 for v in main_prog.global_block().vars.values()
             if getattr(v, "persistable", False))
-        print(f"Program: {n_params} persistable vars")
+        print(f"Program: {n_params} persistable vars")  # cli-print: report
 
 
 def op_freq_statistic(program):

@@ -36,8 +36,8 @@ def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
     if isinstance(v, jcore.Tracer):
         jax.debug.print(msg + " {}", v)
     else:
-        print(f"{msg} shape={tuple(v.shape)} dtype={v.dtype}\n"
-              f"{np.asarray(v).ravel()[:summarize]}")
+        print(f"{msg} shape={tuple(v.shape)} "  # cli-print: Print op
+              f"dtype={v.dtype}\n{np.asarray(v).ravel()[:summarize]}")
     return input
 
 

@@ -5,6 +5,12 @@ import time
 
 import numpy as np
 
+from ..observability import log as _log
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+
+_logger = _log.get_logger(__name__)
+
 
 class Callback:
     def set_model(self, model):
@@ -65,13 +71,13 @@ class ProgBarLogger(Callback):
             loss = logs[0] if isinstance(logs, (list, tuple)) else logs
             if isinstance(loss, tuple):
                 loss = loss[0]
-            print(f"[{mode}] epoch {getattr(self, 'epoch', 0)} "
-                  f"step {step}: loss={loss}")
+            _logger.info("[%s] epoch %s step %s: loss=%s", mode,
+                         getattr(self, "epoch", 0), step, loss)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
             dt = time.time() - self._t0
-            print(f"Epoch {epoch} done in {dt:.1f}s: {logs}")
+            _logger.info("Epoch %s done in %.1fs: %s", epoch, dt, logs)
 
 
 class ModelCheckpoint(Callback):
@@ -175,9 +181,68 @@ class ReduceLROnPlateau(Callback):
                 lr = max(float(opt.get_lr()) * self.factor, self.min_lr)
                 opt.set_lr(lr)
                 if self.verbose:
-                    print(f"ReduceLROnPlateau: lr -> {lr:.2e}")
+                    _logger.info("ReduceLROnPlateau: lr -> %.2e", lr)
             self._wait = 0
             self._cool = self.cooldown
+
+
+class TelemetryCallback(Callback):
+    """Training telemetry into the observability registry (ISSUE 2):
+    per-step wall-time and loss histograms, a step counter, and an
+    epoch gauge — plus one `train_step` span per batch so a traced
+    training window lines up with serving traces in the same JSONL.
+    Model.fit attaches one automatically whenever telemetry is enabled
+    (PADDLE_TPU_TELEMETRY=1 / observability.enable()); all updates
+    no-op when it is off, so it is always safe to attach."""
+
+    # step-time buckets: 1ms (CPU-tiny smoke) .. 30s (big-model chip steps)
+    _STEP_BUCKETS = (.001, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5,
+                     5.0, 10.0, 30.0)
+
+    def __init__(self, prefix="train"):
+        self.prefix = prefix
+        self._h_step = _metrics.histogram(
+            f"{prefix}_step_seconds", "wall time of one train step",
+            buckets=self._STEP_BUCKETS)
+        self._h_loss = _metrics.histogram(
+            f"{prefix}_loss", "per-step loss",
+            buckets=(.01, .1, .5, 1, 2, 5, 10, 100))
+        self._c_steps = _metrics.counter(
+            f"{prefix}_steps_total", "train steps completed")
+        self._g_epoch = _metrics.gauge(
+            f"{prefix}_epoch", "current epoch")
+        self._t0 = None
+        self._span = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._g_epoch.set(epoch)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        if mode != "train":
+            return
+        self._t0 = time.perf_counter()
+        if _tracing.enabled():
+            self._span = _tracing.span("train_step", step=step)
+            self._span.__enter__()
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train":
+            return
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
+        if self._t0 is not None:
+            self._h_step.observe(time.perf_counter() - self._t0)
+            self._t0 = None
+        self._c_steps.inc()
+        loss = logs[0] if isinstance(logs, (list, tuple)) and logs \
+            else logs
+        if isinstance(loss, tuple):
+            loss = loss[0]
+        try:
+            self._h_loss.observe(float(np.ravel(np.asarray(loss))[0]))
+        except (TypeError, ValueError):
+            pass  # non-scalar logs (metrics dicts) — step time still lands
 
 
 class VisualDL(Callback):

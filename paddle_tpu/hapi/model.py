@@ -175,8 +175,15 @@ class Model:
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
-        cbs = cb_mod.CallbackList(callbacks or
-                                  [cb_mod.ProgBarLogger(log_freq, verbose)])
+        cb_list = list(callbacks or
+                       [cb_mod.ProgBarLogger(log_freq, verbose)])
+        # telemetry on and no explicit TelemetryCallback -> attach one,
+        # so `fit` feeds the step-time/loss histograms for free
+        from ..observability import metrics as _obs_metrics
+        if _obs_metrics.enabled() and not any(
+                isinstance(c, cb_mod.TelemetryCallback) for c in cb_list):
+            cb_list.append(cb_mod.TelemetryCallback())
+        cbs = cb_mod.CallbackList(cb_list)
         cbs.set_model(self)
         cbs.on_begin("train")
         history = []

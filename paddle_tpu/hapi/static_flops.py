@@ -132,9 +132,11 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     total = sum(r[3] for r in rows)
     if print_detail:
-        print(f"{'Layer':<20}{'Input':<22}{'Output':<22}{'FLOPs':>14}")
+        print(f"{'Layer':<20}{'Input':<22}"  # cli-print: flops table
+              f"{'Output':<22}{'FLOPs':>14}")
         for name, i, o, fl in rows:
-            print(f"{name:<20}{str(i):<22}{str(o):<22}{fl:>14,}")
-    print(f"Total Flops: {total}     Total Params: "
+            print(f"{name:<20}{str(i):<22}"  # cli-print
+                  f"{str(o):<22}{fl:>14,}")
+    print(f"Total Flops: {total}     Total Params: "  # cli-print
           f"{sum(int(np.prod(p.shape)) for p in net.parameters())}")
     return total

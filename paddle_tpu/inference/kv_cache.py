@@ -26,6 +26,27 @@ them in via `swap_arrays`.
 """
 from __future__ import annotations
 
+from ..observability import metrics as _metrics
+
+# Pool telemetry (ISSUE 2): pushed on every alloc/grow/free, one bool
+# check each while PADDLE_TPU_TELEMETRY is off. With several live
+# caches the gauges reflect the most recently mutated pool (serving
+# runs exactly one).
+_m_used_blocks = _metrics.gauge(
+    "kv_pool_used_blocks", "allocated blocks (trash block excluded)")
+_m_free_blocks = _metrics.gauge(
+    "kv_pool_free_blocks", "blocks available for allocation")
+_m_utilization = _metrics.gauge(
+    "kv_pool_utilization", "live tokens / usable pool tokens")
+_m_block_fill = _metrics.gauge(
+    "kv_pool_block_fill", "live tokens / allocated block capacity "
+    "(1.0 = no internal fragmentation)")
+_m_sequences = _metrics.gauge(
+    "kv_pool_sequences", "sequences holding blocks")
+_m_alloc_failures = _metrics.counter(
+    "kv_pool_alloc_failures_total",
+    "allocations refused because the pool was exhausted")
+
 
 class BlockPoolExhausted(RuntimeError):
     """Raised when an allocation needs more free blocks than the pool has."""
@@ -81,6 +102,7 @@ class PagedKVCache:
 
     def _take_blocks(self, n):
         if n > len(self._free):
+            _m_alloc_failures.inc()
             raise BlockPoolExhausted(
                 f"need {n} blocks, only {len(self._free)} free "
                 f"(pool {self.num_blocks - 1})")
@@ -88,6 +110,17 @@ class PagedKVCache:
         used = self.num_blocks - 1 - len(self._free)
         self._peak_blocks = max(self._peak_blocks, used)
         return taken
+
+    def _push_gauges(self):
+        if not _metrics.enabled():  # keep the hot path one branch
+            return
+        used = self.num_blocks - 1 - len(self._free)
+        held = sum(self._lens.values())
+        _m_used_blocks.set(used)
+        _m_free_blocks.set(len(self._free))
+        _m_sequences.set(len(self._tables))
+        _m_utilization.set(held / (self.capacity_tokens or 1))
+        _m_block_fill.set(held / ((used * self.block_size) or 1))
 
     def allocate(self, seq_id, num_tokens):
         """Start a new sequence holding `num_tokens` tokens; returns its
@@ -97,6 +130,7 @@ class PagedKVCache:
         table = self._take_blocks(blocks_for(num_tokens, self.block_size))
         self._tables[seq_id] = table
         self._lens[seq_id] = int(num_tokens)
+        self._push_gauges()
         return list(table)
 
     def ensure(self, seq_id, num_tokens):
@@ -107,6 +141,7 @@ class PagedKVCache:
         if need > 0:
             table.extend(self._take_blocks(need))
         self._lens[seq_id] = max(self._lens[seq_id], int(num_tokens))
+        self._push_gauges()
         return list(table)
 
     def append(self, seq_id, n=1):
@@ -119,6 +154,7 @@ class PagedKVCache:
         table = self._tables.pop(seq_id)
         del self._lens[seq_id]
         self._free.extend(reversed(table))
+        self._push_gauges()
         return len(table)
 
     def seq_len(self, seq_id):

@@ -24,12 +24,41 @@ same regardless — decode time is batch-invariant at fixed B).
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
 
 import numpy as np
+
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
+
+# Shared serving telemetry (ISSUE 2): near-zero cost while
+# PADDLE_TPU_TELEMETRY is off — every update is one bool check.
+_m_queue_depth = _metrics.gauge(
+    "serving_queue_depth", "requests waiting for a batch/slot",
+    labelnames=("server",))
+_m_slots_busy = _metrics.gauge(
+    "serving_slots_busy", "occupied decode slots (paged) / in-flight "
+    "batch rows (dense)", labelnames=("server",))
+_m_requests_done = _metrics.counter(
+    "serving_requests_total", "requests completed",
+    labelnames=("server",))
+_m_request_latency = _metrics.histogram(
+    "serving_request_latency_seconds", "submit -> future resolved",
+    labelnames=("server",))
+_m_ttft = _metrics.histogram(
+    "serving_ttft_seconds", "submit -> first generated token (paged)")
+_m_slot_releases = _metrics.counter(
+    "serving_slot_releases_total", "paged slots freed, by why the "
+    "request finished", labelnames=("reason",))
+_m_slot_refills = _metrics.counter(
+    "serving_slot_refills_total",
+    "idle paged slots refilled from the queue mid-flight")
+
+_req_ids = itertools.count()
 
 
 @dataclass
@@ -38,6 +67,8 @@ class _Req:
     future: Future
     t_submit: float
     padded: bool = False
+    rid: str = ""
+    ttft: float | None = None
 
 
 class GenerationServer:
@@ -106,12 +137,16 @@ class GenerationServer:
         row = np.full((self.prompt_len,), self.pad_token_id, np.int32)
         row[self.prompt_len - ids.size:] = ids  # LEFT padding
         req = _Req(ids=row, future=Future(), t_submit=time.perf_counter(),
-                   padded=ids.size < self.prompt_len)
+                   padded=ids.size < self.prompt_len,
+                   rid=f"d{next(_req_ids)}")
         with self._lock:
             if self._stop:
                 raise RuntimeError("server stopped")
             self._queue.append(req)
+            _m_queue_depth.labels(server="dense").set(len(self._queue))
             self._lock.notify()
+        _tracing.event("request_submitted", request_id=req.rid,
+                       prompt_len=int(ids.size))
         return req.future
 
     def start(self):
@@ -185,6 +220,7 @@ class GenerationServer:
                 self._lock.wait(timeout=remaining)
             batch = self._queue[:self.batch_size]
             del self._queue[:len(batch)]
+            _m_queue_depth.labels(server="dense").set(len(self._queue))
             return batch
 
     def _loop(self):
@@ -192,6 +228,9 @@ class GenerationServer:
             batch = self._take_batch()
             if not batch:
                 return
+            for r in batch:
+                _tracing.event("request_admitted", request_id=r.rid)
+            _m_slots_busy.labels(server="dense").set(len(batch))
             rows = [r.ids for r in batch]
             while len(rows) < self.batch_size:  # pad: same device cost
                 rows.append(rows[0])
@@ -209,8 +248,11 @@ class GenerationServer:
             defaults[0] = np.uint32(
                 (int(self._defaults[0]) + self._batches) & 0xFFFFFFFF)
             try:
-                out = self._program(ids, *defaults)
-                out = np.asarray(getattr(out, "numpy", lambda: out)())
+                with _tracing.span("decode_dispatch",
+                                   request_ids=[r.rid for r in batch],
+                                   batch=len(batch)):
+                    out = self._program(ids, *defaults)
+                    out = np.asarray(getattr(out, "numpy", lambda: out)())
             except Exception as e:  # noqa: BLE001 — fan the error out
                 for r in batch:
                     r.future.set_exception(e)
@@ -223,7 +265,13 @@ class GenerationServer:
                 self._tokens_out += new_tokens * len(batch)
                 for i, r in enumerate(batch):
                     self._lat.append(t_done - r.t_submit)
+            _m_slots_busy.labels(server="dense").set(0)
             for i, r in enumerate(batch):
+                _tracing.event("request_done", request_id=r.rid,
+                               new_tokens=int(new_tokens))
+                _m_requests_done.labels(server="dense").inc()
+                _m_request_latency.labels(server="dense").observe(
+                    t_done - r.t_submit)
                 r.future.set_result(out[i])
 
 
@@ -323,6 +371,7 @@ class PagedGenerationServer:
         self._thread = None
         # stats window
         self._lat = []
+        self._ttft = []
         self._tokens_out = 0
         self._requests_done = 0
         self._steps = 0
@@ -347,13 +396,17 @@ class PagedGenerationServer:
             raise ValueError(f"max_new_tokens {budget} not in "
                              f"[1, {self.max_new}]")
         req = _Req(ids=ids, future=Future(),
-                   t_submit=time.perf_counter())
+                   t_submit=time.perf_counter(),
+                   rid=f"p{next(_req_ids)}")
         req.budget = budget
         with self._lock:
             if self._stop:
                 raise RuntimeError("server stopped")
             self._queue.append(req)
+            _m_queue_depth.labels(server="paged").set(len(self._queue))
             self._lock.notify()
+        _tracing.event("request_submitted", request_id=req.rid,
+                       prompt_len=int(ids.size), budget=budget)
         return req.future
 
     def start(self):
@@ -380,8 +433,12 @@ class PagedGenerationServer:
             self._queue.clear()
 
     def reset_stats(self):
+        """Zero the measurement window — latency AND the TTFT samples
+        the window's ttft percentiles derive from, so a post-reset
+        stats() can never mix epochs."""
         with self._lock:
             self._lat.clear()
+            self._ttft.clear()
             self._tokens_out = 0
             self._requests_done = 0
             self._steps = 0
@@ -393,9 +450,13 @@ class PagedGenerationServer:
     def stats(self):
         with self._lock:
             lat = sorted(self._lat)
+            ttft = sorted(self._ttft)
             dt = (time.perf_counter() - self._t0) if self._t0 else 0.0
             n = len(lat)
+            nt = len(ttft)
             pct = (lambda p: lat[min(n - 1, int(p * n))] if n else 0.0)
+            tpct = (lambda p: ttft[min(nt - 1, int(p * nt))] if nt
+                    else 0.0)
             out = {
                 "requests": n,
                 "new_tokens": self._tokens_out,
@@ -403,6 +464,8 @@ class PagedGenerationServer:
                 "p50_ms": pct(0.50) * 1e3,
                 "p90_ms": pct(0.90) * 1e3,
                 "p99_ms": pct(0.99) * 1e3,
+                "ttft_p50_ms": tpct(0.50) * 1e3,
+                "ttft_p99_ms": tpct(0.99) * 1e3,
                 "decode_steps": self._steps,
                 "prefills": self._prefills,
                 # mean busy slots per decode step: the continuous-batching
@@ -468,24 +531,38 @@ class PagedGenerationServer:
             self._slots[i] = {"seq": seq, "req": req, "toks": [],
                               "pos": req.ids.size, "budget": req.budget}
             picked.append((i, req, seq))
+            _m_slot_refills.inc()
+            _tracing.event("request_admitted", request_id=req.rid,
+                           slot=i, seq=seq)
+        if picked:
+            _m_queue_depth.labels(server="paged").set(len(self._queue))
         return picked
 
     def _prefill(self, slot_idx, req, seq):
         jnp = self._jnp
         n = int(req.ids.size)
-        self.cache.allocate(seq, n)
-        bucket = self._bucket(n)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = req.ids
-        tables = jnp.asarray(self.cache.table_array([seq], self._m_width))
-        tok, kc, vc = self._decoder.prefill(
-            self._params, jnp.asarray(ids), jnp.asarray([n]), tables,
-            self.cache.k_blocks, self.cache.v_blocks, self._next_key(),
-            jnp.float32(self.temperature))
-        self.cache.swap_arrays(kc, vc)
+        # the span ends when the FIRST generated token is on the host —
+        # its end timestamp IS the request's first-token time
+        with _tracing.span("prefill", request_id=req.rid,
+                           prompt_len=n, seq=seq):
+            self.cache.allocate(seq, n)
+            bucket = self._bucket(n)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :n] = req.ids
+            tables = jnp.asarray(self.cache.table_array([seq],
+                                                        self._m_width))
+            tok, kc, vc = self._decoder.prefill(
+                self._params, jnp.asarray(ids), jnp.asarray([n]), tables,
+                self.cache.k_blocks, self.cache.v_blocks,
+                self._next_key(), jnp.float32(self.temperature))
+            self.cache.swap_arrays(kc, vc)
+            tok0 = int(np.asarray(tok)[0])
+        req.ttft = time.perf_counter() - req.t_submit
+        _m_ttft.observe(req.ttft)
         with self._lock:
             self._prefills += 1
-        self._slot_token(slot_idx, int(np.asarray(tok)[0]))
+            self._ttft.append(req.ttft)
+        self._slot_token(slot_idx, tok0)
 
     def _slot_token(self, i, tok):
         """Record one generated token for slot i; completes the request
@@ -495,17 +572,26 @@ class PagedGenerationServer:
         hit_eos = (self.eos >= 0 and tok == self.eos)
         if hit_eos or len(slot["toks"]) >= slot["budget"]:
             seq, req = slot["seq"], slot["req"]
-            out = np.concatenate([req.ids,
-                                  np.asarray(slot["toks"], np.int32)])
-            self.cache.free(seq)
-            del self._worst[seq]
-            self._slots[i] = None
-            t_done = time.perf_counter()
-            with self._lock:
-                self._lat.append(t_done - req.t_submit)
-                self._tokens_out += len(slot["toks"])
-                self._requests_done += 1
-            req.future.set_result(out)
+            reason = "eos" if hit_eos else "budget"
+            _tracing.event("request_done", request_id=req.rid,
+                           new_tokens=len(slot["toks"]),
+                           ttft_s=req.ttft, reason=reason)
+            with _tracing.span("detokenize", request_id=req.rid):
+                out = np.concatenate([req.ids,
+                                      np.asarray(slot["toks"], np.int32)])
+                self.cache.free(seq)
+                del self._worst[seq]
+                self._slots[i] = None
+                t_done = time.perf_counter()
+                with self._lock:
+                    self._lat.append(t_done - req.t_submit)
+                    self._tokens_out += len(slot["toks"])
+                    self._requests_done += 1
+                _m_slot_releases.labels(reason=reason).inc()
+                _m_requests_done.labels(server="paged").inc()
+                _m_request_latency.labels(server="paged").observe(
+                    t_done - req.t_submit)
+                req.future.set_result(out)
 
     def _loop(self):
         jnp = self._jnp
@@ -528,6 +614,7 @@ class PagedGenerationServer:
                     req.future.set_exception(e)
             active_idx = [i for i, s in enumerate(self._slots)
                           if s is not None]
+            _m_slots_busy.labels(server="paged").set(len(active_idx))
             if not active_idx:
                 continue
             k = self.steps_per_dispatch
@@ -549,20 +636,26 @@ class PagedGenerationServer:
                 [s["seq"] if s is not None else None
                  for s in self._slots], self._m_width))
             try:
-                if self._mstep is None:
-                    nxt, kc, vc = self._decoder.step(
-                        self._params, jnp.asarray(tok), jnp.asarray(pos),
-                        jnp.asarray(act), tables, self.cache.k_blocks,
-                        self.cache.v_blocks, self._next_key(),
-                        jnp.float32(self.temperature))
-                    toks = np.asarray(nxt)[None]       # [1, S]
-                else:
-                    toks, kc, vc = self._mstep(
-                        self._params, jnp.asarray(tok), jnp.asarray(pos),
-                        jnp.asarray(act), tables, self.cache.k_blocks,
-                        self.cache.v_blocks, self._next_key(),
-                        jnp.float32(self.temperature))
-                    toks = np.asarray(toks)            # [k, S]
+                with _tracing.span(
+                        "decode_dispatch", k=k,
+                        request_ids=[self._slots[i]["req"].rid
+                                     for i in active_idx]):
+                    if self._mstep is None:
+                        nxt, kc, vc = self._decoder.step(
+                            self._params, jnp.asarray(tok),
+                            jnp.asarray(pos), jnp.asarray(act), tables,
+                            self.cache.k_blocks, self.cache.v_blocks,
+                            self._next_key(),
+                            jnp.float32(self.temperature))
+                        toks = np.asarray(nxt)[None]   # [1, S]
+                    else:
+                        toks, kc, vc = self._mstep(
+                            self._params, jnp.asarray(tok),
+                            jnp.asarray(pos), jnp.asarray(act), tables,
+                            self.cache.k_blocks, self.cache.v_blocks,
+                            self._next_key(),
+                            jnp.float32(self.temperature))
+                        toks = np.asarray(toks)        # [k, S]
             except Exception as e:  # noqa: BLE001 — fan out, drop slots
                 for i in active_idx:
                     s = self._slots[i]

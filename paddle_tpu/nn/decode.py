@@ -221,19 +221,30 @@ class PagedDecoder:
     def __init__(self, spec, block_size, return_logits=False, donate=None):
         import jax
 
+        from ..observability import tracing as _tracing
+
         if donate is None:  # CPU donation is a no-op warning in jaxlib
             donate = jax.default_backend() not in ("cpu",)
         self.spec = tuple(spec)
         self.block_size = int(block_size)
         self.return_logits = bool(return_logits)
         self._donate = bool(donate)
-        self.prefill, self.step = _jitted_paged_fns(
+        prefill, step = _jitted_paged_fns(
             self.spec, self.block_size, self.return_logits, self._donate)
+        # dispatch-boundary spans (ISSUE 2): when tracing is on, every
+        # jitted prefill/step call shows up as its own span — the
+        # device-side cost inside a request's prefill/decode phases;
+        # when off, the wrapper is one bool check
+        self.prefill = _tracing.wrap("prefill_dispatch", prefill)
+        self.step = _tracing.wrap("step_dispatch", step)
 
     def multistep(self, n_steps):
         """Fused n-token decode (see _jitted_multistep)."""
-        return _jitted_multistep(self.spec, self.block_size, int(n_steps),
-                                 self._donate)
+        from ..observability import tracing as _tracing
+
+        fn = _jitted_multistep(self.spec, self.block_size, int(n_steps),
+                               self._donate)
+        return _tracing.wrap("multistep_dispatch", fn, k=int(n_steps))
 
     @classmethod
     def for_config(cls, cfg, block_size, **kw):

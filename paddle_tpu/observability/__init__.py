@@ -1,0 +1,41 @@
+"""paddle_tpu.observability — unified runtime telemetry (ISSUE 2).
+
+Three pillars, shared by serving, training, and bench:
+
+  * `metrics` — process-wide registry of counters/gauges/histograms
+    with labels; Prometheus-text and JSON snapshot exporters; near-zero
+    cost when disabled.
+  * `tracing` — span API emitting a JSONL event log with monotonic
+    timestamps, plus the per-request trace assembler (queue-wait /
+    admission / prefill / decode / detokenize phases, TTFT, per-token
+    latency) and the utils/profiler.top_ops bridge.
+  * `log` — the library logger (PADDLE_TPU_LOG_LEVEL verbosity);
+    library code uses this instead of bare print()
+    (scripts/check_no_print.py enforces it).
+
+One switch turns the first two on: PADDLE_TPU_TELEMETRY=1 in the
+environment, or `observability.enable()` at runtime.
+"""
+from __future__ import annotations
+
+from . import log, metrics, tracing  # noqa: F401
+from .log import get_logger  # noqa: F401
+from .metrics import (REGISTRY, counter, gauge, histogram,  # noqa: F401
+                      snapshot, to_prometheus)
+from .tracing import (TRACER, assemble_request_traces,  # noqa: F401
+                      attach_device_ops, span, summarize_traces)
+
+
+def enable():
+    """Turn on metrics collection AND tracing."""
+    metrics.enable()
+    tracing.enable()
+
+
+def disable():
+    metrics.disable()
+    tracing.disable()
+
+
+def enabled():
+    return metrics.enabled() or tracing.enabled()

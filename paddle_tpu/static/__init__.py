@@ -104,7 +104,7 @@ def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
         if isinstance(val, jax.core.Tracer):
             jax.debug.print((message or "") + "{x}", x=val)
         else:
-            print((message or "")
+            print((message or "")  # cli-print: the Print op's contract
                   + str(np.asarray(val).ravel()[:summarize]))
     return input
 

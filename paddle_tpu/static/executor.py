@@ -18,6 +18,11 @@ import numpy as np
 
 from ..core.place import Place, _expected_place
 from ..core.tensor import Tensor
+
+
+def _debug_logger():
+    from ..observability import log as _log
+    return _log.get_logger(__name__)
 from .program import (OpNode, Program, Variable, default_main_program,
                       default_startup_program)
 
@@ -285,7 +290,7 @@ class Executor:
                     getattr(v, "name", str(v)) for v in fetch_list]
                 msg = ", ".join(f"{lbl}={np.asarray(o).ravel()[:4]}"
                                 for lbl, o in zip(labels, outs))
-                print(f"step {step}: {msg}")
+                _debug_logger().info("step %s: %s", step, msg)
             step += 1
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,

@@ -16,8 +16,8 @@ def try_import(name):
 
 def run_check():
     import jax
-    print("paddle_tpu is installed successfully!")
-    print(f"devices: {jax.devices()}")
+    print("paddle_tpu is installed successfully!")  # cli-print: run_check
+    print(f"devices: {jax.devices()}")  # cli-print
     from .. import nn, optimizer, to_tensor
     lin = nn.Linear(4, 2)
     out = lin(to_tensor([[1.0, 2.0, 3.0, 4.0]]))
@@ -25,7 +25,7 @@ def run_check():
     loss.backward()
     opt = optimizer.SGD(0.1, parameters=lin.parameters())
     opt.step()
-    print("single-device training check: OK")
+    print("single-device training check: OK")  # cli-print
 
 
 def deprecated(since=None, update_to=None, reason=None):

@@ -7,11 +7,15 @@ kernels. Also provides a light host-side step timer.
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 from collections import defaultdict
 
 import jax
 
+from ..observability import log as _log
+
+_logger = _log.get_logger(__name__)
 _records = defaultdict(list)
 
 
@@ -23,8 +27,8 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile
         yield
     finally:
         jax.profiler.stop_trace()
-        print(f"[profiler] trace written to {profile_path} "
-              f"({time.time() - t0:.2f}s)")
+        _logger.info("[profiler] trace written to %s (%.2fs)",
+                     profile_path, time.time() - t0)
 
 
 def start_profiler(state="All", tracer_option="Default",
@@ -36,16 +40,14 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
     jax.profiler.stop_trace()
 
 
-@contextlib.contextmanager
-def record_event(name):
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        _records[name].append(time.perf_counter() - t0)
-
-
 class RecordEvent:
+    """Host-side event timer: context manager AND decorator.
+
+        with RecordEvent("matmul"): ...
+        @record_event("step")            # or bare @record_event: the
+        def step(...): ...               # event is named after the fn
+    """
+
     def __init__(self, name):
         self.name = name
 
@@ -56,12 +58,34 @@ class RecordEvent:
     def __exit__(self, *exc):
         _records[self.name].append(time.perf_counter() - self._t0)
 
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+        return wrapped
+
+
+def record_event(name):
+    """RecordEvent factory; also usable as a bare decorator
+    (`@record_event`), naming the event after the function."""
+    if callable(name):
+        return RecordEvent(name.__qualname__)(name)
+    return RecordEvent(name)
+
 
 def summary():
+    """Per-event stats: count/total/mean plus min/max/p50/p99 (nearest-
+    rank percentiles over the recorded samples)."""
     out = {}
     for name, times in _records.items():
-        out[name] = {"count": len(times), "total": sum(times),
-                     "mean": sum(times) / len(times)}
+        ts = sorted(times)
+        n = len(ts)
+        total = sum(ts)
+        pct = (lambda p: ts[min(n - 1, int(p * n))])
+        out[name] = {"count": n, "total": total, "mean": total / n,
+                     "min": ts[0], "max": ts[-1],
+                     "p50": pct(0.50), "p99": pct(0.99)}
     return out
 
 
@@ -144,10 +168,11 @@ def print_top_ops(fn, steps=3, k=25):
     rows = sorted(((n, ms, c) for n, (ms, c) in totals.items()),
                   key=lambda x: -x[1])[:k]
     shown = sum(ms for _, ms, _ in rows)
-    print(f"{'op':<60} {'ms':>10} {'count':>7} {'%':>6}")
+    print(f"{'op':<60} {'ms':>10} {'count':>7} {'%':>6}")  # cli-print
     for name, ms, c in rows:
-        print(f"{name[:60]:<60} {ms:>10.3f} {c:>7} "
+        print(f"{name[:60]:<60} {ms:>10.3f} {c:>7} "  # cli-print: table
               f"{100 * ms / max(grand, 1e-9):>5.1f}%")
-    print(f"# top-{len(rows)} covers {100 * shown / max(grand, 1e-9):.1f}% "
+    print(f"# top-{len(rows)} covers "  # cli-print: print_top_ops report
+          f"{100 * shown / max(grand, 1e-9):.1f}% "
           f"of {grand:.1f}ms total device-op time")
     return rows

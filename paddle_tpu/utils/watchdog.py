@@ -34,6 +34,18 @@ import threading
 import time
 import traceback
 
+from ..observability import log as _log
+from ..observability import metrics as _metrics
+
+_logger = _log.get_logger(__name__)
+# heartbeat age is PULLED at metrics-export time (gauge_fn) so the
+# beat() hot path stays untouched; with several live watchdogs the
+# gauge follows the most recently started one
+_m_fired = _metrics.counter(
+    "watchdog_fired_total", "watchdog timeouts observed")
+_m_beats = _metrics.counter(
+    "watchdog_beats_total", "heartbeats received")
+
 
 class Watchdog:
     def __init__(self, timeout, on_timeout=None, action="interrupt",
@@ -59,6 +71,7 @@ class Watchdog:
         in the timeout report."""
         self._last = time.monotonic()
         self._beats += 1
+        _m_beats.inc()
         if info:
             self._info = info
 
@@ -73,6 +86,10 @@ class Watchdog:
         # cycle can never let it resurrect and fire against the new run
         self._stop = threading.Event()
         self._last = time.monotonic()
+        _metrics.REGISTRY.gauge_fn(
+            "watchdog_heartbeat_age_seconds",
+            "seconds since the last beat() of the active watchdog",
+            lambda: time.monotonic() - self._last)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="paddle-tpu-watchdog",
                                         args=(self._stop,))
@@ -104,6 +121,7 @@ class Watchdog:
             if idle < self.timeout:
                 continue
             self._fired += 1
+            _m_fired.inc()
             self._report(idle)
             cb = self.on_timeout
             if cb is not None:
@@ -136,7 +154,7 @@ class Watchdog:
                 lines.extend(
                     ln.rstrip() for ln in traceback.format_stack(frame))
         report = "\n".join(lines)
-        print(report, file=sys.stderr)
+        _logger.error(report)
         if self.dump_path:
             try:
                 with open(self.dump_path, "a") as f:

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Static check: no bare print() in paddle_tpu/ library code (ISSUE 2).
+
+Library diagnostics must go through paddle_tpu.observability.log (env-
+var verbosity, stderr, never pollutes machine-parsed stdout). Two
+escape hatches for surfaces where printing IS the contract:
+
+  * ALLOWLIST — whole files that are interactive display components
+    (the progress bar renders with carriage returns);
+  * a `# cli-print` pragma on the print call's first line — explicit
+    CLI/report surfaces (run_check, version.show, the fluid Print op,
+    summary()/flops() tables, print_top_ops).
+
+AST-based, so comments/docstrings/strings never false-positive and
+`jax.debug.print` (an attribute call) is never flagged. Exit 0 clean,
+1 with a violation listing — wired into tier-1 as
+tests/test_no_print.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+
+# interactive display components: print with end=""/\r is the widget
+ALLOWLIST = {
+    "paddle_tpu/hapi/progressbar.py",
+}
+PRAGMA = "cli-print"
+
+
+def check_file(path, rel):
+    src = open(path, encoding="utf-8").read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+    lines = src.splitlines()
+    bad = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if PRAGMA in line:
+            continue
+        bad.append(f"{rel}:{node.lineno}: bare print() — use "
+                   "paddle_tpu.observability.log.get_logger(__name__) "
+                   "or mark an explicit CLI surface with  # cli-print")
+    return bad
+
+
+def main():
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if rel in ALLOWLIST:
+                continue
+            violations.extend(check_file(path, rel))
+    if violations:
+        print(f"check_no_print: {len(violations)} violation(s):")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print("check_no_print: OK (no bare print() in paddle_tpu/)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

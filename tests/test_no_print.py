@@ -1,0 +1,18 @@
+"""Tier-1 wiring for scripts/check_no_print.py (ISSUE 2 satellite):
+library code under paddle_tpu/ must not use bare print() — diagnostics
+go through paddle_tpu.observability.log; explicit CLI/report surfaces
+carry a `# cli-print` pragma and display widgets are allowlisted."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_no_bare_print_in_library():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_no_print.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"\n{r.stdout}{r.stderr}"
+    assert "OK" in r.stdout
