@@ -9,4 +9,4 @@ commit = "tpu-native"
 
 
 def show():
-    print(f"paddle_tpu {full_version} (commit {commit})")
+    print(f"paddle_tpu {full_version} (commit {commit})")  # cli-print
