@@ -475,7 +475,7 @@ def _bench_decode(on_tpu):
     return records
 
 
-def _bench_served(on_tpu, telemetry=False):
+def _bench_served(on_tpu, telemetry=False, tiny=False):
     """Served mixed-length traffic: the SAME uniform(64..1024-class)
     prompt pool driven through (a) the padded static-batch
     GenerationServer — every request padded to the global prompt_len, a
@@ -486,6 +486,13 @@ def _bench_served(on_tpu, telemetry=False):
     submitted upfront, wall clock measured to completion (each pass runs
     once unmeasured to compile, then reset_stats + a measured pass).
 
+    A third record is the OPEN-LOOP axis (ISSUE 3): the same warm paged
+    server driven at fixed-seed Poisson arrivals (~70% of the
+    closed-loop request rate), measuring steady-state admission CHURN —
+    requests arriving while others decode, which is where prefill
+    stalls live; it carries itl_p99_ms and prefill_dispatches, the two
+    numbers the packed/chunked prefill scheduler exists to move.
+
     telemetry=True (`bench.py served --telemetry`, ISSUE 2): after the
     baseline paged pass, interleaved off/on measured passes run on the
     SAME warm server (_served_telemetry_pass) — a Prometheus-text
@@ -493,14 +500,27 @@ def _bench_served(on_tpu, telemetry=False):
     (TELEMETRY_trace.jsonl), and the assembled per-request phase report
     (TELEMETRY_request_traces.json) land next to the BENCH_*.json
     files, and the extra record carries the measured overhead vs. the
-    telemetry-off passes (acceptance bar: < 3%)."""
-    from paddle_tpu.inference import GenerationServer, PagedGenerationServer
+    telemetry-off passes (acceptance bar: < 3%).
+
+    tiny=True (`bench.py served --tiny`): seconds-scale smoke config
+    that skips the padded comparison and telemetry — it exists so
+    tier-1 can assert the served/open-loop record SCHEMA (the
+    prefill_dispatches/itl_p99_ms fields) without paying the full
+    CPU-degraded sweep."""
+    from paddle_tpu.inference import (GenerationServer,
+                                      PagedGenerationServer,
+                                      measure_poisson_load)
     from paddle_tpu.models.gpt2 import GPT2, GPT2Config
 
-    if on_tpu:
+    if tiny:
+        cfg = GPT2Config.tiny()
+        n_req, new, slots, bs, k = 6, 4, 2, 4, 2
+        lo, hi, chunk = 4, 24, 16
+    elif on_tpu:
         cfg = GPT2Config()
         n_req, new, slots, bs, k = 32, 64, 8, 128, 8
         lo, hi = 64, 768  # hi + new + k-1 must stay under max_position
+        chunk = 512
     else:
         # mid-size CPU proxy: big enough that compute dominates dispatch
         # (the regime the chip is always in) — at tiny scale the per-
@@ -510,6 +530,7 @@ def _bench_served(on_tpu, telemetry=False):
                          num_heads=8, max_position=512)
         n_req, new, slots, bs, k = 16, 16, 4, 16, 8
         lo, hi = 32, 384
+        chunk = 96
     cfg.dropout = 0.0
     model = GPT2(cfg)
     model.eval()
@@ -529,64 +550,126 @@ def _bench_served(on_tpu, telemetry=False):
         return server.stats()
 
     # (a) padded static batcher over the in-process dense-cache decode
-    def prog(ids, seed, temp, eos, top_p, pad):
-        return model.generate(
-            ids, new, temperature=float(temp), seed=int(seed),
-            eos_token_id=None if int(eos) < 0 else int(eos),
-            top_p=float(top_p),
-            pad_token_id=None if int(pad) < 0 else int(pad)).numpy()
+    # (skipped in tiny mode: the smoke asserts schema, not the speedup)
+    st_pad = None
+    if not tiny:
+        def prog(ids, seed, temp, eos, top_p, pad):
+            return model.generate(
+                ids, new, temperature=float(temp), seed=int(seed),
+                eos_token_id=None if int(eos) < 0 else int(eos),
+                top_p=float(top_p),
+                pad_token_id=None if int(pad) < 0 else int(pad)).numpy()
 
-    srv = GenerationServer(prog, batch_size=slots, prompt_len=hi,
-                           pad_token_id=0, max_wait_ms=5.0).start()
-    try:
-        st_pad = drain(srv)
-    finally:
-        srv.stop()
+        srv = GenerationServer(prog, batch_size=slots, prompt_len=hi,
+                               pad_token_id=0, max_wait_ms=5.0).start()
+        try:
+            st_pad = drain(srv)
+        finally:
+            srv.stop()
     # (b) continuous batching over the paged KV cache
     psrv = PagedGenerationServer(model, max_slots=slots, block_size=bs,
                                  max_prompt_len=hi, max_new_tokens=new,
-                                 steps_per_dispatch=k).start()
+                                 steps_per_dispatch=k,
+                                 prefill_chunk_tokens=chunk).start()
     rec_tel = None
     try:
         st_paged = drain(psrv)
-        if telemetry:
+        if telemetry and not tiny:
             rec_tel = _served_telemetry_pass(psrv, prompts, on_tpu)
+        # (c) open-loop Poisson churn on the same warm server, offered
+        # at ~70% of the closed-loop request rate (fixed arrival seed)
+        rps = 0.7 * st_paged["requests"] / max(st_paged["wall_s"], 1e-9)
+        psrv.reset_stats()
+        st_open = measure_poisson_load(psrv, prompts, rps, n_req,
+                                       seed=1234, timeout=900)
+        # (d) chunking lever isolated: SAME arrivals, chunk budget =
+        # whole prompt (still packed, no chunk/decode interleaving) —
+        # the ITL-p99 delta vs (c) is what chunked prefill buys under
+        # churn. One unmeasured pass first: the wider packed buckets
+        # compile here, not inside the measured window.
+        psrv.prefill_chunk_tokens = hi
+        measure_poisson_load(psrv, prompts, rps, n_req, seed=1234,
+                             timeout=900)
+        psrv.reset_stats()
+        st_unchunked = measure_poisson_load(psrv, prompts, rps, n_req,
+                                            seed=1234, timeout=900)
     finally:
         psrv.stop()
 
+    base = "gpt2tiny_served" if tiny else "gpt2s_served"
     suffix = "" if on_tpu else "_CPU_DEGRADED"
-    rec_pad = {
-        "metric": f"gpt2s_served_mixed_padded_tokens_per_sec{suffix}",
-        "value": round(st_pad["tokens_per_sec"], 1),
-        "unit": "tokens/s",
-        "vs_baseline": 1.0,
-        "baseline": "self (the padded static-batch server IS the bar)",
-        "p99_ms": round(st_pad["p99_ms"], 1),
-    }
     rec_paged = {
-        "metric": f"gpt2s_served_mixed_paged_tokens_per_sec{suffix}",
+        "metric": f"{base}_mixed_paged_tokens_per_sec{suffix}",
         "value": round(st_paged["tokens_per_sec"], 1),
         "unit": "tokens/s",
-        "vs_baseline": round(st_paged["tokens_per_sec"]
-                             / max(st_pad["tokens_per_sec"], 1e-9), 3),
-        "baseline": "padded static-batch GenerationServer, same traffic",
         "p99_ms": round(st_paged["p99_ms"], 1),
+        "itl_p99_ms": round(st_paged["itl_p99_ms"], 2),
+        "prefill_dispatches": st_paged["prefill_dispatches"],
         "slot_fill": round(st_paged["slot_fill"], 3),
         "kv_block_fill": round(st_paged["kv_block_fill"], 3),
     }
+    rec_open = {
+        "metric": f"{base}_openloop_paged_tokens_per_sec{suffix}",
+        "value": round(st_open["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(st_open["tokens_per_sec"]
+                             / max(st_paged["tokens_per_sec"], 1e-9), 3),
+        "baseline": "same paged server, closed-loop all-upfront drain",
+        "p99_ms": round(st_open["p99_ms"], 1),
+        "ttft_p99_ms": round(st_open["ttft_p99_ms"], 1),
+        "itl_p50_ms": round(st_open["itl_p50_ms"], 2),
+        "itl_p99_ms": round(st_open["itl_p99_ms"], 2),
+        "prefills": st_open["prefills"],
+        "prefill_dispatches": st_open["prefill_dispatches"],
+        "offered_rps": round(st_open["offered_rps"], 3),
+        "achieved_rps": round(st_open["achieved_rps"], 3),
+        # same arrivals with chunking OFF (budget = whole prompt):
+        # the chunk budget's ITL-vs-TTFT trade, measured
+        "itl_p99_ms_unchunked": round(st_unchunked["itl_p99_ms"], 2),
+        "ttft_p99_ms_unchunked": round(st_unchunked["ttft_p99_ms"], 1),
+    }
+    if st_pad is not None:
+        rec_pad = {
+            "metric": f"{base}_mixed_padded_tokens_per_sec{suffix}",
+            "value": round(st_pad["tokens_per_sec"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": 1.0,
+            "baseline": "self (the padded static-batch server IS the bar)",
+            "p99_ms": round(st_pad["p99_ms"], 1),
+        }
+        rec_paged["vs_baseline"] = round(
+            st_paged["tokens_per_sec"]
+            / max(st_pad["tokens_per_sec"], 1e-9), 3)
+        rec_paged["baseline"] = \
+            "padded static-batch GenerationServer, same traffic"
+        records = [rec_pad, rec_paged, rec_open]
+    else:
+        rec_paged["vs_baseline"] = 1.0
+        rec_paged["baseline"] = "self (tiny schema smoke)"
+        records = [rec_paged, rec_open]
+    if rec_tel is not None:
+        records.append(rec_tel)
     if not on_tpu:
-        rec_pad["degraded"] = rec_paged["degraded"] = True
-        if rec_tel is not None:
-            rec_tel["degraded"] = True
-    records = [rec_pad, rec_paged] + ([rec_tel] if rec_tel else [])
+        for rec in records:
+            rec["degraded"] = True
     for rec in records:
         print(json.dumps(rec))
-    print(f"# served mixed({lo}-{hi})x{n_req} new={new} slots={slots}: "
-          f"padded {st_pad['tokens_per_sec']:,.0f} tok/s "
-          f"p99 {st_pad['p99_ms']:.0f}ms | paged "
-          f"{st_paged['tokens_per_sec']:,.0f} tok/s "
-          f"p99 {st_paged['p99_ms']:.0f}ms "
-          f"({rec_paged['vs_baseline']:.2f}x)", file=sys.stderr)
+    if st_pad is not None:
+        print(f"# served mixed({lo}-{hi})x{n_req} new={new} "
+              f"slots={slots}: padded {st_pad['tokens_per_sec']:,.0f} "
+              f"tok/s p99 {st_pad['p99_ms']:.0f}ms | paged "
+              f"{st_paged['tokens_per_sec']:,.0f} tok/s "
+              f"p99 {st_paged['p99_ms']:.0f}ms "
+              f"({rec_paged['vs_baseline']:.2f}x)", file=sys.stderr)
+    print(f"# served open-loop: {st_open['offered_rps']:.2f} rps offered "
+          f"({st_open['achieved_rps']:.2f} achieved), "
+          f"{st_open['tokens_per_sec']:,.0f} tok/s, "
+          f"itl p99 {st_open['itl_p99_ms']:.1f}ms "
+          f"(unchunked {st_unchunked['itl_p99_ms']:.1f}ms), "
+          f"ttft p99 {st_open['ttft_p99_ms']:.0f}ms "
+          f"(unchunked {st_unchunked['ttft_p99_ms']:.0f}ms), "
+          f"{st_open['prefill_dispatches']} prefill dispatches for "
+          f"{st_open['prefills']} prefills", file=sys.stderr)
     return records
 
 
@@ -690,11 +773,12 @@ def main():
     import paddle_tpu  # noqa: F401
 
     flags = {a for a in sys.argv[1:] if a.startswith("--")}
-    unknown = flags - {"--telemetry"}
+    unknown = flags - {"--telemetry", "--tiny"}
     if unknown:
         raise SystemExit(f"unknown bench flag(s) {sorted(unknown)}; "
-                         "supported: --telemetry")
+                         "supported: --telemetry, --tiny")
     telemetry = "--telemetry" in flags
+    tiny = "--tiny" in flags
     pos = [a for a in sys.argv[1:] if not a.startswith("--")]
     axis = pos[0] if pos else os.environ.get("PADDLE_TPU_BENCH_MODEL")
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -704,7 +788,7 @@ def main():
             _bench_decode(on_tpu)
             return
         if axis == "served":
-            _bench_served(on_tpu, telemetry=telemetry)
+            _bench_served(on_tpu, telemetry=telemetry, tiny=tiny)
             return
         if axis not in AXES:  # a typo must not silently bench gpt2s
             raise SystemExit(
@@ -725,7 +809,7 @@ def main():
         # decode compiles 6 programs (2 lengths x 3 configs when cold);
         # served compiles ~6 too (5 prefill buckets + 1 step)
         need = 210 if name == "decode" else (
-            150 if name == "served" else (60 if records else 0))
+            180 if name == "served" else (60 if records else 0))
         if _remaining() < need:
             skipped.append(name)
             continue

@@ -267,4 +267,4 @@ class PredictorPool:
 
 from .kv_cache import BlockPoolExhausted, PagedKVCache  # noqa: E402
 from .serving import (GenerationServer, PagedGenerationServer,  # noqa: E402
-                      measure_offered_load)
+                      measure_offered_load, measure_poisson_load)

@@ -144,6 +144,36 @@ class PagedKVCache:
         self._push_gauges()
         return list(table)
 
+    def ensure_many(self, updates):
+        """Bulk multi-sequence allocation: atomically create-or-grow
+        several sequences so each covers its requested token count.
+        `updates`: iterable of (seq_id, num_tokens). Either every
+        sequence ends up covered or — when the pool can't hold the
+        TOTAL demand — BlockPoolExhausted is raised with NO side
+        effects. One call serves a whole packed prefill chunk plan
+        (inference/serving.py), so a mid-plan exhaustion can never
+        leave half the chunk's sequences grown."""
+        updates = [(s, int(n)) for s, n in updates]
+        need = []
+        total = 0
+        for seq_id, n in updates:
+            grow = blocks_for(n, self.block_size) \
+                - len(self._tables.get(seq_id, ()))
+            need.append(max(0, grow))
+            total += max(0, grow)
+        if total > len(self._free):
+            _m_alloc_failures.inc()
+            raise BlockPoolExhausted(
+                f"need {total} blocks across {len(updates)} sequences, "
+                f"only {len(self._free)} free "
+                f"(pool {self.num_blocks - 1})")
+        for (seq_id, n), grow in zip(updates, need):
+            table = self._tables.setdefault(seq_id, [])
+            if grow:
+                table.extend(self._take_blocks(grow))
+            self._lens[seq_id] = max(self._lens.get(seq_id, 0), n)
+        self._push_gauges()
+
     def append(self, seq_id, n=1):
         """Reserve room for `n` more tokens; returns the (possibly grown)
         block table."""
@@ -166,6 +196,11 @@ class PagedKVCache:
     def blocks_held(self, seq_id):
         """Blocks currently backing seq_id (0 if not yet allocated)."""
         return len(self._tables.get(seq_id, ()))
+
+    def has_seq(self, seq_id):
+        """Whether seq_id currently owns a block table (the public form
+        of the `seq in cache._tables` probe exception handlers need)."""
+        return seq_id in self._tables
 
     def table_array(self, seq_ids, width=None):
         """Dense int32 [len(seq_ids), width] block-table matrix for the

@@ -57,6 +57,19 @@ _m_slot_releases = _metrics.counter(
 _m_slot_refills = _metrics.counter(
     "serving_slot_refills_total",
     "idle paged slots refilled from the queue mid-flight")
+_m_itl = _metrics.histogram(
+    "paddle_tpu_serving_itl_seconds",
+    "inter-token latency per generated token (decode-dispatch gap "
+    "amortized over the tokens it emitted, paged) — the metric the "
+    "prefill_chunk_tokens knob is tuned against")
+_m_prefill_dispatches = _metrics.counter(
+    "serving_prefill_dispatches_total",
+    "packed ragged prefill chunk dispatches (paged); an admission "
+    "burst of N requests costs O(1) of these per decode round, not N")
+_m_decode_stall = _metrics.histogram(
+    "serving_decode_stall_seconds",
+    "time in-flight decode slots stalled while a packed prefill chunk "
+    "dispatch ran (bounded by the chunk token budget)")
 
 _req_ids = itertools.count()
 
@@ -300,9 +313,31 @@ class PagedGenerationServer:
     reservation is accounting, not allocation.
 
     model: a GPT2 (or same-layout) module; its params are snapshotted at
-    construction (weight_quant="int8" serves W8A16). Prefill pads each
-    prompt to a power-of-two bucket so the number of compiled prefill
-    programs stays logarithmic in max_prompt_len.
+    construction (weight_quant="int8" serves W8A16).
+
+    Prefill is PACKED and CHUNKED (Ragged Paged Attention direction,
+    arXiv:2604.15464; Sarathi-style chunk budget): every loop round, up
+    to `prefill_chunk_tokens` prompt tokens across ALL slots still
+    feeding their prompts are concatenated into one token-packed stream
+    and run as ONE packed ragged prefill dispatch — an admission burst
+    of N requests costs O(1) prefill dispatches per decode round
+    instead of N sequential B=1 dispatches (each paying the 8-70ms
+    tunnel floor, PERF.md). Prompts longer than the chunk budget are
+    split across rounds, the partial K/V state living in the paged
+    cache (which supports it natively), so in-flight decode slots see
+    at most one chunk-budget prefill between decode dispatches and
+    inter-token latency stays bounded during admission churn. The
+    packed stream is bucketed to a power of two, so compile count is
+    logarithmic in the packed token budget rather than per
+    prompt-length bucket.
+
+    prefill_chunk_tokens: max REAL prompt tokens per packed prefill
+        dispatch (default 512). Smaller bounds decode ITL tighter
+        during bursts; larger finishes prefills (TTFT) sooner.
+    pack_align: each prompt chunk's packed region is aligned to this
+        many tokens (default: 128 on TPU — the Pallas ragged-prefill
+        kernel's query-tile contract — else 8). Alignment padding is
+        routed to the trash block.
 
     steps_per_dispatch > 1 turns on multi-step scheduling: that many
     decode tokens run as ONE jitted lax.scan dispatch, amortizing the
@@ -316,7 +351,8 @@ class PagedGenerationServer:
     def __init__(self, model, *, max_slots=4, block_size=16,
                  max_prompt_len=None, max_new_tokens=32, num_blocks=None,
                  eos_token_id=None, temperature=0.0, seed=0,
-                 weight_quant=None, steps_per_dispatch=1):
+                 weight_quant=None, steps_per_dispatch=1,
+                 prefill_chunk_tokens=512, pack_align=None):
         import jax
         import jax.numpy as jnp
 
@@ -337,6 +373,12 @@ class PagedGenerationServer:
                 f"exceeds max_position ({cfg.max_position})")
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        if self.prefill_chunk_tokens < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1")
+        if pack_align is None:  # Pallas kernel query-tile contract on TPU
+            pack_align = 128 if jax.default_backend() not in ("cpu",) else 8
+        self._pack_align = int(pack_align)
         self.eos = -1 if eos_token_id is None else int(eos_token_id)
         self.temperature = float(temperature)
         params, _ = model.functional_state()
@@ -372,10 +414,12 @@ class PagedGenerationServer:
         # stats window
         self._lat = []
         self._ttft = []
+        self._itl = []
         self._tokens_out = 0
         self._requests_done = 0
         self._steps = 0
         self._prefills = 0
+        self._prefill_dispatches = 0
         self._active_integral = 0
         self._fill_integral = 0.0
         self._t0 = None
@@ -439,23 +483,34 @@ class PagedGenerationServer:
         with self._lock:
             self._lat.clear()
             self._ttft.clear()
+            self._itl.clear()
             self._tokens_out = 0
             self._requests_done = 0
             self._steps = 0
             self._prefills = 0
+            self._prefill_dispatches = 0
             self._active_integral = 0
             self._fill_integral = 0.0
             self._t0 = time.perf_counter()
 
     def stats(self):
+        """Window stats. ITL (inter-token latency) is per GENERATED
+        token: each decode dispatch's host-visible gap since the slot's
+        previous emission, amortized over the tokens it emitted (with
+        multi-step scheduling, k tokens land per dispatch) — the metric
+        the prefill_chunk_tokens knob trades against TTFT."""
         with self._lock:
             lat = sorted(self._lat)
             ttft = sorted(self._ttft)
+            itl = sorted(self._itl)
             dt = (time.perf_counter() - self._t0) if self._t0 else 0.0
             n = len(lat)
             nt = len(ttft)
+            ni = len(itl)
             pct = (lambda p: lat[min(n - 1, int(p * n))] if n else 0.0)
             tpct = (lambda p: ttft[min(nt - 1, int(p * nt))] if nt
+                    else 0.0)
+            ipct = (lambda p: itl[min(ni - 1, int(p * ni))] if ni
                     else 0.0)
             out = {
                 "requests": n,
@@ -466,8 +521,11 @@ class PagedGenerationServer:
                 "p99_ms": pct(0.99) * 1e3,
                 "ttft_p50_ms": tpct(0.50) * 1e3,
                 "ttft_p99_ms": tpct(0.99) * 1e3,
+                "itl_p50_ms": ipct(0.50) * 1e3,
+                "itl_p99_ms": ipct(0.99) * 1e3,
                 "decode_steps": self._steps,
                 "prefills": self._prefills,
+                "prefill_dispatches": self._prefill_dispatches,
                 # mean busy slots per decode step: the continuous-batching
                 # analogue of the dense server's batch_fill
                 "slot_fill": (self._active_integral
@@ -496,15 +554,6 @@ class PagedGenerationServer:
                 total += max(0, self._worst[slot["seq"]] - held)
         return total
 
-    def _bucket(self, n):
-        """Power-of-two prefill bucket: one compiled prefill program per
-        bucket, so compile count stays logarithmic in max_prompt_len
-        (n <= max_prompt_len is validated at submit)."""
-        b = max(self.block_size, 8)
-        while b < n:
-            b *= 2
-        return min(b, self.max_prompt_len)
-
     def _admit_locked(self):
         """Fill idle slots from the queue while the pool can cover each
         request's worst case; runs prefill OUTSIDE the lock? No — prefill
@@ -528,8 +577,14 @@ class PagedGenerationServer:
             seq = self._seq_counter
             self._seq_counter += 1
             self._worst[seq] = worst
+            # fed: prompt tokens already written to the paged cache —
+            # a slot is in the PREFILL phase until fed == prompt length,
+            # then decodes; t_pre0/t_last anchor the per-request prefill
+            # trace span and the ITL clock
             self._slots[i] = {"seq": seq, "req": req, "toks": [],
-                              "pos": req.ids.size, "budget": req.budget}
+                              "pos": req.ids.size, "budget": req.budget,
+                              "fed": 0, "chunks": 0, "t_pre0": None,
+                              "t_last": None}
             picked.append((i, req, seq))
             _m_slot_refills.inc()
             _tracing.event("request_admitted", request_id=req.rid,
@@ -538,31 +593,132 @@ class PagedGenerationServer:
             _m_queue_depth.labels(server="paged").set(len(self._queue))
         return picked
 
-    def _prefill(self, slot_idx, req, seq):
+    def _prefill_packed(self, pre_idx):
+        """ONE packed ragged prefill dispatch: take up to
+        prefill_chunk_tokens prompt tokens across the slots still
+        feeding their prompts (head-of-line slot order), concatenate
+        the chunks into a token-packed stream (each chunk's region
+        aligned to _pack_align, the packed length bucketed to a power
+        of two), bulk-grow the chunk's block tables, and run the
+        packed_prefill program — K/V lands directly in each sequence's
+        paged blocks. Slots whose FINAL chunk is in this dispatch
+        sample their first token here (that is their TTFT)."""
         jnp = self._jnp
-        n = int(req.ids.size)
-        # the span ends when the FIRST generated token is on the host —
-        # its end timestamp IS the request's first-token time
-        with _tracing.span("prefill", request_id=req.rid,
-                           prompt_len=n, seq=seq):
-            self.cache.allocate(seq, n)
-            bucket = self._bucket(n)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :n] = req.ids
-            tables = jnp.asarray(self.cache.table_array([seq],
-                                                        self._m_width))
-            tok, kc, vc = self._decoder.prefill(
-                self._params, jnp.asarray(ids), jnp.asarray([n]), tables,
-                self.cache.k_blocks, self.cache.v_blocks,
-                self._next_key(), jnp.float32(self.temperature))
-            self.cache.swap_arrays(kc, vc)
-            tok0 = int(np.asarray(tok)[0])
-        req.ttft = time.perf_counter() - req.t_submit
-        _m_ttft.observe(req.ttft)
+        align = self._pack_align
+        budget = self.prefill_chunk_tokens
+        plan = []  # (slot_idx, start, n, packed_offset)
+        off = 0
+        for i in pre_idx:
+            if budget <= 0:
+                break
+            s = self._slots[i]
+            n = min(s["req"].ids.size - s["fed"], budget)
+            plan.append((i, s["fed"], n, off))
+            off += -(-n // align) * align
+            budget -= n
+        T = align  # power-of-two bucket: compile count is logarithmic
+        while T < off:  # in the packed budget, not per prompt length
+            T *= 2
+        # COMPACT segment rows: the dispatch carries tables only for the
+        # plan's slots (row count bucketed to a power of two), so a
+        # one-request churn round pays for one row's cache, not
+        # max_slots of them
+        P = 1
+        while P < len(plan):
+            P *= 2
+        toks = np.zeros((T,), np.int32)
+        seg = np.zeros((T,), np.int32)
+        pos = np.full((T,), -1, np.int32)  # -1 marks packing pad
+        sample_idx = np.zeros((P,), np.int32)
+        done_rows = []  # (slot_idx, compact_row)
+        for r, (i, start, n, o) in enumerate(plan):
+            s = self._slots[i]
+            toks[o:o + n] = s["req"].ids[start:start + n]
+            seg[o:o + n] = r
+            pos[o:o + n] = np.arange(start, start + n, dtype=np.int32)
+            if s["t_pre0"] is None:
+                s["t_pre0"] = time.perf_counter()
+            if start + n == s["req"].ids.size:
+                sample_idx[r] = o + n - 1
+                done_rows.append((i, r))
+        # decode-phase slots stall while this dispatch runs — the stall
+        # the chunk budget exists to bound
+        in_plan = {p[0] for p in plan}
+        decoding = any(s is not None and j not in in_plan
+                       and s["fed"] >= s["req"].ids.size
+                       for j, s in enumerate(self._slots))
+        t0 = time.perf_counter()
+        try:
+            with _tracing.span(
+                    "prefill_chunk", packed=T, segments=len(plan),
+                    tokens=int(sum(p[2] for p in plan)),
+                    request_ids=[self._slots[i]["req"].rid
+                                 for i, *_ in plan]):
+                # bulk multi-sequence allocation: the whole chunk plan's
+                # tables grow atomically (reservation-backed, so this
+                # cannot exhaust the pool mid-plan)
+                self.cache.ensure_many(
+                    [(self._slots[i]["seq"], start + n)
+                     for i, start, n, _ in plan])
+                # cap the table width at a power-of-two bucket of the
+                # plan's deepest chunk end: early chunks of long
+                # prompts attend (and the fallback gathers) only the
+                # cache they can reach, and the jit re-specializes per
+                # (T, width) pair — still logarithmically many
+                mcap = 1
+                need = max(self._blocks_for(start + n, self.block_size)
+                           for _, start, n, _ in plan)
+                while mcap < need:
+                    mcap *= 2
+                mcap = min(mcap, self._m_width)
+                tables = jnp.asarray(self.cache.table_array(
+                    [self._slots[plan[r][0]]["seq"]
+                     if r < len(plan) else None for r in range(P)],
+                    mcap))
+                tok, kc, vc = self._decoder.packed_prefill(
+                    self._params, jnp.asarray(toks), jnp.asarray(seg),
+                    jnp.asarray(pos), tables, jnp.asarray(sample_idx),
+                    self.cache.k_blocks, self.cache.v_blocks,
+                    self._next_key(), jnp.float32(self.temperature))
+                tok_h = np.asarray(tok)
+        except Exception as e:  # noqa: BLE001 — fail the chunk's requests
+            for i, *_ in plan:
+                s = self._slots[i]
+                seq, req = s["seq"], s["req"]
+                if self.cache.has_seq(seq):
+                    self.cache.free(seq)
+                self._worst.pop(seq, None)
+                self._slots[i] = None
+                req.future.set_exception(e)
+            return
+        self.cache.swap_arrays(kc, vc)
+        t_now = time.perf_counter()
+        if decoding:
+            _m_decode_stall.observe(t_now - t0)
+        _m_prefill_dispatches.inc()
         with self._lock:
-            self._prefills += 1
-            self._ttft.append(req.ttft)
-        self._slot_token(slot_idx, tok0)
+            self._prefill_dispatches += 1
+        for i, start, n, o in plan:
+            s = self._slots[i]
+            s["fed"] = start + n
+            s["chunks"] += 1
+        for i, r in done_rows:
+            s = self._slots[i]
+            req = s["req"]
+            req.ttft = t_now - req.t_submit
+            _m_ttft.observe(req.ttft)
+            # per-request prefill phase for the trace assembler: starts
+            # at the request's FIRST chunk dispatch, ends now (its end
+            # timestamp IS the request's first-token time)
+            _tracing.event("prefill", request_id=req.rid,
+                           ts=s["t_pre0"], dur=t_now - s["t_pre0"],
+                           prompt_len=int(req.ids.size), seq=s["seq"],
+                           chunks=s["chunks"])
+            with self._lock:
+                self._prefills += 1
+                self._ttft.append(req.ttft)
+            s["t_last"] = t_now
+            self._slot_token(i, int(tok_h[r]))
 
     def _slot_token(self, i, tok):
         """Record one generated token for slot i; completes the request
@@ -599,31 +755,33 @@ class PagedGenerationServer:
             with self._lock:
                 if self._stop:
                     return
-                picked = self._admit_locked()
-                if not picked and all(s is None for s in self._slots):
+                self._admit_locked()
+                if all(s is None for s in self._slots):
                     self._lock.wait(timeout=0.1)
                     continue
-            for i, req, seq in picked:
-                try:
-                    self._prefill(i, req, seq)
-                except Exception as e:  # noqa: BLE001 — fail one request
-                    if seq in self.cache._tables:
-                        self.cache.free(seq)
-                    self._worst.pop(seq, None)
-                    self._slots[i] = None
-                    req.future.set_exception(e)
+            # ---- packed/chunked prefill: at most ONE chunk dispatch
+            # per round, interleaved with the decode dispatch below, so
+            # in-flight decode never stalls longer than one chunk budget
+            pre_idx = [i for i, s in enumerate(self._slots)
+                       if s is not None
+                       and s["fed"] < s["req"].ids.size]
+            if pre_idx:
+                self._prefill_packed(pre_idx)
+            _m_slots_busy.labels(server="paged").set(
+                sum(s is not None for s in self._slots))
+            # decode phase: prompt fully fed (first token sampled)
             active_idx = [i for i, s in enumerate(self._slots)
-                          if s is not None]
-            _m_slots_busy.labels(server="paged").set(len(active_idx))
+                          if s is not None
+                          and s["fed"] >= s["req"].ids.size]
             if not active_idx:
                 continue
             k = self.steps_per_dispatch
             # grow tables for the incoming token(s) BEFORE the step
             # writes them (k tokens starting at the feed position)
-            for i in active_idx:
-                s = self._slots[i]
-                self.cache.ensure(s["seq"],
-                                  s["pos"] + len(s["toks"]) - 1 + k)
+            self.cache.ensure_many(
+                [(self._slots[i]["seq"], self._slots[i]["pos"]
+                  + len(self._slots[i]["toks"]) - 1 + k)
+                 for i in active_idx])
             tok = np.zeros((self.max_slots,), np.int32)
             pos = np.zeros((self.max_slots,), np.int32)
             act = np.zeros((self.max_slots,), bool)
@@ -665,15 +823,29 @@ class PagedGenerationServer:
                     self._slots[i] = None
                 continue
             self.cache.swap_arrays(kc, vc)
+            t_now = time.perf_counter()
             with self._lock:
                 self._steps += 1
                 self._active_integral += len(active_idx)
                 self._fill_integral += self.cache.stats()["block_fill"]
             for i in active_idx:
+                s = self._slots[i]
+                t_prev = s["t_last"] if s["t_last"] is not None else t_now
+                consumed = 0
                 for j in range(toks.shape[0]):
+                    consumed += 1
                     self._slot_token(i, int(toks[j, i]))
                     if self._slots[i] is None:  # finished mid-scan: the
                         break  # remaining scan tokens are discarded
+                if self._slots[i] is not None:
+                    self._slots[i]["t_last"] = t_now
+                # ITL: the dispatch's host-visible gap amortized over
+                # the tokens it emitted for this slot
+                per = max(t_now - t_prev, 0.0) / consumed
+                with self._lock:
+                    self._itl.extend([per] * consumed)
+                for _ in range(consumed):
+                    _m_itl.observe(per)
 
 
 def measure_offered_load(server, prompts, offered_rps, duration_s):
@@ -697,4 +869,34 @@ def measure_offered_load(server, prompts, offered_rps, duration_s):
     out = server.stats()
     out["offered_rps"] = offered_rps
     out["achieved_rps"] = i / (t_submit_end - t0)
+    return out
+
+
+def measure_poisson_load(server, prompts, offered_rps, n_requests,
+                         seed=0, timeout=600):
+    """Open-loop arrival drive: submit `n_requests` prompts (cycled from
+    the pool) at FIXED-SEED Poisson arrivals — exponential inter-arrival
+    gaps with mean 1/offered_rps — then wait for all of them. Unlike the
+    closed-loop all-upfront drain, this exercises steady-state admission
+    CHURN: requests arrive while others are mid-decode, which is where
+    prefill stalls live. Returns the server's stats() for the window
+    plus offered/achieved rates."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / max(offered_rps, 1e-9),
+                           size=int(n_requests))
+    futs = []
+    t0 = time.perf_counter()
+    arrival = 0.0
+    for i in range(int(n_requests)):
+        arrival += gaps[i]
+        now = time.perf_counter() - t0
+        if now < arrival:
+            time.sleep(arrival - now)
+        futs.append(server.submit(prompts[i % len(prompts)]))
+    t_submit_end = time.perf_counter()  # offer window ends here
+    for f in futs:
+        f.result(timeout=timeout)
+    out = server.stats()
+    out["offered_rps"] = offered_rps
+    out["achieved_rps"] = int(n_requests) / (t_submit_end - t0)
     return out

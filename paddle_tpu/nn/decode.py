@@ -10,7 +10,11 @@ transformer against the block-pool KV cache (inference/kv_cache.py):
     matching);
   * step — one token per sequence against the paged cache via
     ops.paged_decode_attention (Pallas ragged kernel on TPU, XLA gather
-    elsewhere), writing the incoming token's K/V at its cache position.
+    elsewhere), writing the incoming token's K/V at its cache position;
+  * packed_prefill — ONE dispatch over a token-packed multi-sequence
+    chunk stream (segment-causal attention against the paged cache via
+    ops.ragged_prefill_attention), the engine of the serving
+    scheduler's packed/chunked prefill.
 
 Both are pure functions of (params, inputs, cache arrays) so the cache
 arrays round-trip functionally (donated on accelerators). Masking is by
@@ -32,15 +36,15 @@ __all__ = ["BeamSearchDecoder", "dynamic_decode", "PagedDecoder"]
 
 
 @functools.lru_cache(maxsize=32)
-def _build_paged_fns(spec, block_size, return_logits):
-    """(spec, block_size) -> (prefill_fn, step_fn), raw and jittable.
-    spec = (L, H, Dh, E, eps, tied) — the tuple models/gpt2.py builds."""
+def _layer_helpers(spec):
+    """Shared GPT-2-layout building blocks (layernorm, int8-aware matmul,
+    qkv split, embed/head, sampling, residual+MLP) used by every paged
+    program builder below. spec = (L, H, Dh, E, eps, tied) — the tuple
+    models/gpt2.py builds."""
     import jax
     import jax.numpy as jnp
 
     L, H, Dh, E, eps, tied = spec
-    scale = Dh ** -0.5
-    BS = int(block_size)
 
     def ln(x, w, b):
         mu = jnp.mean(x, axis=-1, keepdims=True)
@@ -104,6 +108,26 @@ def _build_paged_fns(spec, block_size, return_logits):
             + params[f"h.{i}.fc1.bias"], approximate=True)
         return x + matw(params, f"h.{i}.fc2.weight", hdn, dt) \
             + params[f"h.{i}.fc2.bias"]
+
+    ns = type("LayerHelpers", (), {})()
+    ns.ln, ns.matw, ns.qkv_split = ln, matw, qkv_split
+    ns.make_embed_head, ns.pick, ns.block_and_mlp = \
+        make_embed_head, pick, block_and_mlp
+    return ns
+
+
+@functools.lru_cache(maxsize=32)
+def _build_paged_fns(spec, block_size, return_logits):
+    """(spec, block_size) -> (prefill_fn, step_fn), raw and jittable."""
+    import jax
+    import jax.numpy as jnp
+
+    L, H, Dh, E, eps, tied = spec
+    scale = Dh ** -0.5
+    BS = int(block_size)
+    hp = _layer_helpers(spec)
+    ln, qkv_split, make_embed_head, pick, block_and_mlp = (
+        hp.ln, hp.qkv_split, hp.make_embed_head, hp.pick, hp.block_and_mlp)
 
     def prefill_fn(params, ids, lens, tables, kc, vc, key, temp):
         """ids [B, S0] right-padded; lens [B]; tables [B, M]. Returns
@@ -176,6 +200,71 @@ def _build_paged_fns(spec, block_size, return_logits):
 
 
 @functools.lru_cache(maxsize=32)
+def _build_packed_prefill(spec, block_size, return_logits):
+    """Packed ragged prefill: ONE dispatch prefills a token-packed
+    multi-sequence chunk stream (the tentpole of the chunked-prefill
+    scheduler, inference/serving.py). Raw and jittable."""
+    import jax.numpy as jnp
+
+    L, H, Dh, E, eps, tied = spec
+    scale = Dh ** -0.5
+    BS = int(block_size)
+    hp = _layer_helpers(spec)
+
+    def packed_prefill_fn(params, toks, seg, pos, tables, sample_idx,
+                          kc, vc, key, temp):
+        """toks [T] packed token stream; seg [T] slot row per token;
+        pos [T] absolute cache position (-1 = packing pad); tables
+        [B, M]; sample_idx [B] packed index of each slot row's last
+        prompt token (host only reads rows whose prompt completed this
+        chunk). Returns (tok [B], kc, vc[, logits [B, V] f32]).
+
+        Every token attends its own sequence's cache positions [0, pos]
+        via ops.ragged_prefill_attention — which sees both this chunk's
+        freshly written K/V and earlier chunks' blocks, so a prompt
+        split across chunks needs no state beyond the paged cache."""
+        from ..ops.attention import ragged_prefill_attention
+
+        T = toks.shape[0]
+        dt = params["ln_f.weight"].dtype
+        embed, head = hp.make_embed_head(params, dt)
+        valid = pos >= 0
+        p0 = jnp.where(valid, pos, 0)
+        x = embed(toks) + params["wpe.weight"][p0]        # [T, E]
+        # pad tokens write to the trash block; their attention output is
+        # finite garbage (uniform weights over masked -inf scores) that
+        # no sample_idx ever reads
+        blk = jnp.where(valid, tables[seg, p0 // BS], 0)  # [T]
+        off = p0 % BS
+        for i in range(L):
+            a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
+                      params[f"h.{i}.ln_1.bias"])
+            q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
+            kc = kc.at[i, blk, off].set(k)
+            vc = vc.at[i, blk, off].set(v)
+            o = ragged_prefill_attention(q, kc[i], vc[i], tables, seg,
+                                         pos, scale=scale).reshape(T, E)
+            x = hp.block_and_mlp(params, i, x, o, dt)
+        xf = x[sample_idx]                                # [B, E]
+        xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
+        logits = head(xf)
+        tok = hp.pick(logits, key, temp)
+        if return_logits:
+            return tok, kc, vc, logits
+        return tok, kc, vc
+
+    return packed_prefill_fn
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_packed_prefill(spec, block_size, return_logits, donate):
+    import jax
+
+    fn = _build_packed_prefill(spec, block_size, return_logits)
+    return jax.jit(fn, donate_argnums=(6, 7) if donate else ())
+
+
+@functools.lru_cache(maxsize=32)
 def _jitted_paged_fns(spec, block_size, return_logits, donate):
     import jax
 
@@ -237,6 +326,10 @@ class PagedDecoder:
         # when off, the wrapper is one bool check
         self.prefill = _tracing.wrap("prefill_dispatch", prefill)
         self.step = _tracing.wrap("step_dispatch", step)
+        self.packed_prefill = _tracing.wrap(
+            "packed_prefill_dispatch",
+            _jitted_packed_prefill(self.spec, self.block_size,
+                                   self.return_logits, self._donate))
 
     def multistep(self, n_steps):
         """Fused n-token decode (see _jitted_multistep)."""

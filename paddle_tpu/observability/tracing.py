@@ -18,7 +18,13 @@ The serving engine emits a small vocabulary per request
 
     request_submitted    point event, request_id
     request_admitted     point event, request_id (slot picked)
-    prefill              span, request_id (ends with the FIRST token)
+    prefill_chunk        span, request_ids=[...] (ONE packed ragged
+                         prefill dispatch serving several requests'
+                         prompt chunks)
+    prefill              per-request event with explicit ts/dur: first
+                         chunk dispatch start -> final chunk done (its
+                         end IS the request's first-token time); carries
+                         `chunks`, the dispatches the prompt spanned
     decode_dispatch      span, request_ids=[...] (one batched step for
                          every active slot; k tokens when multi-step)
     request_done         point event, request_id, new_tokens, ttft_s
@@ -273,6 +279,8 @@ def assemble_request_traces(evs=None, path=None):
             r = rec(rid)
             r["t_prefill_start"] = ev["ts"]
             r["t_first_token"] = ev["ts"] + ev.get("dur", 0.0)
+            if ev.get("chunks") is not None:
+                r["prefill_chunks"] = ev["chunks"]
         elif name == "decode_dispatch":
             for rid2 in ev.get("request_ids", ()):
                 r = rec(rid2)
@@ -319,6 +327,8 @@ def assemble_request_traces(evs=None, path=None):
             "decode_dispatches": r["decode_dispatches"],
             "decode_dispatch_ms": round(r["decode_dispatch_ms"], 4),
         }
+        if "prefill_chunks" in r:  # chunked prefill (paged server)
+            out[rid]["prefill_chunks"] = r["prefill_chunks"]
     return out
 
 

@@ -162,6 +162,67 @@ def paged_decode_attention(q, k_blocks, v_blocks, block_tables, ctx_lens,
     return jnp.einsum("bhs,bhsd->bhd", w, v)
 
 
+def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
+                             scale=None):
+    """Packed ragged prefill attention over a PAGED KV cache: every token
+    of a token-packed multi-sequence stream attends its OWN sequence's
+    cache positions [0, pos] — both the K/V this chunk just wrote and
+    whatever earlier chunks of the same prompt left in the paged blocks,
+    so chunked prefill carries no extra state.
+
+    q: [T, H, Dh] — packed query stream (several prompt chunks).
+    k_blocks/v_blocks: [N, BS, H, Dh] — ONE layer's block pool.
+    block_tables: [B, M] int32 — block ids per slot row, 0-padded.
+    seg: [T] int32 — slot row (index into block_tables) of each token.
+    pos: [T] int32 — absolute cache position of each token; -1 marks a
+        packing-pad token (its output is garbage the caller discards).
+
+    Returns [T, H, Dh] in q's dtype. On TPU with aligned shapes this
+    dispatches to the Pallas kernel (ops/pallas/ragged_prefill.py),
+    which additionally requires the PACKING CONTRACT: each segment's
+    packed region starts at a multiple of Q_TILE=128, so one query tile
+    never mixes segments.
+
+    The XLA fallback gathers ONE [B, M*BS, ...] copy per slot ROW
+    (never per token — a [T, M*BS, ...] materialization measured 8x
+    slower than the sequential prefill at bench shapes), scores every
+    query against every row's cache HEAD-MAJOR (one transpose per
+    call instead of a relayout inside every batched matmul — a
+    measured 3.4x on the same shapes), and applies the row-AND-position
+    mask before a joint softmax over all rows — exactly the per-row
+    softmax, because only the query's own row has unmasked columns."""
+    T, H, Dh = q.shape
+    _, BS, _, _ = k_blocks.shape
+    B, M = block_tables.shape
+    sc = (Dh ** -0.5) if scale is None else scale
+    if _on_tpu():
+        try:
+            from .pallas.ragged_prefill import (Q_TILE,
+                                                ragged_prefill_attention_kernel,
+                                                supported_shapes)
+            if supported_shapes(Dh, BS, H, T):
+                return ragged_prefill_attention_kernel(
+                    q, k_blocks, v_blocks, block_tables,
+                    seg[::Q_TILE], pos[::Q_TILE], scale=float(sc))
+        except Exception as e:  # noqa: BLE001
+            _warn_flash_fallback(e)
+    # row-gather, head-major, joint-row softmax
+    k = k_blocks[block_tables].reshape(B, M * BS, H, Dh) \
+        .transpose(2, 0, 1, 3)                            # [H, B, C, Dh]
+    v = v_blocks[block_tables].reshape(B, M * BS, H, Dh) \
+        .transpose(2, 0, 1, 3)
+    qh = q.transpose(1, 0, 2)                             # [H, T, Dh]
+    s = jnp.einsum("htd,hbcd->htbc", qh, k).astype(jnp.float32) * sc
+    own = seg[:, None] == jnp.arange(B)[None, :]          # [T, B]
+    ok = jnp.arange(M * BS)[None, :] <= pos[:, None]      # [T, M*BS]
+    mask = own[:, :, None] & ok[:, None, :]               # [T, B, M*BS]
+    s = jnp.where(mask[None], s, -1e30)
+    w = jax.nn.softmax(
+        s.reshape(H, T, B * M * BS), axis=-1
+    ).reshape(H, T, B, M * BS).astype(q.dtype)
+    return jnp.einsum("htbc,hbcd->htd", w, v).transpose(1, 0, 2)
+
+
 @defop()
 def fused_multi_head_attention(x, qkv_weight, qkv_bias, out_weight, out_bias,
                                num_heads, attn_mask=None, dropout_p=0.0,

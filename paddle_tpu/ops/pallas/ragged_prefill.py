@@ -1,0 +1,154 @@
+"""Packed ragged prefill attention — Pallas TPU kernel (segment-causal,
+block-table driven).
+
+The serving scheduler concatenates every admitted prompt chunk this
+round into ONE token-packed stream (Ragged Paged Attention,
+arXiv:2604.15464 direction; Sarathi-style chunked prefill bounds the
+per-dispatch token budget). Each packed token attends its OWN sequence's
+paged-cache positions [0, pos] — which covers both the tokens this chunk
+just wrote and the K/V that earlier chunks of the same prompt left in
+the paged blocks, so chunked prefill needs no extra state carrier.
+
+Layout (matches inference/kv_cache.py):
+    q:        [T, H, Dh]              packed query stream
+    k_blocks: [N, BS, H, Dh]          one layer's pool
+    tables:   [B, M] int32            block ids per slot row, 0-padded
+    tile_seg: [T // QT] int32         slot row of each query tile
+    tile_pos: [T // QT] int32         absolute cache position of each
+                                      tile's first token; -1 = pad tile
+
+Packing contract: the scheduler aligns every segment's packed region to
+the QT=128 query tile, so ONE tile never mixes segments — that keeps
+the grid a plain (num_q_tiles, M) with the per-tile segment and start
+position SCALAR-PREFETCHED, the same trick the decode kernel uses: the
+k/v BlockSpec index map reads `tables[tile_seg[qi], m]`, so the
+pipeline DMAs exactly the pool blocks each tile's sequence names and
+never materializes the [T, M*BS, ...] gather copy the XLA fallback
+builds. KV blocks past a tile's causal horizon (and pad tiles) still
+occupy grid steps but are predicated off.
+
+Per (tile, kv-block) step the score tile is [H, QT, BS] from a
+head-batched dot over Dh; online-softmax state (m, l, acc) rides VMEM
+scratch across the M dimension exactly like paged_attention.py, with
+the extra QT query axis on the lanes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_TPU_PALLAS = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_TPU_PALLAS = False
+
+NEG_INF = -1e30
+Q_TILE = 128  # query-tile (and packing alignment) size
+
+
+def supported_shapes(head_dim, block_size, num_heads, total_tokens):
+    """Shape gate for the compiled TPU kernel (interpret mode takes any)."""
+    return (head_dim in (32, 64, 128, 256) and block_size % 128 == 0
+            and num_heads % 8 == 0 and total_tokens % Q_TILE == 0)
+
+
+def _kernel(tile_seg_ref, tile_pos_ref, tables_ref, q_ref, k_ref, v_ref,
+            o_ref, acc_ref, m_ref, l_ref, *, scale, nm, qt):
+    qi = pl.program_id(0)
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q0 = tile_pos_ref[qi]  # abs position of the tile's first query; -1 pad
+    bs = k_ref.shape[1]
+
+    # a kv block matters iff it starts at or before the tile's LAST
+    # query's causal horizon; pad tiles (q0 < 0) skip every block
+    @pl.when((q0 >= 0) & (mi * bs <= q0 + qt - 1))
+    def _compute():
+        q = q_ref[:]  # [H, QT, Dh] — input dtype feeds the MXU full-rate
+        k = k_ref[0]  # [BS, H, Dh]
+        v = v_ref[0]
+        # s[h, i, j] = sum_d q[h, i, d] * k[j, h, d]: batch over heads
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [H, QT, BS]
+        row = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        col = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(col <= row, s, NEG_INF)  # segment-causal by abs pos
+        m_prev = m_ref[:]                       # [H, QT]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+        p = jnp.exp(s - m_new[:, :, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=2)
+        # o[h, i, d] += sum_j p[h, i, j] * v[j, h, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # [H, QT, Dh]
+        acc_ref[:] = acc_ref[:] * alpha[:, :, None] + pv
+        m_ref[:] = m_new
+
+    @pl.when(mi == nm - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:], 1e-30)  # pad tiles flush zeros
+        o_ref[:] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "q_tile", "interpret"))
+def ragged_prefill_attention_kernel(q, k_blocks, v_blocks, tables,
+                                    tile_seg, tile_pos, *, scale=None,
+                                    q_tile=None, interpret=False):
+    """Pallas packed ragged prefill attention. See module docstring for
+    the layout and packing contract; returns [T, H, Dh] in q's dtype.
+    q_tile defaults to the production Q_TILE=128 (interpret-mode tests
+    shrink it to exercise tiny shapes)."""
+    qt = Q_TILE if q_tile is None else int(q_tile)
+    T, H, Dh = q.shape
+    _, BS, _, _ = k_blocks.shape
+    M = tables.shape[1]
+    if T % qt:
+        raise ValueError(f"packed length {T} not a multiple of the "
+                         f"query tile {qt}")
+    NQ = T // qt
+    scale = (Dh ** -0.5) if scale is None else float(scale)
+
+    qh = q.transpose(1, 0, 2)  # [H, T, Dh]: heads ride the sublane axis
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # tile_seg, tile_pos, tables steer the DMA
+        grid=(NQ, M),
+        in_specs=[
+            pl.BlockSpec((H, qt, Dh),
+                         lambda qi, m, ts, tp, tb: (0, qi, 0)),
+            pl.BlockSpec((1, BS, H, Dh),
+                         lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0, 0)),
+            pl.BlockSpec((1, BS, H, Dh),
+                         lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((H, qt, Dh),
+                               lambda qi, m, ts, tp, tb: (0, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, qt, Dh), jnp.float32),
+            pltpu.VMEM((H, qt), jnp.float32),
+            pltpu.VMEM((H, qt), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_kernel, scale=scale, nm=M, qt=qt)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((H, T, Dh), q.dtype),
+        interpret=interpret,
+    )(tile_seg.astype(jnp.int32), tile_pos.astype(jnp.int32),
+      tables.astype(jnp.int32), qh, k_blocks, v_blocks)
+    return out.transpose(1, 0, 2)

@@ -73,6 +73,38 @@ class TestBlockPool:
         with pytest.raises(KeyError):
             c.free("zzz")
 
+    def test_has_seq(self):
+        c = self._cache()
+        assert not c.has_seq("a")
+        c.allocate("a", 4)
+        assert c.has_seq("a")
+        c.free("a")
+        assert not c.has_seq("a")
+
+    def test_ensure_many_creates_and_grows_atomically(self):
+        c = self._cache()
+        c.allocate("a", 3)
+        # bulk: grow "a" to 6 (1 more block) and create "b" at 9 (3)
+        c.ensure_many([("a", 6), ("b", 9)])
+        assert c.seq_len("a") == 6 and len(c.block_table("a")) == 2
+        assert c.seq_len("b") == 9 and len(c.block_table("b")) == 3
+        assert c.free_block_count == 2
+        # shrink request is a no-op (lengths never go backwards)
+        c.ensure_many([("a", 2)])
+        assert c.seq_len("a") == 6
+
+    def test_ensure_many_exhaustion_has_no_side_effects(self):
+        c = self._cache()
+        c.allocate("a", 16)                # 4 of 7 blocks
+        with pytest.raises(BlockPoolExhausted):
+            # total demand 4 blocks ("b" 3 + "a" grow 1), only 3 free:
+            # NEITHER sequence may change
+            c.ensure_many([("b", 12), ("a", 20)])
+        assert not c.has_seq("b")
+        assert c.seq_len("a") == 16
+        assert len(c.block_table("a")) == 4
+        assert c.free_block_count == 3
+
     def test_stats_and_table_array(self):
         c = self._cache()
         c.allocate("a", 6)

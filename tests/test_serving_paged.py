@@ -140,6 +140,115 @@ class TestContinuousBatching:
         for a, b in zip(outs[1], outs[4]):
             np.testing.assert_array_equal(a, b)
 
+    def test_admission_burst_is_one_packed_prefill_dispatch(self,
+                                                            tiny_model):
+        """ISSUE 3 acceptance: an admission burst of N requests must
+        cost O(1) packed prefill dispatches, not N sequential B=1
+        dispatches — all N prompts here fit one chunk budget, so the
+        whole burst is exactly ONE dispatch."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(7)
+        prompts = [rs.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (3, 5, 4, 6)]
+        srv = PagedGenerationServer(model, max_slots=4, block_size=4,
+                                    max_prompt_len=8, max_new_tokens=3,
+                                    prefill_chunk_tokens=64)
+        futs = [srv.submit(p) for p in prompts]  # burst BEFORE start
+        srv.start()
+        try:
+            for p, f in zip(prompts, futs):
+                ref = model.generate(p[None], 3).numpy()[0]
+                np.testing.assert_array_equal(f.result(timeout=300), ref)
+            st = srv.stats()
+            assert st["prefills"] == 4
+            assert st["prefill_dispatches"] == 1
+        finally:
+            srv.stop()
+
+    def test_chunked_prefill_spans_multiple_dispatches(self, tiny_model):
+        """A prompt longer than the chunk budget must be prefilled
+        across 3+ chunk dispatches (partial K/V carried in the paged
+        cache) and still match solo generate token-for-token; a prompt
+        shorter than one chunk rides along unharmed."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(8)
+        long_p = rs.randint(1, cfg.vocab_size, (15,)).astype(np.int32)
+        short_p = rs.randint(1, cfg.vocab_size, (3,)).astype(np.int32)
+        srv = PagedGenerationServer(model, max_slots=2, block_size=4,
+                                    max_prompt_len=16, max_new_tokens=4,
+                                    prefill_chunk_tokens=5).start()
+        try:
+            futs = [srv.submit(long_p), srv.submit(short_p)]
+            for p, f in zip((long_p, short_p), futs):
+                ref = model.generate(p[None], 4).numpy()[0]
+                np.testing.assert_array_equal(f.result(timeout=300), ref)
+            st = srv.stats()
+            # 15-token prompt at a 5-token budget: >= 3 chunk dispatches
+            assert st["prefill_dispatches"] >= 3
+            assert st["prefills"] == 2
+        finally:
+            srv.stop()
+
+    def test_itl_stats_populated(self, tiny_model):
+        """stats() must carry the inter-token-latency percentiles the
+        chunk-budget knob is tuned against."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(9)
+        srv = PagedGenerationServer(model, max_slots=2, block_size=4,
+                                    max_prompt_len=8,
+                                    max_new_tokens=6).start()
+        try:
+            srv.submit(rs.randint(1, cfg.vocab_size, (4,))
+                       .astype(np.int32)).result(timeout=300)
+            st = srv.stats()
+            assert 0 < st["itl_p50_ms"] <= st["itl_p99_ms"]
+            srv.reset_stats()
+            assert srv.stats()["itl_p99_ms"] == 0.0
+        finally:
+            srv.stop()
+
+    def test_failed_prefill_cleans_up_and_serves_on(self, tiny_model,
+                                                    monkeypatch):
+        """The failed-request cleanup path (satellite: has_seq, not
+        _tables reach-in): a packed prefill dispatch that raises must
+        fail exactly the chunk's requests, return their blocks to the
+        pool, and leave the server serving later requests."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(10)
+        srv = PagedGenerationServer(model, max_slots=2, block_size=4,
+                                    max_prompt_len=8, max_new_tokens=3)
+        boom = {"armed": True}
+        real = srv._decoder.packed_prefill
+
+        def flaky(*a, **kw):
+            if boom.pop("armed", False):
+                raise RuntimeError("injected prefill failure")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(srv._decoder, "packed_prefill", flaky)
+        srv.start()
+        try:
+            bad = srv.submit(rs.randint(1, cfg.vocab_size, (5,))
+                             .astype(np.int32))
+            with pytest.raises(RuntimeError, match="injected"):
+                bad.result(timeout=300)
+            assert srv.cache.stats()["used_blocks"] == 0
+            assert not srv.cache.has_seq(0)
+            p = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+            ref = model.generate(p[None], 3).numpy()[0]
+            np.testing.assert_array_equal(
+                srv.submit(p).result(timeout=300), ref)
+        finally:
+            srv.stop()
+
     def test_concurrent_clients(self, tiny_model):
         from paddle_tpu.inference import PagedGenerationServer
 
@@ -186,24 +295,52 @@ class TestContinuousBatching:
             srv.submit([1, 2, 3])
 
 
-@pytest.mark.slow
-def test_served_bench_axis_emits_records():
-    """`bench.py served` (mixed-length traffic, padded vs paged) must
-    emit both JSON records; slow-marked so tier-1 stays fast."""
+def _run_served_bench(*args, timeout=600):
     env = dict(os.environ)
     env.update({"PADDLE_TPU_BENCH_PROBED": "1", "JAX_PLATFORMS": "cpu",
                 "PALLAS_AXON_POOL_IPS": ""})
     env.pop("XLA_FLAGS", None)
-    r = subprocess.run([sys.executable, "bench.py", "served"], env=env,
-                       capture_output=True, text=True, timeout=600,
+    r = subprocess.run([sys.executable, "bench.py", "served", *args],
+                       env=env, capture_output=True, text=True,
+                       timeout=timeout,
                        cwd=os.path.dirname(os.path.dirname(
                            os.path.abspath(__file__))))
     assert r.returncode == 0, r.stderr[-3000:]
     lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
-    assert len(lines) == 2, r.stdout
-    recs = [json.loads(ln) for ln in lines]
+    return [json.loads(ln) for ln in lines], r.stdout
+
+
+@pytest.mark.slow
+def test_served_bench_axis_emits_records():
+    """`bench.py served` (mixed-length traffic: padded vs paged
+    closed-loop, plus the open-loop Poisson axis) must emit all three
+    JSON records; slow-marked so tier-1 stays fast."""
+    recs, stdout = _run_served_bench()
+    assert len(recs) == 3, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("openloop" in rec["metric"] for rec in recs)
     for rec in recs:
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "p99_ms" in rec
+
+
+def test_served_bench_openloop_tiny_schema():
+    """Tier-1 smoke (ISSUE 3 satellite): the tiny served bench must run
+    fast and its records must carry the new schema fields — a regression
+    in the record format fails loudly here, not in a chip session."""
+    recs, stdout = _run_served_bench("--tiny", timeout=420)
+    assert len(recs) == 2, stdout
+    paged = next(r for r in recs if "openloop" not in r["metric"])
+    open_rec = next(r for r in recs if "openloop" in r["metric"])
+    for rec in (paged, open_rec):
+        assert rec["value"] > 0
+        assert rec.get("degraded") is True
+        assert "prefill_dispatches" in rec
+        assert "itl_p99_ms" in rec
+    # open-loop axis: fixed-seed Poisson arrival accounting
+    for fld in ("offered_rps", "achieved_rps", "ttft_p99_ms",
+                "itl_p50_ms", "prefills"):
+        assert fld in open_rec, open_rec
+    assert open_rec["offered_rps"] > 0
+    assert open_rec["prefill_dispatches"] >= 1
