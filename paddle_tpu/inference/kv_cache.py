@@ -23,38 +23,142 @@ The pool itself is host-side bookkeeping (allocate/ensure/free on Python
 ints); the device arrays are functional — jitted prefill/step functions
 take them as inputs and return the updated arrays, and the cache swaps
 them in via `swap_arrays`.
+
+Prefix caching (round 9): the pool is CONTENT-ADDRESSED. A full block
+holding tokens `B_i` of a sequence whose earlier blocks hash to `h_i-1`
+gets the rolling prefix hash `h_i = H(h_i-1, B_i)`; an index maps hash
+-> block id, and blocks carry REFCOUNTS (number of block tables
+containing them). A new request whose prompt prefix matches a chain of
+cached blocks is attached to them by `attach_prefix` — its block table
+simply names the cached blocks (refcount bumped), so the shared prefix
+is never prefilled again. The last PARTIAL block of a published prompt
+is indexed too (entry carries its fill), which is what makes
+conversation-continuation and identical-prompt resubmission hits
+possible; writing into a shared or index-claimed region goes through
+`prepare_write`, which COPIES the block first (copy-on-write) so the
+cached content and every other referent stay intact. Freed blocks that
+still hold indexed content are not returned to the free list — they are
+parked in an LRU *retention* list and reclaimed (index entries dropped,
+block freed) only when an allocation would otherwise exhaust the pool.
+
+Invariants (fuzz-tested in tests/test_prefix_cache.py):
+  * free list, retention list and the union of live block tables
+    PARTITION the usable pool (block 0 in none of them);
+  * `_ref[b]` equals the number of live tables containing `b`; a block
+    leaves the partition's "live" class exactly when it hits zero;
+  * an index entry (hash -> block, fill) only ever describes rows
+    `[0, fill)` of its block, and those rows are immutable while the
+    entry exists (writers CoW or drop the entry first).
 """
 from __future__ import annotations
+
+import functools
+import hashlib
+import itertools
+from collections import OrderedDict
+
+import numpy as np
 
 from ..observability import metrics as _metrics
 
 # Pool telemetry (ISSUE 2): pushed on every alloc/grow/free, one bool
-# check each while PADDLE_TPU_TELEMETRY is off. With several live
-# caches the gauges reflect the most recently mutated pool (serving
-# runs exactly one).
+# check each while PADDLE_TPU_TELEMETRY is off. Every series carries a
+# `pool` label (one per cache instance) so several live caches — the
+# serving cache plus an offline generate(), say — can no longer alias
+# each other's gauges.
+_POOL_LABEL = ("pool",)
 _m_used_blocks = _metrics.gauge(
-    "kv_pool_used_blocks", "allocated blocks (trash block excluded)")
+    "kv_pool_used_blocks", "allocated blocks (trash block excluded)",
+    labelnames=_POOL_LABEL)
 _m_free_blocks = _metrics.gauge(
-    "kv_pool_free_blocks", "blocks available for allocation")
+    "kv_pool_free_blocks", "blocks available for allocation",
+    labelnames=_POOL_LABEL)
+_m_retained_blocks = _metrics.gauge(
+    "kv_pool_retained_blocks", "freed-but-indexed blocks parked in the "
+    "prefix-cache LRU retention list (reclaimed under pool pressure)",
+    labelnames=_POOL_LABEL)
 _m_utilization = _metrics.gauge(
-    "kv_pool_utilization", "live tokens / usable pool tokens")
+    "kv_pool_utilization", "live tokens / usable pool tokens",
+    labelnames=_POOL_LABEL)
 _m_block_fill = _metrics.gauge(
     "kv_pool_block_fill", "live tokens / allocated block capacity "
-    "(1.0 = no internal fragmentation)")
+    "(1.0 = no internal fragmentation; can exceed 1.0 when prefix "
+    "blocks are shared)", labelnames=_POOL_LABEL)
 _m_sequences = _metrics.gauge(
-    "kv_pool_sequences", "sequences holding blocks")
+    "kv_pool_sequences", "sequences holding blocks",
+    labelnames=_POOL_LABEL)
 _m_alloc_failures = _metrics.counter(
     "kv_pool_alloc_failures_total",
-    "allocations refused because the pool was exhausted")
+    "allocations refused because the pool was exhausted",
+    labelnames=_POOL_LABEL)
+
+# Prefix-cache telemetry (round 9 tentpole).
+_m_prefix_lookups = _metrics.counter(
+    "kv_prefix_cache_lookups_total",
+    "attach_prefix calls (one per admitted request when caching is on)",
+    labelnames=_POOL_LABEL)
+_m_prefix_hits = _metrics.counter(
+    "kv_prefix_cache_hits_total",
+    "attach_prefix calls that matched at least one cached token",
+    labelnames=_POOL_LABEL)
+_m_prefix_hit_tokens = _metrics.counter(
+    "kv_prefix_cache_hit_tokens_total",
+    "prompt tokens served from cached blocks instead of prefill",
+    labelnames=_POOL_LABEL)
+_m_prefix_lookup_tokens = _metrics.counter(
+    "kv_prefix_cache_lookup_tokens_total",
+    "prompt tokens eligible for matching (prompt length - 1: the last "
+    "token is always recomputed to sample token 0)",
+    labelnames=_POOL_LABEL)
+_m_prefix_evictions = _metrics.counter(
+    "kv_prefix_cache_evictions_total",
+    "retained blocks reclaimed (index entries dropped) under pool "
+    "pressure", labelnames=_POOL_LABEL)
+_m_prefix_cow = _metrics.counter(
+    "kv_prefix_cache_cow_copies_total",
+    "copy-on-write block copies (a write landed in a shared or "
+    "index-claimed block)", labelnames=_POOL_LABEL)
+
+_pool_ids = itertools.count()
+
+#: parent hash of a sequence's first block (nothing hashes to 0).
+ROOT_HASH = 0
 
 
 class BlockPoolExhausted(RuntimeError):
-    """Raised when an allocation needs more free blocks than the pool has."""
+    """Raised when an allocation needs more free blocks than the pool has
+    (after reclaiming every LRU-retained prefix-cache block)."""
 
 
 def blocks_for(num_tokens: int, block_size: int) -> int:
     """Blocks needed to hold `num_tokens` tokens."""
     return max(0, -(-int(num_tokens) // int(block_size)))
+
+
+def prefix_block_hash(parent: int, tokens) -> int:
+    """Rolling content hash of one block: H(parent_hash, block_tokens).
+
+    blake2b over the 16-byte parent digest + the tokens as int64 LE —
+    deterministic, dtype-normalized, and collision-safe in a way
+    Python's randomized builtin hash() is not (a collision here would
+    serve the wrong K/V)."""
+    data = int(parent).to_bytes(16, "little") + \
+        np.ascontiguousarray(np.asarray(tokens, np.int64)).tobytes()
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=16).digest(), "little")
+
+
+@functools.lru_cache(maxsize=8)
+def _copy_block_fn(donate):
+    """Jitted whole-block device copy (the CoW kernel): one dynamic
+    slice + scatter per array, recompiled per (shape, dtype) only."""
+    import jax
+
+    def cp(kc, vc, src, dst):
+        return (kc.at[:, dst].set(kc[:, src]),
+                vc.at[:, dst].set(vc[:, src]))
+
+    return jax.jit(cp, donate_argnums=(0, 1) if donate else ())
 
 
 class PagedKVCache:
@@ -66,10 +170,13 @@ class PagedKVCache:
         smokes and short sequences.
     num_blocks: pool size INCLUDING the reserved trash block 0, so the
         usable capacity is (num_blocks - 1) * block_size tokens.
+    name: label for the `kv_pool_*` / `kv_prefix_cache_*` metric series
+        (auto-assigned "poolN" when omitted, so concurrent caches never
+        alias each other's telemetry).
     """
 
     def __init__(self, num_layers, num_heads, head_dim, *, block_size=128,
-                 num_blocks=64, dtype=None):
+                 num_blocks=64, dtype=None, name=None):
         import jax.numpy as jnp
 
         if num_blocks < 2:
@@ -80,6 +187,7 @@ class PagedKVCache:
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
+        self._name = str(name) if name else f"pool{next(_pool_ids)}"
         dt = jnp.float32 if dtype is None else dtype
         shape = (self.num_layers, self.num_blocks, self.block_size,
                  self.num_heads, self.head_dim)
@@ -89,7 +197,24 @@ class PagedKVCache:
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._tables: dict[object, list[int]] = {}
         self._lens: dict[object, int] = {}
+        # prefix-cache state: refcounts (tables containing each block),
+        # the content index hash -> (block, fill, parent), the reverse
+        # block -> entry-hashes map, candidate fills per parent hash
+        # (lookup iteration), and the LRU retention list of freed blocks
+        # that still hold indexed content.
+        self._ref: dict[int, int] = {}
+        self._index: dict[int, tuple[int, int, int]] = {}
+        self._block_entries: dict[int, set[int]] = {}
+        self._child_fills: dict[int, dict[int, int]] = {}
+        self._retained: OrderedDict[int, None] = OrderedDict()
         self._peak_blocks = 0
+        self._peak_retained = 0
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
+        self._hit_tokens = 0
+        self._lookup_tokens = 0
+        self._evictions = 0
+        self._cow_copies = 0
 
     # ---- pool bookkeeping (host-side) ---------------------------------
     @property
@@ -97,60 +222,138 @@ class PagedKVCache:
         return len(self._free)
 
     @property
+    def retained_block_count(self):
+        return len(self._retained)
+
+    @property
+    def available_block_count(self):
+        """Blocks an allocation can obtain: the free list plus the
+        LRU-retained blocks it may reclaim — the number admission
+        control should reason about."""
+        return len(self._free) + len(self._retained)
+
+    @property
     def capacity_tokens(self):
         return (self.num_blocks - 1) * self.block_size
 
+    def _get_table(self, seq_id, op):
+        try:
+            return self._tables[seq_id]
+        except KeyError:
+            raise KeyError(
+                f"unknown sequence {seq_id!r} in {op}(): not allocated "
+                f"in this cache (live sequences: {len(self._tables)})"
+            ) from None
+
     def _take_blocks(self, n):
+        """Pop `n` blocks off the free list (refcount 1 each),
+        reclaiming LRU-retained prefix blocks as needed. Callers must
+        pre-check availability when they need all-or-nothing semantics
+        (`ensure_many` does)."""
+        while len(self._free) < n and self._retained:
+            self._reclaim_lru()
         if n > len(self._free):
-            _m_alloc_failures.inc()
+            _m_alloc_failures.labels(pool=self._name).inc()
             raise BlockPoolExhausted(
                 f"need {n} blocks, only {len(self._free)} free "
                 f"(pool {self.num_blocks - 1})")
         taken = [self._free.pop() for _ in range(n)]
-        used = self.num_blocks - 1 - len(self._free)
+        for b in taken:
+            self._ref[b] = 1
+        used = self.num_blocks - 1 - len(self._free) - len(self._retained)
         self._peak_blocks = max(self._peak_blocks, used)
         return taken
+
+    def _release_block(self, b):
+        """Drop one table reference to `b`; at refcount zero the block
+        goes to the LRU retention list if the prefix index still names
+        it, else back to the free list."""
+        left = self._ref.get(b, 0) - 1
+        if left > 0:
+            self._ref[b] = left
+            return
+        self._ref.pop(b, None)
+        if self._block_entries.get(b):
+            self._retained[b] = None
+            self._retained.move_to_end(b)
+            self._peak_retained = max(self._peak_retained,
+                                      len(self._retained))
+        else:
+            self._free.append(b)
+
+    def _reclaim_lru(self):
+        """Evict the least-recently-retained block: drop its index
+        entries and return it to the free list."""
+        b, _ = self._retained.popitem(last=False)
+        for h in list(self._block_entries.get(b, ())):
+            self._drop_entry(h)
+        self._free.append(b)
+        self._evictions += 1
+        _m_prefix_evictions.labels(pool=self._name).inc()
+
+    def _register_entry(self, h, block, fill, parent):
+        self._index[h] = (block, fill, parent)
+        self._block_entries.setdefault(block, set()).add(h)
+        fills = self._child_fills.setdefault(parent, {})
+        fills[fill] = fills.get(fill, 0) + 1
+
+    def _drop_entry(self, h):
+        block, fill, parent = self._index.pop(h)
+        ents = self._block_entries.get(block)
+        if ents is not None:
+            ents.discard(h)
+            if not ents:
+                del self._block_entries[block]
+        fills = self._child_fills.get(parent)
+        if fills is not None:
+            left = fills.get(fill, 1) - 1
+            if left > 0:
+                fills[fill] = left
+            else:
+                fills.pop(fill, None)
+                if not fills:
+                    del self._child_fills[parent]
 
     def _push_gauges(self):
         if not _metrics.enabled():  # keep the hot path one branch
             return
-        used = self.num_blocks - 1 - len(self._free)
+        p = self._name
+        used = self.num_blocks - 1 - len(self._free) - len(self._retained)
         held = sum(self._lens.values())
-        _m_used_blocks.set(used)
-        _m_free_blocks.set(len(self._free))
-        _m_sequences.set(len(self._tables))
-        _m_utilization.set(held / (self.capacity_tokens or 1))
-        _m_block_fill.set(held / ((used * self.block_size) or 1))
+        _m_used_blocks.labels(pool=p).set(used)
+        _m_free_blocks.labels(pool=p).set(len(self._free))
+        _m_retained_blocks.labels(pool=p).set(len(self._retained))
+        _m_sequences.labels(pool=p).set(len(self._tables))
+        _m_utilization.labels(pool=p).set(held / (self.capacity_tokens
+                                                  or 1))
+        _m_block_fill.labels(pool=p).set(
+            held / ((used * self.block_size) or 1))
 
     def allocate(self, seq_id, num_tokens):
         """Start a new sequence holding `num_tokens` tokens; returns its
-        block table. Raises BlockPoolExhausted without side effects."""
+        block table. Raises BlockPoolExhausted without side effects.
+        (Thin wrapper over `ensure_many` — every create/grow path shares
+        its bookkeeping so the pool invariants live in one place.)"""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
-        table = self._take_blocks(blocks_for(num_tokens, self.block_size))
-        self._tables[seq_id] = table
-        self._lens[seq_id] = int(num_tokens)
-        self._push_gauges()
-        return list(table)
+        self.ensure_many([(seq_id, num_tokens)])
+        return list(self._tables[seq_id])
 
     def ensure(self, seq_id, num_tokens):
         """Grow `seq_id` so positions [0, num_tokens) have backing blocks
         (length is also advanced to num_tokens if it grew)."""
-        table = self._tables[seq_id]
-        need = blocks_for(num_tokens, self.block_size) - len(table)
-        if need > 0:
-            table.extend(self._take_blocks(need))
-        self._lens[seq_id] = max(self._lens[seq_id], int(num_tokens))
-        self._push_gauges()
-        return list(table)
+        self._get_table(seq_id, "ensure")  # descriptive unknown-seq error
+        self.ensure_many([(seq_id, num_tokens)])
+        return list(self._tables[seq_id])
 
     def ensure_many(self, updates):
         """Bulk multi-sequence allocation: atomically create-or-grow
         several sequences so each covers its requested token count.
         `updates`: iterable of (seq_id, num_tokens). Either every
         sequence ends up covered or — when the pool can't hold the
-        TOTAL demand — BlockPoolExhausted is raised with NO side
-        effects. One call serves a whole packed prefill chunk plan
+        TOTAL demand even after reclaiming every retained block —
+        BlockPoolExhausted is raised with NO side effects. One call
+        serves a whole packed prefill chunk plan
         (inference/serving.py), so a mid-plan exhaustion can never
         leave half the chunk's sequences grown."""
         updates = [(s, int(n)) for s, n in updates]
@@ -161,12 +364,12 @@ class PagedKVCache:
                 - len(self._tables.get(seq_id, ()))
             need.append(max(0, grow))
             total += max(0, grow)
-        if total > len(self._free):
-            _m_alloc_failures.inc()
+        if total > len(self._free) + len(self._retained):
+            _m_alloc_failures.labels(pool=self._name).inc()
             raise BlockPoolExhausted(
                 f"need {total} blocks across {len(updates)} sequences, "
-                f"only {len(self._free)} free "
-                f"(pool {self.num_blocks - 1})")
+                f"only {len(self._free)} free + {len(self._retained)} "
+                f"reclaimable (pool {self.num_blocks - 1})")
         for (seq_id, n), grow in zip(updates, need):
             table = self._tables.setdefault(seq_id, [])
             if grow:
@@ -177,21 +380,31 @@ class PagedKVCache:
     def append(self, seq_id, n=1):
         """Reserve room for `n` more tokens; returns the (possibly grown)
         block table."""
-        return self.ensure(seq_id, self._lens[seq_id] + int(n))
+        return self.ensure(seq_id, self.seq_len(seq_id) + int(n))
 
     def free(self, seq_id):
-        """Return a sequence's blocks to the pool; returns how many."""
-        table = self._tables.pop(seq_id)
+        """Release a sequence's blocks (refcount-aware: shared prefix
+        blocks stay live for their other referents, indexed blocks park
+        in the LRU retention list); returns how many table entries were
+        released."""
+        table = self._get_table(seq_id, "free")
+        del self._tables[seq_id]
         del self._lens[seq_id]
-        self._free.extend(reversed(table))
+        for b in reversed(table):
+            self._release_block(b)
         self._push_gauges()
         return len(table)
 
     def seq_len(self, seq_id):
-        return self._lens[seq_id]
+        try:
+            return self._lens[seq_id]
+        except KeyError:
+            raise KeyError(
+                f"unknown sequence {seq_id!r} in seq_len(): not "
+                f"allocated in this cache") from None
 
     def block_table(self, seq_id):
-        return list(self._tables[seq_id])
+        return list(self._get_table(seq_id, "block_table"))
 
     def blocks_held(self, seq_id):
         """Blocks currently backing seq_id (0 if not yet allocated)."""
@@ -202,12 +415,156 @@ class PagedKVCache:
         of the `seq in cache._tables` probe exception handlers need)."""
         return seq_id in self._tables
 
+    # ---- prefix caching (round 9) -------------------------------------
+    def attach_prefix(self, seq_id, token_ids):
+        """Content-addressed prefix attach: find the longest chain of
+        cached blocks matching `token_ids` and start `seq_id` on them by
+        copying table entries (refcount bump — no compute, no device
+        work). Returns the number of cached tokens (0 = no match, and
+        the sequence is NOT created: the caller's normal allocate path
+        applies).
+
+        At most `len(token_ids) - 1` tokens ever match: the final
+        prompt token is always left to the prefill dispatch, which
+        needs at least one real position to sample token 0 from. The
+        match may end mid-block (the index also holds the tail partial
+        block of every published prompt) — the claimed rows of that
+        block are shared, and the sequence's first write into it goes
+        through `prepare_write` (copy-on-write)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        ids = np.asarray(token_ids).reshape(-1)
+        n = int(ids.size)
+        max_match = n - 1
+        self._prefix_lookups += 1
+        self._lookup_tokens += max(0, max_match)
+        if _metrics.enabled():
+            _m_prefix_lookups.labels(pool=self._name).inc()
+            _m_prefix_lookup_tokens.labels(pool=self._name).inc(
+                max(0, max_match))
+        matched: list[int] = []
+        h = ROOT_HASH
+        pos = 0
+        while pos < max_match:
+            fills = self._child_fills.get(h)
+            if not fills:
+                break
+            avail = n - pos            # tokens we can hash from here
+            hit = None
+            for f in sorted(fills, reverse=True):  # longest match first
+                if f > avail:
+                    continue
+                hh = prefix_block_hash(h, ids[pos:pos + f])
+                ent = self._index.get(hh)
+                if ent is not None:
+                    hit = (hh, ent, f)
+                    break
+            if hit is None:
+                break
+            hh, (block, _fill, _parent), f = hit
+            use = min(f, max_match - pos)  # cap: last token never cached
+            matched.append(block)
+            pos += use
+            if f < self.block_size or use < f:
+                break                  # partial block ends the chain
+            h = hh
+        if pos == 0:
+            return 0
+        for b in matched:              # claim the chain
+            r = self._ref.get(b, 0)
+            if r == 0:                 # parked in retention: revive
+                self._retained.pop(b, None)
+            self._ref[b] = r + 1
+        self._tables[seq_id] = matched
+        self._lens[seq_id] = pos
+        self._prefix_hits += 1
+        self._hit_tokens += pos
+        if _metrics.enabled():
+            _m_prefix_hits.labels(pool=self._name).inc()
+            _m_prefix_hit_tokens.labels(pool=self._name).inc(pos)
+        self._push_gauges()
+        return pos
+
+    def publish_prefix(self, seq_id, token_ids):
+        """Index `seq_id`'s blocks under their rolling content hashes so
+        later sequences can attach them. Call AFTER the K/V for
+        `token_ids` has actually been written to the device arrays
+        (i.e. once the prompt is fully prefilled). Full blocks chain;
+        the tail partial block (if any) is indexed with its fill.
+        Hashes that already exist keep their original block (first
+        publisher wins)."""
+        table = self._get_table(seq_id, "publish_prefix")
+        ids = np.asarray(token_ids).reshape(-1)
+        n = int(ids.size)
+        if n > self._lens[seq_id]:
+            raise ValueError(
+                f"cannot publish {n} tokens for sequence {seq_id!r}: "
+                f"only {self._lens[seq_id]} are live")
+        bs = self.block_size
+        h = ROOT_HASH
+        nfull = n // bs
+        for i in range(nfull):
+            hh = prefix_block_hash(h, ids[i * bs:(i + 1) * bs])
+            if hh not in self._index:
+                self._register_entry(hh, table[i], bs, h)
+            h = hh
+        fill = n - nfull * bs
+        if fill:
+            hh = prefix_block_hash(h, ids[nfull * bs:])
+            if hh not in self._index:
+                self._register_entry(hh, table[nfull], fill, h)
+
+    def prepare_write(self, seq_id, pos):
+        """Make the block holding position `pos` exclusively writable
+        for `seq_id` before a dispatch writes K/V there. No-op for
+        fresh blocks. If the block is shared (refcount > 1) or the
+        prefix index claims rows at/after `pos`, the block is COPIED
+        on the device and the table entry swapped (copy-on-write) —
+        every other referent and the index keep the original. When the
+        pool has no spare block and the sequence is the sole referent,
+        the blocking index entries are dropped instead and the write
+        proceeds in place (no copy needed). Returns True iff a CoW
+        copy happened."""
+        import jax
+        import jax.numpy as jnp
+
+        table = self._get_table(seq_id, "prepare_write")
+        bi = int(pos) // self.block_size
+        if bi >= len(table):
+            return False               # growth region: nothing cached
+        block = table[bi]
+        row = int(pos) % self.block_size
+        shared = self._ref.get(block, 0) > 1
+        blocking = [h for h in self._block_entries.get(block, ())
+                    if self._index[h][1] > row]
+        if not shared and not blocking:
+            return False               # exclusive + unclaimed rows
+        if self.available_block_count >= 1:
+            new = self._take_blocks(1)[0]
+            fn = _copy_block_fn(jax.default_backend() not in ("cpu",))
+            self.k_blocks, self.v_blocks = fn(
+                self.k_blocks, self.v_blocks, jnp.int32(block),
+                jnp.int32(new))
+            table[bi] = new
+            self._release_block(block)
+            self._cow_copies += 1
+            _m_prefix_cow.labels(pool=self._name).inc()
+            self._push_gauges()
+            return True
+        if shared:
+            _m_alloc_failures.labels(pool=self._name).inc()
+            raise BlockPoolExhausted(
+                f"copy-on-write for sequence {seq_id!r} at position "
+                f"{pos} needs 1 block, pool exhausted "
+                f"(pool {self.num_blocks - 1})")
+        for h in blocking:             # sole referent: cede the cache
+            self._drop_entry(h)        # entries, write in place
+        return False
+
     def table_array(self, seq_ids, width=None):
         """Dense int32 [len(seq_ids), width] block-table matrix for the
         jitted step; unused entries point at trash block 0. A seq_id of
         None yields an all-trash row (an idle server slot)."""
-        import numpy as np
-
         rows = [self._tables.get(s, []) if s is not None else []
                 for s in seq_ids]
         if width is None:
@@ -227,19 +584,37 @@ class PagedKVCache:
         self.v_blocks = v_blocks
 
     def stats(self):
-        used = self.num_blocks - 1 - len(self._free)
+        used = self.num_blocks - 1 - len(self._free) - len(self._retained)
         held = sum(self._lens.values())
         return {
             "block_size": self.block_size,
             "num_blocks": self.num_blocks - 1,  # usable (trash excluded)
             "used_blocks": used,
             "free_blocks": len(self._free),
+            "retained_blocks": len(self._retained),
+            "peak_retained_blocks": self._peak_retained,
             "peak_used_blocks": self._peak_blocks,
             "sequences": len(self._tables),
             "held_tokens": held,
             # fraction of usable pool tokens occupied by live tokens
+            # (per-sequence lengths: shared prefix blocks count once
+            # per referent, so >1.0 is possible under heavy sharing)
             "utilization": held / (self.capacity_tokens or 1),
             # live tokens per allocated slot (internal fragmentation:
-            # 1.0 = every allocated block byte holds a real token)
+            # 1.0 = every allocated block byte holds a real token;
+            # sharing can push it above 1.0)
             "block_fill": held / ((used * self.block_size) or 1),
+            "prefix_cache": {
+                "index_entries": len(self._index),
+                "lookups": self._prefix_lookups,
+                "hits": self._prefix_hits,
+                "hit_tokens": self._hit_tokens,
+                "lookup_tokens": self._lookup_tokens,
+                # matched fraction of matchable prompt tokens (the
+                # last token of every prompt is never matchable)
+                "hit_rate": self._hit_tokens / (self._lookup_tokens
+                                                or 1),
+                "evictions": self._evictions,
+                "cow_copies": self._cow_copies,
+            },
         }

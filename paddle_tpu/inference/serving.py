@@ -346,13 +346,30 @@ class PagedGenerationServer:
     granularity: EOS/budget is only observed every k tokens, so up to
     k-1 tokens per request are decoded and discarded, and slot refill
     waits for the scan to return. k=1 is exact continuous batching.
+
+    enable_prefix_cache=True turns on block-level PREFIX CACHING
+    (round 9): on admission the request's prompt is matched against
+    the pool's content index (`PagedKVCache.attach_prefix`) and the
+    longest cached block chain is attached by table-entry copy — those
+    tokens are marked already-fed and the packed ragged prefill starts
+    at the first uncached token (the PR 3 chunk path already resumes
+    mid-sequence, so no engine change is needed). A fully cached
+    prompt prefills exactly ONE token: the last prompt token is always
+    recomputed to sample token 0. Completed prompts are published back
+    to the index; freed blocks with indexed content park in the
+    cache's LRU retention list and are reclaimed only under pool
+    pressure. Admission reserves one extra block per request for the
+    (at most one) copy-on-write a mid-block shared tail can force.
+    Default OFF: a disabled server takes the exact pre-cache
+    allocation path (no lookups, no publishes, no spare block).
     """
 
     def __init__(self, model, *, max_slots=4, block_size=16,
                  max_prompt_len=None, max_new_tokens=32, num_blocks=None,
                  eos_token_id=None, temperature=0.0, seed=0,
                  weight_quant=None, steps_per_dispatch=1,
-                 prefill_chunk_tokens=512, pack_align=None):
+                 prefill_chunk_tokens=512, pack_align=None,
+                 enable_prefix_cache=False):
         import jax
         import jax.numpy as jnp
 
@@ -389,10 +406,14 @@ class PagedGenerationServer:
                              "(supported: 'int8')")
         self._params = params
         dt = params["ln_f.weight"].dtype
+        self.enable_prefix_cache = bool(enable_prefix_cache)
         self._m_width = blocks_for(
             self.max_prompt_len + self.max_new + slack, self.block_size)
         if num_blocks is None:  # worst case: every slot at full horizon
-            num_blocks = self.max_slots * self._m_width + 1
+            # (+1 CoW spare per slot when prefix caching is on, so the
+            # default pool still fits max_slots worst-case requests)
+            spare = 1 if self.enable_prefix_cache else 0
+            num_blocks = self.max_slots * (self._m_width + spare) + 1
         self.cache = PagedKVCache(
             cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, block_size=self.block_size,
@@ -566,29 +587,40 @@ class PagedGenerationServer:
             req = self._queue[0]
             # worst case includes the multi-step overrun slack: a scan may
             # write up to steps_per_dispatch-1 discarded tokens past the
-            # budget before the host sees the EOS
+            # budget before the host sees the EOS — plus one spare block
+            # for the (at most one) copy-on-write a prefix-cache attach
+            # ending mid-block can force
             worst = self._blocks_for(
                 req.ids.size + req.budget + self.steps_per_dispatch - 1,
-                self.block_size)
-            if self.cache.free_block_count - self._outstanding_blocks() \
-                    < worst:
+                self.block_size) + (1 if self.enable_prefix_cache else 0)
+            # available counts LRU-retained prefix blocks: alloc paths
+            # reclaim them before raising, so they back reservations
+            if self.cache.available_block_count \
+                    - self._outstanding_blocks() < worst:
                 break  # head-of-line: keep arrival order under pressure
             self._queue.pop(0)
             seq = self._seq_counter
             self._seq_counter += 1
             self._worst[seq] = worst
+            # prefix caching: attach the longest cached block chain and
+            # mark those tokens already-fed — the packed prefill below
+            # starts at the first uncached token
+            cached = 0
+            if self.enable_prefix_cache:
+                cached = self.cache.attach_prefix(seq, req.ids)
             # fed: prompt tokens already written to the paged cache —
             # a slot is in the PREFILL phase until fed == prompt length,
             # then decodes; t_pre0/t_last anchor the per-request prefill
             # trace span and the ITL clock
             self._slots[i] = {"seq": seq, "req": req, "toks": [],
                               "pos": req.ids.size, "budget": req.budget,
-                              "fed": 0, "chunks": 0, "t_pre0": None,
+                              "fed": cached, "cached": cached,
+                              "chunks": 0, "t_pre0": None,
                               "t_last": None}
             picked.append((i, req, seq))
             _m_slot_refills.inc()
             _tracing.event("request_admitted", request_id=req.rid,
-                           slot=i, seq=seq)
+                           slot=i, seq=seq, cached_tokens=cached)
         if picked:
             _m_queue_depth.labels(server="paged").set(len(self._queue))
         return picked
@@ -660,6 +692,13 @@ class PagedGenerationServer:
                 self.cache.ensure_many(
                     [(self._slots[i]["seq"], start + n)
                      for i, start, n, _ in plan])
+                if self.enable_prefix_cache:
+                    # copy-on-write guard: a chunk starting mid-block in
+                    # an attached (shared or index-claimed) block gets a
+                    # private copy before the dispatch writes into it
+                    for i, start, _n, _o in plan:
+                        self.cache.prepare_write(
+                            self._slots[i]["seq"], start)
                 # cap the table width at a power-of-two bucket of the
                 # plan's deepest chunk end: early chunks of long
                 # prompts attend (and the fallback gathers) only the
@@ -707,13 +746,18 @@ class PagedGenerationServer:
             req = s["req"]
             req.ttft = t_now - req.t_submit
             _m_ttft.observe(req.ttft)
+            if self.enable_prefix_cache:
+                # every prompt K/V position is now written: index the
+                # blocks so later requests can attach this prefix
+                self.cache.publish_prefix(s["seq"], req.ids)
             # per-request prefill phase for the trace assembler: starts
             # at the request's FIRST chunk dispatch, ends now (its end
             # timestamp IS the request's first-token time)
             _tracing.event("prefill", request_id=req.rid,
                            ts=s["t_pre0"], dur=t_now - s["t_pre0"],
                            prompt_len=int(req.ids.size), seq=s["seq"],
-                           chunks=s["chunks"])
+                           chunks=s["chunks"],
+                           cached_tokens=s["cached"])
             with self._lock:
                 self._prefills += 1
                 self._ttft.append(req.ttft)
@@ -873,17 +917,20 @@ def measure_offered_load(server, prompts, offered_rps, duration_s):
 
 
 def measure_poisson_load(server, prompts, offered_rps, n_requests,
-                         seed=0, timeout=600):
+                         seed=0, timeout=600, max_new_tokens=None):
     """Open-loop arrival drive: submit `n_requests` prompts (cycled from
     the pool) at FIXED-SEED Poisson arrivals — exponential inter-arrival
     gaps with mean 1/offered_rps — then wait for all of them. Unlike the
     closed-loop all-upfront drain, this exercises steady-state admission
     CHURN: requests arrive while others are mid-decode, which is where
     prefill stalls live. Returns the server's stats() for the window
-    plus offered/achieved rates."""
+    plus offered/achieved rates. max_new_tokens caps each request's
+    budget (the shared-prefix TTFT axis keeps decode short)."""
     rng = np.random.RandomState(seed)
     gaps = rng.exponential(1.0 / max(offered_rps, 1e-9),
                            size=int(n_requests))
+    kw = {} if max_new_tokens is None \
+        else {"max_new_tokens": int(max_new_tokens)}
     futs = []
     t0 = time.perf_counter()
     arrival = 0.0
@@ -892,7 +939,7 @@ def measure_poisson_load(server, prompts, offered_rps, n_requests,
         now = time.perf_counter() - t0
         if now < arrival:
             time.sleep(arrival - now)
-        futs.append(server.submit(prompts[i % len(prompts)]))
+        futs.append(server.submit(prompts[i % len(prompts)], **kw))
     t_submit_end = time.perf_counter()  # offer window ends here
     for f in futs:
         f.result(timeout=timeout)

@@ -311,10 +311,13 @@ class GPT2(nn.Layer):
         bs = int(block_size)
         m_width = blocks_for(max(S0, int(lens.max()) + max_new), bs)
         total_blocks = sum(blocks_for(int(n) + max_new, bs) for n in lens)
+        # fixed pool label: offline generate() builds a transient cache
+        # per call — an auto-assigned name would mint a new metric
+        # series every call under telemetry
         cache = PagedKVCache(self.cfg.num_layers, self.cfg.num_heads,
                              self.cfg.hidden_size // self.cfg.num_heads,
                              block_size=bs, num_blocks=total_blocks + 1,
-                             dtype=dt)
+                             dtype=dt, name="gpt2-generate")
         for b in range(B):  # offline batch: reserve the full horizon
             cache.allocate(b, int(lens[b]) + max_new)
         tables = jnp.asarray(cache.table_array(range(B), m_width))

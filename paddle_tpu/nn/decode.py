@@ -14,7 +14,14 @@ transformer against the block-pool KV cache (inference/kv_cache.py):
   * packed_prefill — ONE dispatch over a token-packed multi-sequence
     chunk stream (segment-causal attention against the paged cache via
     ops.ragged_prefill_attention), the engine of the serving
-    scheduler's packed/chunked prefill.
+    scheduler's packed/chunked prefill. The chunk contract is
+    position-based, not history-based: a chunk's tokens attend
+    whatever K/V the block tables reach at positions <= pos,
+    regardless of WHO wrote it — an earlier chunk of the same prompt
+    (PR 3 chunking) or a cached prefix another sequence prefilled and
+    `PagedKVCache.attach_prefix` re-attached (round 9 prefix caching).
+    Prefix-cache resume therefore needs no engine change: the server
+    just starts the packed stream at the first uncached token.
 
 Both are pure functions of (params, inputs, cache arrays) so the cache
 arrays round-trip functionally (donated on accelerators). Masking is by
@@ -222,7 +229,10 @@ def _build_packed_prefill(spec, block_size, return_logits):
         Every token attends its own sequence's cache positions [0, pos]
         via ops.ragged_prefill_attention — which sees both this chunk's
         freshly written K/V and earlier chunks' blocks, so a prompt
-        split across chunks needs no state beyond the paged cache."""
+        split across chunks needs no state beyond the paged cache.
+        Blocks a prefix-cache attach copied into the table read
+        identically: a chunk starting at the first uncached token
+        resumes on top of K/V another sequence prefilled."""
         from ..ops.attention import ragged_prefill_attention
 
         T = toks.shape[0]

@@ -233,9 +233,28 @@ class TestServingTrace:
         done = {s["labels"]["server"]: s["value"]
                 for s in snap["serving_requests_total"]["series"]}
         assert done.get("paged") == 4
-        assert snap["kv_pool_used_blocks"]["series"][0]["value"] == 0
+        pool_series = snap["kv_pool_used_blocks"]["series"]
+        assert all(s["value"] == 0 for s in pool_series)  # drained
+        assert all("pool" in s["labels"] for s in pool_series)
         refills = snap["serving_slot_refills_total"]["series"][0]["value"]
         assert refills == 4  # every admission fills an idle slot
+
+    def test_kv_pool_gauges_do_not_alias_across_caches(self,
+                                                       telemetry_on):
+        """Satellite (round 9): two live caches must land on DISTINCT
+        `pool`-labeled series — the pre-label behavior silently showed
+        whichever pool mutated last."""
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+
+        c1 = PagedKVCache(1, 1, 2, block_size=4, num_blocks=4)
+        c2 = PagedKVCache(1, 1, 2, block_size=4, num_blocks=8)
+        c1.allocate("a", 4)
+        c2.allocate("b", 20)
+        assert c1._name != c2._name
+        by = {s["labels"]["pool"]: s["value"]
+              for s in M.snapshot()["kv_pool_used_blocks"]["series"]}
+        assert by[c1._name] == 1.0
+        assert by[c2._name] == 5.0
 
     def test_reset_stats_clears_ttft(self, tiny_model, telemetry_on):
         from paddle_tpu.inference import PagedGenerationServer

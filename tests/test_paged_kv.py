@@ -81,6 +81,36 @@ class TestBlockPool:
         c.free("a")
         assert not c.has_seq("a")
 
+    def test_unknown_seq_errors_are_descriptive(self):
+        """Satellite: free/seq_len/block_table/ensure on an unknown
+        sequence must raise a KeyError NAMING the sequence, not a bare
+        KeyError from the internal dict."""
+        c = self._cache()
+        c.allocate("a", 4)
+        for fn in (c.free, c.seq_len, c.block_table,
+                   lambda s: c.ensure(s, 8), lambda s: c.append(s)):
+            with pytest.raises(KeyError, match="unknown sequence 'ghost'"):
+                fn("ghost")
+        # and the failed probes left the pool untouched
+        assert c.has_seq("a") and c.free_block_count == 6
+
+    def test_allocate_and_ensure_share_ensure_many_bookkeeping(self):
+        """Satellite: the grow paths are collapsed onto ensure_many —
+        allocate/ensure get its atomicity (reclaim-aware precheck, no
+        side effects on failure) and identical accounting."""
+        c = self._cache()
+        t = c.allocate("a", 9)
+        assert t == c.block_table("a") and len(t) == 3
+        assert c.ensure("a", 10) == t          # same block, no growth
+        assert c.seq_len("a") == 10
+        with pytest.raises(BlockPoolExhausted, match="reclaimable"):
+            c.allocate("b", 999)               # same error surface
+        assert not c.has_seq("b")
+        with pytest.raises(BlockPoolExhausted, match="reclaimable"):
+            c.ensure("a", 999)
+        assert c.seq_len("a") == 10            # unchanged on failure
+        assert len(c.block_table("a")) == 3
+
     def test_ensure_many_creates_and_grows_atomically(self):
         c = self._cache()
         c.allocate("a", 3)

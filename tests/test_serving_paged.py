@@ -313,27 +313,32 @@ def _run_served_bench(*args, timeout=600):
 @pytest.mark.slow
 def test_served_bench_axis_emits_records():
     """`bench.py served` (mixed-length traffic: padded vs paged
-    closed-loop, plus the open-loop Poisson axis) must emit all three
-    JSON records; slow-marked so tier-1 stays fast."""
+    closed-loop, the open-loop Poisson axis, and the shared-prefix
+    caching axis) must emit all four JSON records; slow-marked so
+    tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 3, stdout
+    assert len(recs) == 4, stdout
     assert any("paged" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
+    assert any("sharedprefix" in rec["metric"] for rec in recs)
     for rec in recs:
         assert rec["value"] > 0
         assert rec.get("degraded") is True
-        assert "p99_ms" in rec
+        assert "p99_ms" in rec or "sharedprefix" in rec["metric"]
 
 
 def test_served_bench_openloop_tiny_schema():
-    """Tier-1 smoke (ISSUE 3 satellite): the tiny served bench must run
-    fast and its records must carry the new schema fields — a regression
-    in the record format fails loudly here, not in a chip session."""
+    """Tier-1 smoke (ISSUE 3 + round-9 satellites): the tiny served
+    bench must run fast and its records must carry the schema fields —
+    a regression in the record format (including the shared-prefix
+    cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=420)
-    assert len(recs) == 2, stdout
-    paged = next(r for r in recs if "openloop" not in r["metric"])
+    assert len(recs) == 3, stdout
+    paged = next(r for r in recs if "openloop" not in r["metric"]
+                 and "sharedprefix" not in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
-    for rec in (paged, open_rec):
+    sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
+    for rec in (paged, open_rec, sp_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -344,3 +349,14 @@ def test_served_bench_openloop_tiny_schema():
         assert fld in open_rec, open_rec
     assert open_rec["offered_rps"] > 0
     assert open_rec["prefill_dispatches"] >= 1
+    # shared-prefix axis: cache-on/off TTFT comparison + pool stats
+    for fld in ("ttft_p50_ms_uncached", "ttft_p99_ms",
+                "ttft_p99_ms_uncached", "tokens_per_sec",
+                "tokens_per_sec_uncached", "prefix_hit_rate",
+                "prefix_hit_tokens", "prefix_lookup_tokens",
+                "prefix_evictions", "prefix_cow_copies",
+                "retained_blocks", "peak_retained_blocks",
+                "shared_prefix_len", "offered_rps", "vs_baseline"):
+        assert fld in sp_rec, sp_rec
+    assert sp_rec["prefix_hit_tokens"] > 0  # the warm prefix must hit
+    assert 0 < sp_rec["prefix_hit_rate"] <= 1.0
