@@ -581,6 +581,34 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     rec_tel = None
     try:
         st_paged = drain(psrv)
+
+        # (b2) mixed-sampling axis (round 10): the SAME prompt pool,
+        # 50% greedy / 50% sampled (varied top-p, fixed per-request
+        # seeds), closed-loop drain on the same warm server — the
+        # tok/s delta vs the all-greedy pass (b) is the vectorized
+        # sampling pipeline's per-step overhead (every decode dispatch
+        # leaves the argmax fast path once one sampled slot is
+        # resident).
+        from paddle_tpu.sampling import SamplingParams
+
+        def mix_sp(i):
+            if i % 2 == 0:
+                return None  # greedy
+            return SamplingParams(temperature=0.8,
+                                  top_p=(0.7, 0.85, 0.95)[(i // 2) % 3],
+                                  seed=1000 + i)
+
+        def drain_mixed(server):
+            for f in [server.submit(p, sampling=mix_sp(i))  # warm pass:
+                      for i, p in enumerate(prompts)]:  # compiles the
+                f.result(timeout=900)                  # sampled variants
+            server.reset_stats()
+            for f in [server.submit(p, sampling=mix_sp(i))
+                      for i, p in enumerate(prompts)]:
+                f.result(timeout=900)
+            return server.stats()
+
+        st_mix = drain_mixed(psrv)
         if telemetry and not tiny:
             rec_tel = _served_telemetry_pass(psrv, prompts, on_tpu)
         # (c) open-loop Poisson churn on the same warm server, offered
@@ -697,6 +725,26 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         "itl_p99_ms_unchunked": round(st_unchunked["itl_p99_ms"], 2),
         "ttft_p99_ms_unchunked": round(st_unchunked["ttft_p99_ms"], 1),
     }
+    rec_mix = {
+        "metric": f"{base}_mixedsampling_paged_tokens_per_sec{suffix}",
+        "value": round(st_mix["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        # <1 = the sampling pipeline costs that fraction of all-greedy
+        # throughput at 50% sampled traffic
+        "vs_baseline": round(st_mix["tokens_per_sec"]
+                             / max(st_paged["tokens_per_sec"], 1e-9), 3),
+        "baseline": "same prompts all-greedy on the same warm server",
+        "sampling_overhead_pct": round(
+            (st_paged["tokens_per_sec"]
+             / max(st_mix["tokens_per_sec"], 1e-9) - 1) * 100, 2),
+        "sampled_fraction": 0.5,
+        "p99_ms": round(st_mix["p99_ms"], 1),
+        "itl_p99_ms": round(st_mix["itl_p99_ms"], 2),
+        "prefill_dispatches": st_mix["prefill_dispatches"],
+        "sampled_dispatches": st_mix["sampling_sampled_dispatches"],
+        "fast_path_dispatches": st_mix["sampling_fast_path_dispatches"],
+        "stop_reasons": st_mix["stop_reasons"],
+    }
     sp_lookup = max(pc1["lookup_tokens"] - pc0["lookup_tokens"], 1)
     rec_sp = {
         "metric": f"{base}_sharedprefix_cached_ttft_p50_ms{suffix}",
@@ -741,11 +789,11 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
             / max(st_pad["tokens_per_sec"], 1e-9), 3)
         rec_paged["baseline"] = \
             "padded static-batch GenerationServer, same traffic"
-        records = [rec_pad, rec_paged, rec_open, rec_sp]
+        records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp]
     else:
         rec_paged["vs_baseline"] = 1.0
         rec_paged["baseline"] = "self (tiny schema smoke)"
-        records = [rec_paged, rec_open, rec_sp]
+        records = [rec_paged, rec_mix, rec_open, rec_sp]
     if rec_tel is not None:
         records.append(rec_tel)
     if not on_tpu:
@@ -760,6 +808,13 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
               f"{st_paged['tokens_per_sec']:,.0f} tok/s "
               f"p99 {st_paged['p99_ms']:.0f}ms "
               f"({rec_paged['vs_baseline']:.2f}x)", file=sys.stderr)
+    print(f"# served mixed-sampling(50% greedy/50% sampled): "
+          f"{st_mix['tokens_per_sec']:,.0f} tok/s vs "
+          f"{st_paged['tokens_per_sec']:,.0f} all-greedy "
+          f"({rec_mix['sampling_overhead_pct']:+.1f}% overhead), "
+          f"{rec_mix['sampled_dispatches']} sampled / "
+          f"{rec_mix['fast_path_dispatches']} fast-path dispatches",
+          file=sys.stderr)
     print(f"# served open-loop: {st_open['offered_rps']:.2f} rps offered "
           f"({st_open['achieved_rps']:.2f} achieved), "
           f"{st_open['tokens_per_sec']:,.0f} tok/s, "

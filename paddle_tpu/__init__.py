@@ -53,6 +53,7 @@ from . import hapi  # noqa: E402,F401
 from .hapi.model import Model  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from . import observability  # noqa: E402,F401
+from . import sampling  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import parallel  # noqa: E402,F401
 from . import text  # noqa: E402,F401

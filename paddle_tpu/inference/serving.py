@@ -34,6 +34,7 @@ import numpy as np
 
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..sampling import SamplingParams
 
 # Shared serving telemetry (ISSUE 2): near-zero cost while
 # PADDLE_TPU_TELEMETRY is off — every update is one bool check.
@@ -70,8 +71,23 @@ _m_decode_stall = _metrics.histogram(
     "serving_decode_stall_seconds",
     "time in-flight decode slots stalled while a packed prefill chunk "
     "dispatch ran (bounded by the chunk token budget)")
+_m_stop_reason = _metrics.counter(
+    "serving_stop_reason_total",
+    "finished requests by why generation stopped "
+    "(eos | stop_token | stop_string | budget)",
+    labelnames=("server", "reason"))
+_m_sampling_fast = _metrics.counter(
+    "serving_sampling_fast_path_dispatches_total",
+    "decode dispatches that took the all-greedy fast path (no resident "
+    "request samples: bare argmax, no sort/PRNG cost)")
+_m_sampling_sampled = _metrics.counter(
+    "serving_sampling_sampled_dispatches_total",
+    "decode dispatches through the full vectorized sampling pipeline "
+    "(>= 1 resident sampled request)")
 
 _req_ids = itertools.count()
+
+STOP_REASONS = ("eos", "stop_token", "stop_string", "budget")
 
 
 @dataclass
@@ -82,6 +98,8 @@ class _Req:
     padded: bool = False
     rid: str = ""
     ttft: float | None = None
+    sampling: SamplingParams | None = None
+    seed: int = 0
 
 
 class GenerationServer:
@@ -137,21 +155,71 @@ class GenerationServer:
         self._batches = 0
         self._batches_at_reset = 0
         self._rows = 0
+        self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
         self._t0 = None
 
+    def _req_sig(self, sampling):
+        """Program-level parameter signature a batch must share: the
+        dense decode program takes ONE (temperature, top_p, seed, eos)
+        per dispatch, so the batcher only groups requests whose
+        signatures match. None = server defaults (rolling batch seed).
+        Returns (temp, top_p, seed|None, eos, from_stop_ids)."""
+        seed0, temp0, eos0, top_p0, _ = self._defaults
+        if sampling is None:
+            return (float(temp0), float(top_p0), None, int(eos0), False)
+        s = sampling
+        # the dense program has no per-slot param buffers: fields that
+        # need them are rejected EAGERLY, naming the field (the paged
+        # server supports all of them)
+        for field_name, bad in (
+                ("top_k", s.top_k != 0),
+                ("min_p", s.min_p != 0.0),
+                ("repetition_penalty", s.repetition_penalty != 1.0),
+                ("presence_penalty", s.presence_penalty != 0.0),
+                ("frequency_penalty", s.frequency_penalty != 0.0),
+                ("stop_strings", bool(s.stop_strings)),
+                ("max_new_tokens", s.max_new_tokens is not None)):
+            if bad:
+                raise ValueError(
+                    f"GenerationServer (dense) does not support "
+                    f"SamplingParams.{field_name}="
+                    f"{getattr(s, field_name)!r}; use "
+                    f"PagedGenerationServer")
+        if len(s.stop_token_ids) > 1:
+            raise ValueError(
+                "GenerationServer (dense) supports at most one stop "
+                f"token id (the program's eos), got "
+                f"{s.stop_token_ids!r}; use PagedGenerationServer")
+        eos = (int(s.stop_token_ids[0]) if s.stop_token_ids
+               else int(eos0))
+        return (s.temperature, s.top_p, s.seed, eos,
+                bool(s.stop_token_ids))
+
     # ---- client API ----------------------------------------------------
-    def submit(self, ids):
+    def submit(self, ids, sampling=None):
         """Enqueue one prompt (list/array of ints, length <= prompt_len).
-        Returns a Future resolving to the [prompt_len + new] int32 row."""
+        Returns a Future resolving to the [prompt_len + new] int32 row.
+
+        sampling: optional SamplingParams. The dense program runs one
+        (temperature, top_p, seed, eos) per dispatch, so requests are
+        batched with same-signature peers; per-slot fields (top_k,
+        min_p, penalties, stop strings, per-request budgets) raise
+        eagerly — the paged server supports them."""
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingParams):
+            raise TypeError(f"sampling must be a SamplingParams, "
+                            f"got {type(sampling).__name__}")
         ids = np.asarray(ids, np.int32).reshape(-1)
         if ids.size == 0 or ids.size > self.prompt_len:
             raise ValueError(
                 f"prompt length {ids.size} not in [1, {self.prompt_len}]")
+        sig = self._req_sig(sampling)  # eager validation
         row = np.full((self.prompt_len,), self.pad_token_id, np.int32)
         row[self.prompt_len - ids.size:] = ids  # LEFT padding
         req = _Req(ids=row, future=Future(), t_submit=time.perf_counter(),
                    padded=ids.size < self.prompt_len,
-                   rid=f"d{next(_req_ids)}")
+                   rid=f"d{next(_req_ids)}", sampling=sampling)
+        req.sig = sig
         with self._lock:
             if self._stop:
                 raise RuntimeError("server stopped")
@@ -193,11 +261,15 @@ class GenerationServer:
             self._tokens_out = 0
             self._rows = 0
             self._batches_at_reset = self._batches
+            self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
             self._t0 = time.perf_counter()
 
     def stats(self):
         """Throughput and latency of the current measurement WINDOW —
-        everything since start() or the last reset_stats() call."""
+        everything since start() or the last reset_stats() call.
+        `stop_reasons` carries the same four-key breakdown as the paged
+        server's stats (the dense program only ever produces eos /
+        stop_token / budget — stop_string stays 0)."""
         with self._lock:
             lat = sorted(self._lat)
             dt = (time.perf_counter() - self._t0) if self._t0 else 0.0
@@ -213,13 +285,17 @@ class GenerationServer:
                 "p50_ms": pct(0.50) * 1e3,
                 "p90_ms": pct(0.90) * 1e3,
                 "p99_ms": pct(0.99) * 1e3,
+                "stop_reasons": dict(self._stop_reasons),
                 "wall_s": dt,
             }
 
     # ---- batcher loop --------------------------------------------------
     def _take_batch(self):
         """Block for the first request, then gather until full batch or
-        the max_wait deadline. Returns [] on stop."""
+        the max_wait deadline; only requests sharing the head-of-line
+        request's program signature (temperature/top_p/seed/eos) join —
+        mismatched requests keep their queue order for a later batch.
+        Returns [] on stop."""
         with self._lock:
             while not self._queue and not self._stop:
                 self._lock.wait(timeout=0.1)
@@ -231,8 +307,15 @@ class GenerationServer:
                 if remaining <= 0:
                     break
                 self._lock.wait(timeout=remaining)
-            batch = self._queue[:self.batch_size]
-            del self._queue[:len(batch)]
+            sig = self._queue[0].sig
+            batch = []
+            for r in self._queue:
+                if len(batch) == self.batch_size:
+                    break
+                if r.sig == sig:
+                    batch.append(r)
+            for r in batch:
+                self._queue.remove(r)
             _m_queue_depth.labels(server="dense").set(len(self._queue))
             return batch
 
@@ -252,14 +335,22 @@ class GenerationServer:
             # engage it when some row is actually padded, so full-length
             # prompts that legitimately contain pad_token_id aren't
             # masked at those positions
-            defaults = list(self._defaults)
+            temp, top_p, seed, eos, _from_stop = batch[0].sig
+            defaults = [np.uint32(0), np.float32(temp), np.int32(eos),
+                        np.float32(top_p), self._defaults[-1]]
             if not any(r.padded for r in batch):
                 defaults[-1] = np.int32(-1)
-            # per-batch seed: with temperature > 0 a FIXED seed would
-            # draw identical sampling noise for every batch (identical
-            # prompts -> identical completions, forever)
-            defaults[0] = np.uint32(
-                (int(self._defaults[0]) + self._batches) & 0xFFFFFFFF)
+            if seed is not None:
+                # explicit per-request seed (SamplingParams.seed): part
+                # of the batch signature, so every row asked for it —
+                # reproducible by construction
+                defaults[0] = np.uint32(seed)
+            else:
+                # per-batch seed: with temperature > 0 a FIXED seed
+                # would draw identical sampling noise for every batch
+                # (identical prompts -> identical completions, forever)
+                defaults[0] = np.uint32(
+                    (int(self._defaults[0]) + self._batches) & 0xFFFFFFFF)
             try:
                 with _tracing.span("decode_dispatch",
                                    request_ids=[r.rid for r in batch],
@@ -272,17 +363,30 @@ class GenerationServer:
                 continue
             t_done = time.perf_counter()
             new_tokens = out.shape[1] - self.prompt_len
+            # stop accounting (schema-congruent with the paged server):
+            # the program keeps emitting eos after a hit, so "did any
+            # generated token match the batch's eos id" is exact
+            reasons = []
+            for i, r in enumerate(batch):
+                gen = out[i, self.prompt_len:]
+                if eos >= 0 and (gen == eos).any():
+                    reasons.append("stop_token" if _from_stop else "eos")
+                else:
+                    reasons.append("budget")
             with self._lock:
                 self._batches += 1
                 self._rows += len(batch)
                 self._tokens_out += new_tokens * len(batch)
                 for i, r in enumerate(batch):
                     self._lat.append(t_done - r.t_submit)
+                    self._stop_reasons[reasons[i]] += 1
             _m_slots_busy.labels(server="dense").set(0)
             for i, r in enumerate(batch):
                 _tracing.event("request_done", request_id=r.rid,
                                new_tokens=int(new_tokens))
                 _m_requests_done.labels(server="dense").inc()
+                _m_stop_reason.labels(server="dense",
+                                      reason=reasons[i]).inc()
                 _m_request_latency.labels(server="dense").observe(
                     t_done - r.t_submit)
                 r.future.set_result(out[i])
@@ -369,10 +473,12 @@ class PagedGenerationServer:
                  eos_token_id=None, temperature=0.0, seed=0,
                  weight_quant=None, steps_per_dispatch=1,
                  prefill_chunk_tokens=512, pack_align=None,
-                 enable_prefix_cache=False):
+                 enable_prefix_cache=False, detokenize=None,
+                 stop_tail_tokens=16):
         import jax
         import jax.numpy as jnp
 
+        from ..sampling import SlotParamStore
         from ..nn.decode import PagedDecoder
         from .kv_cache import PagedKVCache, blocks_for
 
@@ -420,10 +526,19 @@ class PagedGenerationServer:
             num_blocks=int(num_blocks), dtype=dt)
         self._blocks_for = blocks_for
         self._decoder = PagedDecoder.for_config(cfg, self.block_size)
-        self._mstep = (self._decoder.multistep(self.steps_per_dispatch)
-                       if self.steps_per_dispatch > 1 else None)
-        self._key = jax.random.key(int(seed))
-        self._rng_calls = 0
+        # per-slot sampling state (round 10): struct-of-arrays param
+        # buffers + the [slots, V] penalty count buffer, scattered on
+        # admit/refill. Constructor temperature is the DEFAULT for
+        # requests submitted without SamplingParams (validated here).
+        self._sp_store = SlotParamStore(self.max_slots, cfg.vocab_size)
+        self._default_sampling = SamplingParams(
+            temperature=self.temperature)
+        self._detok = detokenize
+        self.stop_tail_tokens = int(stop_tail_tokens)
+        if self.stop_tail_tokens < 1:
+            raise ValueError("stop_tail_tokens must be >= 1")
+        self._seed0 = int(seed) & 0xFFFFFFFF
+        self._auto_seeds = itertools.count()
         # slot state: None (idle) or dict(seq, req, toks, pos, budget)
         self._slots = [None] * self.max_slots
         self._worst: dict[int, int] = {}  # seq -> worst-case block count
@@ -443,26 +558,56 @@ class PagedGenerationServer:
         self._prefill_dispatches = 0
         self._active_integral = 0
         self._fill_integral = 0.0
+        self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
+        self._fastpath_dispatches = 0
+        self._sampled_dispatches = 0
         self._t0 = None
 
     # ---- client API ----------------------------------------------------
-    def submit(self, ids, max_new_tokens=None):
+    def submit(self, ids, max_new_tokens=None, sampling=None):
         """Enqueue one prompt (any length <= max_prompt_len; NO padding
         needed). Returns a Future resolving to the UNPADDED
-        [len + generated] int32 sequence (generation stops at EOS or the
-        token budget)."""
+        [len + generated] int32 sequence (generation stops at EOS, a
+        stop condition, or the token budget).
+
+        sampling: optional `SamplingParams` — per-request temperature /
+        top-k / top-p / min-p, penalties, PRNG seed, stop token ids /
+        stop strings, and token budget. Validation is EAGER (here), so
+        a bad value fails the submit, not a later jitted dispatch.
+        `max_new_tokens` (arg) overrides `sampling.max_new_tokens`
+        overrides the server default. Stop strings require the server
+        to be built with a `detokenize` callable; matching runs against
+        the detokenized last `stop_tail_tokens` tokens."""
+        if sampling is None:
+            sampling = self._default_sampling
+        elif not isinstance(sampling, SamplingParams):
+            raise TypeError(f"sampling must be a SamplingParams, "
+                            f"got {type(sampling).__name__}")
+        if sampling.stop_strings and self._detok is None:
+            raise ValueError(
+                "stop_strings given but the server has no detokenizer "
+                "(pass detokenize= to the PagedGenerationServer "
+                "constructor)")
         ids = np.asarray(ids, np.int32).reshape(-1)
         if ids.size == 0 or ids.size > self.max_prompt_len:
             raise ValueError(f"prompt length {ids.size} not in "
                              f"[1, {self.max_prompt_len}]")
-        budget = self.max_new if max_new_tokens is None \
-            else int(max_new_tokens)
+        budget = (max_new_tokens if max_new_tokens is not None
+                  else sampling.max_new_tokens)
+        budget = self.max_new if budget is None else int(budget)
         if not 1 <= budget <= self.max_new:
             raise ValueError(f"max_new_tokens {budget} not in "
                              f"[1, {self.max_new}]")
         req = _Req(ids=ids, future=Future(),
                    t_submit=time.perf_counter(),
-                   rid=f"p{next(_req_ids)}")
+                   rid=f"p{next(_req_ids)}", sampling=sampling)
+        # per-request PRNG stream seed: explicit seeds reproduce tokens
+        # regardless of batch composition; auto seeds derive from the
+        # server seed + a submission counter (distinct streams per
+        # request, deterministic given arrival order)
+        req.seed = (sampling.seed if sampling.seed is not None else
+                    (self._seed0 + 0x9E3779B9 * (1 + next(
+                        self._auto_seeds))) & 0xFFFFFFFF)
         req.budget = budget
         with self._lock:
             if self._stop:
@@ -512,6 +657,9 @@ class PagedGenerationServer:
             self._prefill_dispatches = 0
             self._active_integral = 0
             self._fill_integral = 0.0
+            self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
+            self._fastpath_dispatches = 0
+            self._sampled_dispatches = 0
             self._t0 = time.perf_counter()
 
     def stats(self):
@@ -547,6 +695,13 @@ class PagedGenerationServer:
                 "decode_steps": self._steps,
                 "prefills": self._prefills,
                 "prefill_dispatches": self._prefill_dispatches,
+                # finished requests by why generation stopped, plus the
+                # sampling pipeline's dispatch-mode split (fast path =
+                # no resident sampled request: bare argmax)
+                "stop_reasons": dict(self._stop_reasons),
+                "sampling_fast_path_dispatches":
+                    self._fastpath_dispatches,
+                "sampling_sampled_dispatches": self._sampled_dispatches,
                 # mean busy slots per decode step: the continuous-batching
                 # analogue of the dense server's batch_fill
                 "slot_fill": (self._active_integral
@@ -562,10 +717,6 @@ class PagedGenerationServer:
             return out
 
     # ---- engine --------------------------------------------------------
-    def _next_key(self):
-        self._rng_calls += 1
-        return self._jax.random.fold_in(self._key, self._rng_calls)
-
     def _outstanding_blocks(self):
         """Blocks the active slots may still demand in the worst case."""
         total = 0
@@ -617,6 +768,11 @@ class PagedGenerationServer:
                               "fed": cached, "cached": cached,
                               "chunks": 0, "t_pre0": None,
                               "t_last": None}
+            # scatter the request's sampling params into its slot row
+            # (one device row-reset only when the request uses
+            # penalties); the server-level EOS joins its stop-id set
+            self._sp_store.set_slot(i, req.sampling, req.seed,
+                                    eos=self.eos, prompt_ids=req.ids)
             picked.append((i, req, seq))
             _m_slot_refills.inc()
             _tracing.event("request_admitted", request_id=req.rid,
@@ -714,12 +870,23 @@ class PagedGenerationServer:
                     [self._slots[plan[r][0]]["seq"]
                      if r < len(plan) else None for r in range(P)],
                     mcap))
-                tok, kc, vc = self._decoder.packed_prefill(
-                    self._params, jnp.asarray(toks), jnp.asarray(seg),
-                    jnp.asarray(pos), tables, jnp.asarray(sample_idx),
-                    self.cache.k_blocks, self.cache.v_blocks,
-                    self._next_key(), jnp.float32(self.temperature))
+                # per-slot sampling buffers gathered to compact plan
+                # rows; token-0 sampling (PRNG step 0) runs the same
+                # vectorized pipeline as decode
+                done_set = {r for _, r in done_rows}
+                sp_args, sp_mode = self._sp_store.packed_args(
+                    [plan[r][0] if r < len(plan) else None
+                     for r in range(P)],
+                    [r in done_set for r in range(P)])
+                tok, stopped, kc, vc, counts = \
+                    self._decoder.packed_prefill(
+                        self._params, jnp.asarray(toks),
+                        jnp.asarray(seg), jnp.asarray(pos), tables,
+                        jnp.asarray(sample_idx), self.cache.k_blocks,
+                        self.cache.v_blocks, sp_args, sp_mode)
+                self._sp_store.swap_counts(counts)
                 tok_h = np.asarray(tok)
+                stopped_h = np.asarray(stopped)
         except Exception as e:  # noqa: BLE001 — fail the chunk's requests
             for i, *_ in plan:
                 s = self._slots[i]
@@ -728,6 +895,7 @@ class PagedGenerationServer:
                     self.cache.free(seq)
                 self._worst.pop(seq, None)
                 self._slots[i] = None
+                self._sp_store.clear_slot(i)
                 req.future.set_exception(e)
             return
         self.cache.swap_arrays(kc, vc)
@@ -762,17 +930,34 @@ class PagedGenerationServer:
                 self._prefills += 1
                 self._ttft.append(req.ttft)
             s["t_last"] = t_now
-            self._slot_token(i, int(tok_h[r]))
+            self._slot_token(i, int(tok_h[r]),
+                             device_stopped=bool(stopped_h[r]))
 
-    def _slot_token(self, i, tok):
+    def _slot_token(self, i, tok, device_stopped=False):
         """Record one generated token for slot i; completes the request
-        on EOS or budget exhaustion (slot freed for refill)."""
+        when generation stopped (slot freed for refill). Stop sources,
+        in precedence order:
+          * device_stopped — the dispatch's per-slot stop-token matrix
+            matched (server EOS or a request stop_token_id);
+          * stop strings — host-side: the request's stop strings
+            searched in the detokenized last `stop_tail_tokens` tokens
+            (the emitted tokens stay in the output);
+          * budget — the request's token budget is exhausted."""
         slot = self._slots[i]
         slot["toks"].append(tok)
-        hit_eos = (self.eos >= 0 and tok == self.eos)
-        if hit_eos or len(slot["toks"]) >= slot["budget"]:
+        sp = slot["req"].sampling
+        reason = None
+        if device_stopped:
+            reason = ("eos" if self.eos >= 0 and tok == self.eos
+                      else "stop_token")
+        elif sp is not None and sp.stop_strings:
+            tail = self._detok(slot["toks"][-self.stop_tail_tokens:])
+            if any(s in tail for s in sp.stop_strings):
+                reason = "stop_string"
+        if reason is None and len(slot["toks"]) >= slot["budget"]:
+            reason = "budget"
+        if reason is not None:
             seq, req = slot["seq"], slot["req"]
-            reason = "eos" if hit_eos else "budget"
             _tracing.event("request_done", request_id=req.rid,
                            new_tokens=len(slot["toks"]),
                            ttft_s=req.ttft, reason=reason)
@@ -782,12 +967,16 @@ class PagedGenerationServer:
                 self.cache.free(seq)
                 del self._worst[seq]
                 self._slots[i] = None
+                self._sp_store.clear_slot(i)
                 t_done = time.perf_counter()
                 with self._lock:
                     self._lat.append(t_done - req.t_submit)
                     self._tokens_out += len(slot["toks"])
                     self._requests_done += 1
+                    self._stop_reasons[reason] += 1
                 _m_slot_releases.labels(reason=reason).inc()
+                _m_stop_reason.labels(server="paged",
+                                      reason=reason).inc()
                 _m_requests_done.labels(server="paged").inc()
                 _m_request_latency.labels(server="paged").observe(
                     t_done - req.t_submit)
@@ -829,35 +1018,52 @@ class PagedGenerationServer:
             tok = np.zeros((self.max_slots,), np.int32)
             pos = np.zeros((self.max_slots,), np.int32)
             act = np.zeros((self.max_slots,), bool)
+            steps = np.zeros((self.max_slots,), np.int32)
             for i in active_idx:
                 s = self._slots[i]
                 tok[i] = s["toks"][-1]
                 pos[i] = s["pos"] + len(s["toks"]) - 1
                 act[i] = True
+                steps[i] = len(s["toks"])  # PRNG step counter
             tables = jnp.asarray(self.cache.table_array(
                 [s["seq"] if s is not None else None
                  for s in self._slots], self._m_width))
+            # per-slot sampling buffers + the static dispatch mode: ONE
+            # jitted dispatch serves the whole mixed batch; all-greedy
+            # residents take the argmax fast path
+            sp_args, sp_mode = self._sp_store.step_args(steps)
+            if sp_mode[0]:
+                _m_sampling_sampled.inc()
+            else:
+                _m_sampling_fast.inc()
+            with self._lock:
+                if sp_mode[0]:
+                    self._sampled_dispatches += 1
+                else:
+                    self._fastpath_dispatches += 1
             try:
                 with _tracing.span(
                         "decode_dispatch", k=k,
                         request_ids=[self._slots[i]["req"].rid
                                      for i in active_idx]):
-                    if self._mstep is None:
-                        nxt, kc, vc = self._decoder.step(
-                            self._params, jnp.asarray(tok),
-                            jnp.asarray(pos), jnp.asarray(act), tables,
-                            self.cache.k_blocks, self.cache.v_blocks,
-                            self._next_key(),
-                            jnp.float32(self.temperature))
+                    if k == 1:
+                        nxt, stopped, kc, vc, counts = \
+                            self._decoder.step(
+                                self._params, jnp.asarray(tok),
+                                jnp.asarray(pos), jnp.asarray(act),
+                                tables, self.cache.k_blocks,
+                                self.cache.v_blocks, sp_args, sp_mode)
                         toks = np.asarray(nxt)[None]   # [1, S]
+                        stops = np.asarray(stopped)[None]
                     else:
-                        toks, kc, vc = self._mstep(
-                            self._params, jnp.asarray(tok),
-                            jnp.asarray(pos), jnp.asarray(act), tables,
-                            self.cache.k_blocks, self.cache.v_blocks,
-                            self._next_key(),
-                            jnp.float32(self.temperature))
+                        toks, stopped, kc, vc, counts = \
+                            self._decoder.multistep(k, sp_mode)(
+                                self._params, jnp.asarray(tok),
+                                jnp.asarray(pos), jnp.asarray(act),
+                                tables, self.cache.k_blocks,
+                                self.cache.v_blocks, sp_args)
                         toks = np.asarray(toks)        # [k, S]
+                        stops = np.asarray(stopped)
             except Exception as e:  # noqa: BLE001 — fan out, drop slots
                 for i in active_idx:
                     s = self._slots[i]
@@ -865,7 +1071,9 @@ class PagedGenerationServer:
                     del self._worst[s["seq"]]
                     s["req"].future.set_exception(e)
                     self._slots[i] = None
+                    self._sp_store.clear_slot(i)
                 continue
+            self._sp_store.swap_counts(counts)
             self.cache.swap_arrays(kc, vc)
             t_now = time.perf_counter()
             with self._lock:
@@ -878,7 +1086,8 @@ class PagedGenerationServer:
                 consumed = 0
                 for j in range(toks.shape[0]):
                     consumed += 1
-                    self._slot_token(i, int(toks[j, i]))
+                    self._slot_token(i, int(toks[j, i]),
+                                     device_stopped=bool(stops[j, i]))
                     if self._slots[i] is None:  # finished mid-scan: the
                         break  # remaining scan tokens are discarded
                 if self._slots[i] is not None:

@@ -184,7 +184,8 @@ class GPT2(nn.Layer):
     def generate(self, input_ids, max_new_tokens, temperature=0.0,
                  eos_token_id=None, seed=0, top_k=0, top_p=1.0,
                  pad_token_id=None, weight_quant=None, kv_quant=None,
-                 kv_cache="dense", prompt_lens=None, block_size=16):
+                 kv_cache="dense", prompt_lens=None, block_size=16,
+                 sampling=None):
         """Autoregressive decoding with a KV cache (serving path; ref
         capability: fluid beam_search/sampling decode ops). TPU-first:
         static shapes throughout — prefill compiles once per prompt shape,
@@ -198,12 +199,31 @@ class GPT2(nn.Layer):
         with per-row `prompt_lens` (no pad-value matching), block_size
         sets the pool granularity, and the step loop runs host-side —
         it is the engine the continuous-batching server drives, exposed
-        here for parity testing and offline use."""
+        here for parity testing and offline use.
+
+        sampling: optional `paddle_tpu.sampling.SamplingParams` applied
+        to EVERY batch row; overrides the temperature/top_k/top_p/seed
+        args. The paged path runs the full vectorized pipeline
+        (including min_p and penalties; stop_token_ids stop a row like
+        EOS); row r samples from stream seed+r, so each row draws an
+        independent counter-based PRNG stream. The dense path maps the
+        program-level subset (temperature/top_p/seed, one stop id) and
+        rejects the rest eagerly."""
         import jax.numpy as jnp
         import numpy as np
 
         from ..core.tensor import Tensor
+        from ..sampling import SamplingParams
 
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingParams):
+            raise TypeError(f"sampling must be a SamplingParams, "
+                            f"got {type(sampling).__name__}")
+        if sampling is not None and sampling.stop_strings:
+            raise ValueError("stop_strings need a detokenizer — serve "
+                             "via PagedGenerationServer(detokenize=...)")
+        if sampling is not None and sampling.max_new_tokens is not None:
+            max_new_tokens = sampling.max_new_tokens
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(np.asarray(input_ids))
         max_new_tokens = int(max_new_tokens)
@@ -215,14 +235,39 @@ class GPT2(nn.Layer):
             raise ValueError(f"unknown kv_cache {kv_cache!r} "
                              "(supported: 'dense', 'paged')")
         if kv_cache == "paged":
-            if top_k or top_p < 1.0 or kv_quant is not None:
+            if kv_quant is not None:
                 raise ValueError(
-                    "kv_cache='paged' supports greedy/temperature "
-                    "sampling with bf16/f32 or W8A16 weights (no "
-                    "top_k/top_p/kv_quant yet)")
+                    "kv_cache='paged' supports bf16/f32 or W8A16 "
+                    "weights (no kv_quant yet)")
+            if sampling is None:
+                sampling = SamplingParams(
+                    temperature=float(temperature), top_k=int(top_k),
+                    top_p=float(top_p), seed=int(seed))
             return self._generate_paged(
-                ids, max_new_tokens, temperature, eos_token_id, seed,
-                pad_token_id, prompt_lens, block_size, weight_quant)
+                ids, max_new_tokens, eos_token_id, seed, pad_token_id,
+                prompt_lens, block_size, weight_quant, sampling)
+        if sampling is not None:
+            # dense program-level subset: per-slot fields are a paged-
+            # path feature (the dense decode is one fused program)
+            for f in ("min_p", "repetition_penalty", "presence_penalty",
+                      "frequency_penalty"):
+                default = 1.0 if f == "repetition_penalty" else 0.0
+                if getattr(sampling, f) != default:
+                    raise ValueError(
+                        f"kv_cache='dense' does not support "
+                        f"SamplingParams.{f}={getattr(sampling, f)!r}; "
+                        f"use kv_cache='paged'")
+            if len(sampling.stop_token_ids) > 1:
+                raise ValueError(
+                    "kv_cache='dense' supports at most one stop token "
+                    f"id (the eos), got {sampling.stop_token_ids!r}")
+            temperature = sampling.temperature
+            top_k = sampling.top_k
+            top_p = sampling.top_p
+            if sampling.seed is not None:
+                seed = sampling.seed
+            if sampling.stop_token_ids:
+                eos_token_id = sampling.stop_token_ids[0]
         if prompt_lens is not None:
             raise ValueError("prompt_lens is only meaningful with "
                              "kv_cache='paged' (the dense path derives "
@@ -267,23 +312,25 @@ class GPT2(nn.Layer):
                             kv_quant == "int8")
         return Tensor(out, stop_gradient=True)
 
-    def _generate_paged(self, ids, max_new, temp, eos_token_id, seed,
+    def _generate_paged(self, ids, max_new, eos_token_id, seed,
                         pad_token_id, prompt_lens, block_size,
-                        weight_quant):
+                        weight_quant, sampling):
         """Paged-cache decode: RIGHT-padded prompts + per-row lengths,
         host-side step loop over the jitted PagedDecoder (the same
-        engine the continuous-batching server drives). Output rows are
-        [prompt, generated, fill]: generated tokens start at each row's
-        true length; eos padding continues after a hit like the dense
+        engine the continuous-batching server drives), with the full
+        per-slot sampling pipeline (`sampling` applied to every row;
+        row r uses PRNG stream seed+r). Output rows are [prompt,
+        generated, fill]: generated tokens start at each row's true
+        length; eos/stop padding continues after a hit like the dense
         path; the tail past len+max_new is filled with pad_token_id
         (else eos, else 0)."""
-        import jax
         import jax.numpy as jnp
         import numpy as np
 
         from ..core.tensor import Tensor
         from ..inference.kv_cache import PagedKVCache, blocks_for
         from ..nn.decode import PagedDecoder
+        from ..sampling import SlotParamStore
 
         ids = np.asarray(ids).astype(np.int32)
         B, S0 = ids.shape
@@ -322,34 +369,44 @@ class GPT2(nn.Layer):
             cache.allocate(b, int(lens[b]) + max_new)
         tables = jnp.asarray(cache.table_array(range(B), m_width))
         dec = PagedDecoder.for_config(self.cfg, bs)
-        key = jax.random.key(int(seed))
-        key, sub = jax.random.split(key)
-        temp_t = jnp.float32(temp)
+        # per-row sampling buffers: the same params every row, stream
+        # seed+r per row (independent counter-based PRNG streams)
+        store = SlotParamStore(B, self.cfg.vocab_size)
+        base_seed = sampling.seed if sampling.seed is not None \
+            else int(seed)
+        for b in range(B):
+            store.set_slot(b, sampling, base_seed + b, eos=eos,
+                           prompt_ids=ids[b, :int(lens[b])])
+        fill = pad_token_id if pad_token_id is not None \
+            else (eos if eos >= 0 else 0)
+        stop_fill = eos if eos >= 0 else fill
         lens_j = jnp.asarray(lens)
         active = jnp.ones((B,), bool)
-        tok, kc, vc = dec.prefill(params, jnp.asarray(ids), lens_j, tables,
-                                  cache.k_blocks, cache.v_blocks, sub,
-                                  temp_t)
+        sp, mode = store.step_args(np.zeros((B,), np.int32))
+        tok, stopped, kc, vc, counts = dec.prefill(
+            params, jnp.asarray(ids), lens_j, tables, cache.k_blocks,
+            cache.v_blocks, sp, mode)
         cache.swap_arrays(kc, vc)
+        store.swap_counts(counts)
         tok = np.asarray(tok)
-        done = (tok == eos) & (eos >= 0)
+        done = np.asarray(stopped)
         out_toks = [tok]
         pos = lens.copy()
-        for _ in range(max_new - 1):
-            key, sub = jax.random.split(key)
-            nxt, kc, vc = dec.step(params, jnp.asarray(out_toks[-1]),
-                                   jnp.asarray(pos), active, tables, kc,
-                                   vc, sub, temp_t)
+        for step in range(1, max_new):
+            sp, mode = store.step_args(np.full((B,), step, np.int32))
+            nxt, stopped, kc, vc, counts = dec.step(
+                params, jnp.asarray(out_toks[-1]), jnp.asarray(pos),
+                active, tables, kc, vc, sp, mode)
             cache.swap_arrays(kc, vc)
+            store.swap_counts(counts)
             nxt = np.asarray(nxt)
-            if eos >= 0:  # dense-path semantics: keep emitting eos
-                nxt = np.where(done, eos, nxt)
-                done = done | (nxt == eos)
+            # dense-path semantics: rows that hit eos (or a stop token)
+            # keep emitting the stop-fill value
+            nxt = np.where(done, stop_fill, nxt)
+            done = done | np.asarray(stopped)
             out_toks.append(nxt)
             pos = pos + 1
         gen = np.stack(out_toks, axis=1)             # [B, max_new]
-        fill = pad_token_id if pad_token_id is not None \
-            else (eos if eos >= 0 else 0)
         out = np.full((B, S0 + max_new), fill, np.int32)
         for b in range(B):
             n = int(lens[b])

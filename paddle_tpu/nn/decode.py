@@ -23,6 +23,20 @@ transformer against the block-pool KV cache (inference/kv_cache.py):
     Prefix-cache resume therefore needs no engine change: the server
     just starts the packed stream at the first uncached token.
 
+Sampling (round 10) is PER-SLOT: every program takes a struct-of-arrays
+parameter dict `sp` (paddle_tpu/sampling/buffers.py) — temperature /
+top-k / top-p / min-p / penalty columns, per-request counter-based PRNG
+seeds, and the per-slot stop-token matrix — and pushes the logits
+through the vectorized processor pipeline
+(paddle_tpu/sampling/processors.py), so one jitted dispatch serves a
+batch mixing greedy and arbitrarily-configured sampled requests. The
+`mode` pair (any-sampled, any-penalties) is STATIC: (False, False) is
+the all-greedy fast path that compiles to a bare argmax plus the stop
+check; parameter VALUES are traced and never recompile. Every program
+returns device-checked `stopped` flags (per-slot stop-token matrix,
+EOS folded in by the server) and, in penalty mode, the updated token-
+count scatter buffer.
+
 Both are pure functions of (params, inputs, cache arrays) so the cache
 arrays round-trip functionally (donated on accelerators). Masking is by
 LENGTH everywhere: a prompt legitimately containing the server's
@@ -41,12 +55,14 @@ from .layer.legacy import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F4
 
 __all__ = ["BeamSearchDecoder", "dynamic_decode", "PagedDecoder"]
 
+GREEDY_MODE = (False, False)
+
 
 @functools.lru_cache(maxsize=32)
 def _layer_helpers(spec):
     """Shared GPT-2-layout building blocks (layernorm, int8-aware matmul,
-    qkv split, embed/head, sampling, residual+MLP) used by every paged
-    program builder below. spec = (L, H, Dh, E, eps, tied) — the tuple
+    qkv split, embed/head, residual+MLP) used by every paged program
+    builder below. spec = (L, H, Dh, E, eps, tied) — the tuple
     models/gpt2.py builds."""
     import jax
     import jax.numpy as jnp
@@ -96,15 +112,6 @@ def _layer_helpers(spec):
 
         return embed, head
 
-    def pick(logits, key, temp):
-        def sample():
-            l = logits / jnp.maximum(temp, 1e-6)
-            return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
-
-        return jax.lax.cond(
-            temp > 0.0, sample,
-            lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32))
-
     def block_and_mlp(params, i, x, o, dt):
         x = x + matw(params, f"h.{i}.out_proj.weight", o, dt) \
             + params[f"h.{i}.out_proj.bias"]
@@ -118,27 +125,31 @@ def _layer_helpers(spec):
 
     ns = type("LayerHelpers", (), {})()
     ns.ln, ns.matw, ns.qkv_split = ln, matw, qkv_split
-    ns.make_embed_head, ns.pick, ns.block_and_mlp = \
-        make_embed_head, pick, block_and_mlp
+    ns.make_embed_head, ns.block_and_mlp = make_embed_head, block_and_mlp
     return ns
 
 
-@functools.lru_cache(maxsize=32)
-def _build_paged_fns(spec, block_size, return_logits):
-    """(spec, block_size) -> (prefill_fn, step_fn), raw and jittable."""
+@functools.lru_cache(maxsize=64)
+def _build_paged_fns(spec, block_size, return_logits, mode):
+    """(spec, block_size, mode) -> (prefill_fn, step_fn), raw and
+    jittable. mode = (any_sampled, any_penalties): the static variant
+    pair of the sampling pipeline (see module docstring)."""
     import jax
     import jax.numpy as jnp
+
+    from ..sampling import processors as _proc
 
     L, H, Dh, E, eps, tied = spec
     scale = Dh ** -0.5
     BS = int(block_size)
+    sampled, penalties = mode
     hp = _layer_helpers(spec)
-    ln, qkv_split, make_embed_head, pick, block_and_mlp = (
-        hp.ln, hp.qkv_split, hp.make_embed_head, hp.pick, hp.block_and_mlp)
+    ln, qkv_split, make_embed_head, block_and_mlp = (
+        hp.ln, hp.qkv_split, hp.make_embed_head, hp.block_and_mlp)
 
-    def prefill_fn(params, ids, lens, tables, kc, vc, key, temp):
+    def prefill_fn(params, ids, lens, tables, kc, vc, sp):
         """ids [B, S0] right-padded; lens [B]; tables [B, M]. Returns
-        (tok0 [B], kc, vc[, logits0 f32])."""
+        (tok0 [B], stopped [B], kc, vc, counts|None[, logits0 f32])."""
         B, S0 = ids.shape
         dt = params["ln_f.weight"].dtype
         embed, head = make_embed_head(params, dt)
@@ -169,12 +180,19 @@ def _build_paged_fns(spec, block_size, return_logits):
         xf = x[jnp.arange(B), lens - 1]                # true last token
         xf = ln(xf, params["ln_f.weight"], params["ln_f.bias"])
         logits = head(xf)
-        tok = pick(logits, key, temp)
+        tok = _proc.sample_tokens(logits, sp, sampled=sampled,
+                                  penalties=penalties)
+        stopped = _proc.check_stops(tok, sp["stop"],
+                                    jnp.ones((B,), bool))
+        counts = None
+        if penalties:
+            counts = _proc.update_counts(sp["counts"], jnp.arange(B),
+                                         tok, jnp.ones((B,), bool))
         if return_logits:
-            return tok, kc, vc, logits
-        return tok, kc, vc
+            return tok, stopped, kc, vc, counts, logits
+        return tok, stopped, kc, vc, counts
 
-    def step_fn(params, tok, pos, active, tables, kc, vc, key, temp):
+    def step_fn(params, tok, pos, active, tables, kc, vc, sp):
         """One decode token per sequence. tok [B] is written at cache
         position pos [B]; attention sees positions [0, pos]. Idle slots
         (active False) write to trash and emit token 0."""
@@ -198,33 +216,44 @@ def _build_paged_fns(spec, block_size, return_logits):
             x = block_and_mlp(params, i, x, o, dt)
         xf = ln(x, params["ln_f.weight"], params["ln_f.bias"])
         logits = head(xf)
-        nxt = jnp.where(active, pick(logits, key, temp), 0)
+        nxt = jnp.where(active,
+                        _proc.sample_tokens(logits, sp, sampled=sampled,
+                                            penalties=penalties), 0)
+        stopped = _proc.check_stops(nxt, sp["stop"], active)
+        counts = None
+        if penalties:
+            counts = _proc.update_counts(sp["counts"], jnp.arange(B),
+                                         nxt, active)
         if return_logits:
-            return nxt, kc, vc, logits
-        return nxt, kc, vc
+            return nxt, stopped, kc, vc, counts, logits
+        return nxt, stopped, kc, vc, counts
 
     return prefill_fn, step_fn
 
 
-@functools.lru_cache(maxsize=32)
-def _build_packed_prefill(spec, block_size, return_logits):
+@functools.lru_cache(maxsize=64)
+def _build_packed_prefill(spec, block_size, return_logits, mode):
     """Packed ragged prefill: ONE dispatch prefills a token-packed
     multi-sequence chunk stream (the tentpole of the chunked-prefill
     scheduler, inference/serving.py). Raw and jittable."""
     import jax.numpy as jnp
 
+    from ..sampling import processors as _proc
+
     L, H, Dh, E, eps, tied = spec
     scale = Dh ** -0.5
     BS = int(block_size)
+    sampled, penalties = mode
     hp = _layer_helpers(spec)
 
     def packed_prefill_fn(params, toks, seg, pos, tables, sample_idx,
-                          kc, vc, key, temp):
+                          kc, vc, sp):
         """toks [T] packed token stream; seg [T] slot row per token;
         pos [T] absolute cache position (-1 = packing pad); tables
         [B, M]; sample_idx [B] packed index of each slot row's last
         prompt token (host only reads rows whose prompt completed this
-        chunk). Returns (tok [B], kc, vc[, logits [B, V] f32]).
+        chunk). Returns (tok [B], stopped [B], kc, vc, counts|None
+        [, logits [B, V] f32]).
 
         Every token attends its own sequence's cache positions [0, pos]
         via ops.ragged_prefill_attention — which sees both this chunk's
@@ -232,7 +261,13 @@ def _build_packed_prefill(spec, block_size, return_logits):
         split across chunks needs no state beyond the paged cache.
         Blocks a prefix-cache attach copied into the table read
         identically: a chunk starting at the first uncached token
-        resumes on top of K/V another sequence prefilled."""
+        resumes on top of K/V another sequence prefilled.
+
+        Sampling rows are COMPACT plan rows: sp's columns are gathered
+        host-side to plan order, sp["crows"] maps plan row -> slot for
+        the count buffer, and sp["row_done"] masks the rows whose
+        token-0 sample is real (still-feeding and padding rows compute
+        a discarded token)."""
         from ..ops.attention import ragged_prefill_attention
 
         T = toks.shape[0]
@@ -258,69 +293,91 @@ def _build_packed_prefill(spec, block_size, return_logits):
         xf = x[sample_idx]                                # [B, E]
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
         logits = head(xf)
-        tok = hp.pick(logits, key, temp)
+        tok = _proc.sample_tokens(logits, sp, sampled=sampled,
+                                  penalties=penalties)
+        B = sample_idx.shape[0]
+        stopped = _proc.check_stops(tok, sp["stop"],
+                                    jnp.ones((B,), bool))
+        counts = None
+        if penalties:
+            counts = _proc.update_counts(sp["counts"], sp["crows"], tok,
+                                         sp["row_done"])
         if return_logits:
-            return tok, kc, vc, logits
-        return tok, kc, vc
+            return tok, stopped, kc, vc, counts, logits
+        return tok, stopped, kc, vc, counts
 
     return packed_prefill_fn
 
 
-@functools.lru_cache(maxsize=32)
-def _jitted_packed_prefill(spec, block_size, return_logits, donate):
+@functools.lru_cache(maxsize=64)
+def _jitted_packed_prefill(spec, block_size, return_logits, donate, mode):
     import jax
 
-    fn = _build_packed_prefill(spec, block_size, return_logits)
+    fn = _build_packed_prefill(spec, block_size, return_logits, mode)
     return jax.jit(fn, donate_argnums=(6, 7) if donate else ())
 
 
-@functools.lru_cache(maxsize=32)
-def _jitted_paged_fns(spec, block_size, return_logits, donate):
+@functools.lru_cache(maxsize=64)
+def _jitted_paged_fns(spec, block_size, return_logits, donate, mode):
     import jax
 
-    prefill_fn, step_fn = _build_paged_fns(spec, block_size, return_logits)
+    prefill_fn, step_fn = _build_paged_fns(spec, block_size,
+                                           return_logits, mode)
     dp = (4, 5) if donate else ()   # kc, vc in prefill_fn
     ds = (5, 6) if donate else ()   # kc, vc in step_fn
     return (jax.jit(prefill_fn, donate_argnums=dp),
             jax.jit(step_fn, donate_argnums=ds))
 
 
-@functools.lru_cache(maxsize=32)
-def _jitted_multistep(spec, block_size, n_steps, donate):
+@functools.lru_cache(maxsize=64)
+def _jitted_multistep(spec, block_size, n_steps, donate, mode):
     """`n_steps` decode tokens in ONE dispatch (a lax.scan over step_fn):
     multi-step scheduling for dispatch-latency-bound serving — at the
     measured 8-70ms tunnel floor a strict token-per-dispatch loop is
     floor-bound, so the server amortizes the floor over n_steps tokens
-    and discards (at most n_steps-1) post-EOS/post-budget tokens
-    host-side. Returns (toks [n_steps, B], kc, vc)."""
+    and discards (at most n_steps-1) post-stop/post-budget tokens
+    host-side. Per-slot PRNG steps advance with the scan index, so the
+    fused scan draws the same per-request streams as n_steps separate
+    dispatches. Returns (toks [n_steps, B], stopped [n_steps, B], kc,
+    vc, counts|None)."""
     import jax
 
-    _, step_fn = _build_paged_fns(spec, block_size, False)
+    _, step_fn = _build_paged_fns(spec, block_size, False, mode)
+    sampled, penalties = mode
 
-    def multi(params, tok, pos, active, tables, kc, vc, key, temp):
-        def body(carry, _):
-            tok, pos, kc, vc, key = carry
-            key, sub = jax.random.split(key)
-            nxt, kc, vc = step_fn(params, tok, pos, active, tables, kc,
-                                  vc, sub, temp)
-            return (nxt, pos + 1, kc, vc, key), nxt
+    def multi(params, tok, pos, active, tables, kc, vc, sp):
+        def body(carry, j):
+            tok, pos, kc, vc, counts = carry
+            spj = dict(sp)
+            if sampled:
+                spj["steps"] = sp["steps"] + j
+            if penalties:
+                spj["counts"] = counts
+            nxt, stopped, kc, vc, counts = step_fn(
+                params, tok, pos, active, tables, kc, vc, spj)
+            if not penalties:
+                counts = carry[4]
+            return (nxt, pos + 1, kc, vc, counts), (nxt, stopped)
 
-        (tok, pos, kc, vc, key), toks = jax.lax.scan(
-            body, (tok, pos, kc, vc, key), None, length=n_steps)
-        return toks, kc, vc
+        counts0 = sp.get("counts")
+        (tok, pos, kc, vc, counts), (toks, stops) = jax.lax.scan(
+            body, (tok, pos, kc, vc, counts0),
+            jax.numpy.arange(n_steps))
+        return toks, stops, kc, vc, counts
 
     return jax.jit(multi, donate_argnums=(5, 6) if donate else ())
 
 
 class PagedDecoder:
-    """Jitted (prefill, step) pair over the paged KV cache for one
-    GPT-2-layout spec. Instances are cheap — the compiled functions are
-    cached process-wide by (spec, block_size, return_logits)."""
+    """Jitted (prefill, step, packed_prefill) family over the paged KV
+    cache for one GPT-2-layout spec. Instances are cheap — the compiled
+    functions are cached process-wide by (spec, block_size,
+    return_logits, mode); per-instance only the tracing wrappers are
+    held. `mode` is the (any_sampled, any_penalties) static pair from
+    `SlotParamStore.mode()` — the default is the all-greedy fast path."""
 
     def __init__(self, spec, block_size, return_logits=False, donate=None):
         import jax
-
-        from ..observability import tracing as _tracing
 
         if donate is None:  # CPU donation is a no-op warning in jaxlib
             donate = jax.default_backend() not in ("cpu",)
@@ -328,25 +385,51 @@ class PagedDecoder:
         self.block_size = int(block_size)
         self.return_logits = bool(return_logits)
         self._donate = bool(donate)
-        prefill, step = _jitted_paged_fns(
-            self.spec, self.block_size, self.return_logits, self._donate)
-        # dispatch-boundary spans (ISSUE 2): when tracing is on, every
-        # jitted prefill/step call shows up as its own span — the
-        # device-side cost inside a request's prefill/decode phases;
-        # when off, the wrapper is one bool check
-        self.prefill = _tracing.wrap("prefill_dispatch", prefill)
-        self.step = _tracing.wrap("step_dispatch", step)
-        self.packed_prefill = _tracing.wrap(
-            "packed_prefill_dispatch",
-            _jitted_packed_prefill(self.spec, self.block_size,
-                                   self.return_logits, self._donate))
+        self._variants = {}
 
-    def multistep(self, n_steps):
+    def _variant(self, mode):
+        """(prefill, step, packed_prefill) tracing-wrapped jitted fns
+        for one static sampling mode. Dispatch-boundary spans (ISSUE 2):
+        when tracing is on, every jitted call shows up as its own span —
+        the device-side cost inside a request's prefill/decode phases;
+        when off, the wrapper is one bool check."""
+        v = self._variants.get(mode)
+        if v is None:
+            from ..observability import tracing as _tracing
+
+            prefill, step = _jitted_paged_fns(
+                self.spec, self.block_size, self.return_logits,
+                self._donate, mode)
+            packed = _jitted_packed_prefill(
+                self.spec, self.block_size, self.return_logits,
+                self._donate, mode)
+            v = (_tracing.wrap("prefill_dispatch", prefill),
+                 _tracing.wrap("step_dispatch", step),
+                 _tracing.wrap("packed_prefill_dispatch", packed))
+            self._variants[mode] = v
+        return v
+
+    def prefill(self, params, ids, lens, tables, kc, vc, sp,
+                mode=GREEDY_MODE):
+        return self._variant(mode)[0](params, ids, lens, tables, kc, vc,
+                                      sp)
+
+    def step(self, params, tok, pos, active, tables, kc, vc, sp,
+             mode=GREEDY_MODE):
+        return self._variant(mode)[1](params, tok, pos, active, tables,
+                                      kc, vc, sp)
+
+    def packed_prefill(self, params, toks, seg, pos, tables, sample_idx,
+                       kc, vc, sp, mode=GREEDY_MODE):
+        return self._variant(mode)[2](params, toks, seg, pos, tables,
+                                      sample_idx, kc, vc, sp)
+
+    def multistep(self, n_steps, mode=GREEDY_MODE):
         """Fused n-token decode (see _jitted_multistep)."""
         from ..observability import tracing as _tracing
 
         fn = _jitted_multistep(self.spec, self.block_size, int(n_steps),
-                               self._donate)
+                               self._donate, mode)
         return _tracing.wrap("multistep_dispatch", fn, k=int(n_steps))
 
     @classmethod

@@ -38,16 +38,35 @@ def sort(x, axis=-1, descending=False):
     return jnp.flip(out, axis=axis) if descending else out
 
 
-@defop()
-def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+def topk_impl(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    """Raw (non-defop) top-k: the ONE implementation shared by the
+    `topk` op and the sampling subsystem's top-k logit processor
+    (paddle_tpu/sampling/processors.py uses it with k = V as the
+    descending full sort the filter thresholds derive from).
+
+    The smallest-k path is a stable ascending argsort + gather — NOT
+    the `lax.top_k(-x)` negation trick, which (a) wraps for unsigned
+    dtypes and INT_MIN (0 negates to 0, so the smallest unsigned value
+    ranked LAST), and (b) returned values/indices whose tie order
+    disagreed with the largest-k path for duplicate entries. Both
+    paths now gather values at the returned indices, so
+    `vals == take_along_axis(x, idx)` holds by construction and ties
+    prefer the lower index in either direction."""
     axis = axis % x.ndim
     moved = jnp.moveaxis(x, axis, -1)
     if largest:
         vals, idx = jax.lax.top_k(moved, k)
     else:
-        vals, idx = jax.lax.top_k(-moved, k)
-        vals = -vals
-    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx.astype(jnp.int32), -1, axis)
+        order = jnp.argsort(moved, axis=-1, stable=True)
+        idx = order[..., :k]
+        vals = jnp.take_along_axis(moved, idx, axis=-1)
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx.astype(jnp.int32), -1, axis))
+
+
+@defop()
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    return topk_impl(x, k, axis=axis, largest=largest, sorted=sorted)
 
 
 @defop()

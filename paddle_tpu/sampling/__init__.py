@@ -1,0 +1,25 @@
+"""Per-request sampling subsystem (ISSUE 5).
+
+Three layers:
+
+* `params` — `SamplingParams`, the eagerly-validated per-request knob
+  bundle (temperature / top-k / top-p / min-p, penalties, seed, stop
+  conditions, token budget);
+* `processors` — pure, vectorized `([B, V] logits, per-slot arrays) ->
+  [B, V]` logit processors plus the counter-based per-request PRNG
+  streams, composed inside the jitted decode step so ONE dispatch
+  serves a batch mixing greedy and sampled requests;
+* `buffers` — `SlotParamStore`, the host-side struct-of-arrays slot
+  buffers (scattered on admit/refill) and the [B, V] token-count
+  scatter buffer behind the penalty processors.
+
+`nn.decode.PagedDecoder` consumes the buffers; both serving engines
+accept `SamplingParams` on `submit`; `GPT2.generate` threads them
+through the offline paged path. See docs/SERVING.md ("Per-request
+sampling").
+"""
+from .buffers import GREEDY_MODE, SlotParamStore, greedy_args  # noqa: F401
+from .params import GREEDY, SamplingParams  # noqa: F401
+
+__all__ = ["SamplingParams", "GREEDY", "GREEDY_MODE", "SlotParamStore",
+           "greedy_args"]

@@ -1,0 +1,180 @@
+"""Struct-of-arrays slot parameter buffers for the serving engine.
+
+`SlotParamStore` is the host-side owner of the per-slot sampling state:
+one numpy column per `SamplingParams` field, indexed by decode slot, a
+per-slot stop-token id set, and the [n_slots, V] token-count scatter
+buffer the penalty processors read. Admission scatters a request's
+params into its slot row (`set_slot`); slot release resets the row to
+greedy defaults (`clear_slot`) so the dispatch MODE flags — the static
+(any-sampled, any-penalties) pair that picks a compiled decode variant
+— always reflect the resident requests only.
+
+`step_args` / `packed_args` assemble the device argument dict one
+jitted dispatch consumes: always the stop-token matrix; plus the
+sampling columns when any resident request samples; plus the penalty
+columns and count buffer when any uses penalties. Param VALUES are
+traced — only the mode pair and the pow2-bucketed stop-matrix width
+select compiled variants, so the variant count is small and bounded.
+
+The count buffer round-trips functionally through the jitted decode
+(like the KV pool arrays): dispatches return the updated array and the
+server reinstalls it via `swap_counts`. Cost: n_slots * vocab * 4
+bytes (8 slots x GPT-2 vocab = ~1.6 MB) — only materialized once a
+penalty-using request is admitted.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .params import GREEDY, SamplingParams
+
+
+def _pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def greedy_args(rows):
+    """Minimal all-greedy argument dict for direct decoder calls (tests
+    and offline paths that want plain argmax with no stop ids)."""
+    import jax.numpy as jnp
+
+    return {"stop": jnp.full((int(rows), 1), -1, jnp.int32)}
+
+
+GREEDY_MODE = (False, False)
+
+
+class SlotParamStore:
+    """Per-slot sampling parameters as struct-of-arrays buffers."""
+
+    def __init__(self, n_slots, vocab_size):
+        self.n = int(n_slots)
+        self.V = int(vocab_size)
+        self._params: list[SamplingParams] = [GREEDY] * self.n
+        self._seeds = np.zeros((self.n,), np.uint32)
+        self._stop_ids: list[tuple] = [()] * self.n
+        self._counts = None  # device [n, V] int32, lazy
+
+    # ---- slot lifecycle ------------------------------------------------
+    def set_slot(self, i, params, seed, eos=-1, prompt_ids=None):
+        """Scatter one request's params into slot row i (admission /
+        refill). The server-level EOS id joins the request's stop ids in
+        the slot's stop set; `prompt_ids` seeds the penalty count row
+        when the request uses penalties."""
+        self._params[i] = params
+        self._seeds[i] = np.uint32(int(seed) & 0xFFFFFFFF)
+        ids = set(params.stop_token_ids)
+        if eos is not None and eos >= 0:
+            ids.add(int(eos))
+        self._stop_ids[i] = tuple(sorted(ids))
+        if params.uses_penalties and prompt_ids is not None:
+            self.reset_counts_row(i, prompt_ids)
+
+    def clear_slot(self, i):
+        self._params[i] = GREEDY
+        self._seeds[i] = 0
+        self._stop_ids[i] = ()
+
+    def params(self, i):
+        return self._params[i]
+
+    # ---- dispatch mode (static jit-variant selector) -------------------
+    def mode(self, rows=None):
+        ps = (self._params if rows is None
+              else [self._params[r] for r in rows])
+        return (any(not p.is_greedy for p in ps),
+                any(p.uses_penalties for p in ps))
+
+    # ---- count scatter buffer ------------------------------------------
+    @property
+    def counts(self):
+        import jax.numpy as jnp
+
+        if self._counts is None:
+            self._counts = jnp.zeros((self.n, self.V), jnp.int32)
+        return self._counts
+
+    def reset_counts_row(self, i, prompt_ids):
+        import jax.numpy as jnp
+
+        row = np.bincount(np.asarray(prompt_ids, np.int64).reshape(-1),
+                          minlength=self.V)[:self.V].astype(np.int32)
+        self._counts = self.counts.at[i].set(jnp.asarray(row))
+
+    def swap_counts(self, new):
+        """Reinstall the count buffer a dispatch returned (None when the
+        dispatch ran a no-penalty variant)."""
+        if new is not None:
+            self._counts = new
+
+    # ---- device argument assembly --------------------------------------
+    def _stop_matrix(self, rows):
+        w = _pow2(max([len(self._stop_ids[r]) for r in rows] + [1]))
+        m = np.full((len(rows), w), -1, np.int32)
+        for j, r in enumerate(rows):
+            ids = self._stop_ids[r]
+            m[j, :len(ids)] = ids
+        return m
+
+    def _assemble(self, rows, steps, mode):
+        import jax.numpy as jnp
+
+        sampled, penalties = mode
+        ps = [self._params[r] for r in rows]
+        sp = {"stop": jnp.asarray(self._stop_matrix(rows))}
+        if sampled:
+            temp = np.array([p.temperature for p in ps], np.float32)
+            sp["temperature"] = jnp.asarray(temp)
+            sp["sample"] = jnp.asarray(temp > 0.0)
+            sp["top_k"] = jnp.asarray(
+                np.array([p.top_k for p in ps], np.int32))
+            sp["top_p"] = jnp.asarray(
+                np.array([p.top_p for p in ps], np.float32))
+            sp["min_p"] = jnp.asarray(
+                np.array([p.min_p for p in ps], np.float32))
+            sp["seeds"] = jnp.asarray(self._seeds[list(rows)])
+            sp["steps"] = jnp.asarray(np.asarray(steps, np.int32))
+        if penalties:
+            sp["rep"] = jnp.asarray(
+                np.array([p.repetition_penalty for p in ps], np.float32))
+            sp["pres"] = jnp.asarray(
+                np.array([p.presence_penalty for p in ps], np.float32))
+            sp["freq"] = jnp.asarray(
+                np.array([p.frequency_penalty for p in ps], np.float32))
+            sp["counts"] = self.counts
+        return sp
+
+    def step_args(self, steps):
+        """Decode-dispatch arguments: one row per slot (row == slot).
+        `steps` [n_slots] int32 = tokens generated so far per slot (the
+        PRNG step counter). Returns (sp dict, mode)."""
+        rows = list(range(self.n))
+        mode = self.mode()
+        return self._assemble(rows, steps, mode), mode
+
+    def packed_args(self, slot_rows, done_mask):
+        """Packed-prefill arguments: compact plan rows. `slot_rows` maps
+        plan row -> slot index (None = padding row); `done_mask` marks
+        rows whose prompt completes this chunk (the only rows whose
+        token-0 sample is real). Token-0 sampling is PRNG step 0.
+        Returns (sp dict, mode)."""
+        import jax.numpy as jnp
+
+        real = [r for r in slot_rows if r is not None]
+        mode = self.mode(real)
+        rows = [r if r is not None else 0 for r in slot_rows]
+        valid = np.array([r is not None for r in slot_rows], bool)
+        sp = self._assemble(rows, np.zeros((len(rows),), np.int32), mode)
+        if not mode[0]:
+            sp.pop("sample", None)
+        else:
+            # padding rows must not sample (their seeds alias slot 0)
+            sp["sample"] = sp["sample"] & jnp.asarray(valid)
+        if mode[1]:
+            sp["crows"] = jnp.asarray(np.array(rows, np.int32))
+            sp["row_done"] = jnp.asarray(
+                np.asarray(done_mask, bool) & valid)
+        return sp, mode

@@ -1,0 +1,136 @@
+"""Vectorized logit processors + per-request PRNG streams.
+
+Every processor is a pure `([B, V] logits, per-slot param arrays) ->
+[B, V]` function, composed INSIDE the jitted decode step: the per-slot
+parameters live in struct-of-arrays device buffers (sampling/buffers.py)
+indexed by slot row, so one compiled dispatch serves a batch mixing
+greedy and arbitrarily-configured sampled requests — the same way block
+tables already let one dispatch serve ragged sequence lengths.
+
+Randomness is COUNTER-BASED per request: row r's draw at generation
+step s uses `fold_in(PRNGKey(seed_r), s)`. No stream ever advances
+because of another slot's activity, so (a) a slot refill cannot perturb
+or correlate a co-resident request's tokens, and (b) a fixed seed
+reproduces a request's sampled tokens bit-for-bit regardless of batch
+composition or slot placement (the batch-invariance bar of ISSUE 5).
+
+The all-greedy fast path (`sampled=False`) compiles to a bare argmax —
+zero sort/PRNG cost when no resident request samples. The flags are
+STATIC (they select a compiled variant); the parameter VALUES are
+traced, so new values never recompile.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.search import topk_impl
+
+_NEG_INF = jnp.float32(-jnp.inf)
+
+
+def fold_in_keys(seeds, steps):
+    """[R] uint32 request seeds + [R] int32 step counters -> [R] PRNG
+    keys. Counter-based: key(r, s) depends only on (seed_r, s)."""
+    def one(seed, step):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+    return jax.vmap(one)(seeds, steps)
+
+
+def apply_penalties(logits, counts, rep, pres, freq):
+    """HF-style repetition penalty + OpenAI-style presence/frequency
+    penalties, vectorized over slots. `counts` [R, V] int32 holds each
+    slot's token occurrence counts (prompt + generated — the scatter
+    buffer sampling/buffers.py maintains); rep/pres/freq are [R].
+    Defaults (rep=1, pres=freq=0) are numeric identities, so greedy
+    rows sharing the dispatch are unaffected."""
+    seen = counts > 0
+    rep = rep[:, None]
+    out = jnp.where(seen,
+                    jnp.where(logits > 0, logits / rep, logits * rep),
+                    logits)
+    cf = counts.astype(jnp.float32)
+    out = out - freq[:, None] * cf - pres[:, None] * seen.astype(
+        jnp.float32)
+    return out
+
+
+def filter_logits(scaled, top_k, top_p, min_p):
+    """Compose the top-k / top-p / min-p filters from ONE descending
+    sort (ops.search.topk_impl with k = V — the shared implementation).
+
+    Per-row semantics (0 / 1.0 / 0.0 disable a filter for that row):
+      * top_k keeps the k highest logits;
+      * top_p keeps the smallest prefix of the top-k-FILTERED,
+        renormalized distribution whose exclusive cumulative probability
+        stays under top_p (the best token always survives) — matching
+        the dense-path nucleus semantics in models/gpt2.py;
+      * min_p drops tokens whose probability in that filtered
+        distribution is below min_p * max-probability.
+    Ties at a threshold value are kept (standard top-k tie behavior)."""
+    R, V = scaled.shape
+    sorted_desc, _ = topk_impl(scaled, V)                   # [R, V]
+    pos = jnp.arange(V)[None, :]
+    k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)  # [R]
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    keep = scaled >= kth
+    # the top-k-filtered distribution IS the sorted array with ranks
+    # >= k masked (filtering the k largest preserves descending order)
+    sorted_f = jnp.where(pos < k_eff[:, None], sorted_desc, _NEG_INF)
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs                # exclusive
+    n_keep = jnp.maximum(
+        jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True), 1)
+    # top_p = 1.0 means OFF exactly (float round-off in cum must not
+    # clip genuinely reachable tail tokens)
+    n_keep = jnp.where(top_p[:, None] >= 1.0, V, n_keep)
+    kth_p = jnp.take_along_axis(sorted_f, n_keep - 1, axis=-1)
+    keep &= scaled >= kth_p
+    logz = jax.nn.logsumexp(sorted_f, axis=-1, keepdims=True)
+    p_tok = jnp.exp(scaled - logz)                          # [R, V]
+    keep &= p_tok >= min_p[:, None] * probs[:, :1]
+    return jnp.where(keep, scaled, _NEG_INF)
+
+
+def sample_tokens(logits, sp, *, sampled, penalties):
+    """The composed per-slot sampling pipeline (one dispatch, mixed
+    configs). logits [R, V] float32; sp is the struct-of-arrays buffer
+    dict (sampling/buffers.py). `sampled` / `penalties` are STATIC
+    variant flags. Returns [R] int32 tokens.
+
+    Greedy rows take `argmax(logits)` — bitwise identical to the
+    pre-sampling-subsystem greedy path when the penalty buffers are
+    inactive (and numerically identical when they are, since default
+    penalties are identities)."""
+    if penalties:
+        counts = sp["counts"]
+        if "crows" in sp:  # packed prefill: gather compact plan rows
+            counts = counts[sp["crows"]]
+        logits = apply_penalties(logits, counts, sp["rep"], sp["pres"],
+                                 sp["freq"])
+    tok_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not sampled:
+        return tok_greedy
+    scaled = logits / jnp.maximum(sp["temperature"], 1e-6)[:, None]
+    filt = filter_logits(scaled, sp["top_k"], sp["top_p"], sp["min_p"])
+    keys = fold_in_keys(sp["seeds"], sp["steps"])
+    gum = jax.vmap(
+        lambda k: jax.random.gumbel(k, filt.shape[-1:], jnp.float32))(keys)
+    tok_s = jnp.argmax(filt + gum, axis=-1).astype(jnp.int32)
+    return jnp.where(sp["sample"], tok_s, tok_greedy)
+
+
+def update_counts(counts, rows, tok, inc):
+    """Scatter-add the freshly emitted tokens into the [S, V] count
+    buffer: counts[rows[r], tok[r]] += inc[r]. `inc` masks rows that
+    did not really emit (idle decode slots, packing-pad prefill rows,
+    plan rows whose prompt is still feeding)."""
+    return counts.at[rows, tok].add(inc.astype(jnp.int32))
+
+
+def check_stops(tok, stop_matrix, active):
+    """Device-side stop-token check: [R] tokens against the per-slot
+    [R, W] stop-id matrix (-1-padded; generated ids are >= 0, so pad
+    never matches). Returns [R] bool."""
+    return active & (tok[:, None] == stop_matrix).any(axis=-1)

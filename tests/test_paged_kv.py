@@ -209,20 +209,21 @@ class TestPagedDenseParity:
         for b in range(2):
             cache.allocate(b, int(lens[b]) + 2)
         tables = jnp.asarray(cache.table_array([0, 1], m))
+        from paddle_tpu.sampling import greedy_args
+
         dec = PagedDecoder.for_config(cfg, bs, return_logits=True)
-        key = jax.random.key(0)
-        tok, kc, vc, logits0 = dec.prefill(
+        tok, _stop, kc, vc, _cnt, logits0 = dec.prefill(
             params, jnp.asarray(ids), jnp.asarray(lens), tables,
-            cache.k_blocks, cache.v_blocks, key, jnp.float32(0.0))
+            cache.k_blocks, cache.v_blocks, greedy_args(2))
         # dense reference: full forward on each row's true prompt
         for b in range(2):
             ref = model(ids[b:b + 1, :lens[b]]).numpy()[0, -1]
             np.testing.assert_allclose(np.asarray(logits0)[b], ref,
                                        atol=1e-4, rtol=1e-4)
         # one decode step: logits must match forward on prompt + tok0
-        nxt, kc, vc, logits1 = dec.step(
+        nxt, _stop, kc, vc, _cnt, logits1 = dec.step(
             params, tok, jnp.asarray(lens), jnp.ones((2,), bool), tables,
-            kc, vc, key, jnp.float32(0.0))
+            kc, vc, greedy_args(2))
         tok = np.asarray(tok)
         for b in range(2):
             full = np.concatenate([ids[b, :lens[b]], tok[b:b + 1]])
@@ -265,8 +266,11 @@ class TestPagedDenseParity:
     def test_paged_rejects_unsupported_knobs(self, tiny_model):
         model, cfg = tiny_model
         ids = np.ones((1, 4), np.int32)
-        with pytest.raises(ValueError):
-            model.generate(ids, 2, kv_cache="paged", top_k=5)
+        # top_k/top_p are SUPPORTED on the paged path since round 10
+        # (per-slot sampling pipeline); kv_quant still is not
+        out = model.generate(ids, 2, kv_cache="paged", top_k=5,
+                             temperature=0.5, seed=1).numpy()
+        assert out.shape == (1, 6)
         with pytest.raises(ValueError):
             model.generate(ids, 2, kv_cache="paged", kv_quant="int8")
         with pytest.raises(ValueError):

@@ -352,8 +352,9 @@ class TestCachedPrefillLogitParity:
     def _packed(self, dec, cache, params, seq, toks, start):
         """Run one packed_prefill chunk feeding toks[start:] of `seq`
         (mirrors the server: ensure -> prepare_write -> dispatch)."""
-        import jax
         import jax.numpy as jnp
+
+        from paddle_tpu.sampling import greedy_args
 
         n = toks.size - start
         T = 8
@@ -368,11 +369,10 @@ class TestCachedPrefillLogitParity:
         pos[:n] = np.arange(start, toks.size, dtype=np.int32)
         tables = jnp.asarray(cache.table_array(
             [seq], blocks_for(toks.size, cache.block_size)))
-        tok, kc, vc, logits = dec.packed_prefill(
+        tok, _stop, kc, vc, _cnt, logits = dec.packed_prefill(
             params, jnp.asarray(stream), jnp.asarray(seg),
             jnp.asarray(pos), tables, jnp.asarray([n - 1]),
-            cache.k_blocks, cache.v_blocks, jax.random.key(0),
-            jnp.float32(0.0))
+            cache.k_blocks, cache.v_blocks, greedy_args(1))
         cache.swap_arrays(kc, vc)
         return int(np.asarray(tok)[0]), np.asarray(logits)[0]
 

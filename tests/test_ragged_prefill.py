@@ -147,10 +147,11 @@ class TestPackedPrefillProgram:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = prompt
         tables = jnp.asarray(cache.table_array([0], 8))
-        tok, kc, vc, logits = dec.prefill(
+        from paddle_tpu.sampling import greedy_args
+
+        tok, _stop, kc, vc, _cnt, logits = dec.prefill(
             params, jnp.asarray(ids), jnp.asarray([n]), tables,
-            cache.k_blocks, cache.v_blocks, jax.random.key(0),
-            jnp.float32(0.0))
+            cache.k_blocks, cache.v_blocks, greedy_args(1))
         return int(np.asarray(tok)[0]), np.asarray(logits)[0]
 
     def test_packed_matches_sequential_prefill(self, tiny_model):
@@ -178,11 +179,12 @@ class TestPackedPrefillProgram:
         pos[align:align + 9] = np.arange(9)
         sample_idx = np.array([4, align + 8], np.int32)
         tables = jnp.asarray(cache.table_array([0, 1], 8))
-        tok, kc, vc, logits = dec.packed_prefill(
+        from paddle_tpu.sampling import greedy_args
+
+        tok, _stop, kc, vc, _cnt, logits = dec.packed_prefill(
             params, jnp.asarray(toks), jnp.asarray(seg),
             jnp.asarray(pos), tables, jnp.asarray(sample_idx),
-            cache.k_blocks, cache.v_blocks, jax.random.key(0),
-            jnp.float32(0.0))
+            cache.k_blocks, cache.v_blocks, greedy_args(2))
         tok = np.asarray(tok)
         logits = np.asarray(logits)
         for row, prompt in enumerate(prompts):
@@ -216,11 +218,12 @@ class TestPackedPrefillProgram:
             pos[:n] = np.arange(start, start + n)
             sample_idx = np.array([n - 1], np.int32)
             tables = jnp.asarray(cache.table_array([0], 8))
-            tok, kc, vc, logits = dec.packed_prefill(
+            from paddle_tpu.sampling import greedy_args
+
+            tok, _stop, kc, vc, _cnt, logits = dec.packed_prefill(
                 params, jnp.asarray(toks), jnp.asarray(seg),
                 jnp.asarray(pos), tables, jnp.asarray(sample_idx),
-                cache.k_blocks, cache.v_blocks, jax.random.key(0),
-                jnp.float32(0.0))
+                cache.k_blocks, cache.v_blocks, greedy_args(1))
             cache.swap_arrays(kc, vc)
         ref_tok, ref_logits = self._ref_prefill(model, dec, cfg, prompt)
         assert int(np.asarray(tok)[0]) == ref_tok

@@ -1,0 +1,561 @@
+"""Per-request sampling subsystem (ISSUE 5).
+
+Covers: eager SamplingParams validation (errors name field + value at
+submit time, not jit time), the fixed ops.search.topk duplicate/
+negation semantics shared with the top-k processor, greedy bitwise
+parity with the pre-sampling path, ONE jitted dispatch serving a
+mixed greedy/sampled batch, fixed-seed batch-composition invariance
+(counter-based per-request PRNG streams), prefix-cache ON/OFF parity
+under sampling, device stop-token and host stop-string handling, the
+penalty pipeline, and the dense/paged stats schema congruence."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+from paddle_tpu.sampling import GREEDY, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(11)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    return model, cfg
+
+
+class TestSamplingParamsValidation:
+    """Satellite: a bad value fails EAGERLY, naming field and value —
+    today's alternative is a jit-time shape/NaN failure minutes later."""
+
+    @pytest.mark.parametrize("kw,field", [
+        (dict(temperature=float("nan")), "temperature"),
+        (dict(temperature=-0.5), "temperature"),
+        (dict(temperature=float("inf")), "temperature"),
+        (dict(top_p=0.0), "top_p"),
+        (dict(top_p=1.5), "top_p"),
+        (dict(top_p=float("nan")), "top_p"),
+        (dict(top_k=-1), "top_k"),
+        (dict(top_k=2.5), "top_k"),
+        (dict(min_p=1.0), "min_p"),
+        (dict(min_p=-0.1), "min_p"),
+        (dict(repetition_penalty=0.0), "repetition_penalty"),
+        (dict(repetition_penalty=float("nan")), "repetition_penalty"),
+        (dict(presence_penalty=float("inf")), "presence_penalty"),
+        (dict(frequency_penalty=float("nan")), "frequency_penalty"),
+        (dict(stop_strings=("",)), "stop_strings"),
+        (dict(stop_strings=("ok", "")), "stop_strings"),
+        (dict(stop_token_ids=(-3,)), "stop_token_ids"),
+        (dict(max_new_tokens=0), "max_new_tokens"),
+        (dict(seed="zebra"), "seed"),
+    ])
+    def test_bad_value_names_field(self, kw, field):
+        with pytest.raises(ValueError) as ei:
+            SamplingParams(**kw)
+        msg = str(ei.value)
+        assert field in msg
+        # the offending value is in the message too
+        val = next(iter(kw.values()))
+        probe = (val[-1] if isinstance(val, tuple) else val)
+        assert repr(probe) in msg or str(probe) in msg
+
+    def test_defaults_are_greedy(self):
+        p = SamplingParams()
+        assert p.is_greedy and not p.uses_penalties
+        assert GREEDY.is_greedy
+
+    def test_flags(self):
+        assert not SamplingParams(temperature=0.5).is_greedy
+        assert SamplingParams(presence_penalty=0.1).uses_penalties
+        assert SamplingParams(repetition_penalty=1.2).uses_penalties
+        assert not SamplingParams(top_k=5).uses_penalties
+
+    def test_submit_type_error(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8, max_new_tokens=4)
+        with pytest.raises(TypeError):
+            srv.submit([1, 2], sampling={"temperature": 1.0})
+
+    def test_stop_strings_need_detokenizer(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8, max_new_tokens=4)
+        with pytest.raises(ValueError, match="detokeniz"):
+            srv.submit([1, 2], sampling=SamplingParams(
+                stop_strings=("x",)))
+
+
+class TestTopkOp:
+    """Satellite: ops.search.topk's smallest-k path no longer negates —
+    values are gathered at the returned indices (consistent for
+    duplicates), ties prefer the lower index in both directions, and
+    unsigned/INT_MIN inputs rank correctly."""
+
+    def test_values_consistent_with_indices_duplicates(self):
+        from paddle_tpu import ops
+
+        x = np.array([2.0, 1.0, 2.0, 1.0, 3.0], np.float32)
+        for largest in (True, False):
+            vals, idx = ops.topk(paddle.to_tensor(x), 3, largest=largest)
+            vals, idx = vals.numpy(), idx.numpy()
+            np.testing.assert_array_equal(vals, x[idx])
+        vals, idx = ops.topk(paddle.to_tensor(x), 3, largest=False)
+        np.testing.assert_array_equal(vals.numpy(), [1.0, 1.0, 2.0])
+        np.testing.assert_array_equal(idx.numpy(), [1, 3, 0])  # stable
+
+    def test_unsigned_smallest(self):
+        from paddle_tpu import ops
+
+        x = np.array([3, 0, 2, 7], np.uint32)
+        vals, idx = ops.topk(paddle.to_tensor(x), 2, largest=False)
+        np.testing.assert_array_equal(vals.numpy(), [0, 2])
+        np.testing.assert_array_equal(idx.numpy(), [1, 2])
+
+    def test_int_min_smallest(self):
+        from paddle_tpu import ops
+
+        lo = np.iinfo(np.int32).min
+        x = np.array([5, lo, -1], np.int32)
+        vals, idx = ops.topk(paddle.to_tensor(x), 2, largest=False)
+        np.testing.assert_array_equal(vals.numpy(), [lo, -1])
+        np.testing.assert_array_equal(idx.numpy(), [1, 2])
+
+    def test_processor_uses_shared_impl(self):
+        """The top-k logit processor's descending sort IS
+        ops.search.topk_impl (one implementation): per-row dynamic k
+        against a numpy reference."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.sampling.processors import filter_logits
+
+        rs = np.random.RandomState(0)
+        logits = rs.randn(3, 16).astype(np.float32)
+        top_k = np.array([4, 0, 1], np.int32)   # 0 = off
+        out = np.asarray(filter_logits(
+            jnp.asarray(logits), jnp.asarray(top_k),
+            jnp.asarray(np.ones(3, np.float32)),
+            jnp.asarray(np.zeros(3, np.float32))))
+        for r in range(3):
+            k = int(top_k[r]) or 16
+            kth = np.sort(logits[r])[::-1][k - 1]
+            keep = logits[r] >= kth
+            assert np.isfinite(out[r][keep]).all()
+            assert np.isneginf(out[r][~keep]).all()
+
+
+class TestGreedyBitwiseParity:
+    """Acceptance bar: temperature=0 output is bitwise equal to the
+    pre-PR greedy path on dense AND paged decode."""
+
+    def test_offline_paged_matches_dense_greedy(self, tiny_model):
+        model, cfg = tiny_model
+        rs = np.random.RandomState(1)
+        ids = rs.randint(1, cfg.vocab_size, (2, 9)).astype(np.int32)
+        ref = model.generate(ids, 6).numpy()
+        out = model.generate(ids, 6, kv_cache="paged",
+                             block_size=4).numpy()
+        np.testing.assert_array_equal(out, ref)
+        # explicit SamplingParams(temperature=0) — same path
+        out2 = model.generate(ids, 6, kv_cache="paged", block_size=4,
+                              sampling=SamplingParams()).numpy()
+        np.testing.assert_array_equal(out2, ref)
+
+    def test_served_greedy_matches_solo_generate(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(2)
+        prompts = [rs.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (3, 7, 5)]
+        srv = PagedGenerationServer(model, max_slots=2, block_size=4,
+                                    max_prompt_len=8,
+                                    max_new_tokens=5).start()
+        try:
+            futs = [srv.submit(p, sampling=SamplingParams())
+                    for p in prompts]
+            for p, f in zip(prompts, futs):
+                ref = model.generate(p[None], 5).numpy()[0]
+                np.testing.assert_array_equal(f.result(timeout=300), ref)
+            st = srv.stats()
+            # all-greedy traffic rides the fast path exclusively
+            assert st["sampling_fast_path_dispatches"] > 0
+            assert st["sampling_sampled_dispatches"] == 0
+        finally:
+            srv.stop()
+
+
+class TestMixedBatchOneDispatch:
+    def test_one_dispatch_serves_greedy_and_sampled(self, tiny_model):
+        """Acceptance bar: a batch mixing greedy and sampled slots is
+        served by ONE jitted decode dispatch per step — not one per
+        sampling configuration."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(3)
+        greedy_p = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+        sampled_p = rs.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+        srv = PagedGenerationServer(model, max_slots=2, block_size=4,
+                                    max_prompt_len=8, max_new_tokens=4)
+        calls = {"step": 0, "prefill": 0}
+        real_step = srv._decoder.step
+        real_packed = srv._decoder.packed_prefill
+
+        def counting_step(*a, **kw):
+            calls["step"] += 1
+            return real_step(*a, **kw)
+
+        def counting_packed(*a, **kw):
+            calls["prefill"] += 1
+            return real_packed(*a, **kw)
+
+        srv._decoder.step = counting_step
+        srv._decoder.packed_prefill = counting_packed
+        f1 = srv.submit(greedy_p)  # burst BEFORE start: admitted together
+        f2 = srv.submit(sampled_p, sampling=SamplingParams(
+            temperature=1.0, top_p=0.9, seed=17))
+        srv.start()
+        try:
+            out_greedy = f1.result(timeout=300)
+            out_sampled = f2.result(timeout=300)
+            # the greedy slot is EXACT despite the sampled co-resident
+            ref = model.generate(greedy_p[None], 4).numpy()[0]
+            np.testing.assert_array_equal(out_greedy, ref)
+            assert out_sampled.size == sampled_p.size + 4
+            # budget 4 = 1 prefill-sampled token + 3 decode steps; both
+            # slots decode in lockstep, so 3 shared dispatches total
+            assert calls["prefill"] == 1
+            assert calls["step"] == 3
+            st = srv.stats()
+            assert st["sampling_sampled_dispatches"] == 3
+            assert st["sampling_fast_path_dispatches"] == 0
+        finally:
+            srv.stop()
+
+
+class TestSeededStreams:
+    """Acceptance bar: fixed-seed sampled output is invariant to batch
+    composition and slot placement (counter-based fold_in streams)."""
+
+    def _serve(self, model, submits, **kw):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        srv = PagedGenerationServer(model, **kw)
+        futs = [srv.submit(p, sampling=s) for p, s in submits]
+        srv.start()
+        try:
+            return [f.result(timeout=300) for f in futs]
+        finally:
+            srv.stop()
+
+    def test_fixed_seed_invariant_to_composition_and_slot(self,
+                                                          tiny_model):
+        model, cfg = tiny_model
+        rs = np.random.RandomState(4)
+        target = rs.randint(1, cfg.vocab_size, (6,)).astype(np.int32)
+        others = [rs.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+                  for n in (3, 8, 5)]
+        sp = SamplingParams(temperature=1.0, top_p=0.95, seed=123)
+        kw = dict(max_slots=4, block_size=4, max_prompt_len=8,
+                  max_new_tokens=5)
+        alone = self._serve(model, [(target, sp)], **kw)[0]
+        # same request packed with greedy co-residents, different slot
+        # (submitted last -> highest slot index)
+        packed = self._serve(
+            model, [(o, None) for o in others] + [(target, sp)],
+            **kw)[-1]
+        np.testing.assert_array_equal(alone, packed)
+        # and submitted FIRST (slot 0), with sampled co-residents
+        sp2 = SamplingParams(temperature=1.3, seed=77)
+        first = self._serve(
+            model, [(target, sp)] + [(o, sp2) for o in others],
+            **kw)[0]
+        np.testing.assert_array_equal(alone, first)
+
+    def test_fixed_seed_reproducible_across_servers(self, tiny_model):
+        model, cfg = tiny_model
+        rs = np.random.RandomState(5)
+        p = rs.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+        sp = SamplingParams(temperature=0.9, top_k=8, seed=99)
+        kw = dict(max_slots=2, block_size=4, max_prompt_len=8,
+                  max_new_tokens=6)
+        a = self._serve(model, [(p, sp)], **kw)[0]
+        b = self._serve(model, [(p, sp)], **kw)[0]
+        np.testing.assert_array_equal(a, b)
+
+    def test_auto_seeds_give_distinct_streams(self, tiny_model):
+        """Two identical sampled requests WITHOUT explicit seeds must
+        not mirror each other's tokens (auto-derived per-request
+        streams)."""
+        model, cfg = tiny_model
+        rs = np.random.RandomState(6)
+        p = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+        sp = SamplingParams(temperature=2.0)
+        outs = self._serve(model, [(p, sp), (p, sp)], max_slots=2,
+                           block_size=4, max_prompt_len=8,
+                           max_new_tokens=8)
+        assert not np.array_equal(outs[0], outs[1])
+
+    def test_multistep_matches_single_step_sampled(self, tiny_model):
+        """The fused k-step scan advances each stream by scan index, so
+        multi-step scheduling reproduces k=1 token-for-token even for
+        sampled requests."""
+        model, cfg = tiny_model
+        rs = np.random.RandomState(7)
+        prompts = [rs.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (3, 6)]
+        sps = [SamplingParams(temperature=1.0, seed=31),
+               SamplingParams(temperature=0.8, top_p=0.9, seed=32)]
+        outs = {}
+        for k in (1, 3):
+            outs[k] = self._serve(
+                model, list(zip(prompts, sps)), max_slots=2,
+                block_size=4, max_prompt_len=8, max_new_tokens=6,
+                steps_per_dispatch=k)
+        for a, b in zip(outs[1], outs[3]):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestPrefixCacheSamplingParity:
+    def test_cache_on_off_same_tokens_fixed_seed(self, tiny_model):
+        """Acceptance bar: prefix-cache-ON vs OFF parity holds under
+        sampling with a fixed seed (the attach changes WHERE prompt K/V
+        comes from, never the sampled stream)."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(8)
+        prefix = rs.randint(1, cfg.vocab_size, (10,)).astype(np.int32)
+        tails = [rs.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+                 for n in (3, 5)]
+        prompts = [np.concatenate([prefix, t]) for t in tails]
+        sp = SamplingParams(temperature=1.1, top_p=0.9, seed=5150)
+        outs = {}
+        for on in (False, True):
+            srv = PagedGenerationServer(
+                model, max_slots=2, block_size=4, max_prompt_len=16,
+                max_new_tokens=5, enable_prefix_cache=on).start()
+            try:
+                # sequential: the second prompt attaches the published
+                # prefix of the first when caching is on
+                outs[on] = [srv.submit(p, sampling=sp).result(timeout=300)
+                            for p in prompts]
+                if on:
+                    assert srv.cache.stats()["prefix_cache"]["hits"] >= 1
+            finally:
+                srv.stop()
+        for a, b in zip(outs[False], outs[True]):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestStopHandling:
+    def test_stop_token_ids_stop_on_device(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(9)
+        p = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+        first = int(model.generate(p[None], 1).numpy()[0, -1])
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8,
+                                    max_new_tokens=5).start()
+        try:
+            out = srv.submit(p, sampling=SamplingParams(
+                stop_token_ids=(first,))).result(timeout=300)
+            # stopped on the FIRST generated token, which is kept
+            assert out.size == p.size + 1
+            assert out[-1] == first
+            st = srv.stats()
+            assert st["stop_reasons"]["stop_token"] == 1
+            assert st["stop_reasons"]["budget"] == 0
+        finally:
+            srv.stop()
+
+    def test_stop_strings_host_side(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(10)
+        p = rs.randint(1, cfg.vocab_size, (3,)).astype(np.int32)
+
+        def detok(toks):
+            return "".join(f"<{t}>" for t in toks)
+
+        ref = model.generate(p[None], 6).numpy()[0]
+        gen = ref[p.size:]
+        # a two-token stop string completes exactly when the second
+        # generated token lands
+        target = f"<{int(gen[0])}><{int(gen[1])}>"
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8, max_new_tokens=6,
+                                    detokenize=detok).start()
+        try:
+            out = srv.submit(p, sampling=SamplingParams(
+                stop_strings=(target,))).result(timeout=300)
+            assert out.size == p.size + 2
+            np.testing.assert_array_equal(out, ref[:p.size + 2])
+            assert srv.stats()["stop_reasons"]["stop_string"] == 1
+        finally:
+            srv.stop()
+
+    def test_per_request_budget_from_params(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(11)
+        p = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8,
+                                    max_new_tokens=6).start()
+        try:
+            out = srv.submit(p, sampling=SamplingParams(
+                max_new_tokens=2)).result(timeout=300)
+            assert out.size == p.size + 2
+            # the explicit submit arg wins over the params field
+            out2 = srv.submit(p, max_new_tokens=3,
+                              sampling=SamplingParams(
+                                  max_new_tokens=2)).result(timeout=300)
+            assert out2.size == p.size + 3
+            with pytest.raises(ValueError):
+                srv.submit(p, sampling=SamplingParams(
+                    max_new_tokens=99))
+        finally:
+            srv.stop()
+
+
+class TestPenalties:
+    def test_presence_penalty_prevents_repeats(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(12)
+        p = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8,
+                                    max_new_tokens=8).start()
+        try:
+            out = srv.submit(p, sampling=SamplingParams(
+                presence_penalty=1e9)).result(timeout=300)
+            gen = out[p.size:].tolist()
+            # a huge presence penalty forbids every seen token: all
+            # generated tokens distinct and absent from the prompt
+            assert len(set(gen)) == len(gen)
+            assert not set(gen) & set(p.tolist())
+        finally:
+            srv.stop()
+
+    def test_penalty_counts_reset_on_slot_refill(self, tiny_model):
+        """A slot reused by a second penalty request must not inherit
+        the first request's token counts."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(13)
+        p = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+        sp = SamplingParams(repetition_penalty=1.5)
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8,
+                                    max_new_tokens=4).start()
+        try:
+            a = srv.submit(p, sampling=sp).result(timeout=300)
+            b = srv.submit(p, sampling=sp).result(timeout=300)
+            np.testing.assert_array_equal(a, b)
+        finally:
+            srv.stop()
+
+    def test_offline_generate_penalties(self, tiny_model):
+        model, cfg = tiny_model
+        rs = np.random.RandomState(14)
+        ids = rs.randint(1, cfg.vocab_size, (1, 5)).astype(np.int32)
+        out = model.generate(ids, 6, kv_cache="paged", block_size=4,
+                             sampling=SamplingParams(
+                                 presence_penalty=1e9)).numpy()[0]
+        gen = out[5:].tolist()
+        assert len(set(gen)) == len(gen)
+        assert not set(gen) & set(ids[0].tolist())
+
+
+class TestDenseServerSampling:
+    def _server(self, model, batch_size=2, prompt_len=8, new=3):
+        from paddle_tpu.inference import GenerationServer
+
+        def prog(ids, seed, temp, eos, top_p, pad):
+            return model.generate(
+                ids, new, temperature=float(temp), seed=int(seed),
+                eos_token_id=None if int(eos) < 0 else int(eos),
+                top_p=float(top_p),
+                pad_token_id=None if int(pad) < 0 else int(pad)).numpy()
+
+        return GenerationServer(prog, batch_size=batch_size,
+                                prompt_len=prompt_len, pad_token_id=0)
+
+    def test_accepts_program_level_subset(self, tiny_model):
+        model, cfg = tiny_model
+        rs = np.random.RandomState(15)
+        p = rs.randint(1, cfg.vocab_size, (8,)).astype(np.int32)
+        srv = self._server(model).start()
+        try:
+            sp = SamplingParams(temperature=0.8, top_p=0.9, seed=4)
+            a = srv.submit(p, sampling=sp).result(timeout=300)
+            b = srv.submit(p, sampling=sp).result(timeout=300)
+            # explicit seed -> reproducible across batches
+            np.testing.assert_array_equal(a, b)
+        finally:
+            srv.stop()
+
+    def test_rejects_per_slot_fields_eagerly(self, tiny_model):
+        model, cfg = tiny_model
+        srv = self._server(model)
+        for kw, field in [(dict(top_k=5), "top_k"),
+                          (dict(min_p=0.2), "min_p"),
+                          (dict(repetition_penalty=1.2),
+                           "repetition_penalty"),
+                          (dict(stop_strings=("x",)), "stop_strings"),
+                          (dict(max_new_tokens=2), "max_new_tokens"),
+                          (dict(stop_token_ids=(1, 2)), "stop")]:
+            with pytest.raises(ValueError) as ei:
+                srv.submit([1, 2, 3], sampling=SamplingParams(**kw))
+            assert field in str(ei.value)
+
+    def test_mixed_signatures_batch_separately_and_stats_congruent(
+            self, tiny_model):
+        """Satellite: GenerationServer.stats() carries the same
+        stop-reason breakdown schema as the paged server; mismatched
+        sampling signatures never share a program dispatch."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(16)
+        p1 = rs.randint(1, cfg.vocab_size, (8,)).astype(np.int32)
+        p2 = rs.randint(1, cfg.vocab_size, (8,)).astype(np.int32)
+        srv = self._server(model).start()
+        try:
+            f1 = srv.submit(p1)
+            f2 = srv.submit(p2, sampling=SamplingParams(
+                temperature=1.0, seed=8))
+            g = f1.result(timeout=300)
+            f2.result(timeout=300)
+            ref = model.generate(p1[None], 3).numpy()[0]
+            np.testing.assert_array_equal(g, ref)  # greedy row unpolluted
+            st = srv.stats()
+            assert st["batches"] == 2  # signatures cannot share a batch
+            dense_reasons = st["stop_reasons"]
+        finally:
+            srv.stop()
+        psrv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                     max_prompt_len=8, max_new_tokens=3)
+        paged_reasons = psrv.stats()["stop_reasons"]
+        assert set(dense_reasons) == set(paged_reasons)
+        assert sum(dense_reasons.values()) == 2
+        # reset clears the breakdown on both servers
+        srv.reset_stats()
+        psrv.reset_stats()
+        assert sum(srv.stats()["stop_reasons"].values()) == 0
+        assert sum(psrv.stats()["stop_reasons"].values()) == 0

@@ -317,8 +317,9 @@ def test_served_bench_axis_emits_records():
     caching axis) must emit all four JSON records; slow-marked so
     tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 4, stdout
+    assert len(recs) == 5, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("mixedsampling" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
     assert any("sharedprefix" in rec["metric"] for rec in recs)
     for rec in recs:
@@ -333,16 +334,27 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=420)
-    assert len(recs) == 3, stdout
+    assert len(recs) == 4, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
-                 and "sharedprefix" not in r["metric"])
+                 and "sharedprefix" not in r["metric"]
+                 and "mixedsampling" not in r["metric"])
+    mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
     sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
-    for rec in (paged, open_rec, sp_rec):
+    for rec in (paged, mix_rec, open_rec, sp_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
         assert "itl_p99_ms" in rec
+    # mixed-sampling axis (round 10): fixed-seed 50/50 workload whose
+    # record carries the pipeline-overhead fields
+    for fld in ("sampling_overhead_pct", "sampled_fraction",
+                "sampled_dispatches", "fast_path_dispatches",
+                "stop_reasons"):
+        assert fld in mix_rec, mix_rec
+    assert mix_rec["sampled_fraction"] == 0.5
+    assert mix_rec["sampled_dispatches"] >= 1
+    assert sum(mix_rec["stop_reasons"].values()) > 0
     # open-loop axis: fixed-seed Poisson arrival accounting
     for fld in ("offered_rps", "achieved_rps", "ttft_p99_ms",
                 "itl_p50_ms", "prefills"):
