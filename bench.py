@@ -693,6 +693,21 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     finally:
         psrv.stop()
 
+    # (f) SPECULATION axis (round 11): a repetitive/agentic traffic
+    # mix — prompts whose greedy continuations the self-drafting
+    # n-gram drafter can actually predict — drained closed-loop on a
+    # plain server and on a speculation-enabled server (same config,
+    # steps_per_dispatch=1 both). The record's vs_baseline is the
+    # served tok/s ratio; it also carries the acceptance accounting
+    # and the ORACLE ceiling (a replay drafter with acceptance 1.0 —
+    # the verification engine's amortization limit, independent of
+    # drafter quality). Off TPU this axis runs on the tiny config:
+    # speculation amortizes the per-dispatch floor (the chip's decode
+    # regime — decode is bandwidth/dispatch-bound, PERF.md), and the
+    # compute-bound hs256 CPU proxy would measure XLA matmul width
+    # instead of the dispatch amortization it exists to show.
+    st_spec = _bench_served_speculation(model, cfg, on_tpu, tiny)
+
     base = "gpt2tiny_served" if tiny else "gpt2s_served"
     suffix = "" if on_tpu else "_CPU_DEGRADED"
     rec_paged = {
@@ -775,6 +790,40 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         "shared_prefix_len": sp_len,
         "offered_rps": round(st_sp_on["offered_rps"], 3),
     }
+    sp_plain, sp_on, sp_orc = (st_spec["plain"], st_spec["spec"],
+                               st_spec["oracle"])
+    spec_stats = sp_on["speculation"]
+    rec_spec = {
+        "metric": f"{base}_speculative_tokens_per_sec{suffix}",
+        "value": round(sp_on["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        # the headline of the axis: served tok/s with the self-drafting
+        # n-gram drafter vs plain decode on the same repetitive mix
+        "vs_baseline": round(sp_on["tokens_per_sec"]
+                             / max(sp_plain["tokens_per_sec"], 1e-9), 3),
+        "baseline": "same repetitive mix + server config, "
+                    "speculation off",
+        "tokens_per_sec_plain": round(sp_plain["tokens_per_sec"], 1),
+        "acceptance_rate": round(spec_stats["acceptance_rate"], 4),
+        "proposed_tokens": spec_stats["proposed_tokens"],
+        "accepted_tokens": spec_stats["accepted_tokens"],
+        "rolled_back_tokens": spec_stats["rolled_back_tokens"],
+        "verify_dispatches": spec_stats["verify_dispatches"],
+        "decode_steps": sp_on["decode_steps"],
+        "decode_steps_plain": sp_plain["decode_steps"],
+        "max_draft_tokens": st_spec["K"],
+        # acceptance-1.0 ceiling (replay oracle): what the packed
+        # verification engine delivers when every draft is right —
+        # separates engine amortization from drafter quality
+        "tok_s_ratio_oracle": round(
+            sp_orc["tokens_per_sec"]
+            / max(sp_plain["tokens_per_sec"], 1e-9), 3),
+        "acceptance_rate_oracle": round(
+            sp_orc["speculation"]["acceptance_rate"], 4),
+        "p99_ms": round(sp_on["p99_ms"], 1),
+        "itl_p99_ms": round(sp_on["itl_p99_ms"], 2),
+        "prefill_dispatches": sp_on["prefill_dispatches"],
+    }
     if st_pad is not None:
         rec_pad = {
             "metric": f"{base}_mixed_padded_tokens_per_sec{suffix}",
@@ -789,11 +838,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
             / max(st_pad["tokens_per_sec"], 1e-9), 3)
         rec_paged["baseline"] = \
             "padded static-batch GenerationServer, same traffic"
-        records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp]
+        records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp,
+                   rec_spec]
     else:
         rec_paged["vs_baseline"] = 1.0
         rec_paged["baseline"] = "self (tiny schema smoke)"
-        records = [rec_paged, rec_mix, rec_open, rec_sp]
+        records = [rec_paged, rec_mix, rec_open, rec_sp, rec_spec]
     if rec_tel is not None:
         records.append(rec_tel)
     if not on_tpu:
@@ -833,7 +883,121 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
           f"{rec_sp['prefix_evictions']} evictions, "
           f"{rec_sp['retained_blocks']} retained blocks",
           file=sys.stderr)
+    print(f"# served speculative(repetitive x{st_spec['pool_size']}, "
+          f"K={st_spec['K']}, new={st_spec['new']}): "
+          f"{sp_on['tokens_per_sec']:,.0f} tok/s vs "
+          f"{sp_plain['tokens_per_sec']:,.0f} plain "
+          f"({rec_spec['vs_baseline']:.2f}x), acceptance "
+          f"{rec_spec['acceptance_rate']:.2f}, "
+          f"{rec_spec['verify_dispatches']} verify + "
+          f"{rec_spec['decode_steps']} decode dispatches vs "
+          f"{rec_spec['decode_steps_plain']} plain decode steps; "
+          f"oracle ceiling {rec_spec['tok_s_ratio_oracle']:.2f}x",
+          file=sys.stderr)
     return records
+
+
+def _bench_served_speculation(model, cfg, on_tpu, tiny):
+    """Speculation sub-axis of `bench.py served` (round 11). Builds a
+    REPETITIVE/AGENTIC mix empirically: candidate prompts are tiled
+    short motifs (tool-call-loop shaped), their greedy continuations
+    are recorded once, and the candidates whose continuations the
+    n-gram drafter predicts best (fewest simulated rounds) form the
+    measured pool — "repetitive traffic" for a synthetic-weights model
+    IS traffic whose continuations actually repeat. Returns the
+    measurement dict the served record is assembled from."""
+    from paddle_tpu.inference import PagedGenerationServer
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+    from paddle_tpu.spec_decode import NgramDrafter, SpecConfig
+
+    if tiny:
+        spec_model = model
+        new, n_req, slots, bs, K, mp, chunk = 6, 4, 2, 4, 3, 16, 16
+        passes = 1
+    elif on_tpu:
+        spec_model = model  # gpt2s bf16: the serving config
+        new, n_req, slots, bs, K, mp, chunk = 64, 16, 8, 128, 8, 256, 512
+        passes = 2
+    else:
+        scfg = GPT2Config.tiny()  # dispatch-bound CPU proxy (see (f))
+        scfg.dropout = 0.0
+        spec_model = GPT2(scfg)
+        spec_model.eval()
+        new, n_req, slots, bs, K, mp, chunk = 48, 8, 4, 4, 7, 32, 64
+        passes = 2
+    vocab = spec_model.cfg.vocab_size
+    rng = np.random.RandomState(11)
+    cands = []
+    # candidate lengths bucket to a coarse grid: the recording pass
+    # below runs one dense generate per DISTINCT length (jit shape),
+    # and free-length candidates would compile one variant each
+    step = max(4, mp // 8)
+    for _ in range(4 * n_req):
+        motif = rng.randint(1, vocab,
+                            (int(rng.randint(2, 6)),)).astype(np.int32)
+        n = int(rng.randint(max(4, mp // 3), mp - 3))
+        n = max(step, n // step * step)
+        cands.append(np.tile(motif, -(-n // motif.size))[:n])
+    drafter = NgramDrafter(max_match=3, min_match=1)
+    refs, scored = [], []
+    for p in cands:
+        out = spec_model.generate(p[None], new).numpy()[0]
+        refs.append(out)
+        n = p.size
+        pos, rounds = 1, 0
+        while pos < new:  # simulate the drafter against the recording
+            prop = drafter.propose(out[:n + pos],
+                                   min(K, new - pos - 1) or 1)
+            rounds += 1
+            hits = 0
+            for j, t in enumerate(prop):
+                if int(t) == int(out[n + pos + j]):
+                    hits += 1
+                else:
+                    break
+            pos += hits + 1
+        scored.append((rounds, p))
+    pool = [p for _, p in sorted(scored, key=lambda x: x[0])[:n_req]]
+
+    class _ReplayOracle:
+        """Acceptance-1.0 ceiling drafter: replays the recorded greedy
+        continuations (measures the verify engine, not the drafter)."""
+
+        def propose(self, ctx, max_tokens):
+            ctx = np.asarray(ctx, np.int32)
+            for ref in refs:
+                if ctx.size < ref.size and np.array_equal(
+                        ref[:ctx.size], ctx):
+                    return ref[ctx.size:ctx.size + int(max_tokens)]
+            return np.empty((0,), np.int32)
+
+    def drain(spec):
+        srv = PagedGenerationServer(
+            spec_model, max_slots=slots, block_size=bs,
+            max_prompt_len=mp, max_new_tokens=new,
+            prefill_chunk_tokens=chunk, speculation=spec).start()
+        try:
+            best = None
+            for f in [srv.submit(p) for p in pool]:  # warm/compile
+                f.result(timeout=900)
+            for _ in range(passes):  # best-of-N: ratio-of-minima is
+                srv.reset_stats()    # stabler than one noisy pass
+                for f in [srv.submit(p) for p in pool]:
+                    f.result(timeout=900)
+                st = srv.stats()
+                if best is None or (st["tokens_per_sec"]
+                                    > best["tokens_per_sec"]):
+                    best = st
+            return best
+        finally:
+            srv.stop()
+
+    st_plain = drain(None)
+    st_spec = drain(SpecConfig(max_draft_tokens=K))
+    st_oracle = drain(SpecConfig(max_draft_tokens=K,
+                                 drafter=_ReplayOracle()))
+    return {"plain": st_plain, "spec": st_spec, "oracle": st_oracle,
+            "K": K, "pool_size": len(pool), "new": new}
 
 
 def _served_telemetry_pass(psrv, prompts, on_tpu):
@@ -970,9 +1134,10 @@ def main():
     records, skipped = [], []
     for name in AXES:
         # decode compiles 6 programs (2 lengths x 3 configs when cold);
-        # served compiles ~6 too (5 prefill buckets + 1 step)
+        # served compiles ~8 (5 prefill buckets + step + verify, plus
+        # the round-11 speculation sub-axis drains)
         need = 210 if name == "decode" else (
-            180 if name == "served" else (60 if records else 0))
+            240 if name == "served" else (60 if records else 0))
         if _remaining() < need:
             skipped.append(name)
             continue

@@ -395,6 +395,42 @@ class PagedKVCache:
         self._push_gauges()
         return len(table)
 
+    def truncate_seq(self, seq_id, new_len):
+        """Roll a sequence back to `new_len` live tokens — the rollback
+        half of speculative decoding (rejected draft positions leave the
+        cache) and a general shrink primitive. Tail blocks no longer
+        covering any live position are released refcount-aware: shared
+        prefix blocks stay live for their other referents, blocks the
+        index still names park in the LRU retention list. Rows
+        >= new_len inside the kept tail block become dead — masking is
+        by length everywhere, and later writes simply overwrite them.
+
+        Safe under prefix sharing/CoW because of two standing
+        invariants: `publish_prefix` only ever indexes PROMPT tokens, so
+        a sequence's speculative tail rows are never entry-claimed; and
+        rows >= an entry's fill are outside the immutable region, so
+        rewriting them after a rollback needs no copy. Callers that
+        truncate below a published/attached region they intend to
+        rewrite must route the next write through `prepare_write` (the
+        serving engine never truncates below prompt_len + 1).
+
+        Returns the number of table entries released."""
+        table = self._get_table(seq_id, "truncate_seq")
+        new_len = int(new_len)
+        cur = self._lens[seq_id]
+        if new_len < 0 or new_len > cur:
+            raise ValueError(
+                f"cannot truncate sequence {seq_id!r} to {new_len}: "
+                f"live length is {cur} (truncate_seq only rolls back)")
+        keep = blocks_for(new_len, self.block_size)
+        dropped = table[keep:]
+        del table[keep:]
+        self._lens[seq_id] = new_len
+        for b in reversed(dropped):
+            self._release_block(b)
+        self._push_gauges()
+        return len(dropped)
+
     def seq_len(self, seq_id):
         try:
             return self._lens[seq_id]

@@ -32,9 +32,12 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..observability import log as _obs_log
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from ..sampling import SamplingParams
+
+_logger = _obs_log.get_logger(__name__)
 
 # Shared serving telemetry (ISSUE 2): near-zero cost while
 # PADDLE_TPU_TELEMETRY is off — every update is one bool check.
@@ -84,6 +87,25 @@ _m_sampling_sampled = _metrics.counter(
     "serving_sampling_sampled_dispatches_total",
     "decode dispatches through the full vectorized sampling pipeline "
     "(>= 1 resident sampled request)")
+# Speculative decoding (round 11): proposal/acceptance accounting.
+_m_spec_proposed = _metrics.counter(
+    "serving_spec_proposed_tokens_total",
+    "draft tokens proposed by the drafter across all slots/rounds")
+_m_spec_accepted = _metrics.counter(
+    "serving_spec_accepted_tokens_total",
+    "proposed draft tokens the packed verification accepted")
+_m_spec_rolled_back = _metrics.counter(
+    "serving_spec_rolled_back_tokens_total",
+    "rejected draft positions rolled back out of the paged cache "
+    "(PagedKVCache.truncate_seq)")
+_m_spec_verify = _metrics.counter(
+    "serving_spec_verify_dispatches_total",
+    "packed verification dispatches (one per round scores every "
+    "speculating slot's drafts)")
+_m_spec_accept_rate = _metrics.histogram(
+    "serving_spec_acceptance_rate",
+    "per-slot per-round accepted/proposed draft fraction",
+    buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
 
 _req_ids = itertools.count()
 
@@ -118,12 +140,17 @@ class GenerationServer:
     pad masking is only engaged for batches that contain a padded row;
     in such a mixed batch, a full-length prompt that legitimately
     contains pad_token_id gets those positions masked too — pick a pad
-    id outside the prompt alphabet if prompts mix lengths.
+    id outside the prompt alphabet if prompts mix lengths. submit()
+    GUARDS this case (ADVICE r5): a full-length prompt containing
+    pad_token_id logs a warning naming the positions, or raises when
+    the server is built with strict_pad_check=True. (The paged server
+    masks by length and has no such caveat.)
     """
 
     def __init__(self, program, batch_size=None, prompt_len=None,
                  pad_token_id=0, max_wait_ms=5.0, temperature=0.0,
-                 seed=0, eos_token_id=-1, top_p=1.0):
+                 seed=0, eos_token_id=-1, top_p=1.0,
+                 strict_pad_check=False):
         self._program = program
         # export_generator artifacts record prompt_len and batch_size
         # (batch_size None = batch-polymorphic: the server picks its own)
@@ -141,6 +168,7 @@ class GenerationServer:
         self.batch_size = int(batch_size)
         self.prompt_len = int(prompt_len)
         self.pad_token_id = int(pad_token_id)
+        self.strict_pad_check = bool(strict_pad_check)
         self.max_wait_ms = float(max_wait_ms)
         self._defaults = (np.uint32(seed), np.float32(temperature),
                           np.int32(eos_token_id), np.float32(top_p),
@@ -213,6 +241,20 @@ class GenerationServer:
         if ids.size == 0 or ids.size > self.prompt_len:
             raise ValueError(
                 f"prompt length {ids.size} not in [1, {self.prompt_len}]")
+        if ids.size == self.prompt_len and (ids == self.pad_token_id).any():
+            # the documented value-masking corruption case (ADVICE r5):
+            # this prompt needs no padding itself, but batched with ANY
+            # padded row the program masks its pad-valued positions too
+            at = np.flatnonzero(ids == self.pad_token_id).tolist()
+            msg = (f"full-length prompt contains pad_token_id="
+                   f"{self.pad_token_id} at positions {at}: batched "
+                   f"with padded rows those positions would be masked "
+                   f"(value-equality padding); use "
+                   f"PagedGenerationServer (length masking) or a pad "
+                   f"id outside the prompt alphabet")
+            if self.strict_pad_check:
+                raise ValueError(msg)
+            _logger.warning("GenerationServer.submit: %s", msg)
         sig = self._req_sig(sampling)  # eager validation
         row = np.full((self.prompt_len,), self.pad_token_id, np.int32)
         row[self.prompt_len - ids.size:] = ids  # LEFT padding
@@ -466,6 +508,26 @@ class PagedGenerationServer:
     (at most one) copy-on-write a mid-block shared tail can force.
     Default OFF: a disabled server takes the exact pre-cache
     allocation path (no lookups, no publishes, no spare block).
+
+    speculation=SpecConfig(...) (or True for defaults) turns on
+    SPECULATIVE DECODING (round 11): each round, eligible decode-phase
+    slots ask the drafter (default: the self-drafting n-gram /
+    prompt-lookup drafter — no second model) for up to K draft tokens,
+    and ONE packed verification dispatch (`nn.decode.packed_verify`,
+    the PR 3 packed-prefill kernel shape with per-row sample indices)
+    scores every slot's drafts against the target model. Because the
+    per-request PRNG is counter-based, the target's token at every
+    position is deterministic, so rejection sampling reduces to exact
+    match and fixed-seed output — greedy or sampled, penalties
+    included — is token-identical to non-speculative decode no matter
+    how many drafts were accepted. Accepted tokens plus the bonus
+    token emit in one round (1..K+1 tokens per slot per dispatch);
+    rejected speculative K/V positions roll back via
+    `PagedKVCache.truncate_seq`. Slots with no proposal this round
+    take the plain decode dispatch, interleaved as before. Requires
+    steps_per_dispatch=1; admission reserves a K-token overrun per
+    request. Default OFF: the scheduler round is the exact
+    pre-speculation path.
     """
 
     def __init__(self, model, *, max_slots=4, block_size=16,
@@ -474,7 +536,7 @@ class PagedGenerationServer:
                  weight_quant=None, steps_per_dispatch=1,
                  prefill_chunk_tokens=512, pack_align=None,
                  enable_prefix_cache=False, detokenize=None,
-                 stop_tail_tokens=16):
+                 stop_tail_tokens=16, speculation=None):
         import jax
         import jax.numpy as jnp
 
@@ -486,13 +548,44 @@ class PagedGenerationServer:
         cfg = model.cfg
         self.max_new = int(max_new_tokens)
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
-        slack = self.steps_per_dispatch - 1  # post-EOS overrun horizon
+        # speculation (round 11): True -> default SpecConfig; a
+        # SpecConfig configures the drafter and the K budget. None
+        # keeps the EXACT pre-speculation scheduler path.
+        if speculation is True:
+            from ..spec_decode import SpecConfig
+
+            speculation = SpecConfig()
+        elif speculation is not None:
+            from ..spec_decode import SpecConfig
+
+            if not isinstance(speculation, SpecConfig):
+                raise TypeError(
+                    f"speculation must be a SpecConfig, True or None, "
+                    f"got {type(speculation).__name__}")
+        self.speculation = speculation
+        self._spec_k = (speculation.max_draft_tokens
+                        if speculation is not None else 0)
+        self._drafter = (speculation.make_drafter()
+                         if speculation is not None else None)
+        if speculation is not None and self.steps_per_dispatch > 1:
+            raise ValueError(
+                "speculation requires steps_per_dispatch=1 (the verify "
+                "dispatch already amortizes the per-dispatch floor over "
+                "up to K+1 tokens; fusing verify rounds into a scan "
+                "would need host drafting mid-scan)")
+        # overrun horizon past the budget: a multi-step scan may write
+        # up to k-1 discarded tokens, and a verify dispatch up to K
+        # speculative positions past the last emitted token (rolled
+        # back on rejection, but the blocks must be reservable)
+        slack = max(self.steps_per_dispatch - 1, self._spec_k)
+        self._overrun = slack
         self.max_prompt_len = int(
             max_prompt_len or cfg.max_position - self.max_new - slack)
         if self.max_prompt_len + self.max_new + slack > cfg.max_position:
             raise ValueError(
                 f"max_prompt_len ({self.max_prompt_len}) + max_new_tokens "
-                f"({self.max_new}) + steps_per_dispatch slack ({slack}) "
+                f"({self.max_new}) + overrun slack ({slack}, "
+                f"steps_per_dispatch/speculation) "
                 f"exceeds max_position ({cfg.max_position})")
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
@@ -502,6 +595,13 @@ class PagedGenerationServer:
         if pack_align is None:  # Pallas kernel query-tile contract on TPU
             pack_align = 128 if jax.default_backend() not in ("cpu",) else 8
         self._pack_align = int(pack_align)
+        # verify regions only need alignment where the Pallas kernel
+        # runs; the XLA fallback takes any packing, and a verify
+        # dispatch fires every round — off TPU, padding each K+1-token
+        # region to the prefill alignment would be pure wasted compute
+        self._verify_align = (self._pack_align
+                              if jax.default_backend() not in ("cpu",)
+                              else 1)
         self.eos = -1 if eos_token_id is None else int(eos_token_id)
         self.temperature = float(temperature)
         params, _ = model.functional_state()
@@ -561,6 +661,11 @@ class PagedGenerationServer:
         self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
         self._fastpath_dispatches = 0
         self._sampled_dispatches = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rolled_back = 0
+        self._spec_dispatches = 0
+        self._spec_rounds_per_slot = 0
         self._t0 = None
 
     # ---- client API ----------------------------------------------------
@@ -660,6 +765,11 @@ class PagedGenerationServer:
             self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
             self._fastpath_dispatches = 0
             self._sampled_dispatches = 0
+            self._spec_proposed = 0
+            self._spec_accepted = 0
+            self._spec_rolled_back = 0
+            self._spec_dispatches = 0
+            self._spec_rounds_per_slot = 0
             self._t0 = time.perf_counter()
 
     def stats(self):
@@ -711,6 +821,20 @@ class PagedGenerationServer:
                 # stats read 0 once everything is freed)
                 "kv_block_fill": (self._fill_integral
                                   / (self._steps or 1)),
+                # speculation accounting (round 11): zeros when the
+                # server runs without a SpecConfig — schema-stable so
+                # bench records and dashboards need no gating
+                "speculation": {
+                    "enabled": self.speculation is not None,
+                    "proposed_tokens": self._spec_proposed,
+                    "accepted_tokens": self._spec_accepted,
+                    "rolled_back_tokens": self._spec_rolled_back,
+                    "verify_dispatches": self._spec_dispatches,
+                    "slot_rounds": self._spec_rounds_per_slot,
+                    # fraction of proposed draft tokens accepted
+                    "acceptance_rate": (self._spec_accepted
+                                        / (self._spec_proposed or 1)),
+                },
                 "wall_s": dt,
             }
             out["kv_cache"] = self.cache.stats()
@@ -736,13 +860,14 @@ class PagedGenerationServer:
             if slot is not None or not self._queue:
                 continue
             req = self._queue[0]
-            # worst case includes the multi-step overrun slack: a scan may
-            # write up to steps_per_dispatch-1 discarded tokens past the
-            # budget before the host sees the EOS — plus one spare block
-            # for the (at most one) copy-on-write a prefix-cache attach
-            # ending mid-block can force
+            # worst case includes the overrun slack: a multi-step scan
+            # may write up to steps_per_dispatch-1 discarded tokens past
+            # the budget, and a verify dispatch up to K speculative
+            # positions past the last emitted token — plus one spare
+            # block for the (at most one) copy-on-write a prefix-cache
+            # attach ending mid-block can force
             worst = self._blocks_for(
-                req.ids.size + req.budget + self.steps_per_dispatch - 1,
+                req.ids.size + req.budget + self._overrun,
                 self.block_size) + (1 if self.enable_prefix_cache else 0)
             # available counts LRU-retained prefix blocks: alloc paths
             # reclaim them before raising, so they back reservations
@@ -1008,97 +1133,260 @@ class PagedGenerationServer:
                           and s["fed"] >= s["req"].ids.size]
             if not active_idx:
                 continue
-            k = self.steps_per_dispatch
-            # grow tables for the incoming token(s) BEFORE the step
-            # writes them (k tokens starting at the feed position)
-            self.cache.ensure_many(
-                [(self._slots[i]["seq"], self._slots[i]["pos"]
-                  + len(self._slots[i]["toks"]) - 1 + k)
-                 for i in active_idx])
-            tok = np.zeros((self.max_slots,), np.int32)
-            pos = np.zeros((self.max_slots,), np.int32)
-            act = np.zeros((self.max_slots,), bool)
-            steps = np.zeros((self.max_slots,), np.int32)
-            for i in active_idx:
-                s = self._slots[i]
-                tok[i] = s["toks"][-1]
-                pos[i] = s["pos"] + len(s["toks"]) - 1
-                act[i] = True
-                steps[i] = len(s["toks"])  # PRNG step counter
-            tables = jnp.asarray(self.cache.table_array(
-                [s["seq"] if s is not None else None
-                 for s in self._slots], self._m_width))
-            # per-slot sampling buffers + the static dispatch mode: ONE
-            # jitted dispatch serves the whole mixed batch; all-greedy
-            # residents take the argmax fast path
-            sp_args, sp_mode = self._sp_store.step_args(steps)
-            if sp_mode[0]:
-                _m_sampling_sampled.inc()
-            else:
-                _m_sampling_fast.inc()
-            with self._lock:
-                if sp_mode[0]:
-                    self._sampled_dispatches += 1
-                else:
-                    self._fastpath_dispatches += 1
-            try:
-                with _tracing.span(
-                        "decode_dispatch", k=k,
-                        request_ids=[self._slots[i]["req"].rid
-                                     for i in active_idx]):
-                    if k == 1:
-                        nxt, stopped, kc, vc, counts = \
-                            self._decoder.step(
-                                self._params, jnp.asarray(tok),
-                                jnp.asarray(pos), jnp.asarray(act),
-                                tables, self.cache.k_blocks,
-                                self.cache.v_blocks, sp_args, sp_mode)
-                        toks = np.asarray(nxt)[None]   # [1, S]
-                        stops = np.asarray(stopped)[None]
-                    else:
-                        toks, stopped, kc, vc, counts = \
-                            self._decoder.multistep(k, sp_mode)(
-                                self._params, jnp.asarray(tok),
-                                jnp.asarray(pos), jnp.asarray(act),
-                                tables, self.cache.k_blocks,
-                                self.cache.v_blocks, sp_args)
-                        toks = np.asarray(toks)        # [k, S]
-                        stops = np.asarray(stopped)
-            except Exception as e:  # noqa: BLE001 — fan out, drop slots
-                for i in active_idx:
-                    s = self._slots[i]
-                    self.cache.free(s["seq"])
-                    del self._worst[s["seq"]]
-                    s["req"].future.set_exception(e)
-                    self._slots[i] = None
-                    self._sp_store.clear_slot(i)
+            # speculative decoding (round 11): eligible slots propose
+            # drafts and take ONE packed verification dispatch instead
+            # of a decode step; the rest decode plainly below. With
+            # speculation off this is a no-op and the round is the
+            # exact pre-speculation path.
+            spec_slots = ()
+            if self._drafter is not None:
+                spec_slots = self._speculate(active_idx)
+            plain_idx = [i for i in active_idx
+                         if i not in spec_slots
+                         and self._slots[i] is not None]
+            if not plain_idx:
                 continue
-            self._sp_store.swap_counts(counts)
-            self.cache.swap_arrays(kc, vc)
-            t_now = time.perf_counter()
-            with self._lock:
-                self._steps += 1
-                self._active_integral += len(active_idx)
-                self._fill_integral += self.cache.stats()["block_fill"]
+            self._decode_plain(plain_idx)
+
+    def _decode_plain(self, active_idx):
+        """One plain decode dispatch (k tokens per slot with multi-step
+        scheduling) for the given decode-phase slots — the pre-round-11
+        decode body, extracted so the scheduler can interleave it with
+        the speculative verify dispatch."""
+        jnp = self._jnp
+        k = self.steps_per_dispatch
+        # grow tables for the incoming token(s) BEFORE the step
+        # writes them (k tokens starting at the feed position)
+        self.cache.ensure_many(
+            [(self._slots[i]["seq"], self._slots[i]["pos"]
+              + len(self._slots[i]["toks"]) - 1 + k)
+             for i in active_idx])
+        tok = np.zeros((self.max_slots,), np.int32)
+        pos = np.zeros((self.max_slots,), np.int32)
+        act = np.zeros((self.max_slots,), bool)
+        steps = np.zeros((self.max_slots,), np.int32)
+        for i in active_idx:
+            s = self._slots[i]
+            tok[i] = s["toks"][-1]
+            pos[i] = s["pos"] + len(s["toks"]) - 1
+            act[i] = True
+            steps[i] = len(s["toks"])  # PRNG step counter
+        tables = jnp.asarray(self.cache.table_array(
+            [s["seq"] if s is not None else None
+             for s in self._slots], self._m_width))
+        # per-slot sampling buffers + the static dispatch mode: ONE
+        # jitted dispatch serves the whole mixed batch; all-greedy
+        # residents take the argmax fast path
+        sp_args, sp_mode = self._sp_store.step_args(steps)
+        if sp_mode[0]:
+            _m_sampling_sampled.inc()
+        else:
+            _m_sampling_fast.inc()
+        with self._lock:
+            if sp_mode[0]:
+                self._sampled_dispatches += 1
+            else:
+                self._fastpath_dispatches += 1
+        try:
+            with _tracing.span(
+                    "decode_dispatch", k=k,
+                    request_ids=[self._slots[i]["req"].rid
+                                 for i in active_idx]):
+                if k == 1:
+                    nxt, stopped, kc, vc, counts = \
+                        self._decoder.step(
+                            self._params, jnp.asarray(tok),
+                            jnp.asarray(pos), jnp.asarray(act),
+                            tables, self.cache.k_blocks,
+                            self.cache.v_blocks, sp_args, sp_mode)
+                    toks = np.asarray(nxt)[None]   # [1, S]
+                    stops = np.asarray(stopped)[None]
+                else:
+                    toks, stopped, kc, vc, counts = \
+                        self._decoder.multistep(k, sp_mode)(
+                            self._params, jnp.asarray(tok),
+                            jnp.asarray(pos), jnp.asarray(act),
+                            tables, self.cache.k_blocks,
+                            self.cache.v_blocks, sp_args)
+                    toks = np.asarray(toks)        # [k, S]
+                    stops = np.asarray(stopped)
+        except Exception as e:  # noqa: BLE001 — fan out, drop slots
             for i in active_idx:
                 s = self._slots[i]
-                t_prev = s["t_last"] if s["t_last"] is not None else t_now
-                consumed = 0
-                for j in range(toks.shape[0]):
-                    consumed += 1
-                    self._slot_token(i, int(toks[j, i]),
-                                     device_stopped=bool(stops[j, i]))
-                    if self._slots[i] is None:  # finished mid-scan: the
-                        break  # remaining scan tokens are discarded
-                if self._slots[i] is not None:
-                    self._slots[i]["t_last"] = t_now
-                # ITL: the dispatch's host-visible gap amortized over
-                # the tokens it emitted for this slot
-                per = max(t_now - t_prev, 0.0) / consumed
+                self.cache.free(s["seq"])
+                del self._worst[s["seq"]]
+                s["req"].future.set_exception(e)
+                self._slots[i] = None
+                self._sp_store.clear_slot(i)
+            return
+        self._sp_store.swap_counts(counts)
+        self.cache.swap_arrays(kc, vc)
+        t_now = time.perf_counter()
+        with self._lock:
+            self._steps += 1
+            self._active_integral += len(active_idx)
+            self._fill_integral += self.cache.stats()["block_fill"]
+        for i in active_idx:
+            s = self._slots[i]
+            t_prev = s["t_last"] if s["t_last"] is not None else t_now
+            consumed = 0
+            for j in range(toks.shape[0]):
+                consumed += 1
+                self._slot_token(i, int(toks[j, i]),
+                                 device_stopped=bool(stops[j, i]))
+                if self._slots[i] is None:  # finished mid-scan: the
+                    break  # remaining scan tokens are discarded
+            if self._slots[i] is not None:
+                self._slots[i]["t_last"] = t_now
+            # ITL: the dispatch's host-visible gap amortized over
+            # the tokens it emitted for this slot
+            per = max(t_now - t_prev, 0.0) / consumed
+            with self._lock:
+                self._itl.extend([per] * consumed)
+            for _ in range(consumed):
+                _m_itl.observe(per)
+
+    def _speculate(self, active_idx):
+        """Propose drafts for every eligible decode-phase slot; when
+        any slot got a proposal, run ONE packed verification dispatch
+        covering ALL decode-phase slots — draft-free slots ride along
+        as k=0 rows whose single verify position IS their decode step,
+        so a round never pays a verify AND a plain decode dispatch.
+        Rounds where nobody proposes return () untouched and the loop
+        takes the plain decode dispatch (the exact pre-speculation
+        path, also what a disabled server always runs).
+
+        Draft eligibility: the slot must be able to emit at least 2
+        tokens (remaining budget >= 2 — with 1 left there is nothing a
+        draft could add), and the drafter must propose at least one
+        token for its context."""
+        from ..spec_decode import build_verify_plan
+
+        entries = []
+        any_drafts = False
+        empty = np.empty((0,), np.int32)
+        for i in active_idx:
+            s = self._slots[i]
+            remaining = s["budget"] - len(s["toks"])
+            kcap = min(self._spec_k, remaining - 1)
+            drafts = empty
+            if kcap >= 1:
+                ctx = np.concatenate(
+                    [s["req"].ids, np.asarray(s["toks"], np.int32)])
+                drafts = np.asarray(self._drafter.propose(ctx, kcap),
+                                    np.int32).reshape(-1)[:kcap]
+            if drafts.size:
+                any_drafts = True
+            wpos = s["pos"] + len(s["toks"]) - 1
+            entries.append((i, s["toks"][-1], wpos, len(s["toks"]),
+                            drafts))
+        if not any_drafts:
+            return ()
+        plan = build_verify_plan(entries, self._spec_k,
+                                 self._verify_align,
+                                 min_rows=self.max_slots)
+        self._verify_packed(plan)
+        return set(plan.slots)
+
+    def _verify_packed(self, plan):
+        """ONE packed verification dispatch for the plan's slots, then
+        accept/rollback: each row's drafts were speculatively written at
+        positions wpos+1..wpos+k; the dispatch returns the target's
+        deterministic token per position, the accepted prefix length,
+        and per-position stop flags. Accepted tokens (plus the bonus
+        token) feed the normal `_slot_token` path; rejected tail
+        positions roll the paged cache back via
+        `PagedKVCache.truncate_seq`."""
+        jnp = self._jnp
+        proposed = int(sum(d.size for d in plan.drafts))
+        with self._lock:
+            self._spec_proposed += proposed
+            self._spec_rounds_per_slot += sum(
+                1 for d in plan.drafts if d.size)
+        _m_spec_proposed.inc(proposed)
+        P = plan.dlen.shape[0]
+        try:
+            with _tracing.span(
+                    "verify_dispatch", segments=plan.rows,
+                    proposed=proposed,
+                    request_ids=[self._slots[i]["req"].rid
+                                 for i in plan.slots]):
+                # grow every row's table to its speculative write
+                # horizon in one atomic call (reservation-backed: the
+                # admission worst case includes the K-token overrun)
+                self.cache.ensure_many(
+                    plan.grow_updates([self._slots[i]["seq"]
+                                       for i in plan.slots]))
+                # FIXED table width (the decode-dispatch width, not the
+                # prefill path's pow2 bucketing): verify runs every
+                # round, so its jit shape must be pinned — one compiled
+                # variant per sampling mode
+                tables = jnp.asarray(self.cache.table_array(
+                    [self._slots[plan.slots[r]]["seq"]
+                     if r < plan.rows else None for r in range(P)],
+                    self._m_width))
+                sp_args, sp_mode = self._sp_store.verify_args(
+                    [plan.slots[r] if r < plan.rows else None
+                     for r in range(P)], plan.steps)
+                vtok, accepted, stopped, kc, vc, counts = \
+                    self._decoder.packed_verify(
+                        self._params, jnp.asarray(plan.toks),
+                        jnp.asarray(plan.seg), jnp.asarray(plan.pos),
+                        tables, jnp.asarray(plan.sample_idx),
+                        jnp.asarray(plan.dlen), self.cache.k_blocks,
+                        self.cache.v_blocks, sp_args, sp_mode)
+                vtok_h = np.asarray(vtok)
+                acc_h = np.asarray(accepted)
+                stop_h = np.asarray(stopped)
+        except Exception as e:  # noqa: BLE001 — fan out, drop slots
+            for i in plan.slots:
+                s = self._slots[i]
+                self.cache.free(s["seq"])
+                del self._worst[s["seq"]]
+                s["req"].future.set_exception(e)
+                self._slots[i] = None
+                self._sp_store.clear_slot(i)
+            return
+        self._sp_store.swap_counts(counts)
+        self.cache.swap_arrays(kc, vc)
+        _m_spec_verify.inc()
+        t_now = time.perf_counter()
+        with self._lock:
+            self._spec_dispatches += 1
+        for r, i in enumerate(plan.slots):
+            s = self._slots[i]
+            a = int(acc_h[r])
+            k_r = int(plan.drafts[r].size)
+            # rollback FIRST (while the sequence still exists): the
+            # kept prefix is the last emitted token plus the accepted
+            # drafts; rejected speculative positions leave the cache
+            self.cache.truncate_seq(s["seq"], plan.write_pos[r] + a + 1)
+            rolled = k_r - a
+            if k_r:  # draft-free ride-along rows have nothing to score
                 with self._lock:
-                    self._itl.extend([per] * consumed)
-                for _ in range(consumed):
-                    _m_itl.observe(per)
+                    self._spec_accepted += a
+                    self._spec_rolled_back += rolled
+                _m_spec_accepted.inc(a)
+                _m_spec_rolled_back.inc(rolled)
+                _m_spec_accept_rate.observe(a / k_r)
+                _tracing.event("spec_round", request_id=s["req"].rid,
+                               proposed=k_r, accepted=a,
+                               rolled_back=rolled)
+            t_prev = s["t_last"] if s["t_last"] is not None else t_now
+            consumed = 0
+            for j in range(a + 1):
+                consumed += 1
+                self._slot_token(i, int(vtok_h[r, j]),
+                                 device_stopped=bool(stop_h[r, j]))
+                if self._slots[i] is None:  # stopped mid-prefix: the
+                    break  # remaining accepted tokens are discarded
+            if self._slots[i] is not None:
+                self._slots[i]["t_last"] = t_now
+            per = max(t_now - t_prev, 0.0) / consumed
+            with self._lock:
+                self._itl.extend([per] * consumed)
+            for _ in range(consumed):
+                _m_itl.observe(per)
 
 
 def measure_offered_load(server, prompts, offered_rps, duration_s):

@@ -22,6 +22,14 @@ transformer against the block-pool KV cache (inference/kv_cache.py):
     `PagedKVCache.attach_prefix` re-attached (round 9 prefix caching).
     Prefix-cache resume therefore needs no engine change: the server
     just starts the packed stream at the first uncached token.
+  * packed_verify — speculative-decoding verification (round 11): the
+    SAME packed trunk as packed_prefill (the `_packed_trunk` refactor)
+    scoring each speculating slot's [last_token, draft_1..draft_k]
+    region in one dispatch, with a [P, K1] readout (one sample per
+    draft position plus the bonus position) and ON-DEVICE acceptance:
+    the counter-based PRNG makes the target's token at every step
+    deterministic, so rejection sampling reduces to exact match and
+    fixed-seed output is token-identical to non-speculative decode.
 
 Sampling (round 10) is PER-SLOT: every program takes a struct-of-arrays
 parameter dict `sp` (paddle_tpu/sampling/buffers.py) — temperature /
@@ -231,6 +239,51 @@ def _build_paged_fns(spec, block_size, return_logits, mode):
     return prefill_fn, step_fn
 
 
+@functools.lru_cache(maxsize=32)
+def _packed_trunk(spec, block_size):
+    """Shared packed ragged forward trunk: embed a token-packed
+    multi-sequence stream, write each token's K/V into its paged block
+    rows, and run segment-causal attention per layer. Returns the final
+    hidden stream [T, E] plus the updated cache arrays. The trunk of
+    BOTH `packed_prefill` (PR 3 chunked prefill) and `packed_verify`
+    (speculative decoding) — the two programs differ only in their
+    readout: one sample position per segment vs. one per draft
+    position."""
+    import jax.numpy as jnp
+
+    L, H, Dh, E, eps, tied = spec
+    scale = Dh ** -0.5
+    BS = int(block_size)
+    hp = _layer_helpers(spec)
+
+    def trunk(params, toks, seg, pos, tables, kc, vc):
+        from ..ops.attention import ragged_prefill_attention
+
+        T = toks.shape[0]
+        dt = params["ln_f.weight"].dtype
+        embed, _head = hp.make_embed_head(params, dt)
+        valid = pos >= 0
+        p0 = jnp.where(valid, pos, 0)
+        x = embed(toks) + params["wpe.weight"][p0]        # [T, E]
+        # pad tokens write to the trash block; their attention output is
+        # finite garbage (uniform weights over masked -inf scores) that
+        # no sample index ever reads
+        blk = jnp.where(valid, tables[seg, p0 // BS], 0)  # [T]
+        off = p0 % BS
+        for i in range(L):
+            a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
+                      params[f"h.{i}.ln_1.bias"])
+            q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
+            kc = kc.at[i, blk, off].set(k)
+            vc = vc.at[i, blk, off].set(v)
+            o = ragged_prefill_attention(q, kc[i], vc[i], tables, seg,
+                                         pos, scale=scale).reshape(T, E)
+            x = hp.block_and_mlp(params, i, x, o, dt)
+        return x, kc, vc
+
+    return trunk
+
+
 @functools.lru_cache(maxsize=64)
 def _build_packed_prefill(spec, block_size, return_logits, mode):
     """Packed ragged prefill: ONE dispatch prefills a token-packed
@@ -240,11 +293,9 @@ def _build_packed_prefill(spec, block_size, return_logits, mode):
 
     from ..sampling import processors as _proc
 
-    L, H, Dh, E, eps, tied = spec
-    scale = Dh ** -0.5
-    BS = int(block_size)
     sampled, penalties = mode
     hp = _layer_helpers(spec)
+    trunk = _packed_trunk(spec, block_size)
 
     def packed_prefill_fn(params, toks, seg, pos, tables, sample_idx,
                           kc, vc, sp):
@@ -268,28 +319,9 @@ def _build_packed_prefill(spec, block_size, return_logits, mode):
         the count buffer, and sp["row_done"] masks the rows whose
         token-0 sample is real (still-feeding and padding rows compute
         a discarded token)."""
-        from ..ops.attention import ragged_prefill_attention
-
-        T = toks.shape[0]
-        dt = params["ln_f.weight"].dtype
-        embed, head = hp.make_embed_head(params, dt)
-        valid = pos >= 0
-        p0 = jnp.where(valid, pos, 0)
-        x = embed(toks) + params["wpe.weight"][p0]        # [T, E]
-        # pad tokens write to the trash block; their attention output is
-        # finite garbage (uniform weights over masked -inf scores) that
-        # no sample_idx ever reads
-        blk = jnp.where(valid, tables[seg, p0 // BS], 0)  # [T]
-        off = p0 % BS
-        for i in range(L):
-            a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
-                      params[f"h.{i}.ln_1.bias"])
-            q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
-            kc = kc.at[i, blk, off].set(k)
-            vc = vc.at[i, blk, off].set(v)
-            o = ragged_prefill_attention(q, kc[i], vc[i], tables, seg,
-                                         pos, scale=scale).reshape(T, E)
-            x = hp.block_and_mlp(params, i, x, o, dt)
+        x, kc, vc = trunk(params, toks, seg, pos, tables, kc, vc)
+        _embed, head = hp.make_embed_head(
+            params, params["ln_f.weight"].dtype)
         xf = x[sample_idx]                                # [B, E]
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
         logits = head(xf)
@@ -315,6 +347,167 @@ def _jitted_packed_prefill(spec, block_size, return_logits, donate, mode):
 
     fn = _build_packed_prefill(spec, block_size, return_logits, mode)
     return jax.jit(fn, donate_argnums=(6, 7) if donate else ())
+
+
+@functools.lru_cache(maxsize=32)
+def _verify_trunk(spec, block_size):
+    """The packed trunk specialized to the verify plan's PINNED layout:
+    T = P * W with one W-token region per plan row (verifier.py). Same
+    embed/scatter/MLP as `_packed_trunk`, but attention goes through
+    `ops.verify_window_attention` — on TPU that is literally the
+    packed-prefill Pallas kernel on the flattened stream; off TPU the
+    dense [P, W] layout avoids the generic packed fallback's cross-row
+    score materialization (P-fold wasted compute on a dispatch that
+    runs every scheduler round)."""
+    import jax.numpy as jnp
+
+    L, H, Dh, E, eps, tied = spec
+    scale = Dh ** -0.5
+    BS = int(block_size)
+    hp = _layer_helpers(spec)
+
+    def trunk(params, toks, seg, pos, tables, kc, vc):
+        from ..ops.attention import verify_window_attention
+
+        T = toks.shape[0]
+        P = tables.shape[0]
+        W = T // P
+        dt = params["ln_f.weight"].dtype
+        embed, _head = hp.make_embed_head(params, dt)
+        valid = pos >= 0
+        p0 = jnp.where(valid, pos, 0)
+        x = embed(toks) + params["wpe.weight"][p0]        # [T, E]
+        blk = jnp.where(valid, tables[seg, p0 // BS], 0)  # [T]
+        off = p0 % BS
+        pos2 = pos.reshape(P, W)
+        for i in range(L):
+            a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
+                      params[f"h.{i}.ln_1.bias"])
+            q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
+            kc = kc.at[i, blk, off].set(k)
+            vc = vc.at[i, blk, off].set(v)
+            o = verify_window_attention(
+                q.reshape(P, W, H, Dh), kc[i], vc[i], tables, pos2,
+                scale=scale).reshape(T, E)
+            x = hp.block_and_mlp(params, i, x, o, dt)
+        return x, kc, vc
+
+    return trunk
+
+
+@functools.lru_cache(maxsize=64)
+def _build_packed_verify(spec, block_size, mode):
+    """Speculative verification (spec_decode round): score a packed
+    stream of [last_token, draft_1 .. draft_k] regions — one region per
+    speculating slot — in ONE ragged dispatch, and decide acceptance ON
+    DEVICE with the same per-slot sampling pipeline a plain decode step
+    would run.
+
+    Because the PR 5 PRNG is counter-based (`fold_in(seed, step)` — a
+    pure function of the request seed and the generation step), the
+    target's token at every draft position is DETERMINISTIC given its
+    logits: rejection sampling against it reduces to exact match.
+    Draft j is accepted iff it equals the token the target pipeline
+    samples at step base+j-1 AND every earlier draft was accepted;
+    greedy requests degenerate to argmax match. The emitted tokens are
+    therefore the exact tokens non-speculative decode would have
+    produced, regardless of how many drafts were accepted."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..sampling import processors as _proc
+
+    sampled, penalties = mode
+    hp = _layer_helpers(spec)
+    trunk = _verify_trunk(spec, block_size)
+
+    def verify_fn(params, toks, seg, pos, tables, sample_idx, dlen,
+                  kc, vc, sp):
+        """toks/seg/pos: packed stream as in packed_prefill, holding
+        each speculating slot's last emitted token followed by its
+        draft tokens (K/V written at positions pos..pos+k — rejected
+        tail positions are rolled back host-side via
+        PagedKVCache.truncate_seq). sample_idx [P, K1] packed index of
+        each plan row's verify position j (clamped to the region end
+        for j > dlen); dlen [P] draft count per row — 0 is a REAL row
+        with no drafts this round (its single verify position is
+        exactly a decode step, so draft-free slots ride the same
+        dispatch), -1 marks a padding row. sp: verify_args buffers —
+        per-row base PRNG steps in sp["steps"]; position j samples at
+        step base+j.
+
+        Returns (vtok [P, K1] target tokens, accepted [P] accepted
+        draft counts, stopped [P, K1] per-position stop flags, kc, vc,
+        counts|None). Row r's emitted tokens are vtok[r, :accepted+1]
+        truncated after the first stopped position — exactly what
+        accepted+1 sequential decode steps would have emitted."""
+        P, K1 = sample_idx.shape
+        x, kc, vc = trunk(params, toks, seg, pos, tables, kc, vc)
+        _embed, head = hp.make_embed_head(
+            params, params["ln_f.weight"].dtype)
+        xf = x[sample_idx.reshape(-1)]                    # [P*K1, E]
+        xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
+        logits = head(xf)                                 # [P*K1, V]
+        fed = toks[sample_idx]                            # [P, K1]
+        j = jnp.arange(K1)[None, :]
+        draft_valid = (j >= 1) & (j <= dlen[:, None])     # real drafts
+        row_valid = dlen >= 0
+        # flatten the per-row sp columns to per-position rows (row-major
+        # [P, K1] order matches the logits reshape)
+        spf = {"stop": jnp.repeat(sp["stop"], K1, axis=0)}
+        if sampled:
+            for col in ("temperature", "top_k", "top_p", "min_p",
+                        "seeds", "sample"):
+                spf[col] = jnp.repeat(sp[col], K1, axis=0)
+            # position j is generation step base+j: the SAME counter a
+            # plain decode step would fold in — fixed-seed invariance
+            spf["steps"] = (sp["steps"][:, None]
+                            + jnp.arange(K1)[None, :]).reshape(-1)
+        if penalties:
+            for col in ("rep", "pres", "freq"):
+                spf[col] = jnp.repeat(sp[col], K1, axis=0)
+            # position j's "text so far" includes drafts 1..j (they ARE
+            # the emitted tokens whenever position j's verdict matters)
+            base = sp["counts"][sp["crows"]]              # [P, V]
+            V = base.shape[-1]
+            oh = jax.nn.one_hot(fed, V, dtype=jnp.int32) \
+                * draft_valid[..., None].astype(jnp.int32)
+            spf["counts"] = (base[:, None]
+                             + jnp.cumsum(oh, axis=1)).reshape(P * K1, V)
+        tok = _proc.sample_tokens(logits, spf, sampled=sampled,
+                                  penalties=penalties)
+        vtok = tok.reshape(P, K1)
+        stopped = _proc.check_stops(
+            tok, spf["stop"], jnp.repeat(row_valid, K1)).reshape(P, K1)
+        # draft j accepted iff it matches the target's token at the
+        # previous position and every earlier draft was accepted
+        matches = (fed[:, 1:] == vtok[:, :-1]) & draft_valid[:, 1:]
+        accepted = jnp.cumprod(matches.astype(jnp.int32),
+                               axis=1).sum(axis=1).astype(jnp.int32)
+        counts = None
+        if penalties:
+            # count exactly the emitted tokens: vtok[:, :accepted+1]
+            # truncated after the first stop (host truncation beyond
+            # that — stop strings / budget — always ends the request,
+            # so its counts row is reset on the next admit anyway)
+            sint = stopped.astype(jnp.int32)
+            stop_before = jnp.cumsum(sint, axis=1) - sint
+            emit = (j <= accepted[:, None]) & (stop_before == 0) \
+                & row_valid[:, None]
+            counts = _proc.update_counts(
+                sp["counts"], jnp.repeat(sp["crows"], K1), tok,
+                emit.reshape(-1))
+        return vtok, accepted, stopped, kc, vc, counts
+
+    return verify_fn
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_packed_verify(spec, block_size, donate, mode):
+    import jax
+
+    fn = _build_packed_verify(spec, block_size, mode)
+    return jax.jit(fn, donate_argnums=(7, 8) if donate else ())
 
 
 @functools.lru_cache(maxsize=64)
@@ -388,11 +581,12 @@ class PagedDecoder:
         self._variants = {}
 
     def _variant(self, mode):
-        """(prefill, step, packed_prefill) tracing-wrapped jitted fns
-        for one static sampling mode. Dispatch-boundary spans (ISSUE 2):
-        when tracing is on, every jitted call shows up as its own span —
-        the device-side cost inside a request's prefill/decode phases;
-        when off, the wrapper is one bool check."""
+        """(prefill, step, packed_prefill, packed_verify)
+        tracing-wrapped jitted fns for one static sampling mode.
+        Dispatch-boundary spans (ISSUE 2): when tracing is on, every
+        jitted call shows up as its own span — the device-side cost
+        inside a request's prefill/decode phases; when off, the wrapper
+        is one bool check."""
         v = self._variants.get(mode)
         if v is None:
             from ..observability import tracing as _tracing
@@ -403,9 +597,12 @@ class PagedDecoder:
             packed = _jitted_packed_prefill(
                 self.spec, self.block_size, self.return_logits,
                 self._donate, mode)
+            verify = _jitted_packed_verify(
+                self.spec, self.block_size, self._donate, mode)
             v = (_tracing.wrap("prefill_dispatch", prefill),
                  _tracing.wrap("step_dispatch", step),
-                 _tracing.wrap("packed_prefill_dispatch", packed))
+                 _tracing.wrap("packed_prefill_dispatch", packed),
+                 _tracing.wrap("verify_dispatch", verify))
             self._variants[mode] = v
         return v
 
@@ -423,6 +620,16 @@ class PagedDecoder:
                        kc, vc, sp, mode=GREEDY_MODE):
         return self._variant(mode)[2](params, toks, seg, pos, tables,
                                       sample_idx, kc, vc, sp)
+
+    def packed_verify(self, params, toks, seg, pos, tables, sample_idx,
+                      dlen, kc, vc, sp, mode=GREEDY_MODE):
+        """Speculative draft verification over a packed stream (see
+        _build_packed_verify). sample_idx is [P, K1] — one readout per
+        draft position plus the bonus position — and dlen [P] carries
+        each plan row's draft count (0 = real draft-free row, -1 =
+        padding row)."""
+        return self._variant(mode)[3](params, toks, seg, pos, tables,
+                                      sample_idx, dlen, kc, vc, sp)
 
     def multistep(self, n_steps, mode=GREEDY_MODE):
         """Fused n-token decode (see _jitted_multistep)."""

@@ -223,6 +223,45 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     return jnp.einsum("htbc,hbcd->htd", w, v).transpose(1, 0, 2)
 
 
+def verify_window_attention(q, k_blocks, v_blocks, block_tables, pos,
+                            scale=None):
+    """Speculative-verification attention over a PAGED KV cache: a
+    DENSE [P, W] window of queries per plan row (each row's last
+    emitted token + its draft tokens, W pinned by the verify plan),
+    every query attending its OWN row's cache positions [0, pos].
+
+    q: [P, W, H, Dh]; k_blocks/v_blocks: [N, BS, H, Dh] (one layer's
+    pool); block_tables: [P, M] int32 0-padded; pos: [P, W] int32
+    absolute cache positions (-1 = region pad; its output is finite
+    garbage no readout index touches).
+
+    Semantically this is `ragged_prefill_attention` on the flattened
+    [P*W] stream — and on TPU with aligned shapes it IS that call, so
+    the verify dispatch rides the same Pallas segment-causal kernel as
+    packed prefill. Off TPU the dense layout lets the fallback score
+    each row's window against ONLY its own cache ([P, W, C] scores
+    instead of the packed fallback's [P*W, P, C] cross-row
+    materialization) — the verify dispatch runs every scheduler round,
+    and the P-fold waste measurably capped the speculation speedup on
+    CPU."""
+    P, W, H, Dh = q.shape
+    _, BS, _, _ = k_blocks.shape
+    M = block_tables.shape[1]
+    sc = (Dh ** -0.5) if scale is None else scale
+    if _on_tpu():
+        seg = jnp.repeat(jnp.arange(P, dtype=jnp.int32), W)
+        return ragged_prefill_attention(
+            q.reshape(P * W, H, Dh), k_blocks, v_blocks, block_tables,
+            seg, pos.reshape(-1), scale=sc).reshape(P, W, H, Dh)
+    k = k_blocks[block_tables].reshape(P, M * BS, H, Dh)
+    v = v_blocks[block_tables].reshape(P, M * BS, H, Dh)
+    s = jnp.einsum("pwhd,pchd->phwc", q, k).astype(jnp.float32) * sc
+    ok = jnp.arange(M * BS)[None, None, :] <= pos[:, :, None]
+    s = jnp.where(ok[:, None], s, -1e30)
+    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("phwc,pchd->pwhd", w, v)
+
+
 @defop()
 def fused_multi_head_attention(x, qkv_weight, qkv_bias, out_weight, out_bias,
                                num_heads, attn_mask=None, dropout_p=0.0,

@@ -155,6 +155,25 @@ class SlotParamStore:
         mode = self.mode()
         return self._assemble(rows, steps, mode), mode
 
+    def verify_args(self, slot_rows, steps):
+        """Speculative-verification arguments: compact plan rows like
+        `packed_args`, plus per-row base PRNG steps. `slot_rows` maps
+        plan row -> slot index (None = padding row); `steps` [P] int32
+        is each row's generated-token count — verify position j samples
+        at step base+j on device, the same counter j sequential decode
+        steps would fold in. Padding rows alias slot 0's columns; the
+        verify program masks them via dlen == -1. Returns (sp dict,
+        mode)."""
+        import jax.numpy as jnp
+
+        real = [r for r in slot_rows if r is not None]
+        mode = self.mode(real)
+        rows = [r if r is not None else 0 for r in slot_rows]
+        sp = self._assemble(rows, np.asarray(steps, np.int32), mode)
+        if mode[1]:
+            sp["crows"] = jnp.asarray(np.array(rows, np.int32))
+        return sp, mode
+
     def packed_args(self, slot_rows, done_mask):
         """Packed-prefill arguments: compact plan rows. `slot_rows` maps
         plan row -> slot index (None = padding row); `done_mask` marks

@@ -1,0 +1,35 @@
+"""Speculative decoding for the paged serving engine (round 11).
+
+Decode is one dispatch per token per round and bandwidth-bound, not
+FLOP-bound (PERF.md: the per-token parameter stream is the roofline), so
+the chip can VERIFY K proposed tokens for nearly the price of decoding
+one. Three layers:
+
+* `config` — `SpecConfig`, the eagerly-validated speculation knob
+  bundle (draft budget K, drafter choice, n-gram match window);
+* `drafter` — the `Drafter` protocol plus the self-drafting
+  `NgramDrafter` (per-slot suffix lookup over the request's own
+  prompt + generated tokens — no second model) and the
+  `DraftModelDrafter` seam for a small draft model sharing the target
+  tokenizer;
+* `verifier` — host-side assembly of the packed verification plan (the
+  rejection-sampling half runs on device: `nn.decode.packed_verify`
+  scores every slot's drafts in ONE ragged dispatch, reusing the PR 3
+  packed-prefill kernel shape, and decides acceptance with the exact
+  per-slot sampling pipeline plain decode would run).
+
+Because the PR 5 PRNG is counter-based (`fold_in(seed, step)`), the
+target's token at every position is deterministic given its logits, so
+rejection sampling reduces to exact match and fixed-seed output is
+token-identical to non-speculative decode regardless of how many
+tokens were accepted (greedy degenerates to argmax match). Rejected
+draft positions roll the paged cache back via
+`PagedKVCache.truncate_seq`. See docs/SERVING.md ("Speculative
+decoding").
+"""
+from .config import SpecConfig  # noqa: F401
+from .drafter import Drafter, DraftModelDrafter, NgramDrafter  # noqa: F401
+from .verifier import VerifyPlan, build_verify_plan  # noqa: F401
+
+__all__ = ["SpecConfig", "Drafter", "NgramDrafter", "DraftModelDrafter",
+           "VerifyPlan", "build_verify_plan"]

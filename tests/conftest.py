@@ -11,10 +11,28 @@ os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
                            + os.environ.get("XLA_FLAGS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Persistent XLA compilation cache: the tier-1 suite is compile-dominated
+# on CPU (hundreds of distinct jit shapes) and the driver's wall-clock
+# budget is tight on slow boxes — a warm cache cuts repeat runs 2-4x.
+# Entries key on HLO + compile options + jax/XLA version, so staleness
+# cannot change results. Set in os.environ BEFORE any subprocess spawns
+# so the bench/deploy smoke subprocesses share the cache; set via
+# jax.config for THIS process because sitecustomize imported jax before
+# the env var existed.
+_JAX_CACHE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _JAX_CACHE)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
 jax.devices()  # force CPU backend init before anything else can
 
 import pytest  # noqa: E402

@@ -126,3 +126,37 @@ class TestGenerationServer:
             srv.submit([])
         with pytest.raises(ValueError):
             srv.submit(list(range(7)))  # > prompt_len
+
+    def test_full_length_prompt_with_pad_token_guarded(self, served,
+                                                       monkeypatch):
+        """Satellite (ADVICE r5, serving.py pad caveat): a FULL-LENGTH
+        prompt containing pad_token_id would get those positions masked
+        if batched with any padded row (value-equality padding) —
+        submit() must warn, or reject under strict_pad_check=True. A
+        short prompt containing the pad id, or a full-length prompt
+        without it, passes silently (padding handles the former; the
+        latter is safe)."""
+        from paddle_tpu.inference import GenerationServer
+        from paddle_tpu.inference import serving as serving_mod
+
+        prog, _, _ = served
+        warnings = []
+        monkeypatch.setattr(
+            serving_mod._logger, "warning",
+            lambda msg, *a: warnings.append(msg % a if a else msg))
+        srv = GenerationServer(prog, pad_token_id=0)
+        tricky = np.array([5, 9, 0, 3, 7, 2], np.int32)  # pad mid-prompt
+        fut = srv.submit(tricky)                         # warns, queues
+        assert len(warnings) == 1
+        assert "pad_token_id=0" in warnings[0]
+        assert "positions [2]" in warnings[0]
+        srv.submit(np.array([5, 0, 3], np.int32))        # short: fine
+        srv.submit(np.array([5, 9, 1, 3, 7, 2], np.int32))  # no pad id
+        assert len(warnings) == 1
+        assert not fut.done()                            # queued, not failed
+        # strict mode: the same prompt is rejected at submit()
+        strict = GenerationServer(prog, pad_token_id=0,
+                                  strict_pad_check=True)
+        with pytest.raises(ValueError, match="pad_token_id=0"):
+            strict.submit(tricky)
+        strict.submit(np.array([5, 0, 3], np.int32))     # short still ok

@@ -313,19 +313,26 @@ def _run_served_bench(*args, timeout=600):
 @pytest.mark.slow
 def test_served_bench_axis_emits_records():
     """`bench.py served` (mixed-length traffic: padded vs paged
-    closed-loop, the open-loop Poisson axis, and the shared-prefix
-    caching axis) must emit all four JSON records; slow-marked so
-    tier-1 stays fast."""
+    closed-loop, the open-loop Poisson axis, the shared-prefix caching
+    axis, and the round-11 speculation axis) must emit all six JSON
+    records; slow-marked so tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 5, stdout
+    assert len(recs) == 6, stdout
     assert any("paged" in rec["metric"] for rec in recs)
     assert any("mixedsampling" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
     assert any("sharedprefix" in rec["metric"] for rec in recs)
+    assert any("speculative" in rec["metric"] for rec in recs)
     for rec in recs:
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "p99_ms" in rec or "sharedprefix" in rec["metric"]
+    # the speculation acceptance bar: >= 1.5x served tok/s vs plain
+    # decode on the repetitive mix (CPU-degraded run of the
+    # dispatch-bound proxy; the chip run may beat it)
+    spec = next(r for r in recs if "speculative" in r["metric"])
+    assert spec["vs_baseline"] >= 1.5, spec
+    assert spec["tok_s_ratio_oracle"] >= spec["vs_baseline"] * 0.9
 
 
 def test_served_bench_openloop_tiny_schema():
@@ -334,14 +341,16 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=420)
-    assert len(recs) == 4, stdout
+    assert len(recs) == 5, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
-                 and "mixedsampling" not in r["metric"])
+                 and "mixedsampling" not in r["metric"]
+                 and "speculative" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
     sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
-    for rec in (paged, mix_rec, open_rec, sp_rec):
+    spec_rec = next(r for r in recs if "speculative" in r["metric"])
+    for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -372,3 +381,16 @@ def test_served_bench_openloop_tiny_schema():
         assert fld in sp_rec, sp_rec
     assert sp_rec["prefix_hit_tokens"] > 0  # the warm prefix must hit
     assert 0 < sp_rec["prefix_hit_rate"] <= 1.0
+    # speculation axis (round 11): acceptance accounting + the oracle
+    # ceiling must be present; token conservation must hold exactly
+    for fld in ("vs_baseline", "tokens_per_sec_plain",
+                "acceptance_rate", "proposed_tokens", "accepted_tokens",
+                "rolled_back_tokens", "verify_dispatches",
+                "decode_steps", "decode_steps_plain",
+                "max_draft_tokens", "tok_s_ratio_oracle",
+                "acceptance_rate_oracle"):
+        assert fld in spec_rec, spec_rec
+    assert spec_rec["proposed_tokens"] == (
+        spec_rec["accepted_tokens"] + spec_rec["rolled_back_tokens"])
+    assert 0.0 <= spec_rec["acceptance_rate"] <= 1.0
+    assert spec_rec["verify_dispatches"] >= 1
