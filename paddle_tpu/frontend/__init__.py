@@ -1,0 +1,18 @@
+"""Serving front door (round 12): token streaming, SLO-aware
+scheduling (interactive/batch lanes, TTFT deadlines), preemption with
+prefix-cache swap-out, and multi-tenant fairness (weighted fair share,
+token-rate limits, bounded queues with explicit rejection) — the
+scheduling-and-delivery layer over `inference.PagedGenerationServer`.
+See docs/FRONTDOOR.md.
+"""
+from ..inference.serving import RequestMeta
+from .frontdoor import FrontDoor
+from .scheduler import LANES, LaneScheduler
+from .stream import DeltaAssembler, StreamEvent, StreamHandle
+from .tenancy import QueueFull, TenantConfig, TokenBucket
+
+__all__ = [
+    "FrontDoor", "LaneScheduler", "LANES", "RequestMeta",
+    "DeltaAssembler", "StreamEvent", "StreamHandle",
+    "QueueFull", "TenantConfig", "TokenBucket",
+]

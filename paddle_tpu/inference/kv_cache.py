@@ -597,6 +597,28 @@ class PagedKVCache:
             self._drop_entry(h)        # entries, write in place
         return False
 
+    def swap_out_seq(self, seq_id, token_ids):
+        """Preemption swap-out hook (round 12): publish the sequence's
+        LIVE K/V prefix into the content index, then release its blocks.
+        `token_ids` is the full known token stream (prompt + generated);
+        only the first `seq_len(seq_id)` of them have K/V written, and
+        exactly those are indexed — the freed blocks park in the LRU
+        retention list instead of being scrubbed, so a later
+        `attach_prefix` with the same stream resumes the sequence with
+        near-zero recompute (one token) unless pool pressure reclaimed
+        the blocks in between. Returns the number of tokens published
+        (0 for an empty sequence — nothing to index)."""
+        live = self.seq_len(seq_id)
+        ids = np.asarray(token_ids).reshape(-1)
+        if live > ids.size:
+            raise ValueError(
+                f"swap_out_seq of {seq_id!r}: {live} live tokens but "
+                f"only {ids.size} token ids supplied")
+        if live > 0:
+            self.publish_prefix(seq_id, ids[:live])
+        self.free(seq_id)
+        return live
+
     def table_array(self, seq_ids, width=None):
         """Dense int32 [len(seq_ids), width] block-table matrix for the
         jitted step; unused entries point at trash block 0. A seq_id of

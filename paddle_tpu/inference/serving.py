@@ -106,10 +106,52 @@ _m_spec_accept_rate = _metrics.histogram(
     "serving_spec_acceptance_rate",
     "per-slot per-round accepted/proposed draft fraction",
     buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
-
+# Front door (round 12): preemption, SLO lanes, multi-tenant queueing.
+_m_preemptions = _metrics.counter(
+    "serving_preemptions_total",
+    "slots evicted mid-flight to make room for a higher-priority "
+    "admission (the victim's live K/V is published through the "
+    "prefix-cache index when caching is on, then the request requeues)",
+    labelnames=("reason",))
+_m_resumes = _metrics.counter(
+    "serving_preempt_resumes_total",
+    "preempted requests re-admitted (resume = re-prefill of "
+    "prompt + generated-so-far, served from the prefix cache when the "
+    "swapped-out blocks survived retention)")
+_m_preempt_cached = _metrics.counter(
+    "serving_preempt_cached_tokens_total",
+    "tokens of victim K/V published into the prefix-cache index at "
+    "swap-out (the work preemption preserves instead of recomputing)")
+_m_deadline_miss = _metrics.counter(
+    "serving_deadline_misses_total",
+    "requests whose first token landed after their TTFT deadline",
+    labelnames=("lane",))
+_m_deadline_overage = _metrics.histogram(
+    "serving_deadline_overage_seconds",
+    "by how much a missed TTFT deadline was missed (first token time "
+    "minus deadline; only observed on misses)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
 _req_ids = itertools.count()
 
 STOP_REASONS = ("eos", "stop_token", "stop_string", "budget")
+
+
+@dataclass
+class RequestMeta:
+    """Scheduling metadata the front-door scheduler reads (round 12).
+
+    lane: SLO lane name ("interactive" = TTFT-sensitive, "batch" =
+        throughput). The engine itself is lane-agnostic — lanes only
+        mean something to the installed scheduler policy.
+    tenant: fair-share / rate-limit accounting bucket.
+    deadline_s: relative TTFT deadline in SECONDS from submit; the
+        engine counts (never enforces) misses at first-token time.
+    cost: tokens the tenant's rate bucket is charged at admission
+        (conventionally prompt_len + token budget)."""
+    lane: str = "interactive"
+    tenant: str = "default"
+    deadline_s: float | None = None
+    cost: int = 0
 
 
 @dataclass
@@ -122,6 +164,16 @@ class _Req:
     ttft: float | None = None
     sampling: SamplingParams | None = None
     seed: int = 0
+    # front door (round 12): scheduling metadata, streaming callback,
+    # and preemption resume state. gen0 = tokens generated before the
+    # last preemption (the slot's token list is re-seeded with them so
+    # position/PRNG-step/budget arithmetic is residency-invariant);
+    # resume_ids = ids ++ gen0, the prompt the resume re-prefills.
+    meta: "RequestMeta | None" = None
+    on_token: object = None
+    gen0: tuple = ()
+    resume_ids: np.ndarray | None = None
+    preempts: int = 0  # times this request has been swapped out
 
 
 class GenerationServer:
@@ -666,10 +718,118 @@ class PagedGenerationServer:
         self._spec_rolled_back = 0
         self._spec_dispatches = 0
         self._spec_rounds_per_slot = 0
+        # front door (round 12): pluggable scheduler + preemption /
+        # deadline window counters (zero + unused when no scheduler is
+        # installed — the legacy submit/drain path is bit-identical)
+        self._sched = None
+        self._preemptions = 0
+        self._resumes = 0
+        self._preempt_cached_tokens = 0
+        self._deadline_requests: dict[str, int] = {}
+        self._deadline_misses: dict[str, int] = {}
+        self._lane_ttft: dict[str, list] = {}
+        self._lane_itl: dict[str, list] = {}
         self._t0 = None
 
+    def set_scheduler(self, sched):
+        """Install a front-door scheduler (round 12) — an object owning
+        the request queues and the admission/preemption policy. The
+        engine consults it instead of its FIFO queue for: submission
+        routing (`on_submit`, which may raise to REJECT), candidate
+        selection (`next_request`/`pop`), victim selection for
+        preemption (`victims`), requeue of preempted requests
+        (`requeue`), packed-prefill ordering and per-slot chunk caps
+        (`prefill_plan`), and queue-depth reporting (`lane_depths`/
+        `tenant_depths`/`depth`). None uninstalls; with no scheduler
+        the engine runs the exact legacy reservation-FIFO path.
+        Install before start() — the loop reads it unlocked."""
+        if self._thread is not None:
+            raise RuntimeError("install the scheduler before start()")
+        self._sched = sched
+        return self
+
+    def warm_buckets(self, modes=((False, False),)):
+        """Pre-compile every reachable packed-prefill jit bucket
+        (round 12) so live traffic never pays an XLA compile
+        mid-request. The packed chunk path specializes per
+        (packed length T, plan rows P, table width) triple — all
+        power-of-two bucketed, so the space is small — but WHICH
+        buckets a serving window hits depends on admission/preemption
+        timing (share-capped chunks, one-token cache-hit resumes,
+        churn-sized plans), so a warm-traffic drive cannot enumerate
+        them deterministically. Production front ends compile their
+        shape buckets before taking traffic; this is that switch.
+
+        Each bucket is compiled by ONE synthetic dispatch whose
+        positions are all packing pad (-1), so every write lands in
+        the pool's reserved trash block and no sequence, sampling, or
+        cache state changes. `modes`: the (any_sampled, any_penalties)
+        static pairs to compile (default: the all-greedy fast path;
+        pass `[(False, False), (True, False)]` etc. for sampled
+        traffic). Call before `start()` — the loop owns the cache
+        arrays once it runs. Returns the number of variants compiled."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "warm_buckets must run before start() (the engine loop "
+                "owns the cache arrays once it is running)")
+        jnp = self._jnp
+        align = self._pack_align
+        budget = self.prefill_chunk_tokens
+        pairs = set()
+        for rows in range(1, min(self.max_slots, budget) + 1):
+            P = 1
+            while P < rows:
+                P *= 2
+            # packed length range for a plan of `rows` chunks: each
+            # region is align*ceil(n_i/align) with n_i >= 1 and
+            # sum(n_i) <= budget, so off spans [rows*align, the
+            # one-fat-chunk worst case]
+            off_max = (rows - 1) * align + align * (
+                -(-(budget - rows + 1) // align))
+            T = align
+            while T < rows * align:
+                T *= 2
+            while True:
+                pairs.add((T, P))
+                if T >= off_max:
+                    break
+                T *= 2
+        widths = []
+        w = 1
+        while w < self._m_width:
+            widths.append(w)
+            w *= 2
+        widths.append(self._m_width)  # the min(pow2, m_width) cap
+        n = 0
+        for mode in modes:
+            for T, P in sorted(pairs):
+                for mcap in widths:
+                    # fresh args per dispatch: in penalty mode the
+                    # count buffer is donated on accelerators, so a
+                    # reused dict would hand back an invalidated array
+                    sp = self._sp_store.warm_args(P, mode)
+                    tok, stopped, kc, vc, counts = \
+                        self._decoder.packed_prefill(
+                            self._params, jnp.zeros((T,), jnp.int32),
+                            jnp.zeros((T,), jnp.int32),
+                            jnp.full((T,), -1, jnp.int32),
+                            jnp.zeros((P, mcap), jnp.int32),
+                            jnp.zeros((P,), jnp.int32),
+                            self.cache.k_blocks, self.cache.v_blocks,
+                            sp, mode)
+                    # reinstall the round-tripped arrays (donated on
+                    # accelerators); only trash-block rows were written
+                    self._sp_store.swap_counts(counts)
+                    self.cache.swap_arrays(kc, vc)
+                    n += 1
+        _logger.info("warm_buckets: compiled %d packed-prefill "
+                     "variants (%d shape pairs x %d widths x %d modes)",
+                     n, len(pairs), len(widths), len(modes))
+        return n
+
     # ---- client API ----------------------------------------------------
-    def submit(self, ids, max_new_tokens=None, sampling=None):
+    def submit(self, ids, max_new_tokens=None, sampling=None, *,
+               meta=None, on_token=None):
         """Enqueue one prompt (any length <= max_prompt_len; NO padding
         needed). Returns a Future resolving to the UNPADDED
         [len + generated] int32 sequence (generation stops at EOS, a
@@ -682,7 +842,19 @@ class PagedGenerationServer:
         `max_new_tokens` (arg) overrides `sampling.max_new_tokens`
         overrides the server default. Stop strings require the server
         to be built with a `detokenize` callable; matching runs against
-        the detokenized last `stop_tail_tokens` tokens."""
+        the detokenized last `stop_tail_tokens` tokens.
+
+        meta: optional `RequestMeta` (round 12) — lane / tenant /
+        TTFT deadline / rate cost for the installed front-door
+        scheduler. When a scheduler is installed the request routes
+        into it (its `on_submit` may raise to reject — bounded
+        queues); without one, `meta` rides along inert and the legacy
+        FIFO path runs unchanged.
+        on_token: optional callable `(token:int, reason:str|None)`
+        invoked from the engine thread for every generated token
+        (reason is None mid-stream, the stop reason on the final
+        token). It must be fast and non-blocking; exceptions are
+        logged and dropped, never propagated into the engine loop."""
         if sampling is None:
             sampling = self._default_sampling
         elif not isinstance(sampling, SamplingParams):
@@ -703,9 +875,13 @@ class PagedGenerationServer:
         if not 1 <= budget <= self.max_new:
             raise ValueError(f"max_new_tokens {budget} not in "
                              f"[1, {self.max_new}]")
+        if meta is not None and not isinstance(meta, RequestMeta):
+            raise TypeError(f"meta must be a RequestMeta, "
+                            f"got {type(meta).__name__}")
         req = _Req(ids=ids, future=Future(),
                    t_submit=time.perf_counter(),
-                   rid=f"p{next(_req_ids)}", sampling=sampling)
+                   rid=f"p{next(_req_ids)}", sampling=sampling,
+                   meta=meta, on_token=on_token)
         # per-request PRNG stream seed: explicit seeds reproduce tokens
         # regardless of batch composition; auto seeds derive from the
         # server seed + a submission counter (distinct streams per
@@ -717,8 +893,14 @@ class PagedGenerationServer:
         with self._lock:
             if self._stop:
                 raise RuntimeError("server stopped")
-            self._queue.append(req)
-            _m_queue_depth.labels(server="paged").set(len(self._queue))
+            if self._sched is not None:
+                # scheduler-owned queues: on_submit may raise (bounded
+                # queue rejection) — nothing is enqueued in that case
+                self._sched.on_submit(req, time.perf_counter())
+            else:
+                self._queue.append(req)
+                _m_queue_depth.labels(server="paged").set(
+                    len(self._queue))
             self._lock.notify()
         _tracing.event("request_submitted", request_id=req.rid,
                        prompt_len=int(ids.size), budget=budget)
@@ -743,9 +925,12 @@ class PagedGenerationServer:
             self._thread.join(timeout=120)
             self._thread = None
         with self._lock:
-            for req in self._queue:
-                req.future.set_exception(RuntimeError("server stopped"))
+            pending = list(self._queue)
             self._queue.clear()
+            if self._sched is not None:
+                pending.extend(self._sched.drain())
+            for req in pending:
+                req.future.set_exception(RuntimeError("server stopped"))
 
     def reset_stats(self):
         """Zero the measurement window — latency AND the TTFT samples
@@ -770,6 +955,15 @@ class PagedGenerationServer:
             self._spec_rolled_back = 0
             self._spec_dispatches = 0
             self._spec_rounds_per_slot = 0
+            self._preemptions = 0
+            self._resumes = 0
+            self._preempt_cached_tokens = 0
+            self._deadline_requests = {}
+            self._deadline_misses = {}
+            self._lane_ttft = {}
+            self._lane_itl = {}
+            if self._sched is not None:
+                self._sched.reset_window()
             self._t0 = time.perf_counter()
 
     def stats(self):
@@ -835,10 +1029,66 @@ class PagedGenerationServer:
                     "acceptance_rate": (self._spec_accepted
                                         / (self._spec_proposed or 1)),
                 },
+                # admission headroom RIGHT NOW: free + LRU-reclaimable
+                # blocks — the number the reservation check reasons
+                # about (instantaneous, not a window counter)
+                "available_blocks": self.cache.available_block_count,
+                # queue depths (instantaneous): the FIFO queue without
+                # a scheduler, the scheduler's lane/tenant queues with
+                # one — schema-stable either way (empty dicts when no
+                # front door is installed)
+                "queue_depth": (len(self._queue) if self._sched is None
+                                else self._sched.depth()),
+                "lane_queue_depth": ({} if self._sched is None
+                                     else self._sched.lane_depths()),
+                "tenant_queue_depth": ({} if self._sched is None
+                                       else self._sched.tenant_depths()),
+                # front-door window counters (round 12): zeros when no
+                # scheduler is installed — congruent schema so bench
+                # records and dashboards need no gating (PR 5
+                # convention), reset coherently by reset_stats()
+                "frontdoor": self._frontdoor_stats_locked(),
                 "wall_s": dt,
             }
             out["kv_cache"] = self.cache.stats()
             return out
+
+    def _frontdoor_stats_locked(self):
+        """The stats()["frontdoor"] block; caller holds the lock."""
+        def pcts(samples):
+            s = sorted(samples)
+            n = len(s)
+            return {
+                "p50_ms": (s[min(n - 1, int(0.50 * n))] * 1e3
+                           if n else 0.0),
+                "p99_ms": (s[min(n - 1, int(0.99 * n))] * 1e3
+                           if n else 0.0),
+                "n": n,
+            }
+
+        lanes = {}
+        for lane in sorted(set(self._lane_ttft) | set(self._lane_itl)):
+            lanes[lane] = {
+                "ttft": pcts(self._lane_ttft.get(lane, ())),
+                "itl": pcts(self._lane_itl.get(lane, ())),
+            }
+        d_req = sum(self._deadline_requests.values())
+        d_miss = sum(self._deadline_misses.values())
+        out = {
+            "enabled": self._sched is not None,
+            "preemptions": self._preemptions,
+            "resumes": self._resumes,
+            "preempt_cached_tokens": self._preempt_cached_tokens,
+            "deadline_requests": dict(self._deadline_requests),
+            "deadline_misses": dict(self._deadline_misses),
+            "deadline_miss_rate": d_miss / (d_req or 1),
+            "lanes": lanes,
+            "rejected": 0,
+            "rate_throttled_skips": 0,
+        }
+        if self._sched is not None:
+            out.update(self._sched.window_stats())
+        return out
 
     # ---- engine --------------------------------------------------------
     def _outstanding_blocks(self):
@@ -850,60 +1100,190 @@ class PagedGenerationServer:
                 total += max(0, self._worst[slot["seq"]] - held)
         return total
 
+    def _worst_blocks(self, req):
+        """Worst-case block reservation for `req`: the overrun slack
+        covers a multi-step scan's up-to-k-1 discarded tokens and a
+        verify dispatch's up-to-K speculative positions, plus one spare
+        block for the (at most one) copy-on-write a prefix-cache
+        attach ending mid-block can force. For a PREEMPTED request the
+        resume prompt (ids + generated-so-far) replaces the prompt and
+        the already-generated tokens come off the budget — the total is
+        identical to the original reservation."""
+        prompt = req.resume_ids if req.resume_ids is not None else req.ids
+        remaining = req.budget - len(req.gen0)
+        return self._blocks_for(
+            prompt.size + remaining + self._overrun,
+            self.block_size) + (1 if self.enable_prefix_cache else 0)
+
+    def _install_slot_locked(self, i, req, worst):
+        """Shared admission body: bind `req` to slot `i` (reservation
+        already checked by the caller). A resumed request's slot is
+        re-seeded with its pre-preemption tokens and its resume prompt,
+        so every position/PRNG-step/budget formula downstream is
+        residency-invariant."""
+        seq = self._seq_counter
+        self._seq_counter += 1
+        self._worst[seq] = worst
+        prompt = req.resume_ids if req.resume_ids is not None else req.ids
+        # prefix caching: attach the longest cached block chain and
+        # mark those tokens already-fed — the packed prefill below
+        # starts at the first uncached token. A warm resume attaches
+        # the blocks its own swap-out published (near-zero recompute).
+        cached = 0
+        if self.enable_prefix_cache:
+            cached = self.cache.attach_prefix(seq, prompt)
+        # WARM RESUME fast path (round 12): when every context
+        # position but the last attached from the cache and at least
+        # one token was emitted before the preemption, the slot is
+        # structurally a decode slot already — its last emitted token
+        # is the decode input, position size-1 is the one position to
+        # recompute, and the PRNG step counter is len(gen0). Marking
+        # the prompt fully fed lets it rejoin the next DECODE dispatch
+        # directly: a warm resume costs zero prefill dispatches.
+        warm = (req.resume_ids is not None and bool(req.gen0)
+                and cached >= prompt.size - 1)
+        if warm:
+            # the write block may still be shared with the prefix the
+            # swap-out published — privatize it now (the same CoW
+            # guard the chunked-prefill path runs per chunk)
+            self.cache.prepare_write(seq, prompt.size - 1)
+        # fed: prompt tokens already written to the paged cache —
+        # a slot is in the PREFILL phase until fed == prompt length,
+        # then decodes; t_pre0/t_last anchor the per-request prefill
+        # trace span and the ITL clock
+        self._slots[i] = {"seq": seq, "req": req,
+                          "toks": list(req.gen0), "prompt": prompt,
+                          "pos": req.ids.size, "budget": req.budget,
+                          "fed": prompt.size if warm else cached,
+                          "cached": cached,
+                          "chunks": 0, "t_pre0": None,
+                          "t_last": None}
+        # scatter the request's sampling params into its slot row
+        # (one device row-reset only when the request uses
+        # penalties); the server-level EOS joins its stop-id set —
+        # penalty counts seed from the RESUME prompt, which equals
+        # prompt counts + generated counts, exactly the uninterrupted
+        # run's buffer state
+        self._sp_store.set_slot(i, req.sampling, req.seed,
+                                eos=self.eos, prompt_ids=prompt)
+        if req.resume_ids is not None:
+            self._resumes += 1
+            _m_resumes.inc()
+            _tracing.event("resumed", request_id=req.rid, slot=i,
+                           seq=seq, cached_tokens=cached,
+                           tokens_done=len(req.gen0), warm=warm)
+        _m_slot_refills.inc()
+        _tracing.event("request_admitted", request_id=req.rid,
+                       slot=i, seq=seq, cached_tokens=cached)
+        return seq
+
+    def _preempt_slot_locked(self, i, why="pressure"):
+        """Evict slot `i` mid-flight (round 12): publish its live K/V
+        through the prefix-cache index (when caching is on — the
+        swapped-out blocks park in LRU retention, so a prompt resume
+        re-prefills ~one token unless pool pressure reclaimed them),
+        release its blocks, and hand the request back for requeueing
+        with its generated-so-far tokens saved as resume state. Called
+        between dispatches only (no in-flight device work touches the
+        victim). Returns the request."""
+        s = self._slots[i]
+        seq, req = s["seq"], s["req"]
+        known = (np.concatenate([req.ids,
+                                 np.asarray(s["toks"], np.int32)])
+                 if s["toks"] else req.ids)
+        cached = 0
+        if self.cache.has_seq(seq):  # a never-prefilled slot owns none
+            if self.enable_prefix_cache:
+                cached = self.cache.swap_out_seq(seq, known)
+            else:
+                self.cache.free(seq)
+        del self._worst[seq]
+        self._slots[i] = None
+        self._sp_store.clear_slot(i)
+        req.gen0 = tuple(s["toks"])
+        req.resume_ids = known
+        req.preempts += 1
+        self._preemptions += 1
+        self._preempt_cached_tokens += cached
+        _m_preemptions.labels(reason=why).inc()
+        _m_preempt_cached.inc(cached)
+        _tracing.event("preempted", request_id=req.rid, slot=i, seq=seq,
+                       tokens_done=len(s["toks"]), cached_tokens=cached,
+                       reason=why)
+        return req
+
     def _admit_locked(self):
-        """Fill idle slots from the queue while the pool can cover each
-        request's worst case; runs prefill OUTSIDE the lock? No — prefill
-        here is called with the lock released by the loop; this method
-        only picks (slot, req) pairs."""
+        """Fill idle slots while the pool can cover each request's worst
+        case; runs prefill OUTSIDE the lock? No — prefill here is called
+        with the lock released by the loop; this method only picks
+        (slot, req) pairs. Without a scheduler this is the legacy
+        reservation-FIFO path, bit-identical to pre-round-12; with one,
+        the scheduler orders candidates across lanes/tenants and may
+        preempt victims to make room."""
+        if self._sched is not None:
+            return self._admit_sched_locked()
         picked = []
         for i, slot in enumerate(self._slots):
             if slot is not None or not self._queue:
                 continue
             req = self._queue[0]
-            # worst case includes the overrun slack: a multi-step scan
-            # may write up to steps_per_dispatch-1 discarded tokens past
-            # the budget, and a verify dispatch up to K speculative
-            # positions past the last emitted token — plus one spare
-            # block for the (at most one) copy-on-write a prefix-cache
-            # attach ending mid-block can force
-            worst = self._blocks_for(
-                req.ids.size + req.budget + self._overrun,
-                self.block_size) + (1 if self.enable_prefix_cache else 0)
+            worst = self._worst_blocks(req)
             # available counts LRU-retained prefix blocks: alloc paths
             # reclaim them before raising, so they back reservations
             if self.cache.available_block_count \
                     - self._outstanding_blocks() < worst:
                 break  # head-of-line: keep arrival order under pressure
             self._queue.pop(0)
-            seq = self._seq_counter
-            self._seq_counter += 1
-            self._worst[seq] = worst
-            # prefix caching: attach the longest cached block chain and
-            # mark those tokens already-fed — the packed prefill below
-            # starts at the first uncached token
-            cached = 0
-            if self.enable_prefix_cache:
-                cached = self.cache.attach_prefix(seq, req.ids)
-            # fed: prompt tokens already written to the paged cache —
-            # a slot is in the PREFILL phase until fed == prompt length,
-            # then decodes; t_pre0/t_last anchor the per-request prefill
-            # trace span and the ITL clock
-            self._slots[i] = {"seq": seq, "req": req, "toks": [],
-                              "pos": req.ids.size, "budget": req.budget,
-                              "fed": cached, "cached": cached,
-                              "chunks": 0, "t_pre0": None,
-                              "t_last": None}
-            # scatter the request's sampling params into its slot row
-            # (one device row-reset only when the request uses
-            # penalties); the server-level EOS joins its stop-id set
-            self._sp_store.set_slot(i, req.sampling, req.seed,
-                                    eos=self.eos, prompt_ids=req.ids)
+            seq = self._install_slot_locked(i, req, worst)
             picked.append((i, req, seq))
-            _m_slot_refills.inc()
-            _tracing.event("request_admitted", request_id=req.rid,
-                           slot=i, seq=seq, cached_tokens=cached)
         if picked:
             _m_queue_depth.labels(server="paged").set(len(self._queue))
+        return picked
+
+    def _admit_sched_locked(self):
+        """Scheduler-driven admission (round 12): ask the scheduler for
+        candidates in policy order (lane weights, EDF, tenant fair
+        share, rate limits); a candidate blocked on resources may name
+        preemption victims — each victim is swapped out and requeued,
+        then the reservation is rechecked. A lane whose candidate stays
+        blocked is set aside for this pass (no cross-lane head-of-line
+        blocking) and the other lanes keep admitting."""
+        picked = []
+        blocked: set = set()
+        while True:
+            now = time.perf_counter()
+            req = self._sched.next_request(now, blocked)
+            if req is None:
+                break
+            worst = self._worst_blocks(req)
+            free_i = next((i for i, s in enumerate(self._slots)
+                           if s is None), None)
+
+            def short():
+                return (self.cache.available_block_count
+                        - self._outstanding_blocks()) < worst
+
+            if free_i is None or short():
+                # (slot, resident, remaining tokens): the remaining
+                # budget feeds the policy's drain-wait hysteresis
+                occupied = [(j, self._slots[j]["req"],
+                             self._slots[j]["budget"]
+                             - len(self._slots[j]["toks"]))
+                            for j in range(self.max_slots)
+                            if self._slots[j] is not None]
+                for j in self._sched.victims(req, occupied, now):
+                    victim = self._preempt_slot_locked(j)
+                    self._sched.requeue(victim, now)
+                    free_i = next((i for i, s in enumerate(self._slots)
+                                   if s is None), None)
+                    if free_i is not None and not short():
+                        break
+                if free_i is None or short():
+                    blocked.add(getattr(req.meta, "lane", None))
+                    continue
+            self._sched.pop(req, now)
+            seq = self._install_slot_locked(free_i, req, worst)
+            picked.append((free_i, req, seq))
         return picked
 
     def _prefill_packed(self, pre_idx):
@@ -919,16 +1299,31 @@ class PagedGenerationServer:
         jnp = self._jnp
         align = self._pack_align
         budget = self.prefill_chunk_tokens
+        # chunk-budget sharing (round 12): the scheduler orders the
+        # feeding slots (interactive/EDF first) and may cap each slot's
+        # share of this chunk so one lane cannot monopolize the budget;
+        # without a scheduler the order is slot order, uncapped
+        if self._sched is not None:
+            entries = self._sched.prefill_plan(
+                [(i, self._slots[i]) for i in pre_idx], budget)
+        else:
+            entries = [(i, None) for i in pre_idx]
         plan = []  # (slot_idx, start, n, packed_offset)
         off = 0
-        for i in pre_idx:
+        for i, cap in entries:
             if budget <= 0:
                 break
             s = self._slots[i]
-            n = min(s["req"].ids.size - s["fed"], budget)
+            n = min(s["prompt"].size - s["fed"], budget)
+            if cap is not None:
+                n = min(n, int(cap))
+            if n <= 0:
+                continue
             plan.append((i, s["fed"], n, off))
             off += -(-n // align) * align
             budget -= n
+        if not plan:
+            return
         T = align  # power-of-two bucket: compile count is logarithmic
         while T < off:  # in the packed budget, not per prompt length
             T *= 2
@@ -946,19 +1341,19 @@ class PagedGenerationServer:
         done_rows = []  # (slot_idx, compact_row)
         for r, (i, start, n, o) in enumerate(plan):
             s = self._slots[i]
-            toks[o:o + n] = s["req"].ids[start:start + n]
+            toks[o:o + n] = s["prompt"][start:start + n]
             seg[o:o + n] = r
             pos[o:o + n] = np.arange(start, start + n, dtype=np.int32)
             if s["t_pre0"] is None:
                 s["t_pre0"] = time.perf_counter()
-            if start + n == s["req"].ids.size:
+            if start + n == s["prompt"].size:
                 sample_idx[r] = o + n - 1
                 done_rows.append((i, r))
         # decode-phase slots stall while this dispatch runs — the stall
         # the chunk budget exists to bound
         in_plan = {p[0] for p in plan}
         decoding = any(s is not None and j not in in_plan
-                       and s["fed"] >= s["req"].ids.size
+                       and s["fed"] >= s["prompt"].size
                        for j, s in enumerate(self._slots))
         t0 = time.perf_counter()
         try:
@@ -999,10 +1394,18 @@ class PagedGenerationServer:
                 # rows; token-0 sampling (PRNG step 0) runs the same
                 # vectorized pipeline as decode
                 done_set = {r for _, r in done_rows}
+                # per-row PRNG base step: 0 for a fresh prompt; a
+                # resumed request samples its next token at step
+                # len(generated so far), the exact counter position an
+                # uninterrupted decode would have used
+                base_steps = np.array(
+                    [len(self._slots[plan[r][0]]["toks"])
+                     if r < len(plan) else 0 for r in range(P)],
+                    np.int32)
                 sp_args, sp_mode = self._sp_store.packed_args(
                     [plan[r][0] if r < len(plan) else None
                      for r in range(P)],
-                    [r in done_set for r in range(P)])
+                    [r in done_set for r in range(P)], base_steps)
                 tok, stopped, kc, vc, counts = \
                     self._decoder.packed_prefill(
                         self._params, jnp.asarray(toks),
@@ -1037,23 +1440,43 @@ class PagedGenerationServer:
         for i, r in done_rows:
             s = self._slots[i]
             req = s["req"]
-            req.ttft = t_now - req.t_submit
-            _m_ttft.observe(req.ttft)
+            if req.ttft is None:
+                # first token of the request's LIFETIME — a resumed
+                # request keeps the TTFT of its first residency
+                req.ttft = t_now - req.t_submit
+                _m_ttft.observe(req.ttft)
+                with self._lock:
+                    self._ttft.append(req.ttft)
+                    if req.meta is not None:
+                        lane = req.meta.lane
+                        self._lane_ttft.setdefault(lane, []).append(
+                            req.ttft)
+                        if req.meta.deadline_s is not None:
+                            self._deadline_requests[lane] = \
+                                self._deadline_requests.get(lane, 0) + 1
+                            if req.ttft > req.meta.deadline_s:
+                                self._deadline_misses[lane] = \
+                                    self._deadline_misses.get(lane,
+                                                              0) + 1
+                                _m_deadline_miss.labels(lane=lane).inc()
+                                _m_deadline_overage.observe(
+                                    req.ttft - req.meta.deadline_s)
             if self.enable_prefix_cache:
                 # every prompt K/V position is now written: index the
-                # blocks so later requests can attach this prefix
-                self.cache.publish_prefix(s["seq"], req.ids)
+                # blocks so later requests can attach this prefix (a
+                # resumed request publishes its resume prompt —
+                # original prompt + generated-so-far)
+                self.cache.publish_prefix(s["seq"], s["prompt"])
             # per-request prefill phase for the trace assembler: starts
             # at the request's FIRST chunk dispatch, ends now (its end
             # timestamp IS the request's first-token time)
             _tracing.event("prefill", request_id=req.rid,
                            ts=s["t_pre0"], dur=t_now - s["t_pre0"],
-                           prompt_len=int(req.ids.size), seq=s["seq"],
-                           chunks=s["chunks"],
+                           prompt_len=int(s["prompt"].size),
+                           seq=s["seq"], chunks=s["chunks"],
                            cached_tokens=s["cached"])
             with self._lock:
                 self._prefills += 1
-                self._ttft.append(req.ttft)
             s["t_last"] = t_now
             self._slot_token(i, int(tok_h[r]),
                              device_stopped=bool(stopped_h[r]))
@@ -1076,11 +1499,27 @@ class PagedGenerationServer:
             reason = ("eos" if self.eos >= 0 and tok == self.eos
                       else "stop_token")
         elif sp is not None and sp.stop_strings:
+            # the token list spans preemption boundaries (a resumed
+            # slot is re-seeded with its prior tokens), so a stop
+            # string straddling a swap-out still matches
             tail = self._detok(slot["toks"][-self.stop_tail_tokens:])
             if any(s in tail for s in sp.stop_strings):
                 reason = "stop_string"
         if reason is None and len(slot["toks"]) >= slot["budget"]:
             reason = "budget"
+        cb = slot["req"].on_token
+        if cb is not None:
+            # streaming (round 12): deliver from the engine thread —
+            # the consumer side (frontend.stream) is bounded and
+            # non-blocking; a broken callback must not kill the loop
+            try:
+                cb(tok, reason)
+            except Exception:  # noqa: BLE001 — stream is best-effort
+                _logger.exception(
+                    "on_token callback failed for request %s "
+                    "(stream dropped; generation continues)",
+                    slot["req"].rid)
+                slot["req"].on_token = None
         if reason is not None:
             seq, req = slot["seq"], slot["req"]
             _tracing.event("request_done", request_id=req.rid,
@@ -1122,7 +1561,7 @@ class PagedGenerationServer:
             # in-flight decode never stalls longer than one chunk budget
             pre_idx = [i for i, s in enumerate(self._slots)
                        if s is not None
-                       and s["fed"] < s["req"].ids.size]
+                       and s["fed"] < s["prompt"].size]
             if pre_idx:
                 self._prefill_packed(pre_idx)
             _m_slots_busy.labels(server="paged").set(
@@ -1130,7 +1569,7 @@ class PagedGenerationServer:
             # decode phase: prompt fully fed (first token sampled)
             active_idx = [i for i, s in enumerate(self._slots)
                           if s is not None
-                          and s["fed"] >= s["req"].ids.size]
+                          and s["fed"] >= s["prompt"].size]
             if not active_idx:
                 continue
             # speculative decoding (round 11): eligible slots propose
@@ -1243,6 +1682,9 @@ class PagedGenerationServer:
             per = max(t_now - t_prev, 0.0) / consumed
             with self._lock:
                 self._itl.extend([per] * consumed)
+                if s["req"].meta is not None:
+                    self._lane_itl.setdefault(
+                        s["req"].meta.lane, []).extend([per] * consumed)
             for _ in range(consumed):
                 _m_itl.observe(per)
 
@@ -1385,6 +1827,9 @@ class PagedGenerationServer:
             per = max(t_now - t_prev, 0.0) / consumed
             with self._lock:
                 self._itl.extend([per] * consumed)
+                if s["req"].meta is not None:
+                    self._lane_itl.setdefault(
+                        s["req"].meta.lane, []).extend([per] * consumed)
             for _ in range(consumed):
                 _m_itl.observe(per)
 

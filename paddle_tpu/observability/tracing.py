@@ -274,13 +274,27 @@ def assemble_request_traces(evs=None, path=None):
         if name == "request_submitted" and rid is not None:
             rec(rid)["t_submit"] = ev["ts"]
         elif name == "request_admitted" and rid is not None:
-            rec(rid)["t_admit"] = ev["ts"]
+            # a preempted request is re-admitted: keep the FIRST
+            # admission (phases keep first-residency semantics; the
+            # preempted gap is reported separately as requeue_ms)
+            r = rec(rid)
+            r.setdefault("t_admit", ev["ts"])
+            if "_t_preempt" in r:
+                r["requeue_ms"] = r.get("requeue_ms", 0.0) + \
+                    (ev["ts"] - r.pop("_t_preempt")) * 1e3
+        elif name == "preempted" and rid is not None:
+            r = rec(rid)
+            r["preemptions"] = r.get("preemptions", 0) + 1
+            r["_t_preempt"] = ev["ts"]
         elif name == "prefill" and rid is not None:
             r = rec(rid)
-            r["t_prefill_start"] = ev["ts"]
-            r["t_first_token"] = ev["ts"] + ev.get("dur", 0.0)
+            # keep the FIRST prefill: its end IS the request's first
+            # token; a resume re-prefill lands inside the decode phase
+            r.setdefault("t_prefill_start", ev["ts"])
+            r.setdefault("t_first_token", ev["ts"] + ev.get("dur", 0.0))
             if ev.get("chunks") is not None:
-                r["prefill_chunks"] = ev["chunks"]
+                r["prefill_chunks"] = (r.get("prefill_chunks", 0)
+                                       + ev["chunks"])
         elif name == "decode_dispatch":
             for rid2 in ev.get("request_ids", ()):
                 r = rec(rid2)
@@ -329,6 +343,12 @@ def assemble_request_traces(evs=None, path=None):
         }
         if "prefill_chunks" in r:  # chunked prefill (paged server)
             out[rid]["prefill_chunks"] = r["prefill_chunks"]
+        if r.get("preemptions"):  # front door (round 12): the decode
+            # phase of a preempted request absorbs its swap-out,
+            # requeue wait, and resume re-prefill; requeue_ms says how
+            # much of it was spent evicted
+            out[rid]["preemptions"] = r["preemptions"]
+            out[rid]["requeue_ms"] = round(r.get("requeue_ms", 0.0), 4)
     return out
 
 

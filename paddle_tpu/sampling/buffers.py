@@ -174,11 +174,40 @@ class SlotParamStore:
             sp["crows"] = jnp.asarray(np.array(rows, np.int32))
         return sp, mode
 
-    def packed_args(self, slot_rows, done_mask):
+    def warm_args(self, n_rows, mode=GREEDY_MODE):
+        """Packed-prefill argument dict SHAPED like a live dispatch for
+        `n_rows` plan rows under `mode`, built from idle-slot defaults —
+        the sampling-buffer side of the serving engine's shape-bucket
+        pre-warm (`PagedGenerationServer.warm_buckets`). Every key a
+        real `packed_args` call would carry for that mode is present
+        with the same dtype/shape (stop-matrix width 1 — the idle /
+        single-stop-id case, which is also the pow2 bucket a lone EOS
+        id selects), so a jitted variant compiled against it is the
+        variant live traffic hits."""
+        import jax.numpy as jnp
+
+        rows = [0] * int(n_rows)
+        steps = np.zeros((len(rows),), np.int32)
+        sp = self._assemble(rows, steps, mode)
+        if mode[0]:
+            # no warm row ever actually samples (mirrors packed_args'
+            # padding-row masking: same structure, all-False)
+            sp["sample"] = sp["sample"] & jnp.zeros((len(rows),), bool)
+        if mode[1]:
+            sp["crows"] = jnp.asarray(np.array(rows, np.int32))
+            sp["row_done"] = jnp.asarray(np.zeros((len(rows),), bool))
+        return sp
+
+    def packed_args(self, slot_rows, done_mask, steps=None):
         """Packed-prefill arguments: compact plan rows. `slot_rows` maps
         plan row -> slot index (None = padding row); `done_mask` marks
         rows whose prompt completes this chunk (the only rows whose
-        token-0 sample is real). Token-0 sampling is PRNG step 0.
+        token-0 sample is real). `steps` [P] int32 is each row's PRNG
+        base step — 0 for a fresh prompt (token 0 samples at step 0),
+        and the generated-token count for a PREEMPTED request resuming
+        by re-prefill (round 12), so the resume prefill draws the same
+        counter-based stream position an uninterrupted decode would
+        have. None = all zeros (the exact pre-resume behavior).
         Returns (sp dict, mode)."""
         import jax.numpy as jnp
 
@@ -186,7 +215,9 @@ class SlotParamStore:
         mode = self.mode(real)
         rows = [r if r is not None else 0 for r in slot_rows]
         valid = np.array([r is not None for r in slot_rows], bool)
-        sp = self._assemble(rows, np.zeros((len(rows),), np.int32), mode)
+        if steps is None:
+            steps = np.zeros((len(rows),), np.int32)
+        sp = self._assemble(rows, np.asarray(steps, np.int32), mode)
         if not mode[0]:
             sp.pop("sample", None)
         else:

@@ -1,0 +1,578 @@
+"""Front door (round 12): streaming with stop-string-safe deltas,
+SLO lanes + deadlines, preemption with prefix-cache swap-out (token
+parity vs uninterrupted runs), and multi-tenant fairness (token
+buckets, bounded queues, chunk sharing)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(11)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _detok(toks):
+    """Prefix-stable toy detokenizer: every token renders [id]."""
+    return "".join(f"[{int(t)}]" for t in toks)
+
+
+class TestDeltaAssembler:
+    def test_deltas_concatenate_to_full_text(self):
+        from paddle_tpu.frontend import DeltaAssembler
+
+        asm = DeltaAssembler(_detok, tail_tokens=4)
+        toks = [3, 14, 159, 2, 65, 35]
+        out = "".join(asm.push(t) for t in toks) + asm.finish("budget")
+        assert out == _detok(toks)
+
+    def test_holdback_never_releases_stop_prefix(self):
+        """The satellite fix: before each delta is released, the tail
+        is re-checked — released text never ends with a proper prefix
+        of a stop string, so a suppressed stop string can never have
+        leaked partially."""
+        from paddle_tpu.frontend import DeltaAssembler
+
+        stop = "[7][8][9]"
+        asm = DeltaAssembler(_detok, stop_strings=(stop,),
+                             tail_tokens=8)
+        released = ""
+        for t in (1, 7, 8, 2, 7, 8):  # [7][8] prefixes that fizzle
+            released += asm.push(t)
+            for ln in range(1, len(stop)):
+                assert not released.endswith(stop[:ln]), (t, released)
+            assert stop not in released
+        released += asm.finish("budget")
+        # no stop ever completed: everything is eventually released
+        assert released == _detok([1, 7, 8, 2, 7, 8])
+
+    def test_completed_stop_string_is_suppressed(self):
+        from paddle_tpu.frontend import DeltaAssembler
+
+        stop = "[8][9]"
+        asm = DeltaAssembler(_detok, stop_strings=(stop,),
+                             tail_tokens=8)
+        released = "".join(asm.push(t) for t in (1, 2, 8, 9))
+        released += asm.finish("stop_string")
+        assert released == _detok([1, 2])
+        assert stop not in released
+
+    def test_text_after_stop_match_is_suppressed_too(self):
+        from paddle_tpu.frontend import DeltaAssembler
+
+        asm = DeltaAssembler(lambda ts: "".join(chr(int(t)) for t in ts),
+                             stop_strings=("XY",), tail_tokens=8)
+        released = "".join(asm.push(t) for t in
+                           (ord("a"), ord("X"), ord("Y"), ord("b")))
+        released += asm.finish("stop_string")
+        assert released == "a"
+
+
+class TestStreamHandle:
+    def test_backpressure_coalesces_without_loss(self):
+        from paddle_tpu.frontend import StreamHandle
+
+        h = StreamHandle(max_buffered=2)
+        for t in range(9):
+            h._on_token(t, None)
+        h._on_token(9, "budget")
+        evs = list(h)
+        assert len(evs) <= 2
+        got = [t for ev in evs for t in ev.token_ids]
+        assert got == list(range(10))  # nothing dropped
+        assert h.coalesced == 8
+        assert evs[-1].done and evs[-1].stop_reason == "budget"
+        assert h.stop_reason == "budget"
+
+
+class TestTenancy:
+    def test_token_bucket_is_deterministic(self):
+        from paddle_tpu.frontend import TokenBucket
+
+        b = TokenBucket(rate=10.0, burst=20.0)
+        assert b.affords(15, now=0.0)
+        b.charge(15, now=0.0)
+        assert not b.affords(10, now=0.0)   # 5 left
+        assert b.affords(10, now=0.5)       # +5 refilled
+        b.charge(10, now=0.5)
+        assert b.level == 0.0
+
+    def test_oversized_cost_runs_on_debt_not_starvation(self):
+        from paddle_tpu.frontend import TokenBucket
+
+        b = TokenBucket(rate=10.0, burst=20.0)
+        assert b.affords(100, now=0.0)      # full bucket admits it
+        b.charge(100, now=0.0)
+        assert b.level == -80.0
+        assert not b.affords(1, now=1.0)    # repaying debt
+        assert b.affords(1, now=8.1)        # -80 + 81 = 1
+
+    def test_tenant_config_validation(self):
+        from paddle_tpu.frontend import TenantConfig
+
+        with pytest.raises(ValueError, match="weight"):
+            TenantConfig(weight=0)
+        with pytest.raises(ValueError, match="rate_tokens_per_s"):
+            TenantConfig(rate_tokens_per_s=-1)
+        with pytest.raises(ValueError, match="max_queued"):
+            TenantConfig(max_queued=0)
+
+
+def _fake_req(lane="interactive", tenant="default", deadline=None,
+              t_submit=0.0, cost=10):
+    from types import SimpleNamespace
+
+    from paddle_tpu.frontend import RequestMeta
+
+    return SimpleNamespace(
+        meta=RequestMeta(lane=lane, tenant=tenant, deadline_s=deadline,
+                         cost=cost),
+        t_submit=t_submit, ids=np.zeros(4, np.int32), budget=4)
+
+
+class TestLaneScheduler:
+    def test_edf_within_interactive_lane(self):
+        from paddle_tpu.frontend import LaneScheduler
+
+        s = LaneScheduler()
+        late = _fake_req(deadline=9.0, t_submit=0.0)
+        soon = _fake_req(deadline=1.0, t_submit=0.1, tenant="other")
+        undated = _fake_req(t_submit=-1.0, tenant="third")
+        for r in (late, soon, undated):
+            s.on_submit(r, 0.2)
+        assert s.next_request(0.2) is soon
+        s.pop(soon, 0.2)
+        assert s.next_request(0.2) is late  # dated before undated
+        s.pop(late, 0.2)
+        assert s.next_request(0.2) is undated
+
+    def test_lane_weights_interleave_without_starvation(self):
+        from paddle_tpu.frontend import LaneScheduler
+
+        s = LaneScheduler()  # default 4:1 interactive:batch
+        for k in range(10):
+            s.on_submit(_fake_req(lane="interactive",
+                                  t_submit=float(k)), 0.0)
+            s.on_submit(_fake_req(lane="batch", t_submit=float(k)),
+                        0.0)
+        order = []
+        for _ in range(10):
+            r = s.next_request(0.0)
+            order.append(r.meta.lane)
+            s.pop(r, 0.0)
+        assert order.count("batch") == 2  # 4:1 service ratio
+        assert order.count("interactive") == 8
+
+    def test_tenant_fair_share_by_weight(self):
+        from paddle_tpu.frontend import LaneScheduler, TenantConfig
+
+        s = LaneScheduler([TenantConfig("heavy", weight=2.0),
+                           TenantConfig("light", weight=1.0)],
+                          lane_weights={"interactive": 1, "batch": 1})
+        for k in range(12):
+            s.on_submit(_fake_req(lane="batch", tenant="heavy",
+                                  t_submit=float(k), cost=10), 0.0)
+            s.on_submit(_fake_req(lane="batch", tenant="light",
+                                  t_submit=float(k), cost=10), 0.0)
+        served = []
+        for _ in range(9):
+            r = s.next_request(0.0)
+            served.append(r.meta.tenant)
+            s.pop(r, 0.0)
+        assert served.count("heavy") == 6  # 2:1 stride share
+        assert served.count("light") == 3
+
+    def test_rate_limit_delays_and_bounded_queue_rejects(self):
+        from paddle_tpu.frontend import (LaneScheduler, QueueFull,
+                                         TenantConfig)
+
+        s = LaneScheduler([TenantConfig("t", rate_tokens_per_s=10.0,
+                                        burst_tokens=10.0,
+                                        max_queued=2)])
+        a = _fake_req(tenant="t", cost=10, t_submit=0.0)
+        b = _fake_req(tenant="t", cost=10, t_submit=1.0)
+        s.on_submit(a, 0.0)
+        s.on_submit(b, 0.0)
+        with pytest.raises(QueueFull):          # bounded queue rejects
+            s.on_submit(_fake_req(tenant="t"), 0.0)
+        assert s.window_stats()["rejected"] == 1
+        assert s.next_request(0.0) is a
+        s.pop(a, 0.0)                           # bucket drained to 0
+        assert s.next_request(0.0) is None      # b throttled: DELAYED
+        assert s.window_stats()["rate_throttled_skips"] >= 1
+        assert s.depth() == 1                   # still queued
+        assert s.next_request(1.0) is b         # refilled: eligible
+
+    def test_victims_are_batch_only_newest_first(self):
+        from paddle_tpu.frontend import LaneScheduler
+
+        s = LaneScheduler()
+        occupied = [(0, _fake_req(lane="batch", t_submit=1.0), 40),
+                    (1, _fake_req(lane="interactive", t_submit=2.0),
+                     40),
+                    (2, _fake_req(lane="batch", t_submit=3.0), 40)]
+        inter = _fake_req(lane="interactive", t_submit=4.0)
+        batch = _fake_req(lane="batch", t_submit=4.0)
+        assert s.victims(inter, occupied, 0.0) == [2, 0]
+        assert s.victims(batch, occupied, 0.0) == []
+        s2 = LaneScheduler(preemption=False)
+        assert s2.victims(inter, occupied, 0.0) == []
+
+    def test_drain_wait_hysteresis(self):
+        """A resident within preempt_wait_tokens of its budget means
+        its slot frees in a few rounds: the candidate waits instead of
+        paying a swap-out/resume cycle — unless its deadline has
+        already passed, in which case lateness beats churn."""
+        from paddle_tpu.frontend import LaneScheduler
+
+        s = LaneScheduler(preempt_wait_tokens=4)
+        near = [(0, _fake_req(lane="batch", t_submit=1.0), 40),
+                (1, _fake_req(lane="interactive", t_submit=2.0), 3)]
+        far = [(0, _fake_req(lane="batch", t_submit=1.0), 40),
+               (1, _fake_req(lane="interactive", t_submit=2.0), 30)]
+        inter = _fake_req(lane="interactive", t_submit=4.0)
+        assert s.victims(inter, near, 4.0) == []     # wait it out
+        assert s.victims(inter, far, 4.0) == [0]     # nobody close
+        # deadline already missed: preempt even with a near-finisher
+        late = _fake_req(lane="interactive", deadline=0.5, t_submit=4.0)
+        assert s.victims(late, near, 4.4) == []      # not yet late
+        assert s.victims(late, near, 4.6) == [0]     # past deadline
+        s0 = LaneScheduler(preempt_wait_tokens=0)    # hysteresis off
+        assert s0.victims(inter, near, 4.0) == [0]
+        with pytest.raises(ValueError, match="preempt_wait_tokens"):
+            LaneScheduler(preempt_wait_tokens=-1)
+
+    def test_prefill_plan_caps_interactive_share(self):
+        from paddle_tpu.frontend import LaneScheduler
+
+        s = LaneScheduler(interactive_chunk_share=0.7)
+
+        def slot(lane, need, t=0.0, deadline=None):
+            return {"req": _fake_req(lane=lane, deadline=deadline,
+                                     t_submit=t),
+                    "prompt": np.zeros(need, np.int32), "fed": 0}
+
+        entries = [(0, slot("batch", 100)),
+                   (1, slot("interactive", 80, deadline=5.0)),
+                   (2, slot("interactive", 80, deadline=1.0))]
+        plan = s.prefill_plan(entries, budget=100)
+        # interactive first, EDF order, capped at 70 total
+        assert [i for i, _ in plan] == [2, 1, 0]
+        caps = dict(plan)
+        assert caps[2] + caps[1] == 70
+        assert caps[0] is None
+        # one lane only: no caps
+        solo = s.prefill_plan(entries[1:], budget=100)
+        assert all(c is None for _, c in solo)
+
+
+class TestFrontDoorServing:
+    def test_streaming_deltas_and_stop_string_suppression(
+            self, tiny_model):
+        from paddle_tpu.frontend import FrontDoor
+        from paddle_tpu.sampling import SamplingParams
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(21)
+        p = rs.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+        ref = model.generate(p[None], 6).numpy()[0]
+        gen = [int(t) for t in ref[p.size:]]
+        # stop at the LAST generated token whose rendering does not
+        # already occur earlier in the stream (an earlier occurrence
+        # would legitimately stop the server there instead)
+        j = max(k for k in range(len(gen)) if gen[k] not in gen[:k])
+        stop = _detok([gen[j]])
+        fd = FrontDoor(model, max_slots=1, block_size=4,
+                       max_prompt_len=8, max_new_tokens=6,
+                       detokenize=_detok).start()
+        try:
+            h = fd.submit(p, sampling=SamplingParams(
+                stop_strings=(stop,)))
+            evs = list(h)
+            out = h.result(timeout=300)
+        finally:
+            fd.stop()
+        assert h.stop_reason == "stop_string"
+        assert evs[-1].done
+        # streamed text: everything before the match, suppressed after
+        assert h.text() == _detok(gen[:j])
+        assert stop not in h.text()
+        # the classic array surface still carries the emitted tokens
+        np.testing.assert_array_equal(out, ref[:p.size + j + 1])
+        # token ids streamed == tokens generated
+        assert [t for ev in evs for t in ev.token_ids] == gen[:j + 1]
+
+    @pytest.mark.parametrize("cache_on", [True, False])
+    @pytest.mark.parametrize("mode", ["greedy", "sampled"])
+    def test_preempt_then_resume_token_parity(self, tiny_model,
+                                              cache_on, mode):
+        """Satellite: a preempted-then-resumed request must produce
+        token-identical output to an uninterrupted run — greedy and
+        fixed-seed sampled (penalties included), prefix cache ON and
+        OFF (the counter-based PRNG + residency-invariant slot state
+        carry the guarantee; the cache only changes the resume COST)."""
+        from paddle_tpu.frontend import FrontDoor
+        from paddle_tpu.sampling import SamplingParams
+
+        model, cfg = tiny_model
+        sp = (None if mode == "greedy" else
+              SamplingParams(temperature=0.8, top_p=0.9,
+                             repetition_penalty=1.3, seed=77))
+        rs = np.random.RandomState(33)
+        pv = rs.randint(1, cfg.vocab_size, (7,)).astype(np.int32)
+        pi = rs.randint(1, cfg.vocab_size, (4,)).astype(np.int32)
+
+        def build():
+            return FrontDoor(model, max_slots=1, block_size=4,
+                             max_prompt_len=16, max_new_tokens=24,
+                             enable_prefix_cache=cache_on).start()
+
+        fd = build()
+        try:
+            hv = fd.submit(pv, lane="batch", sampling=sp,
+                           max_new_tokens=24)
+            it = iter(hv)
+            next(it)
+            next(it)  # victim has emitted >= 2 tokens
+            hi = fd.submit(pi, lane="interactive", max_new_tokens=3)
+            out_i = hi.result(timeout=300)
+            out_v = hv.result(timeout=300)
+            st = fd.stats()["frontdoor"]
+            assert st["preemptions"] >= 1
+            assert st["resumes"] >= 1
+            if cache_on:
+                assert st["preempt_cached_tokens"] > 0
+            else:
+                assert st["preempt_cached_tokens"] == 0
+        finally:
+            fd.stop()
+        # uninterrupted references on a fresh front door
+        fd2 = build()
+        try:
+            ref_v = fd2.submit(pv, lane="batch", sampling=sp,
+                               max_new_tokens=24).result(timeout=300)
+            ref_i = fd2.submit(pi, lane="interactive",
+                               max_new_tokens=3).result(timeout=300)
+        finally:
+            fd2.stop()
+        np.testing.assert_array_equal(out_v, ref_v)
+        np.testing.assert_array_equal(out_i, ref_i)
+
+    def test_bounded_queue_rejects_at_submit(self, tiny_model):
+        from paddle_tpu.frontend import FrontDoor, QueueFull
+
+        model, cfg = tiny_model
+        fd = FrontDoor(model, max_slots=1, block_size=4,
+                       max_prompt_len=8, max_new_tokens=4, max_queue=1)
+        # server not started: submissions stay queued in the scheduler
+        fd.submit(np.array([1, 2, 3], np.int32))
+        with pytest.raises(QueueFull, match="front-door queue full"):
+            fd.submit(np.array([4, 5], np.int32))
+        assert fd.stats()["frontdoor"]["rejected"] == 1
+        fd.stop()  # fails the queued future, frees nothing else
+
+    def test_rate_limited_tenant_is_delayed_not_rejected(
+            self, tiny_model):
+        from paddle_tpu.frontend import FrontDoor, TenantConfig
+
+        model, cfg = tiny_model
+        # cost per request = 3 prompt + 2 budget = 5; burst covers one
+        fd = FrontDoor(model, max_slots=2, block_size=4,
+                       max_prompt_len=8, max_new_tokens=2,
+                       tenants=[TenantConfig("slow",
+                                             rate_tokens_per_s=50.0,
+                                             burst_tokens=5.0)]).start()
+        try:
+            rs = np.random.RandomState(5)
+            ps = [rs.randint(1, cfg.vocab_size, (3,)).astype(np.int32)
+                  for _ in range(2)]
+            hs = [fd.submit(p, tenant="slow") for p in ps]
+            for h, p in zip(hs, ps):
+                out = h.result(timeout=300)
+                np.testing.assert_array_equal(
+                    out, model.generate(p[None], 2).numpy()[0])
+            st = fd.stats()["frontdoor"]
+            assert st["rate_throttled_skips"] >= 1  # delayed...
+            assert st["rejected"] == 0              # ...not rejected
+        finally:
+            fd.stop()
+
+    def test_deadline_miss_counted_per_lane(self, tiny_model):
+        from paddle_tpu.frontend import FrontDoor
+
+        model, cfg = tiny_model
+        fd = FrontDoor(model, max_slots=1, block_size=4,
+                       max_prompt_len=8, max_new_tokens=2).start()
+        try:
+            fd.submit(np.array([1, 2, 3], np.int32),
+                      deadline_ms=0.01).result(timeout=300)
+            st = fd.stats()["frontdoor"]
+            assert st["deadline_requests"] == {"interactive": 1}
+            assert st["deadline_misses"] == {"interactive": 1}
+            assert st["deadline_miss_rate"] == 1.0
+            assert st["lanes"]["interactive"]["ttft"]["n"] == 1
+            fd.reset_stats()
+            st = fd.stats()["frontdoor"]
+            assert st["deadline_misses"] == {}  # coherent reset
+            assert st["preemptions"] == 0
+        finally:
+            fd.stop()
+
+    def test_unknown_tenant_rejected_with_explicit_roster(
+            self, tiny_model):
+        from paddle_tpu.frontend import FrontDoor, TenantConfig
+
+        model, cfg = tiny_model
+        fd = FrontDoor(model, max_slots=1, block_size=4,
+                       max_prompt_len=8, max_new_tokens=2,
+                       tenants=[TenantConfig("known")])
+        with pytest.raises(ValueError, match="unknown tenant"):
+            fd.submit(np.array([1, 2], np.int32), tenant="who")
+        fd.stop()
+
+
+class TestEngineSatellites:
+    def test_stats_schema_available_blocks_and_queues(self,
+                                                      tiny_model):
+        """Satellite 1: available_block_count + per-lane/per-tenant
+        queue depth surface in stats() with congruent schema on a
+        PLAIN server (front door off -> zeros/empties), and reset()
+        stays coherent."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8, max_new_tokens=2)
+        st = srv.stats()
+        assert st["available_blocks"] == \
+            srv.cache.available_block_count > 0
+        assert st["queue_depth"] == 0
+        assert st["lane_queue_depth"] == {}
+        assert st["tenant_queue_depth"] == {}
+        fr = st["frontdoor"]
+        assert fr["enabled"] is False
+        for k in ("preemptions", "resumes", "preempt_cached_tokens",
+                  "rejected", "rate_throttled_skips"):
+            assert fr[k] == 0
+        assert fr["deadline_miss_rate"] == 0.0
+        srv.reset_stats()
+        assert srv.stats()["frontdoor"]["preemptions"] == 0
+        srv.stop()
+
+    def test_plain_server_on_token_callback_and_fault_isolation(
+            self, tiny_model):
+        """The engine-level streaming hook works without a front door,
+        and a broken callback is dropped, not fatal."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1, block_size=4,
+                                    max_prompt_len=8,
+                                    max_new_tokens=3).start()
+        try:
+            seen = []
+
+            def cb(tok, reason):
+                seen.append((tok, reason))
+                raise RuntimeError("boom")  # must not kill the loop
+
+            p = np.array([5, 6, 7], np.int32)
+            out = srv.submit(p, on_token=cb).result(timeout=300)
+            ref = model.generate(p[None], 3).numpy()[0]
+            np.testing.assert_array_equal(out, ref)
+            # first callback raised -> dropped after delivery #1
+            assert len(seen) == 1 and seen[0][0] == int(ref[3])
+            # server still serves
+            out2 = srv.submit(p).result(timeout=300)
+            np.testing.assert_array_equal(out2, ref)
+        finally:
+            srv.stop()
+
+    def test_warm_buckets_compiles_without_state_change(self,
+                                                        tiny_model):
+        """warm_buckets pre-compiles the packed-prefill shape buckets
+        with synthetic all-pad dispatches: the pool, sequences, and
+        served output are untouched, and calling it after start()
+        is refused (the loop owns the cache arrays by then)."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+
+        def build():
+            return PagedGenerationServer(
+                model, max_slots=2, block_size=4, max_prompt_len=8,
+                max_new_tokens=2, prefill_chunk_tokens=8,
+                enable_prefix_cache=True)
+
+        srv = build()
+        avail0 = srv.cache.available_block_count
+        assert srv.warm_buckets() >= 2  # >= one variant per (T, P)
+        assert srv.cache.available_block_count == avail0  # no allocs
+        rs = np.random.RandomState(9)
+        ps = [rs.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+              for n in (3, 5)]
+        srv.start()
+        try:
+            for p in ps:
+                out = srv.submit(p).result(timeout=300)
+                np.testing.assert_array_equal(
+                    out, model.generate(p[None], 2).numpy()[0])
+            with pytest.raises(RuntimeError, match="before start"):
+                srv.warm_buckets()
+        finally:
+            srv.stop()
+
+    def test_swap_out_seq_publishes_live_prefix(self, tiny_model):
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+
+        cache = PagedKVCache(1, 1, 4, block_size=4, num_blocks=8)
+        ids = np.arange(100, 112, dtype=np.int32)
+        cache.ensure_many([("s", 10)])  # 10 live of 12 known
+        with pytest.raises(ValueError, match="only .* token ids"):
+            cache.swap_out_seq("s", ids[:8])
+        assert cache.swap_out_seq("s", ids) == 10
+        assert not cache.has_seq("s")
+        assert cache.retained_block_count > 0
+        # resume attaches the published chain: 2 full blocks (the
+        # partial 3rd block tail matches up to len-1 = 9 tokens)
+        assert cache.attach_prefix("s2", ids[:10]) == 9
+
+    def test_preemption_trace_assembles_with_requeue_phase(
+            self, tiny_model):
+        """The trace assembler folds re-admission events instead of
+        double-counting: one record, preemptions + requeue_ms set,
+        phases still tile submit->end."""
+        from paddle_tpu.observability.tracing import \
+            assemble_request_traces
+
+        evs = [
+            {"name": "request_submitted", "request_id": "r", "ts": 0.0},
+            {"name": "request_admitted", "request_id": "r", "ts": 0.1},
+            {"name": "prefill", "request_id": "r", "ts": 0.2,
+             "dur": 0.1, "chunks": 1},
+            {"name": "preempted", "request_id": "r", "ts": 0.5},
+            {"name": "request_admitted", "request_id": "r", "ts": 0.8},
+            {"name": "prefill", "request_id": "r", "ts": 0.9,
+             "dur": 0.1, "chunks": 2},
+            {"name": "request_done", "request_id": "r", "ts": 1.5,
+             "new_tokens": 5, "ttft_s": 0.3},
+            {"name": "detokenize", "request_id": "r", "ts": 1.5,
+             "dur": 0.1},
+        ]
+        rec = assemble_request_traces(evs)["r"]
+        assert rec["preemptions"] == 1
+        assert rec["requeue_ms"] == pytest.approx(300.0)
+        assert rec["ttft_ms"] == pytest.approx(300.0)
+        assert rec["prefill_chunks"] == 3
+        assert sum(rec["phases_ms"].values()) == \
+            pytest.approx(rec["wall_ms"])

@@ -314,15 +314,17 @@ def _run_served_bench(*args, timeout=600):
 def test_served_bench_axis_emits_records():
     """`bench.py served` (mixed-length traffic: padded vs paged
     closed-loop, the open-loop Poisson axis, the shared-prefix caching
-    axis, and the round-11 speculation axis) must emit all six JSON
-    records; slow-marked so tier-1 stays fast."""
+    axis, the round-11 speculation axis, and the round-12 front-door
+    axis) must emit all seven JSON records; slow-marked so tier-1
+    stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 6, stdout
+    assert len(recs) == 7, stdout
     assert any("paged" in rec["metric"] for rec in recs)
     assert any("mixedsampling" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
     assert any("sharedprefix" in rec["metric"] for rec in recs)
     assert any("speculative" in rec["metric"] for rec in recs)
+    assert any("frontdoor" in rec["metric"] for rec in recs)
     for rec in recs:
         assert rec["value"] > 0
         assert rec.get("degraded") is True
@@ -333,6 +335,17 @@ def test_served_bench_axis_emits_records():
     spec = next(r for r in recs if "speculative" in r["metric"])
     assert spec["vs_baseline"] >= 1.5, spec
     assert spec["tok_s_ratio_oracle"] >= spec["vs_baseline"] * 0.9
+    # the front-door acceptance bars (round 12): under the adversarial
+    # bully-burst + bursty-Poisson mix at identical arrivals, the
+    # interactive lane's TTFT p99 must be >= 3x better than the
+    # single-lane FIFO engine while the batch lane keeps >= 85% of its
+    # throughput, with preemption actually exercised
+    fd = next(r for r in recs if "frontdoor" in r["metric"])
+    assert fd["vs_baseline"] >= 3.0, fd
+    assert fd["batch_throughput_ratio"] >= 0.85, fd
+    assert fd["preemptions"] >= 1, fd
+    assert fd["resumes"] >= 1, fd
+    assert fd["preempt_cached_tokens"] > 0, fd
 
 
 def test_served_bench_openloop_tiny_schema():
@@ -341,16 +354,18 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=420)
-    assert len(recs) == 5, stdout
+    assert len(recs) == 6, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
-                 and "speculative" not in r["metric"])
+                 and "speculative" not in r["metric"]
+                 and "frontdoor" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
     sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
     spec_rec = next(r for r in recs if "speculative" in r["metric"])
-    for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec):
+    fd_rec = next(r for r in recs if "frontdoor" in r["metric"])
+    for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -394,3 +409,20 @@ def test_served_bench_openloop_tiny_schema():
         spec_rec["accepted_tokens"] + spec_rec["rolled_back_tokens"])
     assert 0.0 <= spec_rec["acceptance_rate"] <= 1.0
     assert spec_rec["verify_dispatches"] >= 1
+    # front-door axis (round 12): adversarial mix accounting — lanes,
+    # deadlines, preemption/resume conservation, batch-cost fields
+    for fld in ("vs_baseline", "interactive_ttft_p50_ms",
+                "interactive_ttft_p99_ms_baseline",
+                "deadline_miss_rate", "deadline_miss_rate_baseline",
+                "deadline_ms", "batch_tokens_per_sec",
+                "batch_tokens_per_sec_baseline",
+                "batch_throughput_ratio", "preemptions", "resumes",
+                "preempt_cached_tokens", "rejected", "n_bully",
+                "n_interactive"):
+        assert fld in fd_rec, fd_rec
+    # the tiny mix preempts (hysteresis pinned off in the smoke) and
+    # every preemption must later resume
+    assert fd_rec["preemptions"] >= 1, fd_rec
+    assert fd_rec["resumes"] == fd_rec["preemptions"], fd_rec
+    assert 0.0 <= fd_rec["deadline_miss_rate"] <= 1.0
+    assert fd_rec["batch_tokens_per_sec"] > 0
