@@ -509,6 +509,13 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     headline, and the record carries hit-rate / CoW / eviction /
     retained-block stats from the content-addressed pool.
 
+    An eighth record is the QUANTIZATION axis (quantized-serving
+    round): identical fixed-seed Poisson arrivals through bf16 /
+    W8A16 / W8A16+int8-KV servers — served tok/s, TTFT/ITL, greedy
+    token match + logit probe vs bf16, and the slot capacity each kv
+    dtype backs at the bf16 pool's byte budget (the CPU-provable
+    >= 1.8x bar; tok/s is a chip number, CPU has no int8 MXU).
+
     tiny=True (`bench.py served --tiny`): seconds-scale smoke config
     that skips the padded comparison and telemetry — it exists so
     tier-1 can assert the served/open-loop/shared-prefix record SCHEMA
@@ -716,6 +723,15 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     # the same way in both runs.
     st_fd = _bench_served_frontdoor(model, cfg, on_tpu, tiny)
 
+    # (h) QUANTIZATION axis (quantized-serving round): identical
+    # fixed-seed Poisson arrivals through bf16 / W8A16 / W8A16+int8-KV
+    # servers — tok/s + TTFT/ITL + accuracy delta, plus the slot
+    # capacity each kv dtype backs at the bf16 pool's byte budget (the
+    # CPU-provable bar: no int8 MXU off-chip, so the tok/s headline is
+    # a chip number).
+    st_qz = _bench_served_quantization(model, cfg, prompts, slots, bs,
+                                       hi, new, k, chunk, on_tpu, tiny)
+
     base = "gpt2tiny_served" if tiny else "gpt2s_served"
     suffix = "" if on_tpu else "_CPU_DEGRADED"
     rec_paged = {
@@ -832,6 +848,44 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         "itl_p99_ms": round(sp_on["itl_p99_ms"], 2),
         "prefill_dispatches": sp_on["prefill_dispatches"],
     }
+    qz_b, qz_w, qz_q = (st_qz["modes"]["bf16"], st_qz["modes"]["w8a16"],
+                        st_qz["modes"]["w8a16_kv8"])
+    rec_qz = {
+        "metric": f"{base}_quantized_tokens_per_sec{suffix}",
+        "value": round(qz_q["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        # >1 = W8A16+int8-KV serves that many times the bf16 tok/s at
+        # IDENTICAL fixed-seed arrivals (chip bar: >= 1.3x; CPU runs
+        # lack an int8 MXU, so the CPU-provable bar is
+        # slot_capacity_ratio >= 1.8 below)
+        "vs_baseline": round(qz_q["tokens_per_sec"]
+                             / max(qz_b["tokens_per_sec"], 1e-9), 3),
+        "baseline": "same arrivals/prompts, bf16 weights + bf16 KV",
+        "tokens_per_sec_bf16": round(qz_b["tokens_per_sec"], 1),
+        "tokens_per_sec_w8a16": round(qz_w["tokens_per_sec"], 1),
+        "ttft_p50_ms": round(qz_q["ttft_p50_ms"], 2),
+        "ttft_p50_ms_bf16": round(qz_b["ttft_p50_ms"], 2),
+        "itl_p99_ms": round(qz_q["itl_p99_ms"], 2),
+        "itl_p99_ms_bf16": round(qz_b["itl_p99_ms"], 2),
+        "p99_ms": round(qz_q["p99_ms"], 1),
+        "prefill_dispatches": qz_q["prefill_dispatches"],
+        # capacity at FIXED pool bytes (the bf16 pool's budget): the
+        # admission-reservation slot count each kv dtype backs
+        "max_slots_at_fixed_bytes": st_qz["slots_int8"],
+        "max_slots_at_fixed_bytes_bf16": st_qz["slots_bf16"],
+        "slot_capacity_ratio": round(
+            st_qz["slots_int8"] / max(st_qz["slots_bf16"], 1), 3),
+        "pool_budget_bytes": st_qz["pool_budget_bytes"],
+        "kv_bytes_per_token": round(qz_q["bytes_per_token"], 2),
+        "kv_bytes_per_token_bf16": round(qz_b["bytes_per_token"], 2),
+        "kv_scale_bytes": qz_q["quant"]["kv_scale_bytes"],
+        # accuracy delta vs the bf16 outputs on this workload
+        "greedy_token_match": round(qz_q["token_match"], 4),
+        "greedy_token_match_w8a16": round(qz_w["token_match"], 4),
+        "logit_mae": round(st_qz["logit_mae"], 6),
+        "logit_max_abs": round(st_qz["logit_max_abs"], 5),
+        "offered_rps": round(qz_q["offered_rps"], 3),
+    }
     fd_base, fd_on, fd_stats = (st_fd["base"], st_fd["front"],
                                 st_fd["stats"])
     fdd = fd_stats["frontdoor"]
@@ -885,12 +939,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         rec_paged["baseline"] = \
             "padded static-batch GenerationServer, same traffic"
         records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp,
-                   rec_spec, rec_fd]
+                   rec_spec, rec_fd, rec_qz]
     else:
         rec_paged["vs_baseline"] = 1.0
         rec_paged["baseline"] = "self (tiny schema smoke)"
         records = [rec_paged, rec_mix, rec_open, rec_sp, rec_spec,
-                   rec_fd]
+                   rec_fd, rec_qz]
     if rec_tel is not None:
         records.append(rec_tel)
     if not on_tpu:
@@ -951,6 +1005,17 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
           f"{rec_fd['preemptions']} preemptions "
           f"({rec_fd['preempt_cached_tokens']} toks kept cached)",
           file=sys.stderr)
+    print(f"# served quantized(bf16/w8a16/w8a16+kv8 @ "
+          f"{rec_qz['offered_rps']:.2f} rps): "
+          f"{rec_qz['tokens_per_sec_bf16']:,.0f} / "
+          f"{rec_qz['tokens_per_sec_w8a16']:,.0f} / "
+          f"{rec_qz['value']:,.0f} tok/s "
+          f"({rec_qz['vs_baseline']:.2f}x), slots at fixed bytes "
+          f"{rec_qz['max_slots_at_fixed_bytes_bf16']} -> "
+          f"{rec_qz['max_slots_at_fixed_bytes']} "
+          f"({rec_qz['slot_capacity_ratio']:.2f}x), token match "
+          f"{rec_qz['greedy_token_match']:.4f}, logit mae "
+          f"{rec_qz['logit_mae']:.4g}", file=sys.stderr)
     return records
 
 
@@ -1055,6 +1120,129 @@ def _bench_served_speculation(model, cfg, on_tpu, tiny):
                                  drafter=_ReplayOracle()))
     return {"plain": st_plain, "spec": st_spec, "oracle": st_oracle,
             "K": K, "pool_size": len(pool), "new": new}
+
+
+def _bench_served_quantization(model, cfg, prompts, slots, bs, hi, new,
+                               k, chunk, on_tpu, tiny):
+    """Quantization sub-axis of `bench.py served` (quantized-serving
+    round): the SAME fixed-seed Poisson arrival schedule driven through
+    three fresh servers — bf16, W8A16 weights, and W8A16 + int8 KV
+    pool — measuring served tok/s, TTFT/ITL, and the accuracy delta
+    (greedy token match vs the bf16 outputs, plus a decoder-level
+    logit probe on a fixed batch). The axis also reports MAX CONCURRENT
+    SLOTS AT FIXED POOL BYTES: holding the bf16 pool's byte budget
+    constant, how many worst-case requests each kv dtype's pool can
+    reserve — the capacity lever int8 KV exists for, and the one a
+    CPU run can prove exactly (CPU has no int8 MXU, so the tok/s
+    headline is chip-only; the record self-describes which bar it
+    meets)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference import (PagedGenerationServer,
+                                      PagedKVCache,
+                                      measure_poisson_load)
+    from paddle_tpu.inference.kv_cache import blocks_for
+    from paddle_tpu.nn.decode import PagedDecoder
+    from paddle_tpu.sampling.buffers import greedy_args
+
+    n_req = len(prompts)
+    modes = (("bf16", None, None), ("w8a16", "w8a16", None),
+             ("w8a16_kv8", "w8a16", "int8"))
+    results = {}
+    rps = None
+    for name, quant, kvd in modes:
+        srv = PagedGenerationServer(
+            model, max_slots=slots, block_size=bs, max_prompt_len=hi,
+            max_new_tokens=new, steps_per_dispatch=k,
+            prefill_chunk_tokens=chunk, quantization=quant,
+            kv_dtype=kvd).start()
+        try:
+            t_w0 = time.time()
+            outs = [f.result(timeout=900) for f in
+                    [srv.submit(p) for p in prompts]]  # warm + outputs
+            if rps is None:  # one rate for ALL modes: identical
+                # arrivals make the A/B/C comparison the dtype alone
+                rps = 0.7 * n_req / max(time.time() - t_w0, 1e-6)
+            # unmeasured Poisson warm (the shared-prefix-axis lesson):
+            # churn packs different (T, rows, width) prefill buckets
+            # than the closed-loop drain, and the quantized servers'
+            # param/pool pytrees are fresh jit cache keys — those
+            # compiles must not land in the measured window
+            measure_poisson_load(srv, prompts, rps, n_req,
+                                 seed=778, timeout=900)
+            srv.reset_stats()
+            st = measure_poisson_load(srv, prompts, rps, n_req,
+                                      seed=777, timeout=900)
+            st["quant"] = srv.stats()["quantization"]
+            st["bytes_per_token"] = srv.cache.bytes_per_token
+            st["pool_bytes"] = srv.cache.pool_bytes_total
+            st["outs"] = outs
+        finally:
+            srv.stop()
+        results[name] = st
+
+    # accuracy delta vs bf16: greedy served outputs are deterministic
+    # per prompt, so the warm-drain outputs compare token-for-token
+    ref = results["bf16"]["outs"]
+    for name in ("w8a16", "w8a16_kv8"):
+        outs = results[name]["outs"]
+        tot = sum(o.size for o in ref)
+        match = sum((a[:min(a.size, b.size)] ==
+                     b[:min(a.size, b.size)]).sum()
+                    for a, b in zip(ref, outs))
+        results[name]["token_match"] = match / max(tot, 1)
+
+    # decoder-level logit probe: ONE prefill on a fixed batch per mode
+    params, _ = model.functional_state()
+    wq = model.quantize_weights(params)
+    rngp = np.random.RandomState(3)
+    B, S = min(4, slots), min(24, hi)
+    ids = rngp.randint(1, cfg.vocab_size, (B, S)).astype(np.int32)
+    lens = jnp.asarray(np.full((B,), S, np.int32))
+
+    def probe_logits(p, kvd):
+        cache = PagedKVCache(cfg.num_layers, cfg.num_heads,
+                             cfg.hidden_size // cfg.num_heads,
+                             block_size=bs,
+                             num_blocks=B * blocks_for(S, bs) + 1,
+                             dtype=p["ln_f.weight"].dtype, kv_dtype=kvd,
+                             name=f"qprobe-{kvd}")
+        for b in range(B):
+            cache.allocate(b, S)
+        dec = PagedDecoder.for_config(cfg, bs, return_logits=True,
+                                      kv_dtype=kvd)
+        out = dec.prefill(p, jnp.asarray(ids), lens,
+                          jnp.asarray(cache.table_array(range(B))),
+                          cache.k_blocks, cache.v_blocks,
+                          greedy_args(B))
+        return np.asarray(out[-1], np.float32)
+
+    l_ref = probe_logits(params, None)
+    l_q = probe_logits(wq, "int8")
+    logit_mae = float(np.abs(l_q - l_ref).mean())
+    logit_max = float(np.abs(l_q - l_ref).max())
+
+    # slot capacity at FIXED pool bytes: hold the bf16 serving pool's
+    # byte budget constant and count worst-case reservations each kv
+    # dtype can back (blocks are the unit admission reasons about)
+    m_width = blocks_for(hi + new + max(k - 1, 0), bs) + 0
+    budget = results["bf16"]["pool_bytes"]
+
+    def max_slots_at(kvd):
+        probe = PagedKVCache(cfg.num_layers, cfg.num_heads,
+                             cfg.hidden_size // cfg.num_heads,
+                             block_size=bs, num_blocks=2,
+                             dtype=params["ln_f.weight"].dtype,
+                             kv_dtype=kvd, name=f"qcap-{kvd}")
+        per_block = probe.pool_bytes_total / 2
+        n_blocks = int(budget // per_block)
+        return max(0, (n_blocks - 1) // m_width)
+
+    return {"modes": results, "rps": rps, "logit_mae": logit_mae,
+            "logit_max_abs": logit_max,
+            "slots_bf16": max_slots_at(None),
+            "slots_int8": max_slots_at("int8"),
+            "pool_budget_bytes": budget}
 
 
 def _bench_served_frontdoor(model, cfg, on_tpu, tiny):

@@ -266,5 +266,6 @@ class PredictorPool:
 
 
 from .kv_cache import BlockPoolExhausted, PagedKVCache  # noqa: E402
+from .kv_quant import QuantizedKV  # noqa: E402
 from .serving import (GenerationServer, PagedGenerationServer,  # noqa: E402
                       measure_offered_load, measure_poisson_load)

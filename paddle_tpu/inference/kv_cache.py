@@ -41,6 +41,15 @@ still hold indexed content are not returned to the free list — they are
 parked in an LRU *retention* list and reclaimed (index entries dropped,
 block freed) only when an allocation would otherwise exhaust the pool.
 
+Quantized pool (quantized-serving round): `kv_dtype="int8"` stores the
+K/V blocks as int8 codes plus a parallel per-vector scale buffer
+(kv_quant.QuantizedKV, same [*, num_blocks, ...] leading layout), so
+the same HBM holds ~2x the resident tokens. The block-table API is
+UNCHANGED — scales ride their block index through alloc/free/CoW/
+attach/retain/truncate/swap-out automatically — and the jitted
+writers quantize on append while the attention kernels dequantize on
+read, so a bf16 copy of the cache never exists in HBM.
+
 Invariants (fuzz-tested in tests/test_prefix_cache.py):
   * free list, retention list and the union of live block tables
     PARTITION the usable pool (block 0 in none of them);
@@ -90,6 +99,16 @@ _m_sequences = _metrics.gauge(
 _m_alloc_failures = _metrics.counter(
     "kv_pool_alloc_failures_total",
     "allocations refused because the pool was exhausted",
+    labelnames=_POOL_LABEL)
+# HBM accounting (quantized-serving round): dtype-aware, so the int8
+# halving is observable per pool instead of inferred from config.
+_m_pool_bytes = _metrics.gauge(
+    "kv_pool_bytes_total", "device bytes held by the K/V block pool "
+    "(codes + scale buffers when kv_dtype='int8'; dtype-aware)",
+    labelnames=_POOL_LABEL)
+_m_bytes_per_token = _metrics.gauge(
+    "kv_pool_bytes_per_token", "pool bytes per usable token slot "
+    "(bytes_total / capacity_tokens — ~half under int8 KV)",
     labelnames=_POOL_LABEL)
 
 # Prefix-cache telemetry (round 9 tentpole).
@@ -151,12 +170,15 @@ def prefix_block_hash(parent: int, tokens) -> int:
 @functools.lru_cache(maxsize=8)
 def _copy_block_fn(donate):
     """Jitted whole-block device copy (the CoW kernel): one dynamic
-    slice + scatter per array, recompiled per (shape, dtype) only."""
+    slice + scatter per array leaf, recompiled per (structure, shape,
+    dtype) only. kc/vc may be plain arrays or `QuantizedKV`
+    (codes, scales) pytrees — block ids index axis 1 of every leaf, so
+    one tree-mapped copy moves codes and scales in lockstep."""
     import jax
 
     def cp(kc, vc, src, dst):
-        return (kc.at[:, dst].set(kc[:, src]),
-                vc.at[:, dst].set(vc[:, src]))
+        return jax.tree.map(lambda a: a.at[:, dst].set(a[:, src]),
+                            (kc, vc))
 
     return jax.jit(cp, donate_argnums=(0, 1) if donate else ())
 
@@ -170,29 +192,50 @@ class PagedKVCache:
         smokes and short sequences.
     num_blocks: pool size INCLUDING the reserved trash block 0, so the
         usable capacity is (num_blocks - 1) * block_size tokens.
+    kv_dtype: None stores K/V in `dtype` (the pre-quantization pool).
+        "int8" stores int8 codes plus a parallel per-vector scale
+        buffer (kv_quant.QuantizedKV) — ~half the bytes per resident
+        token; every block operation (alloc/free/CoW/attach/retain/
+        truncate/swap-out) moves scales with their block by
+        construction, because both live under the same block index.
+        The DISPATCH side must match: pair an int8 pool with
+        `PagedDecoder(kv_dtype="int8")` (the decoder checks eagerly).
     name: label for the `kv_pool_*` / `kv_prefix_cache_*` metric series
         (auto-assigned "poolN" when omitted, so concurrent caches never
         alias each other's telemetry).
     """
 
     def __init__(self, num_layers, num_heads, head_dim, *, block_size=128,
-                 num_blocks=64, dtype=None, name=None):
+                 num_blocks=64, dtype=None, kv_dtype=None, name=None):
         import jax.numpy as jnp
 
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is the "
                              "reserved trash block)")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
+                             "(supported: None, 'int8')")
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
+        self.kv_dtype = kv_dtype
         self._name = str(name) if name else f"pool{next(_pool_ids)}"
         dt = jnp.float32 if dtype is None else dtype
+        self.dtype = dt
         shape = (self.num_layers, self.num_blocks, self.block_size,
                  self.num_heads, self.head_dim)
-        self.k_blocks = jnp.zeros(shape, dt)
-        self.v_blocks = jnp.zeros(shape, dt)
+        if kv_dtype == "int8":
+            from .kv_quant import QuantizedKV
+
+            self.k_blocks = QuantizedKV(jnp.zeros(shape, jnp.int8),
+                                        jnp.zeros(shape[:-1], dt))
+            self.v_blocks = QuantizedKV(jnp.zeros(shape, jnp.int8),
+                                        jnp.zeros(shape[:-1], dt))
+        else:
+            self.k_blocks = jnp.zeros(shape, dt)
+            self.v_blocks = jnp.zeros(shape, dt)
         # block 0 reserved: free list starts at 1
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._tables: dict[object, list[int]] = {}
@@ -235,6 +278,35 @@ class PagedKVCache:
     @property
     def capacity_tokens(self):
         return (self.num_blocks - 1) * self.block_size
+
+    @property
+    def pool_bytes_total(self):
+        """Device bytes held by the K/V pool arrays (codes + scale
+        buffers under int8 — dtype-aware, fixed at construction)."""
+        import jax
+
+        return sum(int(a.nbytes) for a in
+                   jax.tree.leaves((self.k_blocks, self.v_blocks)))
+
+    @property
+    def scale_bytes(self):
+        """Bytes of the per-vector scale buffers (0 for a dense pool) —
+        the quantization overhead on top of the int8 codes."""
+        if self.kv_dtype != "int8":
+            return 0
+        return int(self.k_blocks.scales.nbytes
+                   + self.v_blocks.scales.nbytes)
+
+    @property
+    def bytes_per_token(self):
+        """Pool bytes per usable token slot (includes the trash block's
+        amortized share — the honest per-token HBM cost)."""
+        return self.pool_bytes_total / (self.capacity_tokens or 1)
+
+    def stats_kv_dtype(self):
+        """The stored element dtype as a stats/dashboard string:
+        "int8" for a quantized pool, else the dense dtype name."""
+        return self.kv_dtype or np.dtype(self.dtype).name
 
     def _get_table(self, seq_id, op):
         try:
@@ -328,6 +400,8 @@ class PagedKVCache:
                                                   or 1))
         _m_block_fill.labels(pool=p).set(
             held / ((used * self.block_size) or 1))
+        _m_pool_bytes.labels(pool=p).set(self.pool_bytes_total)
+        _m_bytes_per_token.labels(pool=p).set(self.bytes_per_token)
 
     def allocate(self, seq_id, num_tokens):
         """Start a new sequence holding `num_tokens` tokens; returns its
@@ -647,6 +721,13 @@ class PagedKVCache:
         return {
             "block_size": self.block_size,
             "num_blocks": self.num_blocks - 1,  # usable (trash excluded)
+            # HBM accounting (quantized-serving round): dtype-aware
+            # byte cost of the pool arrays, so the int8 halving shows
+            # up in stats and dashboards, not just in config
+            "kv_dtype": self.stats_kv_dtype(),
+            "pool_bytes_total": self.pool_bytes_total,
+            "pool_bytes_per_token": self.bytes_per_token,
+            "scale_bytes": self.scale_bytes,
             "used_blocks": used,
             "free_blocks": len(self._free),
             "retained_blocks": len(self._retained),

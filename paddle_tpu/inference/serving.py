@@ -219,6 +219,19 @@ class GenerationServer:
                 "export_generator, or pass them explicitly)")
         self.batch_size = int(batch_size)
         self.prompt_len = int(prompt_len)
+        # quantization block (schema-congruent with the paged server):
+        # the dense program's quantization is baked into the exported
+        # artifact — report what its meta records (scale buffers live
+        # inside the program's params, so scale bytes read 0 here)
+        wq = meta.get("weight_quant")
+        kq = meta.get("kv_quant")
+        self._quant_stats = {
+            "enabled": bool(wq or kq),
+            "mode": "w8a16" if wq == "int8" else "none",
+            "kv_dtype": kq or "native",
+            "kv_scale_bytes": 0,
+            "kv_pool_bytes_total": 0,
+        }
         self.pad_token_id = int(pad_token_id)
         self.strict_pad_check = bool(strict_pad_check)
         self.max_wait_ms = float(max_wait_ms)
@@ -380,6 +393,7 @@ class GenerationServer:
                 "p90_ms": pct(0.90) * 1e3,
                 "p99_ms": pct(0.99) * 1e3,
                 "stop_reasons": dict(self._stop_reasons),
+                "quantization": dict(self._quant_stats),
                 "wall_s": dt,
             }
 
@@ -561,6 +575,23 @@ class PagedGenerationServer:
     Default OFF: a disabled server takes the exact pre-cache
     allocation path (no lookups, no publishes, no spare block).
 
+    QUANTIZED SERVING (this round): `quantization="w8a16"` packs the
+    decoder weights to int8 ONCE at construction
+    (`model.quantize_weights()`, the shared PTQ implementation) and
+    every dispatch — decode step, packed chunked prefill, speculative
+    verify — streams half the weight bytes with a fused rescale
+    epilogue. `kv_dtype="int8"` additionally quantizes the KV POOL:
+    blocks hold int8 codes + per-vector scales
+    (`PagedKVCache(kv_dtype="int8")`), appends quantize on write,
+    attention dequantizes inside the kernel, and prefix-cache
+    publish/attach, CoW, swap-out and truncate all carry the scale
+    buffer with the block — so sharing and preemption keep working
+    quantized, at ~2x resident tokens per pool byte. Both knobs
+    default OFF (the exact pre-round bf16 path); `stats()` reports a
+    schema-stable "quantization" block either way. See docs/SERVING.md
+    "Quantized serving" for the parity-tolerance policy and when NOT
+    to enable.
+
     speculation=SpecConfig(...) (or True for defaults) turns on
     SPECULATIVE DECODING (round 11): each round, eligible decode-phase
     slots ask the drafter (default: the self-drafting n-gram /
@@ -585,7 +616,8 @@ class PagedGenerationServer:
     def __init__(self, model, *, max_slots=4, block_size=16,
                  max_prompt_len=None, max_new_tokens=32, num_blocks=None,
                  eos_token_id=None, temperature=0.0, seed=0,
-                 weight_quant=None, steps_per_dispatch=1,
+                 weight_quant=None, quantization=None, kv_dtype=None,
+                 steps_per_dispatch=1,
                  prefill_chunk_tokens=512, pack_align=None,
                  enable_prefix_cache=False, detokenize=None,
                  stop_tail_tokens=16, speculation=None):
@@ -656,12 +688,31 @@ class PagedGenerationServer:
                               else 1)
         self.eos = -1 if eos_token_id is None else int(eos_token_id)
         self.temperature = float(temperature)
-        params, _ = model.functional_state()
+        # quantized serving hot path: `quantization="w8a16"` packs the
+        # decoder weights ONCE here (model.quantize_weights — the shared
+        # PTQ implementation) and every dispatch — decode, chunked
+        # ragged prefill, speculative verify — runs int8 dots with the
+        # fused rescale epilogue; `weight_quant="int8"` is the pre-round
+        # alias. `kv_dtype="int8"` quantizes the KV POOL itself (int8
+        # codes + per-block-row scales, dequant inside the kernels).
+        # Both default OFF: the disabled path is the exact pre-round
+        # bf16 program.
+        if quantization not in (None, "w8a16"):
+            raise ValueError(f"unknown quantization {quantization!r} "
+                             "(supported: None, 'w8a16')")
         if weight_quant == "int8":
-            params = model._w8_params(params)
+            quantization = "w8a16"
         elif weight_quant is not None:
             raise ValueError(f"unknown weight_quant {weight_quant!r} "
                              "(supported: 'int8')")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
+                             "(supported: None, 'int8')")
+        self.quantization = quantization
+        self.kv_dtype = kv_dtype
+        params, _ = model.functional_state()
+        if quantization == "w8a16":
+            params = model.quantize_weights(params)
         self._params = params
         dt = params["ln_f.weight"].dtype
         self.enable_prefix_cache = bool(enable_prefix_cache)
@@ -675,9 +726,12 @@ class PagedGenerationServer:
         self.cache = PagedKVCache(
             cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, block_size=self.block_size,
-            num_blocks=int(num_blocks), dtype=dt)
+            num_blocks=int(num_blocks), dtype=dt, kv_dtype=kv_dtype)
         self._blocks_for = blocks_for
-        self._decoder = PagedDecoder.for_config(cfg, self.block_size)
+        # the decoder's kv_dtype MUST match the cache's — PagedDecoder
+        # re-checks the pairing eagerly on every dispatch
+        self._decoder = PagedDecoder.for_config(cfg, self.block_size,
+                                                kv_dtype=kv_dtype)
         # per-slot sampling state (round 10): struct-of-arrays param
         # buffers + the [slots, V] penalty count buffer, scattered on
         # admit/refill. Constructor temperature is the DEFAULT for
@@ -1028,6 +1082,17 @@ class PagedGenerationServer:
                     # fraction of proposed draft tokens accepted
                     "acceptance_rate": (self._spec_accepted
                                         / (self._spec_proposed or 1)),
+                },
+                # quantized serving (this round): config + byte
+                # accounting, schema-stable (zeroed-but-present when
+                # disabled — the speculation/frontdoor convention)
+                "quantization": {
+                    "enabled": (self.quantization is not None
+                                or self.kv_dtype is not None),
+                    "mode": self.quantization or "none",
+                    "kv_dtype": self.cache.stats_kv_dtype(),
+                    "kv_scale_bytes": self.cache.scale_bytes,
+                    "kv_pool_bytes_total": self.cache.pool_bytes_total,
                 },
                 # admission headroom RIGHT NOW: free + LRU-reclaimable
                 # blocks — the number the reservation check reasons

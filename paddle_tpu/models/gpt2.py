@@ -152,34 +152,26 @@ class GPT2(nn.Layer):
             ops.reshape(logits, [-1, self.cfg.vocab_size]),
             ops.reshape(labels, [-1]))
 
-    def _w8_params(self, params):
-        """Weight-only int8 (W8A16) params for the decode path, cached per
-        weight version. Cache key: weak refs to EVERY source array
-        (identity, not id() — ids are recycled after GC and could serve
-        stale quantized weights; weakrefs also notice any param changing,
-        not just wte). A dead or mismatched ref is a miss."""
-        import weakref
+    def quantize_weights(self, params=None):
+        """Weight-only int8 (W8A16) packing of the decode path's big 2-D
+        weights: returns a NEW flat params dict where each quantized
+        entry is replaced by `name::w8c` (int8 codes) + `name::w8s`
+        (per-channel scales in the weight dtype); every other entry is
+        passed through. This is the ONE shared implementation behind
+        `generate(weight_quant="int8")`, the W8A16 deployment artifact
+        (`export_generator`), and the serving engines — a
+        `PagedGenerationServer(quantization="w8a16")` calls it ONCE at
+        construction and reuses the packed params across every
+        prefill/step/packed_prefill/packed_verify dispatch, which is
+        why the old lazy per-generate weakref cache (`_w8_cache`) is
+        gone: serving no longer re-quantizes per call, and offline
+        callers hold the snapshot themselves if they loop.
 
-        def _wref(v):
-            try:
-                return weakref.ref(v)
-            except TypeError:  # non-weakrefable leaf: pin it instead
-                return (lambda strong=v: strong)
-        cached = getattr(self, "_w8_cache", None)
-        names = sorted(params)
-        hit = (cached is not None and cached[0] == names
-               and all(r() is params[n]
-                       for n, r in zip(names, cached[1])))
-        if not hit:
-            # drop the stale entry BEFORE building the new one: its key
-            # list can hold strong-ref closures (non-weakrefable leaves)
-            # that would otherwise pin the replaced arrays alive inside
-            # the dead tuple (ADVICE r5)
-            self._w8_cache = None
-            cached = (names, [_wref(params[n]) for n in names],
-                      _quantize_decode_weights_int8(params, self.cfg))
-            self._w8_cache = cached
-        return cached[2]
+        params: optional pre-snapshotted functional params; defaults to
+        the model's current `functional_state()`."""
+        if params is None:
+            params, _ = self.functional_state()
+        return _quantize_decode_weights_int8(params, self.cfg)
 
     def generate(self, input_ids, max_new_tokens, temperature=0.0,
                  eos_token_id=None, seed=0, top_k=0, top_p=1.0,
@@ -199,7 +191,11 @@ class GPT2(nn.Layer):
         with per-row `prompt_lens` (no pad-value matching), block_size
         sets the pool granularity, and the step loop runs host-side —
         it is the engine the continuous-batching server drives, exposed
-        here for parity testing and offline use.
+        here for parity testing and offline use. kv_quant="int8" on
+        the paged path stores the pool as int8 codes + per-vector
+        scales (PagedKVCache(kv_dtype="int8")) with dequant inside the
+        attention kernels — the served int8-KV configuration, parity-
+        tested here offline.
 
         sampling: optional `paddle_tpu.sampling.SamplingParams` applied
         to EVERY batch row; overrides the temperature/top_k/top_p/seed
@@ -235,17 +231,17 @@ class GPT2(nn.Layer):
             raise ValueError(f"unknown kv_cache {kv_cache!r} "
                              "(supported: 'dense', 'paged')")
         if kv_cache == "paged":
-            if kv_quant is not None:
-                raise ValueError(
-                    "kv_cache='paged' supports bf16/f32 or W8A16 "
-                    "weights (no kv_quant yet)")
+            if kv_quant not in (None, "int8"):
+                raise ValueError(f"unknown kv_quant {kv_quant!r} "
+                                 "(supported: 'int8')")
             if sampling is None:
                 sampling = SamplingParams(
                     temperature=float(temperature), top_k=int(top_k),
                     top_p=float(top_p), seed=int(seed))
             return self._generate_paged(
                 ids, max_new_tokens, eos_token_id, seed, pad_token_id,
-                prompt_lens, block_size, weight_quant, sampling)
+                prompt_lens, block_size, weight_quant, sampling,
+                kv_quant)
         if sampling is not None:
             # dense program-level subset: per-slot fields are a paged-
             # path feature (the dense decode is one fused program)
@@ -293,10 +289,10 @@ class GPT2(nn.Layer):
             # the int8->bf16 dequant fuses into the dot's operand pipeline
             # (measured ~1.9x on the streaming path, PERF.md) — halve the
             # per-token parameter stream, keep activations bf16. The
-            # quantization itself is ~250 device ops over 124M params, so
-            # it is cached per weight version (serving calls generate in
-            # a loop).
-            params = self._w8_params(params)
+            # quantization itself is ~250 device ops over 124M params;
+            # loops should snapshot quantize_weights() once — the
+            # serving engines do exactly that at construction.
+            params = self.quantize_weights(params)
         elif weight_quant is not None:
             raise ValueError(f"unknown weight_quant {weight_quant!r} "
                              "(supported: 'int8')")
@@ -314,7 +310,7 @@ class GPT2(nn.Layer):
 
     def _generate_paged(self, ids, max_new, eos_token_id, seed,
                         pad_token_id, prompt_lens, block_size,
-                        weight_quant, sampling):
+                        weight_quant, sampling, kv_quant=None):
         """Paged-cache decode: RIGHT-padded prompts + per-row lengths,
         host-side step loop over the jitted PagedDecoder (the same
         engine the continuous-batching server drives), with the full
@@ -350,7 +346,7 @@ class GPT2(nn.Layer):
         eos = -1 if eos_token_id is None else int(eos_token_id)
         params, _ = self.functional_state()
         if weight_quant == "int8":
-            params = self._w8_params(params)
+            params = self.quantize_weights(params)
         elif weight_quant is not None:
             raise ValueError(f"unknown weight_quant {weight_quant!r} "
                              "(supported: 'int8')")
@@ -364,11 +360,12 @@ class GPT2(nn.Layer):
         cache = PagedKVCache(self.cfg.num_layers, self.cfg.num_heads,
                              self.cfg.hidden_size // self.cfg.num_heads,
                              block_size=bs, num_blocks=total_blocks + 1,
-                             dtype=dt, name="gpt2-generate")
+                             dtype=dt, kv_dtype=kv_quant,
+                             name="gpt2-generate")
         for b in range(B):  # offline batch: reserve the full horizon
             cache.allocate(b, int(lens[b]) + max_new)
         tables = jnp.asarray(cache.table_array(range(B), m_width))
-        dec = PagedDecoder.for_config(self.cfg, bs)
+        dec = PagedDecoder.for_config(self.cfg, bs, kv_dtype=kv_quant)
         # per-row sampling buffers: the same params every row, stream
         # seed+r per row (independent counter-based PRNG streams)
         store = SlotParamStore(B, self.cfg.vocab_size)

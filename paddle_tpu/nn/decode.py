@@ -53,7 +53,20 @@ lanes and idle decode slots write to the reserved trash block 0.
 
 Params use the GPT-2 flat naming ("h.{i}.qkv_proj.weight", ...); the
 weight-only-int8 "::w8c"/"::w8s" key convention of models/gpt2.py is
-honored transparently.
+honored transparently — `GPT2.quantize_weights()` params make every
+program a W8A16 dispatch with a fused rescale epilogue, no decoder
+change needed.
+
+int8 KV (quantized-serving round): `PagedDecoder(kv_dtype="int8")`
+builds the same program family over a QUANTIZED pool
+(`PagedKVCache(kv_dtype="int8")`) — cache appends quantize each
+written K/V vector to int8 with a per-vector absmax scale
+(inference/kv_quant.py), and the attention ops dequantize inside the
+kernel, so the cache is streamed as raw int8 and a bf16 copy never
+exists in HBM. The kv_quant flag is STATIC (part of every builder
+cache key); the default-False path traces exactly the pre-quantization
+program. Dispatches check the decoder/cache pairing eagerly and raise
+naming the mismatched argument.
 """
 from __future__ import annotations
 
@@ -64,6 +77,36 @@ from .layer.legacy import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F4
 __all__ = ["BeamSearchDecoder", "dynamic_decode", "PagedDecoder"]
 
 GREEDY_MODE = (False, False)
+
+
+@functools.lru_cache(maxsize=4)
+def _kv_io(kv_quant):
+    """(write, layer) accessor pair over the cache arrays, selected by
+    the STATIC kv_quant flag (quantized-serving round). Dense pools are
+    plain [L, N, BS, H, Dh] arrays; int8 pools are
+    `inference.kv_quant.QuantizedKV` (codes, per-vector scales)
+    pytrees. `write` quantizes ON APPEND — each written vector gets
+    its own absmax scale, so no already-stored code ever needs
+    rescaling and the functional scatter stays a scatter; `layer`
+    slices one layer's pool for the attention ops (which dequantize
+    inside the kernel)."""
+    if not kv_quant:
+        def write(cache, i, blk, off, t):
+            return cache.at[i, blk, off].set(t)
+
+        def layer(cache, i):
+            return cache[i]
+    else:
+        from ..inference.kv_quant import QuantizedKV, kv_encode
+
+        def write(cache, i, blk, off, t):
+            codes, sc = kv_encode(t, cache.scales.dtype)
+            return QuantizedKV(cache.codes.at[i, blk, off].set(codes),
+                               cache.scales.at[i, blk, off].set(sc))
+
+        def layer(cache, i):
+            return QuantizedKV(cache.codes[i], cache.scales[i])
+    return write, layer
 
 
 @functools.lru_cache(maxsize=32)
@@ -138,10 +181,13 @@ def _layer_helpers(spec):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_paged_fns(spec, block_size, return_logits, mode):
-    """(spec, block_size, mode) -> (prefill_fn, step_fn), raw and
-    jittable. mode = (any_sampled, any_penalties): the static variant
-    pair of the sampling pipeline (see module docstring)."""
+def _build_paged_fns(spec, block_size, return_logits, mode,
+                     kv_quant=False):
+    """(spec, block_size, mode, kv_quant) -> (prefill_fn, step_fn), raw
+    and jittable. mode = (any_sampled, any_penalties): the static
+    variant pair of the sampling pipeline (see module docstring).
+    kv_quant=True takes/returns `QuantizedKV` cache pytrees: appends
+    quantize on write, attention dequantizes in-kernel."""
     import jax
     import jax.numpy as jnp
 
@@ -151,6 +197,7 @@ def _build_paged_fns(spec, block_size, return_logits, mode):
     scale = Dh ** -0.5
     BS = int(block_size)
     sampled, penalties = mode
+    kv_write, kv_layer = _kv_io(bool(kv_quant))
     hp = _layer_helpers(spec)
     ln, qkv_split, make_embed_head, block_and_mlp = (
         hp.ln, hp.qkv_split, hp.make_embed_head, hp.block_and_mlp)
@@ -175,8 +222,8 @@ def _build_paged_fns(spec, block_size, return_logits, mode):
             a = ln(x, params[f"h.{i}.ln_1.weight"],
                    params[f"h.{i}.ln_1.bias"])
             q, k, v = qkv_split(params, i, a)          # [B, S0, H, Dh]
-            kc = kc.at[i, blk, off].set(k)
-            vc = vc.at[i, blk, off].set(v)
+            kc = kv_write(kc, i, blk, off, k)
+            vc = kv_write(vc, i, blk, off, v)
             qh, kh, vh = (u.transpose(0, 2, 1, 3) for u in (q, k, v))
             s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(
                 jnp.float32) * scale
@@ -217,9 +264,10 @@ def _build_paged_fns(spec, block_size, return_logits, mode):
             a = ln(x, params[f"h.{i}.ln_1.weight"],
                    params[f"h.{i}.ln_1.bias"])
             q, k, v = qkv_split(params, i, a)          # [B, H, Dh]
-            kc = kc.at[i, blk, off].set(k)
-            vc = vc.at[i, blk, off].set(v)
-            o = paged_decode_attention(q, kc[i], vc[i], tables, ctx,
+            kc = kv_write(kc, i, blk, off, k)
+            vc = kv_write(vc, i, blk, off, v)
+            o = paged_decode_attention(q, kv_layer(kc, i),
+                                       kv_layer(vc, i), tables, ctx,
                                        scale=scale).reshape(B, E)
             x = block_and_mlp(params, i, x, o, dt)
         xf = ln(x, params["ln_f.weight"], params["ln_f.bias"])
@@ -240,7 +288,7 @@ def _build_paged_fns(spec, block_size, return_logits, mode):
 
 
 @functools.lru_cache(maxsize=32)
-def _packed_trunk(spec, block_size):
+def _packed_trunk(spec, block_size, kv_quant=False):
     """Shared packed ragged forward trunk: embed a token-packed
     multi-sequence stream, write each token's K/V into its paged block
     rows, and run segment-causal attention per layer. Returns the final
@@ -254,6 +302,7 @@ def _packed_trunk(spec, block_size):
     L, H, Dh, E, eps, tied = spec
     scale = Dh ** -0.5
     BS = int(block_size)
+    kv_write, kv_layer = _kv_io(bool(kv_quant))
     hp = _layer_helpers(spec)
 
     def trunk(params, toks, seg, pos, tables, kc, vc):
@@ -274,9 +323,10 @@ def _packed_trunk(spec, block_size):
             a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
                       params[f"h.{i}.ln_1.bias"])
             q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
-            kc = kc.at[i, blk, off].set(k)
-            vc = vc.at[i, blk, off].set(v)
-            o = ragged_prefill_attention(q, kc[i], vc[i], tables, seg,
+            kc = kv_write(kc, i, blk, off, k)
+            vc = kv_write(vc, i, blk, off, v)
+            o = ragged_prefill_attention(q, kv_layer(kc, i),
+                                         kv_layer(vc, i), tables, seg,
                                          pos, scale=scale).reshape(T, E)
             x = hp.block_and_mlp(params, i, x, o, dt)
         return x, kc, vc
@@ -285,7 +335,8 @@ def _packed_trunk(spec, block_size):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_packed_prefill(spec, block_size, return_logits, mode):
+def _build_packed_prefill(spec, block_size, return_logits, mode,
+                          kv_quant=False):
     """Packed ragged prefill: ONE dispatch prefills a token-packed
     multi-sequence chunk stream (the tentpole of the chunked-prefill
     scheduler, inference/serving.py). Raw and jittable."""
@@ -295,7 +346,7 @@ def _build_packed_prefill(spec, block_size, return_logits, mode):
 
     sampled, penalties = mode
     hp = _layer_helpers(spec)
-    trunk = _packed_trunk(spec, block_size)
+    trunk = _packed_trunk(spec, block_size, bool(kv_quant))
 
     def packed_prefill_fn(params, toks, seg, pos, tables, sample_idx,
                           kc, vc, sp):
@@ -342,15 +393,17 @@ def _build_packed_prefill(spec, block_size, return_logits, mode):
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_packed_prefill(spec, block_size, return_logits, donate, mode):
+def _jitted_packed_prefill(spec, block_size, return_logits, donate, mode,
+                           kv_quant=False):
     import jax
 
-    fn = _build_packed_prefill(spec, block_size, return_logits, mode)
+    fn = _build_packed_prefill(spec, block_size, return_logits, mode,
+                               kv_quant)
     return jax.jit(fn, donate_argnums=(6, 7) if donate else ())
 
 
 @functools.lru_cache(maxsize=32)
-def _verify_trunk(spec, block_size):
+def _verify_trunk(spec, block_size, kv_quant=False):
     """The packed trunk specialized to the verify plan's PINNED layout:
     T = P * W with one W-token region per plan row (verifier.py). Same
     embed/scatter/MLP as `_packed_trunk`, but attention goes through
@@ -364,6 +417,7 @@ def _verify_trunk(spec, block_size):
     L, H, Dh, E, eps, tied = spec
     scale = Dh ** -0.5
     BS = int(block_size)
+    kv_write, kv_layer = _kv_io(bool(kv_quant))
     hp = _layer_helpers(spec)
 
     def trunk(params, toks, seg, pos, tables, kc, vc):
@@ -384,10 +438,11 @@ def _verify_trunk(spec, block_size):
             a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
                       params[f"h.{i}.ln_1.bias"])
             q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
-            kc = kc.at[i, blk, off].set(k)
-            vc = vc.at[i, blk, off].set(v)
+            kc = kv_write(kc, i, blk, off, k)
+            vc = kv_write(vc, i, blk, off, v)
             o = verify_window_attention(
-                q.reshape(P, W, H, Dh), kc[i], vc[i], tables, pos2,
+                q.reshape(P, W, H, Dh), kv_layer(kc, i),
+                kv_layer(vc, i), tables, pos2,
                 scale=scale).reshape(T, E)
             x = hp.block_and_mlp(params, i, x, o, dt)
         return x, kc, vc
@@ -396,7 +451,7 @@ def _verify_trunk(spec, block_size):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_packed_verify(spec, block_size, mode):
+def _build_packed_verify(spec, block_size, mode, kv_quant=False):
     """Speculative verification (spec_decode round): score a packed
     stream of [last_token, draft_1 .. draft_k] regions — one region per
     speculating slot — in ONE ragged dispatch, and decide acceptance ON
@@ -419,7 +474,7 @@ def _build_packed_verify(spec, block_size, mode):
 
     sampled, penalties = mode
     hp = _layer_helpers(spec)
-    trunk = _verify_trunk(spec, block_size)
+    trunk = _verify_trunk(spec, block_size, bool(kv_quant))
 
     def verify_fn(params, toks, seg, pos, tables, sample_idx, dlen,
                   kc, vc, sp):
@@ -503,19 +558,21 @@ def _build_packed_verify(spec, block_size, mode):
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_packed_verify(spec, block_size, donate, mode):
+def _jitted_packed_verify(spec, block_size, donate, mode,
+                          kv_quant=False):
     import jax
 
-    fn = _build_packed_verify(spec, block_size, mode)
+    fn = _build_packed_verify(spec, block_size, mode, kv_quant)
     return jax.jit(fn, donate_argnums=(7, 8) if donate else ())
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_paged_fns(spec, block_size, return_logits, donate, mode):
+def _jitted_paged_fns(spec, block_size, return_logits, donate, mode,
+                      kv_quant=False):
     import jax
 
     prefill_fn, step_fn = _build_paged_fns(spec, block_size,
-                                           return_logits, mode)
+                                           return_logits, mode, kv_quant)
     dp = (4, 5) if donate else ()   # kc, vc in prefill_fn
     ds = (5, 6) if donate else ()   # kc, vc in step_fn
     return (jax.jit(prefill_fn, donate_argnums=dp),
@@ -523,7 +580,8 @@ def _jitted_paged_fns(spec, block_size, return_logits, donate, mode):
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_multistep(spec, block_size, n_steps, donate, mode):
+def _jitted_multistep(spec, block_size, n_steps, donate, mode,
+                      kv_quant=False):
     """`n_steps` decode tokens in ONE dispatch (a lax.scan over step_fn):
     multi-step scheduling for dispatch-latency-bound serving — at the
     measured 8-70ms tunnel floor a strict token-per-dispatch loop is
@@ -535,7 +593,8 @@ def _jitted_multistep(spec, block_size, n_steps, donate, mode):
     vc, counts|None)."""
     import jax
 
-    _, step_fn = _build_paged_fns(spec, block_size, False, mode)
+    _, step_fn = _build_paged_fns(spec, block_size, False, mode,
+                                  kv_quant)
     sampled, penalties = mode
 
     def multi(params, tok, pos, active, tables, kc, vc, sp):
@@ -565,20 +624,49 @@ class PagedDecoder:
     """Jitted (prefill, step, packed_prefill) family over the paged KV
     cache for one GPT-2-layout spec. Instances are cheap — the compiled
     functions are cached process-wide by (spec, block_size,
-    return_logits, mode); per-instance only the tracing wrappers are
-    held. `mode` is the (any_sampled, any_penalties) static pair from
-    `SlotParamStore.mode()` — the default is the all-greedy fast path."""
+    return_logits, mode, kv_quant); per-instance only the tracing
+    wrappers are held. `mode` is the (any_sampled, any_penalties)
+    static pair from `SlotParamStore.mode()` — the default is the
+    all-greedy fast path.
 
-    def __init__(self, spec, block_size, return_logits=False, donate=None):
+    kv_dtype: None pairs with a dense `PagedKVCache`; "int8" pairs
+    with `PagedKVCache(kv_dtype="int8")` — appends quantize on write,
+    attention dequantizes inside the kernel. Every dispatch checks the
+    pairing EAGERLY (`_check_kv`): an int8 decoder handed dense bf16
+    cache arrays (or vice versa) raises a ValueError naming the
+    mismatched argument instead of failing deep inside a jit trace."""
+
+    def __init__(self, spec, block_size, return_logits=False, donate=None,
+                 kv_dtype=None):
         import jax
 
         if donate is None:  # CPU donation is a no-op warning in jaxlib
             donate = jax.default_backend() not in ("cpu",)
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
+                             "(supported: None, 'int8')")
         self.spec = tuple(spec)
         self.block_size = int(block_size)
         self.return_logits = bool(return_logits)
+        self.kv_dtype = kv_dtype
+        self._kv_quant = kv_dtype == "int8"
         self._donate = bool(donate)
         self._variants = {}
+
+    def _check_kv(self, kc, vc):
+        """Eager dtype-consistency assert (CI/tooling satellite): the
+        cache arrays must match the decoder's kv_dtype BEFORE any jit
+        tracing, so a miswired server fails with the argument named."""
+        for name, arr in (("kc", kc), ("vc", vc)):
+            got = hasattr(arr, "codes")
+            if got != self._kv_quant:
+                have = "a quantized int8 (QuantizedKV)" if got \
+                    else "a dense"
+                raise ValueError(
+                    f"kv dtype mismatch: PagedDecoder(kv_dtype="
+                    f"{self.kv_dtype!r}) was handed {have} cache array "
+                    f"for argument '{name}' — build the PagedKVCache "
+                    f"and the PagedDecoder with the SAME kv_dtype")
 
     def _variant(self, mode):
         """(prefill, step, packed_prefill, packed_verify)
@@ -593,12 +681,13 @@ class PagedDecoder:
 
             prefill, step = _jitted_paged_fns(
                 self.spec, self.block_size, self.return_logits,
-                self._donate, mode)
+                self._donate, mode, self._kv_quant)
             packed = _jitted_packed_prefill(
                 self.spec, self.block_size, self.return_logits,
-                self._donate, mode)
+                self._donate, mode, self._kv_quant)
             verify = _jitted_packed_verify(
-                self.spec, self.block_size, self._donate, mode)
+                self.spec, self.block_size, self._donate, mode,
+                self._kv_quant)
             v = (_tracing.wrap("prefill_dispatch", prefill),
                  _tracing.wrap("step_dispatch", step),
                  _tracing.wrap("packed_prefill_dispatch", packed),
@@ -608,16 +697,19 @@ class PagedDecoder:
 
     def prefill(self, params, ids, lens, tables, kc, vc, sp,
                 mode=GREEDY_MODE):
+        self._check_kv(kc, vc)
         return self._variant(mode)[0](params, ids, lens, tables, kc, vc,
                                       sp)
 
     def step(self, params, tok, pos, active, tables, kc, vc, sp,
              mode=GREEDY_MODE):
+        self._check_kv(kc, vc)
         return self._variant(mode)[1](params, tok, pos, active, tables,
                                       kc, vc, sp)
 
     def packed_prefill(self, params, toks, seg, pos, tables, sample_idx,
                        kc, vc, sp, mode=GREEDY_MODE):
+        self._check_kv(kc, vc)
         return self._variant(mode)[2](params, toks, seg, pos, tables,
                                       sample_idx, kc, vc, sp)
 
@@ -628,6 +720,7 @@ class PagedDecoder:
         draft position plus the bonus position — and dlen [P] carries
         each plan row's draft count (0 = real draft-free row, -1 =
         padding row)."""
+        self._check_kv(kc, vc)
         return self._variant(mode)[3](params, toks, seg, pos, tables,
                                       sample_idx, dlen, kc, vc, sp)
 
@@ -636,8 +729,15 @@ class PagedDecoder:
         from ..observability import tracing as _tracing
 
         fn = _jitted_multistep(self.spec, self.block_size, int(n_steps),
-                               self._donate, mode)
-        return _tracing.wrap("multistep_dispatch", fn, k=int(n_steps))
+                               self._donate, mode, self._kv_quant)
+        wrapped = _tracing.wrap("multistep_dispatch", fn,
+                                k=int(n_steps))
+
+        def checked(params, tok, pos, active, tables, kc, vc, sp):
+            self._check_kv(kc, vc)
+            return wrapped(params, tok, pos, active, tables, kc, vc, sp)
+
+        return checked
 
     @classmethod
     def for_config(cls, cfg, block_size, **kw):

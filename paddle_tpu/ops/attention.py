@@ -118,13 +118,24 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     return out, (w if return_weights else None)
 
 
+def _is_quantized_kv(kv):
+    """Duck-typed inference.kv_quant.QuantizedKV check (no import — the
+    ops layer must not pull the inference package at module scope)."""
+    return hasattr(kv, "codes") and hasattr(kv, "scales")
+
+
 def paged_decode_attention(q, k_blocks, v_blocks, block_tables, ctx_lens,
                            scale=None):
     """Single-token decode attention over a PAGED KV cache (the
     gather-by-block-table read half of inference/kv_cache.py).
 
     q: [B, H, Dh] — one new token per sequence.
-    k_blocks/v_blocks: [N, BS, H, Dh] — ONE layer's block pool.
+    k_blocks/v_blocks: [N, BS, H, Dh] — ONE layer's block pool; OR a
+        `QuantizedKV` (int8 codes [N, BS, H, Dh], per-vector scales
+        [N, BS, H]) for an int8 pool — dequantization happens INSIDE
+        the kernel/contraction (the scales fold into the score and
+        output einsums), so no bf16 copy of the cache ever
+        materializes in HBM.
     block_tables: [B, M] int32 — block ids per sequence, 0-padded.
     ctx_lens: [B] int32 — tokens (cache positions) visible to each query;
         everything at position >= ctx_len is masked by LENGTH, never by
@@ -136,8 +147,10 @@ def paged_decode_attention(q, k_blocks, v_blocks, block_tables, ctx_lens,
     path, which materializes the [B, M*BS] gathered keys — correct
     everywhere, but it reads the padded table width instead of streaming
     exactly the live blocks."""
+    quant = _is_quantized_kv(k_blocks)
+    kcodes = k_blocks.codes if quant else k_blocks
     B, H, Dh = q.shape
-    _, BS, _, _ = k_blocks.shape
+    _, BS, _, _ = kcodes.shape
     M = block_tables.shape[1]
     sc = (Dh ** -0.5) if scale is None else scale
     if _on_tpu():
@@ -150,6 +163,26 @@ def paged_decode_attention(q, k_blocks, v_blocks, block_tables, ctx_lens,
                     scale=float(sc))
         except Exception as e:  # noqa: BLE001
             _warn_flash_fallback(e)
+    if quant:
+        # gather CODES + per-vector scales; the int8->dt convert fuses
+        # into the einsum operand pipeline (the weight-dot ::w8c trick)
+        # and the scale vector multiplies the SCORE/PROB tensors — the
+        # cache is consumed as raw int8
+        k = jnp.transpose(kcodes[block_tables], (0, 3, 1, 2, 4)) \
+            .reshape(B, H, M * BS, Dh)
+        v = jnp.transpose(v_blocks.codes[block_tables], (0, 3, 1, 2, 4)) \
+            .reshape(B, H, M * BS, Dh)
+        ks = jnp.transpose(k_blocks.scales[block_tables]
+                           .reshape(B, M * BS, H), (0, 2, 1))  # [B,H,C]
+        vs = jnp.transpose(v_blocks.scales[block_tables]
+                           .reshape(B, M * BS, H), (0, 2, 1))
+        s = jnp.einsum("bhd,bhsd->bhs", q, k.astype(q.dtype)) \
+            .astype(jnp.float32) * ks.astype(jnp.float32) * sc
+        valid = jnp.arange(M * BS)[None, :] < ctx_lens[:, None]
+        s = jnp.where(valid[:, None, :], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhs,bhsd->bhd", w * vs.astype(q.dtype),
+                          v.astype(q.dtype))
     # XLA gather path: [B, M, BS, H, Dh] -> [B, H, M*BS, Dh]
     k = jnp.transpose(k_blocks[block_tables], (0, 3, 1, 2, 4)) \
         .reshape(B, H, M * BS, Dh)
@@ -171,7 +204,10 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     so chunked prefill carries no extra state.
 
     q: [T, H, Dh] — packed query stream (several prompt chunks).
-    k_blocks/v_blocks: [N, BS, H, Dh] — ONE layer's block pool.
+    k_blocks/v_blocks: [N, BS, H, Dh] — ONE layer's block pool; OR
+        `QuantizedKV` (int8 codes + per-vector scales) for an int8
+        pool — scales fold into the score/output contractions, the
+        cache streams as raw int8.
     block_tables: [B, M] int32 — block ids per slot row, 0-padded.
     seg: [T] int32 — slot row (index into block_tables) of each token.
     pos: [T] int32 — absolute cache position of each token; -1 marks a
@@ -191,8 +227,10 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     measured 3.4x on the same shapes), and applies the row-AND-position
     mask before a joint softmax over all rows — exactly the per-row
     softmax, because only the query's own row has unmasked columns."""
+    quant = _is_quantized_kv(k_blocks)
+    kcodes = k_blocks.codes if quant else k_blocks
     T, H, Dh = q.shape
-    _, BS, _, _ = k_blocks.shape
+    _, BS, _, _ = kcodes.shape
     B, M = block_tables.shape
     sc = (Dh ** -0.5) if scale is None else scale
     if _on_tpu():
@@ -207,12 +245,25 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
         except Exception as e:  # noqa: BLE001
             _warn_flash_fallback(e)
     # row-gather, head-major, joint-row softmax
-    k = k_blocks[block_tables].reshape(B, M * BS, H, Dh) \
-        .transpose(2, 0, 1, 3)                            # [H, B, C, Dh]
-    v = v_blocks[block_tables].reshape(B, M * BS, H, Dh) \
-        .transpose(2, 0, 1, 3)
+    if quant:
+        k = kcodes[block_tables].reshape(B, M * BS, H, Dh) \
+            .transpose(2, 0, 1, 3).astype(q.dtype)        # [H, B, C, Dh]
+        v = v_blocks.codes[block_tables].reshape(B, M * BS, H, Dh) \
+            .transpose(2, 0, 1, 3).astype(q.dtype)
+        ks = k_blocks.scales[block_tables].reshape(B, M * BS, H) \
+            .transpose(2, 0, 1)                           # [H, B, C]
+        vs = v_blocks.scales[block_tables].reshape(B, M * BS, H) \
+            .transpose(2, 0, 1)
+    else:
+        k = k_blocks[block_tables].reshape(B, M * BS, H, Dh) \
+            .transpose(2, 0, 1, 3)                        # [H, B, C, Dh]
+        v = v_blocks[block_tables].reshape(B, M * BS, H, Dh) \
+            .transpose(2, 0, 1, 3)
+        ks = vs = None
     qh = q.transpose(1, 0, 2)                             # [H, T, Dh]
     s = jnp.einsum("htd,hbcd->htbc", qh, k).astype(jnp.float32) * sc
+    if quant:  # per-KEY scale rides the score tensor post-contraction
+        s = s * ks[:, None].astype(jnp.float32)
     own = seg[:, None] == jnp.arange(B)[None, :]          # [T, B]
     ok = jnp.arange(M * BS)[None, :] <= pos[:, None]      # [T, M*BS]
     mask = own[:, :, None] & ok[:, None, :]               # [T, B, M*BS]
@@ -220,6 +271,8 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     w = jax.nn.softmax(
         s.reshape(H, T, B * M * BS), axis=-1
     ).reshape(H, T, B, M * BS).astype(q.dtype)
+    if quant:  # per-VALUE scale rides the prob tensor
+        w = w * vs[:, None].astype(q.dtype)
     return jnp.einsum("htbc,hbcd->htd", w, v).transpose(1, 0, 2)
 
 
@@ -231,7 +284,9 @@ def verify_window_attention(q, k_blocks, v_blocks, block_tables, pos,
     every query attending its OWN row's cache positions [0, pos].
 
     q: [P, W, H, Dh]; k_blocks/v_blocks: [N, BS, H, Dh] (one layer's
-    pool); block_tables: [P, M] int32 0-padded; pos: [P, W] int32
+    pool) or `QuantizedKV` codes+scales for an int8 pool (scales fold
+    into the contractions); block_tables: [P, M] int32 0-padded; pos:
+    [P, W] int32
     absolute cache positions (-1 = region pad; its output is finite
     garbage no readout index touches).
 
@@ -244,8 +299,10 @@ def verify_window_attention(q, k_blocks, v_blocks, block_tables, pos,
     materialization) — the verify dispatch runs every scheduler round,
     and the P-fold waste measurably capped the speculation speedup on
     CPU."""
+    quant = _is_quantized_kv(k_blocks)
+    kcodes = k_blocks.codes if quant else k_blocks
     P, W, H, Dh = q.shape
-    _, BS, _, _ = k_blocks.shape
+    _, BS, _, _ = kcodes.shape
     M = block_tables.shape[1]
     sc = (Dh ** -0.5) if scale is None else scale
     if _on_tpu():
@@ -253,12 +310,27 @@ def verify_window_attention(q, k_blocks, v_blocks, block_tables, pos,
         return ragged_prefill_attention(
             q.reshape(P * W, H, Dh), k_blocks, v_blocks, block_tables,
             seg, pos.reshape(-1), scale=sc).reshape(P, W, H, Dh)
-    k = k_blocks[block_tables].reshape(P, M * BS, H, Dh)
-    v = v_blocks[block_tables].reshape(P, M * BS, H, Dh)
+    if quant:
+        k = kcodes[block_tables].reshape(P, M * BS, H, Dh) \
+            .astype(q.dtype)
+        v = v_blocks.codes[block_tables].reshape(P, M * BS, H, Dh) \
+            .astype(q.dtype)
+        ks = k_blocks.scales[block_tables].reshape(P, M * BS, H) \
+            .transpose(0, 2, 1)[:, :, None, :]            # [P, H, 1, C]
+        vs = v_blocks.scales[block_tables].reshape(P, M * BS, H) \
+            .transpose(0, 2, 1)[:, :, None, :]
+    else:
+        k = k_blocks[block_tables].reshape(P, M * BS, H, Dh)
+        v = v_blocks[block_tables].reshape(P, M * BS, H, Dh)
+        ks = vs = None
     s = jnp.einsum("pwhd,pchd->phwc", q, k).astype(jnp.float32) * sc
+    if quant:
+        s = s * ks.astype(jnp.float32)
     ok = jnp.arange(M * BS)[None, None, :] <= pos[:, :, None]
     s = jnp.where(ok[:, None], s, -1e30)
     w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    if quant:
+        w = w * vs.astype(q.dtype)
     return jnp.einsum("phwc,pchd->pwhd", w, v)
 
 

@@ -94,27 +94,95 @@ def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _kernel_quant(tables_ref, lens_ref, q_ref, k_ref, ks_ref, v_ref,
+                  vs_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, nm):
+    """int8-KV variant (quantized-serving round): the pool streams as
+    raw int8 codes + per-vector scales; dequantization happens HERE in
+    VMEM on the one block in flight — the bf16 cache never exists in
+    HBM, which is the entire point (decode is cache-READ bound)."""
+    b = pl.program_id(0)
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    ctx = lens_ref[b]
+    bs = k_ref.shape[1]
+
+    @pl.when(mi * bs < ctx)
+    def _compute():
+        q = q_ref[0]  # [H, Dh]
+        dt = q.dtype
+        # per-vector dequant on the VMEM-resident block: [BS, H, Dh]
+        # codes * [BS, H, 1] scales — elementwise, lane-layout friendly
+        k = k_ref[0].astype(dt) * ks_ref[0][..., None].astype(dt)
+        v = v_ref[0].astype(dt) * vs_ref[0][..., None].astype(dt)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [H, BS]
+        pos = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # [H, Dh]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(mi == nm - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("scale", "interpret"))
 def paged_decode_attention_kernel(q, k_blocks, v_blocks, tables, ctx_lens,
                                   *, scale=None, interpret=False):
     """Pallas ragged paged decode attention. See module docstring for the
-    layout; returns [B, H, Dh] in q's dtype."""
+    layout; returns [B, H, Dh] in q's dtype. k_blocks/v_blocks may be
+    `QuantizedKV` (codes [N, BS, H, Dh] int8, scales [N, BS, H]) — the
+    scale tiles ride the same scalar-prefetched block index as their
+    codes and dequant happens in VMEM (`_kernel_quant`)."""
+    quant = hasattr(k_blocks, "codes")
     B, H, Dh = q.shape
-    _, BS, _, _ = k_blocks.shape
+    kcodes = k_blocks.codes if quant else k_blocks
+    _, BS, _, _ = kcodes.shape
     M = tables.shape[1]
     scale = (Dh ** -0.5) if scale is None else float(scale)
 
+    kv_spec = pl.BlockSpec((1, BS, H, Dh),
+                           lambda b, m, tab, cl: (tab[b, m], 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, BS, H),
+                           lambda b, m, tab, cl: (tab[b, m], 0, 0))
+    if quant:
+        in_specs = [
+            pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
+            kv_spec, sc_spec, kv_spec, sc_spec,
+        ]
+        kernel = functools.partial(_kernel_quant, scale=scale, nm=M)
+        operands = (q, k_blocks.codes, k_blocks.scales,
+                    v_blocks.codes, v_blocks.scales)
+    else:
+        in_specs = [
+            pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
+            kv_spec, kv_spec,
+        ]
+        kernel = functools.partial(_kernel, scale=scale, nm=M)
+        operands = (q, k_blocks, v_blocks)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # tables, ctx_lens steer the DMA pipeline
         grid=(B, M),
-        in_specs=[
-            pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
-            pl.BlockSpec((1, BS, H, Dh),
-                         lambda b, m, tab, cl: (tab[b, m], 0, 0, 0)),
-            pl.BlockSpec((1, BS, H, Dh),
-                         lambda b, m, tab, cl: (tab[b, m], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, Dh), jnp.float32),
@@ -122,11 +190,9 @@ def paged_decode_attention_kernel(q, k_blocks, v_blocks, tables, ctx_lens,
             pltpu.VMEM((H, STAT_LANES), jnp.float32),
         ],
     )
-    kernel = functools.partial(_kernel, scale=scale, nm=M)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
         interpret=interpret,
-    )(tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
-      q, k_blocks, v_blocks)
+    )(tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), *operands)

@@ -104,6 +104,54 @@ def _kernel(tile_seg_ref, tile_pos_ref, tables_ref, q_ref, k_ref, v_ref,
         o_ref[:] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
 
 
+def _kernel_quant(tile_seg_ref, tile_pos_ref, tables_ref, q_ref, k_ref,
+                  ks_ref, v_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                  *, scale, nm, qt):
+    """int8-KV variant (quantized-serving round): the block pool
+    streams as raw int8 codes + per-vector scales and is dequantized
+    HERE on the VMEM-resident block — no bf16 cache copy in HBM."""
+    qi = pl.program_id(0)
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q0 = tile_pos_ref[qi]
+    bs = k_ref.shape[1]
+
+    @pl.when((q0 >= 0) & (mi * bs <= q0 + qt - 1))
+    def _compute():
+        q = q_ref[:]  # [H, QT, Dh]
+        dt = q.dtype
+        k = k_ref[0].astype(dt) * ks_ref[0][..., None].astype(dt)
+        v = v_ref[0].astype(dt) * vs_ref[0][..., None].astype(dt)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [H, QT, BS]
+        row = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        col = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(col <= row, s, NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+        p = jnp.exp(s - m_new[:, :, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=2)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # [H, QT, Dh]
+        acc_ref[:] = acc_ref[:] * alpha[:, :, None] + pv
+        m_ref[:] = m_new
+
+    @pl.when(mi == nm - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[:] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("scale", "q_tile", "interpret"))
 def ragged_prefill_attention_kernel(q, k_blocks, v_blocks, tables,
@@ -111,11 +159,17 @@ def ragged_prefill_attention_kernel(q, k_blocks, v_blocks, tables,
                                     q_tile=None, interpret=False):
     """Pallas packed ragged prefill attention. See module docstring for
     the layout and packing contract; returns [T, H, Dh] in q's dtype.
-    q_tile defaults to the production Q_TILE=128 (interpret-mode tests
-    shrink it to exercise tiny shapes)."""
+    k_blocks/v_blocks may be `QuantizedKV` (codes [N, BS, H, Dh] int8,
+    scales [N, BS, H]) — the scale tiles ride the same
+    scalar-prefetched block index as their codes and dequant happens in
+    VMEM (`_kernel_quant`). q_tile defaults to the production
+    Q_TILE=128 (interpret-mode tests shrink it to exercise tiny
+    shapes)."""
+    quant = hasattr(k_blocks, "codes")
     qt = Q_TILE if q_tile is None else int(q_tile)
     T, H, Dh = q.shape
-    _, BS, _, _ = k_blocks.shape
+    kcodes = k_blocks.codes if quant else k_blocks
+    _, BS, _, _ = kcodes.shape
     M = tables.shape[1]
     if T % qt:
         raise ValueError(f"packed length {T} not a multiple of the "
@@ -124,17 +178,27 @@ def ragged_prefill_attention_kernel(q, k_blocks, v_blocks, tables,
     scale = (Dh ** -0.5) if scale is None else float(scale)
 
     qh = q.transpose(1, 0, 2)  # [H, T, Dh]: heads ride the sublane axis
+    q_spec = pl.BlockSpec((H, qt, Dh),
+                          lambda qi, m, ts, tp, tb: (0, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, BS, H, Dh),
+        lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0, 0))
+    sc_spec = pl.BlockSpec(
+        (1, BS, H), lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0))
+    if quant:
+        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec]
+        kernel = functools.partial(_kernel_quant, scale=scale, nm=M,
+                                   qt=qt)
+        operands = (qh, k_blocks.codes, k_blocks.scales,
+                    v_blocks.codes, v_blocks.scales)
+    else:
+        in_specs = [q_spec, kv_spec, kv_spec]
+        kernel = functools.partial(_kernel, scale=scale, nm=M, qt=qt)
+        operands = (qh, k_blocks, v_blocks)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # tile_seg, tile_pos, tables steer the DMA
         grid=(NQ, M),
-        in_specs=[
-            pl.BlockSpec((H, qt, Dh),
-                         lambda qi, m, ts, tp, tb: (0, qi, 0)),
-            pl.BlockSpec((1, BS, H, Dh),
-                         lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0, 0)),
-            pl.BlockSpec((1, BS, H, Dh),
-                         lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((H, qt, Dh),
                                lambda qi, m, ts, tp, tb: (0, qi, 0)),
         scratch_shapes=[
@@ -143,12 +207,11 @@ def ragged_prefill_attention_kernel(q, k_blocks, v_blocks, tables,
             pltpu.VMEM((H, qt), jnp.float32),
         ],
     )
-    kernel = functools.partial(_kernel, scale=scale, nm=M, qt=qt)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((H, T, Dh), q.dtype),
         interpret=interpret,
     )(tile_seg.astype(jnp.int32), tile_pos.astype(jnp.int32),
-      tables.astype(jnp.int32), qh, k_blocks, v_blocks)
+      tables.astype(jnp.int32), *operands)
     return out.transpose(1, 0, 2)

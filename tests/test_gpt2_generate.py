@@ -166,31 +166,42 @@ class TestWeightOnlyInt8Decode:
         assert (a == b).mean() > 0.9
         assert (b[:, :10] == ids).all()
 
-    def test_quant_cache_invalidates_on_weight_change(self):
+    def test_quantize_weights_public_packing(self):
+        """`quantize_weights()` (the quantized-serving satellite that
+        replaced the lazy `_w8_cache`) is the ONE shared W8A16
+        implementation: it packs every big 2-D decode weight into
+        ::w8c/::w8s pairs, reflects in-place weight edits on the next
+        call (no hidden cache to go stale), and round-trips within the
+        per-channel int8 bound."""
         import paddle_tpu as paddle
         from paddle_tpu.models.gpt2 import GPT2, GPT2Config
 
         paddle.seed(1)
         m = GPT2(GPT2Config.tiny())
         m.eval()
-        ids = np.zeros((1, 8), np.int32)
-        m.generate(ids, 4, weight_quant="int8")
-        quant1 = m._w8_cache[-1]
-        m.generate(ids, 4, weight_quant="int8")
-        assert m._w8_cache[-1] is quant1, \
-            "cache missed although no weight changed"
-        m.to(dtype="bfloat16")  # new weight arrays
-        m.generate(ids, 4, weight_quant="int8")
-        assert m._w8_cache[-1] is not quant1, \
-            "stale quantized weights reused after weights changed"
-        # change ONE non-wte parameter in place: the id()-keyed r4 cache
-        # missed this class entirely (advisor finding)
-        quant2 = m._w8_cache[-1]
+        packed = m.quantize_weights()
+        assert not hasattr(m, "_w8_cache")  # the lazy cache is gone
+        for name in ("wte.weight", "h.0.qkv_proj.weight",
+                     "h.1.fc2.weight"):
+            assert name not in packed
+            codes = packed[name + "::w8c"]
+            scales = packed[name + "::w8s"]
+            assert str(codes.dtype) == "int8"
+            assert codes.shape[:len(scales.shape)] != () and \
+                np.abs(np.asarray(codes)).max() <= 127
+        # round-trip bound: |w - codes*scale| <= scale/2 per channel
+        w = dict(m.named_parameters())["h.0.fc1.weight"].numpy()
+        codes = np.asarray(packed["h.0.fc1.weight::w8c"], np.float32)
+        scales = np.asarray(packed["h.0.fc1.weight::w8s"], np.float32)
+        deq = codes * scales[None, :]
+        assert np.abs(deq - w).max() <= scales.max() * 0.51
+        # no stale cache: an in-place weight edit shows up next call
         p = dict(m.named_parameters())["h.0.fc1.weight"]
         p.set_value(np.asarray(p.numpy()) * 0 + 1)
-        m.generate(ids, 4, weight_quant="int8")
-        assert m._w8_cache[-1] is not quant2, \
-            "cache ignored a non-wte parameter change"
+        packed2 = m.quantize_weights()
+        assert not np.array_equal(
+            np.asarray(packed2["h.0.fc1.weight::w8c"]),
+            np.asarray(packed["h.0.fc1.weight::w8c"]))
 
     def test_unknown_weight_quant_raises(self):
         import pytest

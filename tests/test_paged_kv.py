@@ -151,18 +151,24 @@ class TestBlockPool:
         assert c.stats()["peak_used_blocks"] == 2
 
 
-def _truncate_fuzz(steps, seed):
+def _truncate_fuzz(steps, seed, kv_dtype=None):
     """Fixed-seed pool fuzz interleaving `truncate_seq` accept/rollback
     ops (round 11 satellite) with the PR 4 op mix — alloc / ensure /
     append / ensure_many / free / attach / publish / CoW. After EVERY
     op the prefix-cache fuzz's invariant checker asserts that
     free ∪ retained ∪ tables still PARTITION the pool, refcounts equal
     table membership, and token accounting stays exact (a truncated
-    sequence's table covers exactly blocks_for(new_len) blocks)."""
+    sequence's table covers exactly blocks_for(new_len) blocks).
+    kv_dtype="int8" (quantized-serving satellite) runs the same mix on
+    a QUANTIZED pool: the scale buffers are parallel block-indexed
+    arrays, so every partition/free/retain/CoW/truncate invariant
+    must hold bit-for-bit the same — the checker also verifies the
+    codes/scales arrays stay shape-locked to the block pool."""
     from test_prefix_cache import check_invariants
 
     rs = np.random.RandomState(seed)
-    c = PagedKVCache(1, 1, 2, block_size=4, num_blocks=14)
+    c = PagedKVCache(1, 1, 2, block_size=4, num_blocks=14,
+                     kv_dtype=kv_dtype)
     master = rs.randint(1, 50, size=48).astype(np.int32)
     live = {}          # seq -> prompt length (publishable tokens)
     next_seq = [0]
@@ -272,11 +278,24 @@ class TestTruncateFuzz:
         accept/rollback interleaved keep the pool partition exact."""
         _truncate_fuzz(250, seed=4321)
 
+    def test_truncate_interleaved_invariants_int8(self):
+        """Tier-1 (quantized-serving satellite): the same interleaved
+        mix on an int8 pool — scale buffers must partition / free /
+        retain / CoW / truncate in lockstep with the blocks."""
+        c = _truncate_fuzz(250, seed=4321, kv_dtype="int8")
+        assert c.kv_dtype == "int8"
+        assert c.scale_bytes > 0
+
     @pytest.mark.slow
     def test_truncate_interleaved_invariants_long(self):
         """The long fuzz loop (slow-marked per the round-11 CI
         satellite): same mix, 2000 ops, different seed."""
         _truncate_fuzz(2000, seed=97531)
+
+    @pytest.mark.slow
+    def test_truncate_interleaved_invariants_int8_long(self):
+        """Long int8-pool fuzz (slow; quantized-serving satellite)."""
+        _truncate_fuzz(2000, seed=97531, kv_dtype="int8")
 
 
 class TestPagedDenseParity:
@@ -395,12 +414,13 @@ class TestPagedDenseParity:
         model, cfg = tiny_model
         ids = np.ones((1, 4), np.int32)
         # top_k/top_p are SUPPORTED on the paged path since round 10
-        # (per-slot sampling pipeline); kv_quant still is not
+        # (per-slot sampling pipeline), kv_quant="int8" since the
+        # quantized-serving round; unknown kv_quant values still raise
         out = model.generate(ids, 2, kv_cache="paged", top_k=5,
                              temperature=0.5, seed=1).numpy()
         assert out.shape == (1, 6)
         with pytest.raises(ValueError):
-            model.generate(ids, 2, kv_cache="paged", kv_quant="int8")
+            model.generate(ids, 2, kv_cache="paged", kv_quant="int4")
         with pytest.raises(ValueError):
             model.generate(ids, 2, kv_cache="nope")
         with pytest.raises(ValueError):  # dense path must not silently

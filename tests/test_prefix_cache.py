@@ -65,6 +65,17 @@ def check_invariants(c):
         assert 0 < fill <= c.block_size
         assert h in c._block_entries[blk]
         assert c._child_fills[parent].get(fill, 0) >= 1
+    # int8 pool (quantized-serving round): the scale buffers are
+    # block-indexed parallels of the code arrays — same block axis,
+    # same per-row layout — so every block operation above moved them
+    # in lockstep by construction; verify the structure never drifts
+    if c.kv_dtype == "int8":
+        for kv in (c.k_blocks, c.v_blocks):
+            assert str(kv.codes.dtype) == "int8"
+            assert kv.codes.shape == (c.num_layers, c.num_blocks,
+                                      c.block_size, c.num_heads,
+                                      c.head_dim)
+            assert kv.scales.shape == kv.codes.shape[:-1]
 
 
 class TestPrefixPoolUnit:

@@ -314,21 +314,30 @@ def _run_served_bench(*args, timeout=600):
 def test_served_bench_axis_emits_records():
     """`bench.py served` (mixed-length traffic: padded vs paged
     closed-loop, the open-loop Poisson axis, the shared-prefix caching
-    axis, the round-11 speculation axis, and the round-12 front-door
-    axis) must emit all seven JSON records; slow-marked so tier-1
-    stays fast."""
+    axis, the round-11 speculation axis, the round-12 front-door
+    axis, and the quantization axis) must emit all eight JSON records;
+    slow-marked so tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 7, stdout
+    assert len(recs) == 8, stdout
     assert any("paged" in rec["metric"] for rec in recs)
     assert any("mixedsampling" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
     assert any("sharedprefix" in rec["metric"] for rec in recs)
     assert any("speculative" in rec["metric"] for rec in recs)
     assert any("frontdoor" in rec["metric"] for rec in recs)
+    assert any("quantized" in rec["metric"] for rec in recs)
     for rec in recs:
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "p99_ms" in rec or "sharedprefix" in rec["metric"]
+    # the quantization acceptance bar (CPU-provable form): >= 1.8x
+    # worst-case slot reservations at the bf16 pool's byte budget,
+    # with near-perfect greedy agreement on the served workload (the
+    # >= 1.3x tok/s form needs the chip's int8 MXU — rerun queued)
+    qz = next(r for r in recs if "quantized" in r["metric"])
+    assert qz["slot_capacity_ratio"] >= 1.8, qz
+    assert qz["greedy_token_match"] >= 0.9, qz
+    assert qz["greedy_token_match_w8a16"] >= 0.98, qz
     # the speculation acceptance bar: >= 1.5x served tok/s vs plain
     # decode on the repetitive mix (CPU-degraded run of the
     # dispatch-bound proxy; the chip run may beat it)
@@ -354,18 +363,21 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=420)
-    assert len(recs) == 6, stdout
+    assert len(recs) == 7, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
                  and "speculative" not in r["metric"]
-                 and "frontdoor" not in r["metric"])
+                 and "frontdoor" not in r["metric"]
+                 and "quantized" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
     sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
     spec_rec = next(r for r in recs if "speculative" in r["metric"])
     fd_rec = next(r for r in recs if "frontdoor" in r["metric"])
-    for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec):
+    qz_rec = next(r for r in recs if "quantized" in r["metric"])
+    for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec,
+                qz_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -426,3 +438,23 @@ def test_served_bench_openloop_tiny_schema():
     assert fd_rec["resumes"] == fd_rec["preemptions"], fd_rec
     assert 0.0 <= fd_rec["deadline_miss_rate"] <= 1.0
     assert fd_rec["batch_tokens_per_sec"] > 0
+    # quantization axis (quantized-serving round): the record must
+    # carry the bf16/W8A16/W8A16+int8-KV comparison, the fixed-byte
+    # slot capacity pair, and the accuracy-delta fields
+    for fld in ("vs_baseline", "tokens_per_sec_bf16",
+                "tokens_per_sec_w8a16", "ttft_p50_ms",
+                "ttft_p50_ms_bf16", "itl_p99_ms_bf16",
+                "max_slots_at_fixed_bytes",
+                "max_slots_at_fixed_bytes_bf16", "slot_capacity_ratio",
+                "pool_budget_bytes", "kv_bytes_per_token",
+                "kv_bytes_per_token_bf16", "kv_scale_bytes",
+                "greedy_token_match", "greedy_token_match_w8a16",
+                "logit_mae", "logit_max_abs", "offered_rps"):
+        assert fld in qz_rec, qz_rec
+    # dtype-aware byte accounting must actually show the halving, and
+    # the fixed-byte pool must back strictly more int8 slots
+    assert qz_rec["kv_bytes_per_token"] \
+        < 0.6 * qz_rec["kv_bytes_per_token_bf16"], qz_rec
+    assert qz_rec["slot_capacity_ratio"] >= 1.8, qz_rec
+    assert qz_rec["kv_scale_bytes"] > 0
+    assert 0.0 <= qz_rec["greedy_token_match"] <= 1.0
