@@ -516,6 +516,13 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     dtype backs at the bf16 pool's byte budget (the CPU-provable
     >= 1.8x bar; tok/s is a chip number, CPU has no int8 MXU).
 
+    A ninth record is the SHARDED axis (serving_dist round): the same
+    pinned composed workload served on 1/2/4/8-device forced-host
+    meshes (tiny: 1/2), one subprocess per count — token parity across
+    mesh sizes asserted, plus max concurrent slots at FIXED per-device
+    pool bytes (the >= 3x-at-4-devices acceptance bar; tok/s scaling
+    is a chip number, host-mesh collectives run on CPU cores).
+
     tiny=True (`bench.py served --tiny`): seconds-scale smoke config
     that skips the padded comparison and telemetry — it exists so
     tier-1 can assert the served/open-loop/shared-prefix record SCHEMA
@@ -732,6 +739,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     st_qz = _bench_served_quantization(model, cfg, prompts, slots, bs,
                                        hi, new, k, chunk, on_tpu, tiny)
 
+    # (i) SHARDED axis (serving_dist round): the tensor-parallel paged
+    # engine at 1/2/4/8 forced-host devices — subprocesses, because the
+    # device count must be fixed before jax initializes. Token parity
+    # across counts is asserted by the record's token_parity field.
+    st_sh = _bench_served_sharded(on_tpu, tiny)
+
     base = "gpt2tiny_served" if tiny else "gpt2s_served"
     suffix = "" if on_tpu else "_CPU_DEGRADED"
     rec_paged = {
@@ -886,6 +899,40 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         "logit_max_abs": round(st_qz["logit_max_abs"], 5),
         "offered_rps": round(qz_q["offered_rps"], 3),
     }
+    sh_counts = sorted(st_sh)
+    sh_head = st_sh[4 if 4 in st_sh else max(st_sh)]  # acceptance point
+    sh_one = st_sh[1]
+    sh_sigs = {r["token_sig"] for r in st_sh.values()}
+    rec_sh = {
+        "metric": f"{base}_sharded_served_tokens_per_sec{suffix}",
+        "value": round(sh_head["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        # CPU host-mesh: collectives run on host cores, so tok/s
+        # scaling is a chip number — the CPU-provable halves of the
+        # axis are token parity and slot capacity at fixed bytes
+        "vs_baseline": round(sh_head["tokens_per_sec"]
+                             / max(sh_one["tokens_per_sec"], 1e-9), 3),
+        "baseline": "same pinned composed workload, 1-device mesh "
+                    "worker (CPU host-mesh)",
+        "devices": sh_counts,
+        "tp_degree": sh_head["tp"],
+        "dp_degree": sh_head["dp"],
+        "tokens_per_sec_by_devices": {
+            str(n): round(st_sh[n]["tokens_per_sec"], 1)
+            for n in sh_counts},
+        "max_slots_by_devices": {str(n): st_sh[n]["max_slots"]
+                                 for n in sh_counts},
+        # >= 3x at 4 devices is the acceptance bar (slow test asserts)
+        "slot_capacity_ratio": round(
+            sh_head["max_slots"] / max(sh_one["max_slots"], 1), 3),
+        "pool_budget_bytes": sh_head["pool_budget_bytes"],
+        "token_parity": len(sh_sigs) == 1,
+        "p99_ms": round(sh_head["p99_ms"], 1),
+        "itl_p99_ms": round(sh_head["itl_p99_ms"], 2),
+        "prefill_dispatches": sh_head["prefill_dispatches"],
+        "cpu_host_mesh": True,
+        "degraded": True,  # host-mesh numbers even on a chip session
+    }
     fd_base, fd_on, fd_stats = (st_fd["base"], st_fd["front"],
                                 st_fd["stats"])
     fdd = fd_stats["frontdoor"]
@@ -939,12 +986,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         rec_paged["baseline"] = \
             "padded static-batch GenerationServer, same traffic"
         records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp,
-                   rec_spec, rec_fd, rec_qz]
+                   rec_spec, rec_fd, rec_qz, rec_sh]
     else:
         rec_paged["vs_baseline"] = 1.0
         rec_paged["baseline"] = "self (tiny schema smoke)"
         records = [rec_paged, rec_mix, rec_open, rec_sp, rec_spec,
-                   rec_fd, rec_qz]
+                   rec_fd, rec_qz, rec_sh]
     if rec_tel is not None:
         records.append(rec_tel)
     if not on_tpu:
@@ -1005,6 +1052,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
           f"{rec_fd['preemptions']} preemptions "
           f"({rec_fd['preempt_cached_tokens']} toks kept cached)",
           file=sys.stderr)
+    print(f"# served sharded(devices {sh_counts}, host mesh): tok/s "
+          f"{' / '.join(str(rec_sh['tokens_per_sec_by_devices'][str(n)]) for n in sh_counts)}, "
+          f"max slots at fixed {rec_sh['pool_budget_bytes']} B/device "
+          f"{' -> '.join(str(rec_sh['max_slots_by_devices'][str(n)]) for n in sh_counts)} "
+          f"({rec_sh['slot_capacity_ratio']:.2f}x), token parity "
+          f"{rec_sh['token_parity']}", file=sys.stderr)
     print(f"# served quantized(bf16/w8a16/w8a16+kv8 @ "
           f"{rec_qz['offered_rps']:.2f} rps): "
           f"{rec_qz['tokens_per_sec_bf16']:,.0f} / "
@@ -1243,6 +1296,118 @@ def _bench_served_quantization(model, cfg, prompts, slots, bs, hi, new,
             "slots_bf16": max_slots_at(None),
             "slots_int8": max_slots_at("int8"),
             "pool_budget_bytes": budget}
+
+
+def _served_sharded_worker(ndev, tiny):
+    """Subprocess body of the sharded-serving axis: THIS process was
+    spawned with `--xla_force_host_platform_device_count=ndev` (the
+    multichip-dryrun trick), builds the pinned composed workload
+    (greedy + fixed-seed sampled, prefix cache ON, speculation ON,
+    int8 KV + W8A16) on a tp x dp mesh over those devices, and prints
+    ONE JSON dict: measured tok/s + latency, the reservation-backed
+    max concurrent slots at a FIXED per-device pool byte budget, and a
+    signature of every emitted token stream (the parent asserts the
+    signatures agree across device counts — mesh parity)."""
+    import hashlib
+
+    from paddle_tpu.inference import PagedGenerationServer
+    from paddle_tpu.inference.kv_cache import blocks_for
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+    from paddle_tpu.sampling import SamplingParams
+    from paddle_tpu.serving_dist import (ShardedEngineConfig,
+                                         pool_blocks_for_budget)
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    tp = min(int(ndev), cfg.num_heads)
+    dp = int(ndev) // tp
+    sharding = (ShardedEngineConfig(tp=tp, dp=dp) if ndev > 1 else None)
+    rng = np.random.RandomState(3)
+    n_req = 6 if tiny else 12
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (int(rng.randint(4, 40)),)).astype(np.int32)
+               for _ in range(n_req)]
+    sps = [None if i % 2 == 0 else SamplingParams(
+        temperature=0.8, top_p=(0.7, 0.85, 0.95)[i % 3],
+        seed=1000 + i) for i in range(n_req)]
+    new, slots, bs, chunk = 8, 2, 8, 16
+    srv = PagedGenerationServer(
+        model, max_slots=slots, block_size=bs, max_prompt_len=48,
+        max_new_tokens=new, prefill_chunk_tokens=chunk,
+        enable_prefix_cache=True, speculation=True, kv_dtype="int8",
+        quantization="w8a16", sharding=sharding).start()
+    try:
+        def drain():
+            return [f.result(timeout=600) for f in
+                    [srv.submit(p, sampling=s)
+                     for p, s in zip(prompts, sps)]]
+
+        drain()  # warm/compile pass
+        srv.reset_stats()
+        outs = drain()
+        st = srv.stats()
+    finally:
+        srv.stop()
+    sig = hashlib.md5(
+        b"|".join(np.asarray(o, np.int64).tobytes()
+                  for o in outs)).hexdigest()
+    # capacity at FIXED per-device pool bytes: the pool shards heads
+    # over tp and blocks over dp, so the same per-HBM budget backs
+    # tp*dp times the blocks (the CPU-provable half of the axis)
+    budget = 1 << 20
+    nb = pool_blocks_for_budget(cfg, bs, budget, tp=tp, dp=dp,
+                                kv_dtype="int8")
+    per_req = blocks_for(48 + new + 3, bs) + 1  # spec slack + CoW spare
+    max_slots = (nb - 1) // per_req
+    print(json.dumps({
+        "devices": int(ndev), "tp": tp, "dp": dp,
+        "tokens_per_sec": st["tokens_per_sec"],
+        "p99_ms": st["p99_ms"],
+        "itl_p99_ms": st["itl_p99_ms"],
+        "prefill_dispatches": st["prefill_dispatches"],
+        "max_slots": int(max_slots),
+        "pool_budget_bytes": budget,
+        "token_sig": sig,
+        "sharding": st["sharding"],
+    }))
+
+
+def _bench_served_sharded(on_tpu, tiny):
+    """Sharded-serving axis (serving_dist round): the SAME pinned
+    composed workload served at 1/2/4/8 forced-host CPU devices
+    (tiny: 1/2), one subprocess per device count so each gets its own
+    `--xla_force_host_platform_device_count`.  Reports tok/s and the
+    reservation-backed max concurrent slots at FIXED per-device pool
+    bytes per count, and asserts token parity across counts.  Always a
+    CPU host-mesh measurement — collectives run on host cores, so
+    capacity is the CPU-provable number and tok/s scaling is a chip
+    number (rerun queued with the r9-r13 carry-over)."""
+    counts = (1, 2) if tiny else (1, 2, 4, 8)
+    results = {}
+    for n in counts:
+        env = dict(os.environ,
+                   PADDLE_TPU_BENCH_PROBED="1", JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS=f"--xla_force_host_platform_device_count={n}")
+        args = [sys.executable, os.path.abspath(__file__),
+                "served-sharded-worker", str(n)]
+        if tiny:
+            args.append("--tiny")
+        r = subprocess.run(args, env=env, capture_output=True,
+                           text=True, timeout=900,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"sharded worker ({n} devices) failed:\n"
+                f"{r.stderr[-2000:]}")
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        results[n] = json.loads(line)
+    return results
 
 
 def _bench_served_frontdoor(model, cfg, on_tpu, tiny):
@@ -1580,6 +1745,12 @@ def main():
     on_tpu = jax.default_backend() not in ("cpu",)
 
     if axis:  # single-axis mode (manual runs / tests)
+        if axis == "served-sharded-worker":
+            # internal: subprocess body of the sharded-serving axis
+            # (this process was spawned with the forced-host device
+            # count already in XLA_FLAGS)
+            _served_sharded_worker(int(pos[1]), tiny)
+            return
         if axis in ("decode", "gpt2s_gen"):
             _bench_decode(on_tpu)
             return

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from ..parallel.mesh import axis_size as _axis_size
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -383,7 +385,7 @@ def quantized_all_reduce(x, axis_name, bits=8, block=256):
     element ~1/2^(bits-1) of the block max — gradient-noise scale, the
     same regime DGC/bf16-allreduce target."""
     from ..slim import dequantize, quantize_symmetric
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if x.size < n * block:
         # tiny leaves (biases, norm scales): padding to n*block would SEND
         # more bytes than the plain f32 psum saves — don't compress them

@@ -102,14 +102,22 @@ _m_alloc_failures = _metrics.counter(
     labelnames=_POOL_LABEL)
 # HBM accounting (quantized-serving round): dtype-aware, so the int8
 # halving is observable per pool instead of inferred from config.
+# The byte gauges carry a `shard` label (sharded-serving round):
+# shard="all" is the whole-pool total; when the pool's device arrays
+# are sharded over a mesh (serving_dist), per-shard series
+# shard="0".."n-1" report each device's equal slice — the number that
+# has to fit ONE device's HBM.
+_POOL_SHARD_LABELS = ("pool", "shard")
 _m_pool_bytes = _metrics.gauge(
     "kv_pool_bytes_total", "device bytes held by the K/V block pool "
-    "(codes + scale buffers when kv_dtype='int8'; dtype-aware)",
-    labelnames=_POOL_LABEL)
+    "(codes + scale buffers when kv_dtype='int8'; dtype-aware); "
+    "shard='all' = pool total, shard='k' = device k's slice when the "
+    "pool is mesh-sharded", labelnames=_POOL_SHARD_LABELS)
 _m_bytes_per_token = _metrics.gauge(
     "kv_pool_bytes_per_token", "pool bytes per usable token slot "
-    "(bytes_total / capacity_tokens — ~half under int8 KV)",
-    labelnames=_POOL_LABEL)
+    "(bytes_total / capacity_tokens — ~half under int8 KV); same "
+    "shard label semantics as kv_pool_bytes_total",
+    labelnames=_POOL_SHARD_LABELS)
 
 # Prefix-cache telemetry (round 9 tentpole).
 _m_prefix_lookups = _metrics.counter(
@@ -250,6 +258,7 @@ class PagedKVCache:
         self._block_entries: dict[int, set[int]] = {}
         self._child_fills: dict[int, dict[int, int]] = {}
         self._retained: OrderedDict[int, None] = OrderedDict()
+        self._shard_count = 1  # device shards (serving_dist sets > 1)
         self._peak_blocks = 0
         self._peak_retained = 0
         self._prefix_lookups = 0
@@ -302,6 +311,16 @@ class PagedKVCache:
         """Pool bytes per usable token slot (includes the trash block's
         amortized share — the honest per-token HBM cost)."""
         return self.pool_bytes_total / (self.capacity_tokens or 1)
+
+    def set_shard_count(self, n):
+        """Record how many device shards the pool arrays are placed
+        over (serving_dist): the byte gauges then also emit per-shard
+        series. Pure telemetry — the block-table API is shard-blind."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"shard count must be >= 1, got {n}")
+        self._shard_count = n
+        self._push_gauges()
 
     def stats_kv_dtype(self):
         """The stored element dtype as a stats/dashboard string:
@@ -400,8 +419,20 @@ class PagedKVCache:
                                                   or 1))
         _m_block_fill.labels(pool=p).set(
             held / ((used * self.block_size) or 1))
-        _m_pool_bytes.labels(pool=p).set(self.pool_bytes_total)
-        _m_bytes_per_token.labels(pool=p).set(self.bytes_per_token)
+        _m_pool_bytes.labels(pool=p, shard="all").set(
+            self.pool_bytes_total)
+        _m_bytes_per_token.labels(pool=p, shard="all").set(
+            self.bytes_per_token)
+        if self._shard_count > 1:
+            # per-shard slice: the pool arrays shard evenly over the
+            # mesh (heads over tp, blocks over dp), so each device
+            # holds 1/n of the bytes — the per-HBM number
+            per = self.pool_bytes_total / self._shard_count
+            per_tok = self.bytes_per_token / self._shard_count
+            for s in range(self._shard_count):
+                _m_pool_bytes.labels(pool=p, shard=str(s)).set(per)
+                _m_bytes_per_token.labels(pool=p,
+                                          shard=str(s)).set(per_tok)
 
     def allocate(self, seq_id, num_tokens):
         """Start a new sequence holding `num_tokens` tokens; returns its
@@ -727,6 +758,11 @@ class PagedKVCache:
             "kv_dtype": self.stats_kv_dtype(),
             "pool_bytes_total": self.pool_bytes_total,
             "pool_bytes_per_token": self.bytes_per_token,
+            # device shards the pool arrays are placed over (1 =
+            # unsharded); per-shard bytes are what one HBM must hold
+            "shards": self._shard_count,
+            "pool_bytes_per_shard": (self.pool_bytes_total
+                                     / self._shard_count),
             "scale_bytes": self.scale_bytes,
             "used_blocks": used,
             "free_blocks": len(self._free),

@@ -592,6 +592,20 @@ class PagedGenerationServer:
     "Quantized serving" for the parity-tolerance policy and when NOT
     to enable.
 
+    sharding=ShardedEngineConfig(tp, dp) (or True for a 1-device mesh)
+    turns on SHARDED SERVING (serving_dist round): the snapshotted
+    (and optionally quantized) weights are placed on a
+    `jax.sharding.Mesh` per the training TP plan (column/row-split
+    attention + MLP, vocab-parallel head), the KV pool's head axis
+    shards per-device behind the unchanged block-table API (+ the
+    block axis over dp), and every decode program is jitted with
+    explicit in/out shardings — XLA inserts the two TP collectives.
+    The engine loop, prefix cache, speculation, sampling and the
+    front door run unmodified (token parity tested across mesh
+    sizes); a 1-device mesh is bitwise the unsharded engine, and the
+    default None never imports serving_dist. See docs/SERVING.md
+    "Sharded serving".
+
     speculation=SpecConfig(...) (or True for defaults) turns on
     SPECULATIVE DECODING (round 11): each round, eligible decode-phase
     slots ask the drafter (default: the self-drafting n-gram /
@@ -620,7 +634,7 @@ class PagedGenerationServer:
                  steps_per_dispatch=1,
                  prefill_chunk_tokens=512, pack_align=None,
                  enable_prefix_cache=False, detokenize=None,
-                 stop_tail_tokens=16, speculation=None):
+                 stop_tail_tokens=16, speculation=None, sharding=None):
         import jax
         import jax.numpy as jnp
 
@@ -647,6 +661,14 @@ class PagedGenerationServer:
                     f"speculation must be a SpecConfig, True or None, "
                     f"got {type(speculation).__name__}")
         self.speculation = speculation
+        # sharded serving: normalize (True -> defaults) and validate
+        # the mesh config EAGERLY — tp must divide the head count
+        # before the pool layout is fixed below. The disabled path
+        # never imports serving_dist.
+        if sharding is not None:
+            from ..serving_dist import normalize_sharding
+
+            sharding = normalize_sharding(sharding, cfg.num_heads)
         self._spec_k = (speculation.max_draft_tokens
                         if speculation is not None else 0)
         self._drafter = (speculation.make_drafter()
@@ -723,15 +745,33 @@ class PagedGenerationServer:
             # default pool still fits max_slots worst-case requests)
             spare = 1 if self.enable_prefix_cache else 0
             num_blocks = self.max_slots * (self._m_width + spare) + 1
+        if sharding is not None and sharding.dp > 1:
+            # the pool's block axis shards over dp: round the array dim
+            # up so the explicit placement divides evenly (the extra
+            # blocks are just capacity)
+            num_blocks = -(-int(num_blocks) // sharding.dp) * sharding.dp
         self.cache = PagedKVCache(
             cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, block_size=self.block_size,
             num_blocks=int(num_blocks), dtype=dt, kv_dtype=kv_dtype)
         self._blocks_for = blocks_for
+        # sharded serving (serving_dist round): a ShardedEngineConfig
+        # (or True for defaults) places the snapshotted/quantized
+        # weights and the pool arrays on the mesh and hands the decoder
+        # an explicit-shardings bundle. None = the exact pre-round
+        # single-device path — serving_dist is never even imported.
+        self.sharding = None
+        self._mesh = None
+        decode_shardings = None
+        if sharding is not None:
+            from ..serving_dist import apply_sharding
+
+            decode_shardings = apply_sharding(self, sharding)
         # the decoder's kv_dtype MUST match the cache's — PagedDecoder
         # re-checks the pairing eagerly on every dispatch
-        self._decoder = PagedDecoder.for_config(cfg, self.block_size,
-                                                kv_dtype=kv_dtype)
+        self._decoder = PagedDecoder.for_config(
+            cfg, self.block_size, kv_dtype=kv_dtype,
+            shardings=decode_shardings)
         # per-slot sampling state (round 10): struct-of-arrays param
         # buffers + the [slots, V] penalty count buffer, scattered on
         # admit/refill. Constructor temperature is the DEFAULT for
@@ -1094,6 +1134,11 @@ class PagedGenerationServer:
                     "kv_scale_bytes": self.cache.scale_bytes,
                     "kv_pool_bytes_total": self.cache.pool_bytes_total,
                 },
+                # sharded serving (serving_dist round): mesh config the
+                # engine runs on — schema-stable (zeroed when disabled,
+                # trivially reset-coherent: it is construction config,
+                # not a window counter)
+                "sharding": self._sharding_stats(),
                 # admission headroom RIGHT NOW: free + LRU-reclaimable
                 # blocks — the number the reservation check reasons
                 # about (instantaneous, not a window counter)
@@ -1117,6 +1162,15 @@ class PagedGenerationServer:
             }
             out["kv_cache"] = self.cache.stats()
             return out
+
+    def _sharding_stats(self):
+        """The stats()["sharding"] block: the ShardedEngineConfig's
+        shape when sharding is on, the zeroed congruent schema when
+        off (without importing serving_dist on the disabled path)."""
+        if self.sharding is None:
+            return {"enabled": False, "mesh_shape": {}, "tp_degree": 0,
+                    "dp_degree": 0}
+        return self.sharding.stats_block()
 
     def _frontdoor_stats_locked(self):
         """The stats()["frontdoor"] block; caller holds the lock."""

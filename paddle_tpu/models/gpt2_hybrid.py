@@ -203,7 +203,8 @@ def build_hybrid_gpt2_loss(mesh, num_microbatches=2, ring_impl=None,
             # zigzag layout: this rank holds global chunks (i, 2n-1-i) of
             # 2n — position embeddings must follow the SAME permutation
             # the caller applied to the batch (zigzag_order)
-            n_sp = jax.lax.axis_size(sp_axis)
+            from ..parallel.mesh import axis_size
+            n_sp = axis_size(sp_axis)
             half = s_l // 2
             pos = jnp.concatenate(
                 [sp_idx * half + jnp.arange(half),

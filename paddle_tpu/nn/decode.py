@@ -180,18 +180,41 @@ def _layer_helpers(spec):
     return ns
 
 
+def _rep_pin(rep_constraint):
+    """Logit pin for SHARDED programs (serving_dist round): gather the
+    vocab-sharded head output to every device BEFORE the sampling
+    pipeline.  This is the vocab-parallel all-gather placement — and it
+    is load-bearing for parity: left to itself, the SPMD partitioner
+    shards the sort/threefry/argmax pipeline over 2-D meshes and the
+    pinned toolchain MISCOMPILES it (observed: an argmax result 6.0
+    below the true max at dp x mp > 1).  With the logits pinned
+    replicated, every downstream sampling op computes replicated —
+    bitwise the single-device pipeline.  None (the unsharded path) is
+    the identity."""
+    if rep_constraint is None:
+        return lambda x: x
+    import jax
+
+    return lambda x: jax.lax.with_sharding_constraint(x, rep_constraint)
+
+
 @functools.lru_cache(maxsize=64)
 def _build_paged_fns(spec, block_size, return_logits, mode,
-                     kv_quant=False):
+                     kv_quant=False, rep_constraint=None):
     """(spec, block_size, mode, kv_quant) -> (prefill_fn, step_fn), raw
     and jittable. mode = (any_sampled, any_penalties): the static
     variant pair of the sampling pipeline (see module docstring).
     kv_quant=True takes/returns `QuantizedKV` cache pytrees: appends
-    quantize on write, attention dequantizes in-kernel."""
+    quantize on write, attention dequantizes in-kernel.
+    rep_constraint: replicated NamedSharding for the logits pin of
+    sharded programs (see _rep_pin); None traces the exact unsharded
+    program."""
     import jax
     import jax.numpy as jnp
 
     from ..sampling import processors as _proc
+
+    pin = _rep_pin(rep_constraint)
 
     L, H, Dh, E, eps, tied = spec
     scale = Dh ** -0.5
@@ -234,7 +257,7 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
             x = block_and_mlp(params, i, x, o, dt)
         xf = x[jnp.arange(B), lens - 1]                # true last token
         xf = ln(xf, params["ln_f.weight"], params["ln_f.bias"])
-        logits = head(xf)
+        logits = pin(head(xf))
         tok = _proc.sample_tokens(logits, sp, sampled=sampled,
                                   penalties=penalties)
         stopped = _proc.check_stops(tok, sp["stop"],
@@ -271,7 +294,7 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
                                        scale=scale).reshape(B, E)
             x = block_and_mlp(params, i, x, o, dt)
         xf = ln(x, params["ln_f.weight"], params["ln_f.bias"])
-        logits = head(xf)
+        logits = pin(head(xf))
         nxt = jnp.where(active,
                         _proc.sample_tokens(logits, sp, sampled=sampled,
                                             penalties=penalties), 0)
@@ -336,7 +359,7 @@ def _packed_trunk(spec, block_size, kv_quant=False):
 
 @functools.lru_cache(maxsize=64)
 def _build_packed_prefill(spec, block_size, return_logits, mode,
-                          kv_quant=False):
+                          kv_quant=False, rep_constraint=None):
     """Packed ragged prefill: ONE dispatch prefills a token-packed
     multi-sequence chunk stream (the tentpole of the chunked-prefill
     scheduler, inference/serving.py). Raw and jittable."""
@@ -347,6 +370,7 @@ def _build_packed_prefill(spec, block_size, return_logits, mode,
     sampled, penalties = mode
     hp = _layer_helpers(spec)
     trunk = _packed_trunk(spec, block_size, bool(kv_quant))
+    pin = _rep_pin(rep_constraint)
 
     def packed_prefill_fn(params, toks, seg, pos, tables, sample_idx,
                           kc, vc, sp):
@@ -375,7 +399,7 @@ def _build_packed_prefill(spec, block_size, return_logits, mode,
             params, params["ln_f.weight"].dtype)
         xf = x[sample_idx]                                # [B, E]
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
-        logits = head(xf)
+        logits = pin(head(xf))
         tok = _proc.sample_tokens(logits, sp, sampled=sampled,
                                   penalties=penalties)
         B = sample_idx.shape[0]
@@ -451,7 +475,8 @@ def _verify_trunk(spec, block_size, kv_quant=False):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_packed_verify(spec, block_size, mode, kv_quant=False):
+def _build_packed_verify(spec, block_size, mode, kv_quant=False,
+                         rep_constraint=None):
     """Speculative verification (spec_decode round): score a packed
     stream of [last_token, draft_1 .. draft_k] regions — one region per
     speculating slot — in ONE ragged dispatch, and decide acceptance ON
@@ -475,6 +500,7 @@ def _build_packed_verify(spec, block_size, mode, kv_quant=False):
     sampled, penalties = mode
     hp = _layer_helpers(spec)
     trunk = _verify_trunk(spec, block_size, bool(kv_quant))
+    pin = _rep_pin(rep_constraint)
 
     def verify_fn(params, toks, seg, pos, tables, sample_idx, dlen,
                   kc, vc, sp):
@@ -502,7 +528,7 @@ def _build_packed_verify(spec, block_size, mode, kv_quant=False):
             params, params["ln_f.weight"].dtype)
         xf = x[sample_idx.reshape(-1)]                    # [P*K1, E]
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
-        logits = head(xf)                                 # [P*K1, V]
+        logits = pin(head(xf))                            # [P*K1, V]
         fed = toks[sample_idx]                            # [P, K1]
         j = jnp.arange(K1)[None, :]
         draft_valid = (j >= 1) & (j <= dlen[:, None])     # real drafts
@@ -579,9 +605,52 @@ def _jitted_paged_fns(spec, block_size, return_logits, donate, mode,
             jax.jit(step_fn, donate_argnums=ds))
 
 
+@functools.lru_cache(maxsize=32)
+def _sharded_jits(spec, block_size, return_logits, donate, mode,
+                  kv_quant, sh):
+    """The four decode programs jitted with EXPLICIT in/out shardings
+    (sharded-serving round): params per the serving_dist plan, kc/vc
+    pinned to the per-shard pool layout on BOTH sides (so the pool
+    sharding is stable across the functional round-trip and never
+    re-propagates), every host-side input/output replicated.  The
+    traced functions are the exact `_build_*` programs the unsharded
+    path jits — sharding is a placement property, so XLA partitions the
+    same HLO and inserts the TP collectives itself.  Cached
+    process-wide per (program, mode, shardings bundle) — the bundle is
+    hashable, so servers on equal meshes share compiled programs."""
+    import jax
+
+    pr, kv, rep = sh.params, sh.kv, sh.rep
+    prefill_fn, step_fn = _build_paged_fns(spec, block_size,
+                                           return_logits, mode, kv_quant,
+                                           rep)
+    packed_fn = _build_packed_prefill(spec, block_size, return_logits,
+                                      mode, kv_quant, rep)
+    verify_fn = _build_packed_verify(spec, block_size, mode, kv_quant,
+                                     rep)
+    tail = (rep,) if return_logits else ()
+    out5 = (rep, rep, kv, kv, rep) + tail
+    prefill = jax.jit(
+        prefill_fn, in_shardings=(pr, rep, rep, rep, kv, kv, rep),
+        out_shardings=out5, donate_argnums=(4, 5) if donate else ())
+    step = jax.jit(
+        step_fn, in_shardings=(pr, rep, rep, rep, rep, kv, kv, rep),
+        out_shardings=out5, donate_argnums=(5, 6) if donate else ())
+    packed = jax.jit(
+        packed_fn,
+        in_shardings=(pr, rep, rep, rep, rep, rep, kv, kv, rep),
+        out_shardings=out5, donate_argnums=(6, 7) if donate else ())
+    verify = jax.jit(
+        verify_fn,
+        in_shardings=(pr, rep, rep, rep, rep, rep, rep, kv, kv, rep),
+        out_shardings=(rep, rep, rep, kv, kv, rep),
+        donate_argnums=(7, 8) if donate else ())
+    return prefill, step, packed, verify
+
+
 @functools.lru_cache(maxsize=64)
-def _jitted_multistep(spec, block_size, n_steps, donate, mode,
-                      kv_quant=False):
+def _build_multistep(spec, block_size, n_steps, mode, kv_quant=False,
+                     rep_constraint=None):
     """`n_steps` decode tokens in ONE dispatch (a lax.scan over step_fn):
     multi-step scheduling for dispatch-latency-bound serving — at the
     measured 8-70ms tunnel floor a strict token-per-dispatch loop is
@@ -590,11 +659,11 @@ def _jitted_multistep(spec, block_size, n_steps, donate, mode,
     host-side. Per-slot PRNG steps advance with the scan index, so the
     fused scan draws the same per-request streams as n_steps separate
     dispatches. Returns (toks [n_steps, B], stopped [n_steps, B], kc,
-    vc, counts|None)."""
+    vc, counts|None). Raw and jittable."""
     import jax
 
     _, step_fn = _build_paged_fns(spec, block_size, False, mode,
-                                  kv_quant)
+                                  kv_quant, rep_constraint)
     sampled, penalties = mode
 
     def multi(params, tok, pos, active, tables, kc, vc, sp):
@@ -617,7 +686,32 @@ def _jitted_multistep(spec, block_size, n_steps, donate, mode,
             jax.numpy.arange(n_steps))
         return toks, stops, kc, vc, counts
 
+    return multi
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_multistep(spec, block_size, n_steps, donate, mode,
+                      kv_quant=False):
+    import jax
+
+    multi = _build_multistep(spec, block_size, n_steps, mode, kv_quant)
     return jax.jit(multi, donate_argnums=(5, 6) if donate else ())
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_multistep(spec, block_size, n_steps, donate, mode,
+                       kv_quant, sh):
+    """Explicit-in/out-sharded multistep jit, cached process-wide per
+    shardings bundle (see _sharded_jits)."""
+    import jax
+
+    pr, kv, rep = sh.params, sh.kv, sh.rep
+    return jax.jit(
+        _build_multistep(spec, block_size, n_steps, mode, kv_quant,
+                         rep),
+        in_shardings=(pr, rep, rep, rep, rep, kv, kv, rep),
+        out_shardings=(rep, rep, kv, kv, rep),
+        donate_argnums=(5, 6) if donate else ())
 
 
 class PagedDecoder:
@@ -634,10 +728,19 @@ class PagedDecoder:
     attention dequantizes inside the kernel. Every dispatch checks the
     pairing EAGERLY (`_check_kv`): an int8 decoder handed dense bf16
     cache arrays (or vice versa) raises a ValueError naming the
-    mismatched argument instead of failing deep inside a jit trace."""
+    mismatched argument instead of failing deep inside a jit trace.
+
+    shardings: a `serving_dist.DecodeShardings` bundle (sharded
+    serving round) makes every program an explicit-in/out-sharded jit
+    over the bundle's mesh — params per the TP plan, kc/vc pinned to
+    the per-shard pool layout on both sides of the functional
+    round-trip, host-side inputs/outputs replicated, and the head
+    logits pinned replicated before the sampling pipeline
+    (`_rep_pin`). These jits are cached per decoder INSTANCE; None
+    (the default) uses the exact pre-round process-wide caches."""
 
     def __init__(self, spec, block_size, return_logits=False, donate=None,
-                 kv_dtype=None):
+                 kv_dtype=None, shardings=None):
         import jax
 
         if donate is None:  # CPU donation is a no-op warning in jaxlib
@@ -651,7 +754,12 @@ class PagedDecoder:
         self.kv_dtype = kv_dtype
         self._kv_quant = kv_dtype == "int8"
         self._donate = bool(donate)
+        # sharded serving: a serving_dist.DecodeShardings bundle makes
+        # every program an explicit-in/out-sharded jit over the bundle's
+        # mesh (None = the exact pre-round process-cached jits)
+        self._shardings = shardings
         self._variants = {}
+        self._msteps = {}
 
     def _check_kv(self, kc, vc):
         """Eager dtype-consistency assert (CI/tooling satellite): the
@@ -679,15 +787,21 @@ class PagedDecoder:
         if v is None:
             from ..observability import tracing as _tracing
 
-            prefill, step = _jitted_paged_fns(
-                self.spec, self.block_size, self.return_logits,
-                self._donate, mode, self._kv_quant)
-            packed = _jitted_packed_prefill(
-                self.spec, self.block_size, self.return_logits,
-                self._donate, mode, self._kv_quant)
-            verify = _jitted_packed_verify(
-                self.spec, self.block_size, self._donate, mode,
-                self._kv_quant)
+            if self._shardings is not None:
+                prefill, step, packed, verify = _sharded_jits(
+                    self.spec, self.block_size, self.return_logits,
+                    self._donate, mode, self._kv_quant,
+                    self._shardings)
+            else:
+                prefill, step = _jitted_paged_fns(
+                    self.spec, self.block_size, self.return_logits,
+                    self._donate, mode, self._kv_quant)
+                packed = _jitted_packed_prefill(
+                    self.spec, self.block_size, self.return_logits,
+                    self._donate, mode, self._kv_quant)
+                verify = _jitted_packed_verify(
+                    self.spec, self.block_size, self._donate, mode,
+                    self._kv_quant)
             v = (_tracing.wrap("prefill_dispatch", prefill),
                  _tracing.wrap("step_dispatch", step),
                  _tracing.wrap("packed_prefill_dispatch", packed),
@@ -725,11 +839,24 @@ class PagedDecoder:
                                       sample_idx, dlen, kc, vc, sp)
 
     def multistep(self, n_steps, mode=GREEDY_MODE):
-        """Fused n-token decode (see _jitted_multistep)."""
+        """Fused n-token decode (see _build_multistep)."""
+        import jax
+
         from ..observability import tracing as _tracing
 
-        fn = _jitted_multistep(self.spec, self.block_size, int(n_steps),
-                               self._donate, mode, self._kv_quant)
+        if self._shardings is not None:
+            key = (int(n_steps), mode)
+            fn = self._msteps.get(key)
+            if fn is None:
+                fn = _sharded_multistep(self.spec, self.block_size,
+                                        int(n_steps), self._donate,
+                                        mode, self._kv_quant,
+                                        self._shardings)
+                self._msteps[key] = fn
+        else:
+            fn = _jitted_multistep(self.spec, self.block_size,
+                                   int(n_steps), self._donate, mode,
+                                   self._kv_quant)
         wrapped = _tracing.wrap("multistep_dispatch", fn,
                                 k=int(n_steps))
 

@@ -28,6 +28,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .mesh import axis_size as _axis_size
+from .mesh import pvary as _pvary
+
 
 def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
     """Run homogeneous pipeline stages inside shard_map over `axis_name`.
@@ -43,7 +46,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
     Returns [M, mb, ...] outputs of the LAST stage (valid on every rank —
         replicated by a final collect).
     """
-    s = jax.lax.axis_size(axis_name)
+    s = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
     ticks = m + s - 1
@@ -71,10 +74,10 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
         return (buf_next, outs), None
 
     buf0 = jnp.zeros(mb_shape, microbatches.dtype)
-    buf0 = jax.lax.pvary(buf0, axis_name)
+    buf0 = _pvary(buf0, axis_name)
     outs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
-    outs0 = jax.lax.pvary(outs0, axis_name)
-    mbs = jax.lax.pvary(microbatches, axis_name) \
+    outs0 = _pvary(outs0, axis_name)
+    mbs = _pvary(microbatches, axis_name) \
         if not _is_varying(microbatches) else microbatches
     (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
     # outs holds last-stage results only on the last rank; broadcast via
@@ -108,7 +111,7 @@ def pipeline_apply_interleaved(chunk_fn, chunk_params, microbatches,
     (what rank r does at tick t): u = t - r; m = (u//(V*S))*S + u%S;
     chunk slot l = (u % (V*S)) // S; idle iff u < 0 or m >= M.
     """
-    s = jax.lax.axis_size(axis_name)
+    s = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     m_total = microbatches.shape[0]
     if m_total % s:
@@ -149,8 +152,8 @@ def pipeline_apply_interleaved(chunk_fn, chunk_params, microbatches,
         buf_next = jax.lax.ppermute(y, axis_name, fwd_perm)
         return (buf_next, outs), None
 
-    buf0 = jax.lax.pvary(jnp.zeros(mb_shape, microbatches.dtype), axis_name)
-    outs0 = jax.lax.pvary(jnp.zeros((m_total,) + mb_shape,
+    buf0 = _pvary(jnp.zeros(mb_shape, microbatches.dtype), axis_name)
+    outs0 = _pvary(jnp.zeros((m_total,) + mb_shape,
                                     microbatches.dtype), axis_name)
     (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
     outs_masked = jnp.where(idx == s - 1, outs, jnp.zeros_like(outs))

@@ -27,6 +27,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .mesh import axis_size as _axis_size
+from .mesh import pvary as _pvary
+
 NEG_INF = -1e30
 _CHUNK = 512
 
@@ -86,7 +89,7 @@ def _chunk_attn(q, k, v, scale, rel, q_off, k_off, axis_name=None):
     m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
     if axis_name is not None:  # inside shard_map: carry must be sp-varying
-        o0, m0, l0 = (jax.lax.pvary(t, axis_name) for t in (o0, m0, l0))
+        o0, m0, l0 = (_pvary(t, axis_name) for t in (o0, m0, l0))
     (o, m, l), _ = jax.lax.scan(body, (o0, m0, l0), jnp.arange(nck))
     return o, m, l
 
@@ -113,7 +116,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
 
 
 def _ring_chunked(q, k, v, axis_name, causal, scale):
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     q_off = idx * s_local
@@ -147,7 +150,7 @@ def _ring_chunked(q, k, v, axis_name, causal, scale):
     l0 = jnp.zeros((b, h, s, 1), jnp.float32)
     # constants start axis-unvarying under shard_map's type system; the carry
     # becomes sp-varying after the first step, so pre-mark them varying
-    o0, m0, l0 = (jax.lax.pvary(t, axis_name) for t in (o0, m0, l0))
+    o0, m0, l0 = (_pvary(t, axis_name) for t in (o0, m0, l0))
     (o, m, l, _, _), _ = jax.lax.scan(body, (o0, m0, l0, k, v),
                                       jnp.arange(n))
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
@@ -212,7 +215,7 @@ def _rel_for(src_idx, idx, causal):
 
 
 def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, s, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -230,8 +233,8 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (o_acc, lse_new, k_nxt, v_nxt), None
 
-    o0 = jax.lax.pvary(jnp.zeros((b, h, s, d), jnp.float32), axis_name)
-    lse0 = jax.lax.pvary(jnp.full((b, h, s), NEG_INF, jnp.float32),
+    o0 = _pvary(jnp.zeros((b, h, s, d), jnp.float32), axis_name)
+    lse0 = _pvary(jnp.full((b, h, s), NEG_INF, jnp.float32),
                          axis_name)
     (o, lse, _, _), _ = jax.lax.scan(body, (o0, lse0, k, v), jnp.arange(n))
     return o.astype(q.dtype), lse
@@ -246,7 +249,7 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret):
 def _ring_flash_bwd(axis_name, causal, scale, interpret, res, g):
     from ..ops.pallas.flash_attention import LSE_LANES
     q, k, v, out, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, s, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -271,7 +274,7 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, res, g):
         dv_nxt = jax.lax.ppermute(dv_trav, axis_name, perm)
         return (dq_acc, dk_nxt, dv_nxt, k_nxt, v_nxt), None
 
-    z = jax.lax.pvary(jnp.zeros((b, h, s, d), jnp.float32), axis_name)
+    z = _pvary(jnp.zeros((b, h, s, d), jnp.float32), axis_name)
     (dq, dk, dv, _, _), _ = jax.lax.scan(body, (z, z, z, k, v),
                                          jnp.arange(n))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
@@ -354,7 +357,7 @@ def zigzag_ring_attention(q, k, v, axis_name="sp", scale=None):
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     if s_local % 2:
@@ -398,7 +401,7 @@ def zigzag_ring_attention(q, k, v, axis_name="sp", scale=None):
         def later(_):
             # src > idx: only the hi chunk (global pos 2n-1-idx) is after
             # BOTH of src's chunks → q_hi × whole-K, full; lo no-op
-            lo_p = tuple(jax.lax.pvary(t, axis_name) for t in (
+            lo_p = tuple(_pvary(t, axis_name) for t in (
                 jnp.zeros((b, h, half, d), jnp.float32),
                 jnp.full((b, h, half, 1), NEG_INF, jnp.float32),
                 jnp.zeros((b, h, half, 1), jnp.float32)))

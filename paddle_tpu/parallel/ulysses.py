@@ -23,6 +23,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .mesh import axis_size as _axis_size
+
 
 def _local_attention(q, k, v, causal, scale, interpret):
     from .ring_attention import _flash_ok
@@ -47,7 +49,7 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None,
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h = q.shape[1]
     if h % n:
         raise ValueError(
@@ -85,7 +87,7 @@ def sp_attention(q, k, v, axis_name="sp", causal=False, scale=None,
         return zigzag_ring_attention(q, k, v, axis_name=axis_name,
                                      scale=scale)
     if impl is None:
-        n = jax.lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         impl = "ulysses" if q.shape[1] % n == 0 else "ring"
     if impl == "ulysses":
         return ulysses_attention(q, k, v, axis_name, causal, scale,

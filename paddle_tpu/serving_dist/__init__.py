@@ -1,0 +1,63 @@
+"""Sharded serving: the paged engine tensor-parallel on the mesh.
+
+ROADMAP item 1 — the serving engine of PRs 1-8 (paged KV + continuous
+batching, packed chunked prefill, prefix cache, per-request sampling,
+speculation, front door, W8A16/int8-KV) runs on ONE device; the
+training stack already proves 4D dp/pp/mp/sp parallelism with loss
+parity.  This subsystem closes that gap for the DECODE side: it shards
+the existing engine's weights and KV block pool over a
+`jax.sharding.Mesh` (built by `parallel/mesh.py`, the canonical
+dp/pp/mp/sp axes — serving uses `mp` for tensor parallel and `dp` for
+the pool's block axis) and jits the UNCHANGED decode programs
+(`nn/decode.py` prefill / step / packed_prefill / packed_verify) with
+explicit in/out shardings, so XLA inserts exactly the two TP
+collectives per layer family the training TP path already schedules
+(all-reduce after the row-split out_proj/fc2 contractions, all-gather
+of the vocab-sharded logits at the head).
+
+The design invariant: sharding is a PLACEMENT property, not an engine
+property.
+
+  * Block tables, sequence lengths, refcounts, the prefix-cache index,
+    admission reservations — every piece of host bookkeeping in
+    `PagedKVCache` — stay replicated host state, untouched.  The pool's
+    DEVICE arrays shard over the head axis (tp) and optionally the
+    block axis (dp), so prefix publish/attach, copy-on-write, swap-out,
+    truncate and the int8 scale buffers all keep working: they only
+    ever name block INDICES, and every shard holds its head-slice of
+    every block.
+  * The decode programs are the same traced functions; a 1-device mesh
+    compiles the identical program and is bitwise-identical to the
+    unsharded engine (tested).
+  * Composition is free: quantization (w8a16 + int8 KV), speculation,
+    per-request sampling invariance and the FrontDoor run unchanged on
+    the sharded engine — their state is host-side or replicated.
+
+Use:
+
+    from paddle_tpu.serving_dist import ShardedEngineConfig
+    server = PagedGenerationServer(model,
+                                   sharding=ShardedEngineConfig(tp=4))
+
+Development and CPU validation run on forced host devices
+(`XLA_FLAGS=--xla_force_host_platform_device_count=N`, the multichip
+dryrun trick; `scripts/run_mesh_tests.sh` wraps it).  Importing this
+package pulls nothing heavy — `inference/serving.py` imports it lazily
+and only when `sharding=` is actually given.
+"""
+from __future__ import annotations
+
+from .config import (ShardedEngineConfig, disabled_stats_block,
+                     normalize_sharding)
+from .plan import (DecodeShardings, build_decode_shardings,
+                   decode_spec_for, kv_pool_specs, place_decode_params,
+                   place_kv_pool)
+from .engine import (apply_sharding, max_slots_for_budget,
+                     pool_blocks_for_budget)
+
+__all__ = [
+    "ShardedEngineConfig", "normalize_sharding", "disabled_stats_block", "DecodeShardings", "decode_spec_for",
+    "kv_pool_specs", "build_decode_shardings", "place_decode_params",
+    "place_kv_pool", "apply_sharding", "pool_blocks_for_budget",
+    "max_slots_for_budget",
+]

@@ -1,0 +1,117 @@
+"""ShardedEngineConfig — mesh/axis plumbing for the sharded paged engine.
+
+Reuses the canonical mesh builder (`parallel/mesh.py`, axes dp/pp/mp/sp)
+so serving and training agree on axis names: serving tensor parallel IS
+the training `mp` axis (column/row-split weights, vocab-parallel head)
+and the optional slot/data axis is `dp` (the KV pool's block dimension
+shards over it).  pp/sp stay 1 — pipeline and sequence parallel are
+training-side schedules with no decode analogue here.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ShardedEngineConfig:
+    """How to shard one `PagedGenerationServer` across devices.
+
+    tp: tensor-parallel degree — attention/MLP weights column/row-split
+        and the LM head vocab-sharded over the mesh `mp` axis; the KV
+        pool's HEAD axis shards with them, so each device holds
+        1/tp of every block's bytes.
+    dp: optional data/slot degree — the KV pool's BLOCK axis
+        additionally shards over the mesh `dp` axis (per-device pool
+        bytes divide by tp*dp).  Weights are replicated over dp.
+    devices: explicit device list (tests / subsets); None = the first
+        tp*dp of `jax.devices()`.
+    """
+
+    tp: int = 1
+    dp: int = 1
+    devices: tuple = None
+
+    def __post_init__(self):
+        for field_name in ("tp", "dp"):
+            v = getattr(self, field_name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"ShardedEngineConfig.{field_name}={v!r} must be a "
+                    f"positive int")
+        if self.devices is not None:
+            object.__setattr__(self, "devices", tuple(self.devices))
+
+    @property
+    def total(self):
+        return self.tp * self.dp
+
+    def build_mesh(self):
+        """Build the (dp, pp, mp, sp) mesh this config shards over —
+        pp = sp = 1, mp = tp.  Raises naming the shortfall when the
+        backend has fewer devices than tp*dp (the forced-host CPU flag
+        or a real slice provides them)."""
+        import jax
+
+        from ..parallel.mesh import make_mesh
+
+        devices = self.devices
+        if devices is None:
+            avail = jax.devices()
+            if len(avail) < self.total:
+                raise ValueError(
+                    f"ShardedEngineConfig(tp={self.tp}, dp={self.dp}) "
+                    f"needs {self.total} devices, backend has "
+                    f"{len(avail)} (on CPU set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count="
+                    f"{self.total} before importing jax, or use "
+                    f"scripts/run_mesh_tests.sh)")
+            devices = avail[:self.total]
+        elif len(devices) != self.total:
+            raise ValueError(
+                f"ShardedEngineConfig(tp={self.tp}, dp={self.dp}) needs "
+                f"exactly {self.total} devices, got {len(devices)}")
+        return make_mesh(dp=self.dp, mp=self.tp, pp=1, sp=1,
+                         devices=list(devices))
+
+    def stats_block(self):
+        """The `stats()["sharding"]` dict for an ENABLED server (the
+        disabled form is zeroed by the engine — schema-congruent)."""
+        return {
+            "enabled": True,
+            "mesh_shape": {"dp": self.dp, "mp": self.tp},
+            "tp_degree": self.tp,
+            "dp_degree": self.dp,
+        }
+
+
+def normalize_sharding(sharding, num_heads):
+    """Normalize the server's `sharding=` ctor value (True -> default
+    config) and check the ONE hard divisibility requirement eagerly:
+    tp must divide the head count, because the KV pool shards its head
+    axis over mp (a fractional head slice has no block layout).  Param
+    dims that an axis happens not to divide (GPT-2's 50257 vocab, say)
+    just fall back to replicated placement per-leaf in plan.py — only
+    the pool layout is load-bearing."""
+    if sharding is True:
+        sharding = ShardedEngineConfig()
+    if not isinstance(sharding, ShardedEngineConfig):
+        raise TypeError(f"sharding must be a ShardedEngineConfig, True "
+                        f"or None, got {type(sharding).__name__}")
+    if num_heads % sharding.tp:
+        raise ValueError(
+            f"ShardedEngineConfig.tp={sharding.tp} must divide the "
+            f"model's num_heads={num_heads}: the KV pool shards its "
+            f"head axis over the mp mesh axis")
+    return sharding
+
+
+def disabled_stats_block():
+    """The zeroed, schema-congruent `stats()["sharding"]` block an
+    unsharded server reports (the speculation/frontdoor convention:
+    dashboards and bench records need no gating)."""
+    return {
+        "enabled": False,
+        "mesh_shape": {},
+        "tp_degree": 0,
+        "dp_degree": 0,
+    }

@@ -1,0 +1,75 @@
+"""Server-side glue: apply a ShardedEngineConfig to a live engine, and
+the capacity arithmetic the bench/tests reason with.
+
+`apply_sharding` is called from the `PagedGenerationServer` constructor
+(lazily — an unsharded server never imports this package) after the
+weights are snapshotted/quantized and the pool is built, and BEFORE the
+PagedDecoder exists: it places the params and pool arrays on the mesh
+and returns the DecodeShardings bundle the decoder jits with.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .config import ShardedEngineConfig
+from .plan import build_decode_shardings, place_decode_params, place_kv_pool
+
+
+def apply_sharding(server, cfg):
+    """Shard `server`'s weights + KV pool per `cfg`; returns the
+    DecodeShardings for the server's PagedDecoder.  Mutates only
+    placements (device arrays move onto the mesh) — values, host
+    bookkeeping and the engine loop are untouched."""
+    if not isinstance(cfg, ShardedEngineConfig):
+        raise TypeError(f"sharding must be a ShardedEngineConfig, got "
+                        f"{type(cfg).__name__} (the server ctor "
+                        f"normalizes True via normalize_sharding)")
+    mesh = cfg.build_mesh()
+    server._params = place_decode_params(mesh, server._params)
+    place_kv_pool(mesh, server.cache)
+    server.cache.set_shard_count(cfg.total)
+    server.sharding = cfg
+    server._mesh = mesh
+    return build_decode_shardings(mesh, server._params,
+                                  server.kv_dtype)
+
+
+def _block_bytes(num_layers, num_heads, head_dim, block_size,
+                 dtype=np.float32, kv_dtype=None):
+    """Device bytes ONE pool block costs across all layers, K + V
+    (codes + per-vector scales under int8)."""
+    vecs = num_layers * 2 * block_size * num_heads  # K and V
+    if kv_dtype == "int8":
+        return vecs * (head_dim * 1 + np.dtype(dtype).itemsize)
+    return vecs * head_dim * np.dtype(dtype).itemsize
+
+
+def pool_blocks_for_budget(cfg_model, block_size, per_device_bytes,
+                           tp=1, dp=1, dtype=np.float32, kv_dtype=None):
+    """Largest `num_blocks` (INCLUDING trash block 0) whose per-device
+    pool share fits `per_device_bytes`.  The pool shards its head axis
+    over tp and its block axis over dp, so per-device bytes =
+    total / (tp * dp): at FIXED per-device budget the pool holds
+    tp*dp times the blocks — the capacity lever the sharded bench axis
+    measures."""
+    bb = _block_bytes(cfg_model.num_layers, cfg_model.num_heads,
+                      cfg_model.hidden_size // cfg_model.num_heads,
+                      block_size, dtype, kv_dtype)
+    return max(2, int(per_device_bytes * tp * dp // bb))
+
+
+def max_slots_for_budget(cfg_model, block_size, per_device_bytes,
+                         tokens_per_request, tp=1, dp=1,
+                         dtype=np.float32, kv_dtype=None,
+                         spare_blocks=0):
+    """Concurrent slots the admission reservation can back at a fixed
+    per-device pool budget: usable blocks // worst-case blocks per
+    request (`tokens_per_request` = prompt + budget + overrun slack;
+    `spare_blocks` = the +1 CoW spare when prefix caching is on)."""
+    from ..inference.kv_cache import blocks_for
+
+    nb = pool_blocks_for_budget(cfg_model, block_size, per_device_bytes,
+                                tp=tp, dp=dp, dtype=dtype,
+                                kv_dtype=kv_dtype)
+    per_req = blocks_for(tokens_per_request, block_size) + spare_blocks
+    return (nb - 1) // max(per_req, 1)

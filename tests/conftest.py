@@ -50,3 +50,37 @@ def _seed():
     import paddle_tpu as paddle
     paddle.seed(2024)
     yield
+
+
+# The tier-1 suite compiles >1000 jitted programs in ONE process; every
+# live XLA CPU executable holds several mmap'd code regions, and the
+# kernel's vm.max_map_count ceiling (65530 default) turns the ~900th
+# compile into a SEGFAULT inside LLVM (mmap fails mid-codegen) — found
+# when the sharded-serving suite landed at the end of the alphabet and
+# the round-14 distributed-family fixes made ~30 previously-failing
+# tests actually compile their programs. Dropping jax's executable
+# caches releases the mappings (measured 1292 -> 398 for 300 jits);
+# the persistent on-disk compilation cache (enabled above) makes any
+# re-needed program a cheap deserialize, not a recompile.
+_MAP_GUARD_LIMIT = 45_000
+_MAP_GUARD_EVERY = 20
+_map_guard_tick = 0
+
+
+@pytest.fixture(autouse=True)
+def _map_count_guard():
+    yield
+    global _map_guard_tick
+    _map_guard_tick += 1
+    if _map_guard_tick % _MAP_GUARD_EVERY:
+        return
+    try:
+        with open("/proc/self/maps") as f:
+            n = sum(1 for _ in f)
+    except OSError:  # non-Linux: no map ceiling to guard
+        return
+    if n > _MAP_GUARD_LIMIT:
+        import gc
+
+        jax.clear_caches()
+        gc.collect()

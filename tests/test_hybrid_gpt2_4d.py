@@ -17,7 +17,6 @@ import jax
 
 _SCRIPT = r"""
 import jax
-jax.config.update("jax_num_cpu_devices", 16)
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import jax.numpy as jnp
@@ -131,7 +130,10 @@ print("INTERLEAVED_OK", il, ref2)
 
 def test_4d_hybrid_parity_and_training():
     env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
+    # 16 virtual devices via XLA flag: the pinned jax has no
+    # jax_num_cpu_devices config option, and the flag must be in the
+    # environment before the subprocess imports jax
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
     r = subprocess.run([sys.executable, "-c", _SCRIPT], env=env,

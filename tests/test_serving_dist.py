@@ -1,0 +1,474 @@
+"""Sharded serving (serving_dist round): mesh-degenerate and mesh
+parity suites for the tensor-parallel paged engine.
+
+conftest.py forces 8 virtual CPU devices, so 1/2/4-device meshes build
+in-process (the multichip-dryrun trick; scripts/run_mesh_tests.sh wraps
+the same flags for manual runs).
+
+Parity policy: the sharded decode programs are the SAME traced
+functions — a 1-device mesh must be BITWISE-identical to the unsharded
+engine (zero logit drift, asserted).  At tp>1 the row-split out_proj/
+fc2 all-reduce re-associates fp sums (~5e-7 logit drift measured on the
+tiny config), so multi-device parity is asserted token-for-token on
+PINNED workloads, the quantized-serving convention: deterministic given
+the jax/XLA pin, and a near-tie flip fails loudly here instead of in a
+chip session.
+"""
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.inference import PagedGenerationServer
+from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+from paddle_tpu.sampling import SamplingParams
+from paddle_tpu.serving_dist import (ShardedEngineConfig,
+                                     decode_spec_for,
+                                     max_slots_for_budget,
+                                     pool_blocks_for_budget)
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 4,
+                                reason="needs 4 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _pinned_workload(cfg):
+    """The pinned mixed workload every parity test serves: 4 prompts,
+    greedy + fixed-seed sampled (top-p, top-k + repetition penalty)."""
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 17, 9, 23)]
+    sps = [None,
+           SamplingParams(temperature=0.8, top_p=0.9, seed=11),
+           None,
+           SamplingParams(temperature=1.1, top_k=20, seed=7,
+                          repetition_penalty=1.2)]
+    return prompts, sps
+
+
+def _serve(model, prompts, sps=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_prompt_len", 64)
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("prefill_chunk_tokens", 16)
+    srv = PagedGenerationServer(model, **kw).start()
+    try:
+        sps = sps or [None] * len(prompts)
+        outs = [f.result(timeout=600).tolist() for f in
+                [srv.submit(p, sampling=s)
+                 for p, s in zip(prompts, sps)]]
+        st = srv.stats()
+    finally:
+        srv.stop()
+    return outs, st
+
+
+class TestConfig:
+    def test_validation_eager(self):
+        with pytest.raises(ValueError, match="tp=0"):
+            ShardedEngineConfig(tp=0)
+        with pytest.raises(ValueError, match="dp=-1"):
+            ShardedEngineConfig(dp=-1)
+        with pytest.raises(ValueError, match="tp=2.5"):
+            ShardedEngineConfig(tp=2.5)
+
+    def test_tp_must_divide_heads(self, tiny_model):
+        model, cfg = tiny_model
+        with pytest.raises(ValueError, match="num_heads"):
+            PagedGenerationServer(model,
+                                  sharding=ShardedEngineConfig(tp=3))
+
+    def test_sharding_type_checked(self, tiny_model):
+        model, _ = tiny_model
+        with pytest.raises(TypeError, match="ShardedEngineConfig"):
+            PagedGenerationServer(model, sharding="tp4")
+
+    def test_device_shortfall_named(self):
+        cfg = ShardedEngineConfig(tp=4, dp=64)
+        with pytest.raises(ValueError, match="needs 256 devices"):
+            cfg.build_mesh()
+
+    def test_mesh_axes_canonical(self):
+        mesh = ShardedEngineConfig(tp=2, dp=2).build_mesh()
+        assert dict(mesh.shape) == {"dp": 2, "pp": 1, "mp": 2, "sp": 1}
+
+    def test_true_normalizes_to_defaults(self, tiny_model):
+        model, _ = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1,
+                                    max_prompt_len=16,
+                                    max_new_tokens=4, sharding=True)
+        assert srv.sharding == ShardedEngineConfig()
+        assert srv.stats()["sharding"]["tp_degree"] == 1
+
+
+class TestPlan:
+    """The GPT-2 decode sharding plan (flat names + int8 keys)."""
+
+    def test_column_and_row_split(self):
+        from jax.sharding import PartitionSpec as P
+
+        assert decode_spec_for("h.0.qkv_proj.weight", 2) == P(None, "mp")
+        assert decode_spec_for("h.0.qkv_proj.bias", 1) == P("mp")
+        assert decode_spec_for("h.3.fc1.weight", 2) == P(None, "mp")
+        assert decode_spec_for("h.3.fc1.bias", 1) == P("mp")
+        assert decode_spec_for("h.1.out_proj.weight", 2) == P("mp", None)
+        assert decode_spec_for("h.1.out_proj.bias", 1) == P()
+        assert decode_spec_for("h.1.fc2.weight", 2) == P("mp", None)
+        assert decode_spec_for("h.1.fc2.bias", 1) == P()
+
+    def test_vocab_parallel_and_replicated(self):
+        from jax.sharding import PartitionSpec as P
+
+        assert decode_spec_for("wte.weight", 2) == P("mp", None)
+        assert decode_spec_for("wpe.weight", 2) == P()
+        assert decode_spec_for("ln_f.weight", 1) == P()
+        assert decode_spec_for("h.0.ln_1.weight", 1) == P()
+        assert decode_spec_for("lm_head.weight", 2) == P(None, "mp")
+
+    def test_w8_keys_follow_their_weight(self):
+        from jax.sharding import PartitionSpec as P
+
+        # codes shard like the weight; per-output-column scales like
+        # its LAST dim (column-split -> sharded, row-split -> replicated)
+        assert decode_spec_for("h.0.qkv_proj.weight::w8c", 2) \
+            == P(None, "mp")
+        assert decode_spec_for("h.0.qkv_proj.weight::w8s", 1) == P("mp")
+        assert decode_spec_for("h.0.out_proj.weight::w8s", 1) == P(None)
+        assert decode_spec_for("wte.weight::w8c", 2) == P("mp", None)
+        assert decode_spec_for("wte.weight::w8s", 1) == P("mp")
+
+    def test_indivisible_dims_fall_back_replicated(self):
+        """GPT-2's 50257 vocab is not divisible by tp: the placement
+        must drop to replicated for that leaf instead of failing."""
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.serving_dist.plan import _fit
+
+        mesh = ShardedEngineConfig(tp=4).build_mesh()
+        assert _fit(mesh, P("mp", None), (50257, 64)) == P(None, None)
+        assert _fit(mesh, P("mp", None), (1024, 64)) == P("mp", None)
+        assert _fit(mesh, P(None, "mp"), (64, 50257)) == P(None, None)
+
+
+class TestOneDeviceMeshBitwise:
+    """Acceptance: the 1-device mesh path is bitwise-identical to the
+    pre-round unsharded engine."""
+
+    def test_greedy_and_sampled_tokens_identical(self, tiny_model):
+        model, cfg = tiny_model
+        prompts, sps = _pinned_workload(cfg)
+        ref, _ = _serve(model, prompts, sps)
+        out, st = _serve(model, prompts, sps,
+                         sharding=ShardedEngineConfig(tp=1))
+        assert out == ref
+        assert st["sharding"] == {"enabled": True,
+                                  "mesh_shape": {"dp": 1, "mp": 1},
+                                  "tp_degree": 1, "dp_degree": 1}
+
+    def test_decoder_logits_bitwise(self, tiny_model):
+        """Zero logit drift on a 1-device mesh — not just same argmax:
+        the compiled program is the identical HLO modulo no-op
+        sharding annotations."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+        from paddle_tpu.nn.decode import PagedDecoder
+        from paddle_tpu.sampling.buffers import greedy_args
+        from paddle_tpu.serving_dist.plan import (build_decode_shardings,
+                                                  place_decode_params,
+                                                  place_kv_pool)
+
+        model, cfg = tiny_model
+        params, _ = model.functional_state()
+        spec = (cfg.num_layers, cfg.num_heads,
+                cfg.hidden_size // cfg.num_heads, cfg.hidden_size,
+                cfg.layer_norm_epsilon, cfg.tie_embeddings)
+        ids = np.random.RandomState(5).randint(
+            1, cfg.vocab_size, (2, 12)).astype(np.int32)
+        lens = np.array([12, 9], np.int32)
+
+        def prefill_logits(shard):
+            cache = PagedKVCache(cfg.num_layers, cfg.num_heads,
+                                 cfg.hidden_size // cfg.num_heads,
+                                 block_size=8, num_blocks=8,
+                                 dtype=jnp.float32)
+            p, shardings = params, None
+            if shard:
+                mesh = ShardedEngineConfig(tp=1).build_mesh()
+                p = place_decode_params(mesh, params)
+                place_kv_pool(mesh, cache)
+                shardings = build_decode_shardings(mesh, p, None)
+            dec = PagedDecoder(spec, 8, return_logits=True,
+                               shardings=shardings)
+            cache.ensure_many([(0, 12), (1, 9)])
+            tables = jnp.asarray(cache.table_array([0, 1], 2))
+            out = dec.prefill(p, jnp.asarray(ids), jnp.asarray(lens),
+                              tables, cache.k_blocks, cache.v_blocks,
+                              greedy_args(2))
+            return np.asarray(out[-1])
+
+        np.testing.assert_array_equal(prefill_logits(False),
+                                      prefill_logits(True))
+
+
+TP4 = ShardedEngineConfig(tp=4)
+
+
+class TestMeshParity:
+    """Pinned-workload token parity: 4-device TP mesh vs single device,
+    across the whole composed stack (acceptance criterion)."""
+
+    def test_mixed_greedy_sampled(self, tiny_model):
+        model, cfg = tiny_model
+        prompts, sps = _pinned_workload(cfg)
+        ref, _ = _serve(model, prompts, sps)
+        out, st = _serve(model, prompts, sps, sharding=TP4)
+        assert out == ref
+        assert st["sharding"]["tp_degree"] == 4
+
+    def test_prefix_cache_on_off(self, tiny_model):
+        model, cfg = tiny_model
+        prompts, sps = _pinned_workload(cfg)
+        # shared prefix across two of the prompts exercises attach/CoW
+        prompts = [prompts[0], np.concatenate([prompts[3], prompts[0]]),
+                   np.concatenate([prompts[3], prompts[2]]), prompts[3]]
+        ref, _ = _serve(model, prompts, sps)
+        for on in (False, True):
+            out, st = _serve(model, prompts, sps, sharding=TP4,
+                             enable_prefix_cache=on)
+            assert out == ref, f"enable_prefix_cache={on}"
+            if on:
+                assert st["kv_cache"]["prefix_cache"]["hits"] >= 1
+
+    def test_spec_decode(self, tiny_model):
+        model, cfg = tiny_model
+        # repetitive prompts the n-gram drafter can actually predict
+        motif = np.array([7, 11, 13, 5], np.int32)
+        prompts = [np.tile(motif, 5), np.tile(motif[::-1], 4)]
+        ref, _ = _serve(model, prompts, max_new_tokens=12)
+        out, st = _serve(model, prompts, max_new_tokens=12,
+                         sharding=TP4, speculation=True)
+        assert out == ref
+        assert st["speculation"]["proposed_tokens"] >= 1
+
+    def test_int8_kv_and_w8a16(self, tiny_model):
+        """Quantized parity is vs the QUANTIZED single-device engine —
+        the engine invariant (sharding changes placement, not values)."""
+        model, cfg = tiny_model
+        prompts, sps = _pinned_workload(cfg)
+        qkw = dict(quantization="w8a16", kv_dtype="int8")
+        ref, _ = _serve(model, prompts, sps, **qkw)
+        out, st = _serve(model, prompts, sps, sharding=TP4, **qkw)
+        assert out == ref
+        assert st["quantization"]["enabled"] is True
+
+    def test_composed_acceptance_workload(self, tiny_model):
+        """The acceptance pin: greedy + fixed-seed sampled, prefix
+        cache ON, speculation ON, int8 KV (+W8A16) — token-identical
+        at tp=4 vs single device."""
+        model, cfg = tiny_model
+        prompts, sps = _pinned_workload(cfg)
+        kw = dict(enable_prefix_cache=True, speculation=True,
+                  kv_dtype="int8", quantization="w8a16")
+        ref, _ = _serve(model, prompts, sps, **kw)
+        out, st = _serve(model, prompts, sps, sharding=TP4, **kw)
+        assert out == ref
+        assert st["sharding"]["mesh_shape"] == {"dp": 1, "mp": 4}
+
+    def test_dp_axes(self, tiny_model):
+        """dp shards the pool's block axis (pure placement — bitwise
+        zero drift measured); tp x dp composes, sampled rows included
+        (the replicated-logits pin keeps the sampling pipeline off the
+        2-D partitioner, see nn/decode._rep_pin)."""
+        model, cfg = tiny_model
+        prompts, sps = _pinned_workload(cfg)
+        ref, _ = _serve(model, prompts, sps)
+        for tp, dp in ((1, 4), (2, 2)):
+            out, st = _serve(model, prompts, sps,
+                             sharding=ShardedEngineConfig(tp=tp, dp=dp))
+            assert out == ref, (tp, dp)
+            assert st["sharding"]["mesh_shape"] == {"dp": dp, "mp": tp}
+
+    def test_preempt_resume_parity(self, tiny_model):
+        """Preempt-then-resume through the SHARDED pool: swap-out
+        publishes per-shard blocks, warm resume attaches them — output
+        token-identical to the uninterrupted sharded run AND to the
+        unsharded engine."""
+        from paddle_tpu.frontend import FrontDoor
+
+        model, cfg = tiny_model
+        rs = np.random.RandomState(2)  # the round-12/13 pinned pair
+        pv = rs.randint(1, cfg.vocab_size, (1, 7)).astype(np.int32)[0]
+        pi = rs.randint(1, cfg.vocab_size, (1, 4)).astype(np.int32)[0]
+
+        def run(**skw):
+            fd = FrontDoor(model, max_slots=1, block_size=4,
+                           max_prompt_len=16, max_new_tokens=24,
+                           **skw).start()
+            try:
+                hv = fd.submit(pv, lane="batch", max_new_tokens=24)
+                it = iter(hv)
+                next(it)
+                next(it)  # victim has emitted >= 2 tokens
+                hi_ = fd.submit(pi, lane="interactive",
+                                max_new_tokens=3)
+                out_i = hi_.result(timeout=600)
+                out_v = hv.result(timeout=600)
+                st = fd.stats()
+                assert st["frontdoor"]["preemptions"] >= 1
+                assert st["frontdoor"]["resumes"] >= 1
+            finally:
+                fd.stop()
+            return out_v, out_i
+
+        out_v, out_i = run(sharding=TP4)
+        np.testing.assert_array_equal(
+            out_v, model.generate(pv[None], 24).numpy()[0])
+        np.testing.assert_array_equal(
+            out_i, model.generate(pi[None], 3).numpy()[0])
+
+
+class TestStatsAndTelemetry:
+    def test_sharding_block_zeroed_when_disabled(self, tiny_model):
+        model, _ = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1,
+                                    max_prompt_len=16, max_new_tokens=4)
+        st = srv.stats()["sharding"]
+        assert st == {"enabled": False, "mesh_shape": {},
+                      "tp_degree": 0, "dp_degree": 0}
+
+    def test_sharding_block_reset_coherent(self, tiny_model):
+        model, _ = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1,
+                                    max_prompt_len=16, max_new_tokens=4,
+                                    sharding=ShardedEngineConfig(tp=2))
+        before = srv.stats()["sharding"]
+        srv.reset_stats()
+        assert srv.stats()["sharding"] == before
+        assert before["tp_degree"] == 2
+
+    def test_pool_shard_bytes_and_gauges(self, tiny_model):
+        from paddle_tpu.observability import metrics
+
+        model, _ = tiny_model
+        was = metrics.enabled()
+        metrics.enable()
+        try:
+            srv = PagedGenerationServer(
+                model, max_slots=1, max_prompt_len=16, max_new_tokens=4,
+                sharding=ShardedEngineConfig(tp=4))
+            kv = srv.cache.stats()
+            assert kv["shards"] == 4
+            assert kv["pool_bytes_per_shard"] * 4 \
+                == kv["pool_bytes_total"]
+            text = metrics.to_prometheus()
+            pool = srv.cache._name
+            assert f'kv_pool_bytes_total{{pool="{pool}",shard="all"}}' \
+                in text
+            assert f'kv_pool_bytes_total{{pool="{pool}",shard="3"}}' \
+                in text
+        finally:
+            if not was:
+                metrics.disable()
+
+    def test_unsharded_pool_has_no_per_shard_series(self, tiny_model):
+        from paddle_tpu.observability import metrics
+
+        model, _ = tiny_model
+        was = metrics.enabled()
+        metrics.enable()
+        try:
+            srv = PagedGenerationServer(model, max_slots=1,
+                                        max_prompt_len=16,
+                                        max_new_tokens=4)
+            srv.cache.ensure_many([("s", 4)])
+            srv.cache.free("s")
+            pool = srv.cache._name
+            text = metrics.to_prometheus()
+            assert f'kv_pool_bytes_total{{pool="{pool}",shard="all"}}' \
+                in text
+            assert f'{{pool="{pool}",shard="0"}}' not in text
+        finally:
+            if not was:
+                metrics.disable()
+
+
+class TestCapacity:
+    """The sharded pool's capacity lever: at FIXED per-device bytes the
+    pool holds tp*dp times the blocks (acceptance: >= 3x max slots at
+    4 devices vs 1)."""
+
+    def test_blocks_scale_with_mesh(self, tiny_model):
+        _, cfg = tiny_model
+        budget = 1 << 20
+        b1 = pool_blocks_for_budget(cfg, 16, budget)
+        b4 = pool_blocks_for_budget(cfg, 16, budget, tp=4)
+        b22 = pool_blocks_for_budget(cfg, 16, budget, tp=2, dp=2)
+        assert b4 >= 3.9 * b1
+        assert b22 >= 3.9 * b1
+
+    def test_slots_ratio_at_four_devices(self, tiny_model):
+        _, cfg = tiny_model
+        budget = 1 << 20
+        s1 = max_slots_for_budget(cfg, 16, budget, tokens_per_request=96)
+        s4 = max_slots_for_budget(cfg, 16, budget, tokens_per_request=96,
+                                  tp=4)
+        assert s1 >= 1
+        assert s4 >= 3 * s1, (s1, s4)
+
+    def test_sharded_server_actually_admits_more(self, tiny_model):
+        """Not just arithmetic: build both servers at the same
+        per-device byte budget and check the admission-reservation
+        capacity (max_slots the pool can back concurrently)."""
+        from paddle_tpu.inference.kv_cache import blocks_for
+
+        model, cfg = tiny_model
+        budget = 1 << 19
+        horizon = 24 + 8  # prompt cap + budget (no slack: k=1, no spec)
+
+        def build(tp):
+            nb = pool_blocks_for_budget(cfg, 8, budget, tp=tp,
+                                        dtype=np.float32)
+            slots = (nb - 1) // blocks_for(horizon, 8)
+            srv = PagedGenerationServer(
+                model, max_slots=max(slots, 1), block_size=8,
+                max_prompt_len=24, max_new_tokens=8, num_blocks=nb,
+                sharding=ShardedEngineConfig(tp=tp) if tp > 1 else None)
+            per_shard = srv.cache.stats()["pool_bytes_per_shard"]
+            assert per_shard <= budget
+            return slots
+
+        s1, s4 = build(1), build(4)
+        assert s4 >= 3 * max(s1, 1), (s1, s4)
+
+
+class TestZeroOverheadWhenDisabled:
+    def test_unsharded_server_never_imports_serving_dist(self,
+                                                         tiny_model):
+        """Acceptance: serving_dist imports add zero overhead when
+        sharding is disabled — the package must not even be imported."""
+        model, _ = tiny_model
+        saved = {k: sys.modules.pop(k) for k in list(sys.modules)
+                 if k.startswith("paddle_tpu.serving_dist")}
+        try:
+            PagedGenerationServer(model, max_slots=1, max_prompt_len=16,
+                                  max_new_tokens=4)
+            leaked = [k for k in sys.modules
+                      if k.startswith("paddle_tpu.serving_dist")]
+            assert not leaked, leaked
+        finally:
+            sys.modules.update(saved)

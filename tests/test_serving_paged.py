@@ -315,10 +315,10 @@ def test_served_bench_axis_emits_records():
     """`bench.py served` (mixed-length traffic: padded vs paged
     closed-loop, the open-loop Poisson axis, the shared-prefix caching
     axis, the round-11 speculation axis, the round-12 front-door
-    axis, and the quantization axis) must emit all eight JSON records;
-    slow-marked so tier-1 stays fast."""
+    axis, the quantization axis, and the sharded mesh axis) must emit
+    all nine JSON records; slow-marked so tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 8, stdout
+    assert len(recs) == 9, stdout
     assert any("paged" in rec["metric"] for rec in recs)
     assert any("mixedsampling" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
@@ -326,6 +326,7 @@ def test_served_bench_axis_emits_records():
     assert any("speculative" in rec["metric"] for rec in recs)
     assert any("frontdoor" in rec["metric"] for rec in recs)
     assert any("quantized" in rec["metric"] for rec in recs)
+    assert any("sharded" in rec["metric"] for rec in recs)
     for rec in recs:
         assert rec["value"] > 0
         assert rec.get("degraded") is True
@@ -355,6 +356,14 @@ def test_served_bench_axis_emits_records():
     assert fd["preemptions"] >= 1, fd
     assert fd["resumes"] >= 1, fd
     assert fd["preempt_cached_tokens"] > 0, fd
+    # the sharded-serving acceptance bars (serving_dist round): token
+    # parity across 1/2/4/8-device host meshes, and >= 3x max
+    # concurrent slots at 4 devices vs 1 at fixed per-device pool
+    # bytes (capacity is CPU-provable; tok/s scaling is a chip number)
+    sh = next(r for r in recs if "sharded" in r["metric"])
+    assert sh["token_parity"] is True, sh
+    assert sh["slot_capacity_ratio"] >= 3.0, sh
+    assert sh["devices"] == [1, 2, 4, 8], sh
 
 
 def test_served_bench_openloop_tiny_schema():
@@ -362,22 +371,24 @@ def test_served_bench_openloop_tiny_schema():
     bench must run fast and its records must carry the schema fields —
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
-    recs, stdout = _run_served_bench("--tiny", timeout=420)
-    assert len(recs) == 7, stdout
+    recs, stdout = _run_served_bench("--tiny", timeout=540)
+    assert len(recs) == 8, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
                  and "speculative" not in r["metric"]
                  and "frontdoor" not in r["metric"]
-                 and "quantized" not in r["metric"])
+                 and "quantized" not in r["metric"]
+                 and "sharded" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
     sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
     spec_rec = next(r for r in recs if "speculative" in r["metric"])
     fd_rec = next(r for r in recs if "frontdoor" in r["metric"])
     qz_rec = next(r for r in recs if "quantized" in r["metric"])
+    sh_rec = next(r for r in recs if "sharded" in r["metric"])
     for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec,
-                qz_rec):
+                qz_rec, sh_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -458,3 +469,15 @@ def test_served_bench_openloop_tiny_schema():
     assert qz_rec["slot_capacity_ratio"] >= 1.8, qz_rec
     assert qz_rec["kv_scale_bytes"] > 0
     assert 0.0 <= qz_rec["greedy_token_match"] <= 1.0
+    # sharded axis (serving_dist round): per-device-count tok/s + slot
+    # capacity at fixed per-device pool bytes, token parity asserted
+    # across mesh sizes (the tiny smoke runs 1/2 devices)
+    for fld in ("vs_baseline", "devices", "tp_degree", "dp_degree",
+                "tokens_per_sec_by_devices", "max_slots_by_devices",
+                "slot_capacity_ratio", "pool_budget_bytes",
+                "token_parity", "cpu_host_mesh"):
+        assert fld in sh_rec, sh_rec
+    assert sh_rec["token_parity"] is True, sh_rec
+    assert sh_rec["devices"] == [1, 2]
+    # 2 devices at fixed per-device bytes back ~2x the blocks
+    assert sh_rec["slot_capacity_ratio"] >= 1.9, sh_rec
