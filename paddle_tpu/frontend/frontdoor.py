@@ -71,6 +71,15 @@ class FrontDoor:
         unbounded); overflow raises `QueueFull` at submit.
     stream_buffer: per-request cap on undelivered stream events before
         deltas coalesce (backpressure without blocking the engine).
+
+    Ops plane (ISSUE 10): `expose_port=` (and `stall_timeout_s=`,
+    `flight_recorder=`) forward to the engine like every other server
+    kwarg — a fronted fleet node typically runs
+    `FrontDoor(model, expose_port=9100, ...)` and is scraped at
+    `/metrics`, watched at `/statusz` (which then carries the lane /
+    tenant queue blocks), and health-checked at `/healthz`.
+    `ops_url` / `health()` / `statusz()` / `dump_flight_recorder()`
+    surface the engine's ops plane on the facade.
     """
 
     def __init__(self, model=None, *, server=None, tenants=None,
@@ -182,3 +191,20 @@ class FrontDoor:
 
     def reset_stats(self):
         self.server.reset_stats()
+
+    # ---- ops plane (ISSUE 10) --------------------------------------------
+    @property
+    def ops_url(self):
+        """Base URL of the engine's /metrics /statusz /healthz
+        endpoint, or None when the server was built without one."""
+        exp = self.server.exporter
+        return exp.url if exp is not None else None
+
+    def health(self):
+        return self.server.health()
+
+    def statusz(self):
+        return self.server.statusz()
+
+    def dump_flight_recorder(self):
+        return self.server.dump_flight_recorder()

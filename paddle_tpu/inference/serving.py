@@ -25,6 +25,7 @@ same regardless — decode time is batch-invariant at fixed B).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -32,12 +33,16 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..observability import compile_tracker as _compile_tracker
+from ..observability import flight_recorder as _flight
 from ..observability import log as _obs_log
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
 from ..sampling import SamplingParams
 
 _logger = _obs_log.get_logger(__name__)
+
+ENV_METRICS_PORT = "PADDLE_TPU_METRICS_PORT"
 
 # Shared serving telemetry (ISSUE 2): near-zero cost while
 # PADDLE_TPU_TELEMETRY is off — every update is one bool check.
@@ -131,9 +136,31 @@ _m_deadline_overage = _metrics.histogram(
     "by how much a missed TTFT deadline was missed (first token time "
     "minus deadline; only observed on misses)",
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+# Operations plane (ISSUE 10): goodput + health accounting.
+_m_decoded = _metrics.counter(
+    "serving_tokens_decoded_total",
+    "generated-token positions computed on device (decode steps, "
+    "verify positions, prefill token-0 samples, and preempt-resume "
+    "re-prefill of already-generated tokens)")
+_m_replayed = _metrics.counter(
+    "serving_tokens_replayed_total",
+    "decoded-token positions whose work was wasted: multi-step "
+    "post-stop discards, verify positions truncated by a stop, and "
+    "preempt-resume re-prefill of already-generated tokens")
+_m_goodput = _metrics.gauge(
+    "serving_goodput_ratio",
+    "emitted tokens / decoded-token positions for the current stats "
+    "window (1.0 = every device token reached a client; speculation "
+    "rollback, multi-step overrun and preemption replay lower it)")
+_m_engine_exc = _metrics.counter(
+    "serving_engine_exceptions_total",
+    "engine dispatch exceptions fanned out to request futures, by "
+    "dispatch kind", labelnames=("where",))
 _req_ids = itertools.count()
 
 STOP_REASONS = ("eos", "stop_token", "stop_string", "budget")
+
+HEALTH_CODES = {"ok": 0.0, "degraded": 1.0, "stalled": 2.0}
 
 
 @dataclass
@@ -606,6 +633,25 @@ class PagedGenerationServer:
     default None never imports serving_dist. See docs/SERVING.md
     "Sharded serving".
 
+    OPERATIONS PLANE (ISSUE 10): `expose_port=` (or the
+    PADDLE_TPU_METRICS_PORT env var; 0 = ephemeral, tests) starts a
+    stdlib http.server daemon thread serving `/metrics` (Prometheus
+    text from the process registry), `/statusz` (live JSON engine
+    state — the `statusz()` method), and `/healthz`
+    (ok | degraded | stalled; stalled answers 503). It also enables
+    the per-server FLIGHT RECORDER — a bounded ring of structured
+    engine events (admission, chunk plans, dispatch shapes,
+    preempt/resume, pool levels, XLA compiles, exceptions) — and the
+    STALL WATCHDOG, which flips health to "stalled" and auto-dumps the
+    ring when work is pending with no dispatch progress past
+    `stall_timeout_s` (an engine dispatch exception also dumps).
+    XLA compiles at every decode jit boundary are tracked process-wide
+    regardless (`observability.compile_tracker`) and windowed into
+    `stats()["compiles"]`; `stats()["goodput"]` accounts decoded
+    device tokens vs. emitted / speculation-rolled-back / replayed.
+    Default OFF: no port, no threads, and every recorder hook is one
+    bool check — the exact pre-round engine.
+
     speculation=SpecConfig(...) (or True for defaults) turns on
     SPECULATIVE DECODING (round 11): each round, eligible decode-phase
     slots ask the drafter (default: the self-drafting n-gram /
@@ -634,7 +680,9 @@ class PagedGenerationServer:
                  steps_per_dispatch=1,
                  prefill_chunk_tokens=512, pack_align=None,
                  enable_prefix_cache=False, detokenize=None,
-                 stop_tail_tokens=16, speculation=None, sharding=None):
+                 stop_tail_tokens=16, speculation=None, sharding=None,
+                 expose_port=None, flight_recorder=None,
+                 stall_timeout_s=30.0):
         import jax
         import jax.numpy as jnp
 
@@ -812,6 +860,12 @@ class PagedGenerationServer:
         self._spec_rolled_back = 0
         self._spec_dispatches = 0
         self._spec_rounds_per_slot = 0
+        # goodput accounting (ISSUE 10): generated-token positions
+        # computed on device vs. the ones that reached a client —
+        # decoded = goodput + spec-rolled-back + replayed, by
+        # construction at every dispatch site
+        self._decoded_tokens = 0
+        self._replayed_tokens = 0
         # front door (round 12): pluggable scheduler + preemption /
         # deadline window counters (zero + unused when no scheduler is
         # installed — the legacy submit/drain path is bit-identical)
@@ -824,6 +878,157 @@ class PagedGenerationServer:
         self._lane_ttft: dict[str, list] = {}
         self._lane_itl: dict[str, list] = {}
         self._t0 = None
+        # ---- operations plane (ISSUE 10) -----------------------------
+        # expose_port: None + PADDLE_TPU_METRICS_PORT unset = no ops
+        # plane (the exact pre-round path: a disabled flight recorder
+        # is one bool check per hook, no threads, no sockets).
+        # expose_port=0 binds an ephemeral port (tests); the env var is
+        # the production switch that needs no code change.
+        if expose_port is None:
+            env_port = os.environ.get(ENV_METRICS_PORT, "")
+            expose_port = int(env_port) if env_port else None
+        self._ops_progress = 0  # bumped on every dispatch/admission;
+        self._last_error = None  # the stall watchdog samples it
+        if isinstance(flight_recorder, _flight.FlightRecorder):
+            self._recorder = flight_recorder
+        else:
+            self._recorder = _flight.FlightRecorder(
+                enabled=bool(flight_recorder)
+                or expose_port is not None)
+        self.stall_timeout_s = float(stall_timeout_s)
+        self._watchdog = None
+        self.exporter = None
+        # process-wide compile accounting: this engine answers "am I
+        # serving live work" for the in-flight label, mirrors compile
+        # events into its flight recorder, and windows the counter for
+        # stats()["compiles"] (weakrefs — no unregister needed)
+        _compile_tracker.register_in_flight_probe(self._ops_in_flight)
+        _compile_tracker.add_listener(self._on_compile_event)
+        self._compile_mark = _compile_tracker.mark()
+        if expose_port is not None:
+            # asking for a scrape endpoint IS opting into metrics — a
+            # /metrics page of zeros because the registry gate stayed
+            # closed would be the least debuggable outcome of all
+            _metrics.REGISTRY.enable()
+            self._watchdog = _flight.StallWatchdog(
+                lambda: self._ops_progress, self._ops_in_flight,
+                timeout=self.stall_timeout_s,
+                on_stall=self._on_stall).start()
+            from ..observability.exporter import OpsEndpoint
+
+            self.exporter = OpsEndpoint(
+                statusz_fn=self.statusz,
+                healthz_fn=self.health).start(port=expose_port)
+            # pull-time health gauge; like the watchdog heartbeat
+            # gauge, it follows the most recently built ops-plane
+            # server when several are live
+            _metrics.REGISTRY.gauge_fn(
+                "serving_health_state",
+                "engine health (0 ok, 1 degraded, 2 stalled) of the "
+                "most recent ops-plane server",
+                lambda: HEALTH_CODES[self.health()[0]])
+
+    # ---- operations plane (ISSUE 10) -----------------------------------
+    def _ops_in_flight(self):
+        """True while the engine has live work: busy slots or queued
+        requests. Read lock-free from watchdog/compile-tracker threads
+        (GIL-atomic loads; staleness only delays detection one poll)."""
+        if any(s is not None for s in self._slots):
+            return True
+        if self._queue:
+            return True
+        if self._sched is not None:
+            try:
+                return self._sched.depth() > 0
+            except Exception:  # noqa: BLE001 — a torn-down scheduler
+                return False  # must not break health checks
+        return False
+
+    def _on_compile_event(self, ev):
+        # a finished compile IS progress — without this, the dispatch
+        # that just compiled reads as a stall to the watchdog (a
+        # compile that itself exceeds the stall threshold still trips,
+        # which is exactly the incident compile tracking exists for)
+        self._ops_progress += 1
+        self._recorder.record(
+            "compile", program=ev["program"],
+            dur_s=round(ev["dur_s"], 4), in_flight=ev["in_flight"],
+            shard=ev["shard"])
+
+    def _on_stall(self):
+        self._recorder.record("stall", progress=self._ops_progress,
+                              free_blocks=self.cache.
+                              available_block_count)
+        if self._recorder.enabled:
+            self._recorder.dump(trigger="stall")
+
+    def health(self):
+        """(status, detail) for /healthz: "stalled" while the watchdog
+        sees pending work with no dispatch progress (503 — drain me),
+        "degraded" after an engine dispatch exception (sticky until
+        reset_stats), else "ok"."""
+        detail = {
+            "engine_running": self._thread is not None,
+            "progress": self._ops_progress,
+            "stalls": self._watchdog.stalls if self._watchdog else 0,
+        }
+        if self._watchdog is not None and self._watchdog.stalled:
+            detail["stall_timeout_s"] = self.stall_timeout_s
+            return "stalled", detail
+        if self._last_error is not None:
+            detail["last_error"] = self._last_error
+            return "degraded", detail
+        return "ok", detail
+
+    def statusz(self):
+        """Live JSON engine state for /statusz: per-slot residency plus
+        the full stats() blocks (pool, prefix cache, quantization,
+        sharding, speculation, goodput, lanes/tenants when a front
+        door is installed) and the flight-recorder/compile summaries."""
+        with self._lock:
+            slots = []
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue
+                meta = s["req"].meta
+                slots.append({
+                    "slot": i, "request_id": s["req"].rid,
+                    "seq": s["seq"], "prompt_len": int(s["prompt"].size),
+                    "fed": int(s["fed"]), "tokens": len(s["toks"]),
+                    "budget": s["budget"],
+                    "phase": ("decode" if s["fed"] >= s["prompt"].size
+                              else "prefill"),
+                    "lane": meta.lane if meta else None,
+                    "tenant": meta.tenant if meta else None,
+                })
+        status, detail = self.health()
+        return {
+            "server": "paged",
+            "health": {"status": status, **detail},
+            "slots": slots,
+            "max_slots": self.max_slots,
+            "engine": self.stats(),
+            "flight_recorder": self._recorder.stats(),
+            "last_dump": self._recorder.last_dump,
+        }
+
+    def dump_flight_recorder(self):
+        """Manual flight-recorder dump (also triggered automatically by
+        a stall or an engine exception)."""
+        return self._recorder.dump(trigger="manual")
+
+    def _engine_exception(self, where, e, request_ids=()):
+        """Shared dispatch-exception bookkeeping: health goes degraded
+        (sticky until reset_stats), the exception counts per dispatch
+        kind, and the flight recorder auto-dumps — the post-hoc record
+        of the rounds that led here."""
+        self._last_error = f"{where}: {type(e).__name__}: {e}"
+        _m_engine_exc.labels(where=where).inc()
+        self._recorder.record("engine_exception", where=where,
+                              error=self._last_error,
+                              request_ids=list(request_ids))
+        if self._recorder.enabled:
+            self._recorder.dump(trigger="engine_exception")
 
     def set_scheduler(self, sched):
         """Install a front-door scheduler (round 12) — an object owning
@@ -990,12 +1195,23 @@ class PagedGenerationServer:
             if self._sched is not None:
                 # scheduler-owned queues: on_submit may raise (bounded
                 # queue rejection) — nothing is enqueued in that case
-                self._sched.on_submit(req, time.perf_counter())
+                try:
+                    self._sched.on_submit(req, time.perf_counter())
+                except Exception as e:
+                    self._recorder.record(
+                        "reject", request_id=req.rid,
+                        error=f"{type(e).__name__}: {e}")
+                    raise
             else:
                 self._queue.append(req)
                 _m_queue_depth.labels(server="paged").set(
                     len(self._queue))
             self._lock.notify()
+        self._recorder.record(
+            "submit", request_id=req.rid, prompt_len=int(ids.size),
+            budget=budget,
+            lane=meta.lane if meta is not None else None,
+            tenant=meta.tenant if meta is not None else None)
         _tracing.event("request_submitted", request_id=req.rid,
                        prompt_len=int(ids.size), budget=budget)
         return req.future
@@ -1025,6 +1241,11 @@ class PagedGenerationServer:
                 pending.extend(self._sched.drain())
             for req in pending:
                 req.future.set_exception(RuntimeError("server stopped"))
+        # ops plane teardown: release the port and the watchdog thread
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self.exporter is not None:
+            self.exporter.stop()
 
     def reset_stats(self):
         """Zero the measurement window — latency AND the TTFT samples
@@ -1049,6 +1270,10 @@ class PagedGenerationServer:
             self._spec_rolled_back = 0
             self._spec_dispatches = 0
             self._spec_rounds_per_slot = 0
+            self._decoded_tokens = 0
+            self._replayed_tokens = 0
+            self._compile_mark = _compile_tracker.mark()
+            self._last_error = None  # a fresh window is healthy again
             self._preemptions = 0
             self._resumes = 0
             self._preempt_cached_tokens = 0
@@ -1139,6 +1364,41 @@ class PagedGenerationServer:
                 # trivially reset-coherent: it is construction config,
                 # not a window counter)
                 "sharding": self._sharding_stats(),
+                # goodput accounting (ISSUE 10): decoded device tokens
+                # = emitted + speculation-rolled-back + replayed
+                # (multi-step overrun discards, stop-truncated verify
+                # positions, preempt-resume re-prefill of generated
+                # tokens) — conservation holds per window by
+                # construction at every dispatch site
+                "goodput": {
+                    "decoded_tokens": self._decoded_tokens,
+                    "goodput_tokens": self._tokens_out,
+                    "rolled_back_tokens": self._spec_rolled_back,
+                    "replayed_tokens": self._replayed_tokens,
+                    "goodput_ratio": (self._tokens_out
+                                      / (self._decoded_tokens or 1)),
+                },
+                # XLA compiles inside THIS stats window (the process-
+                # wide compile tracker, windowed at reset_stats):
+                # in_flight > 0 means a compile landed on live
+                # requests — the bench's compile-clean assertion
+                "compiles": {
+                    "window_total": _compile_tracker.count_since(
+                        self._compile_mark),
+                    "window_in_flight": _compile_tracker.count_since(
+                        self._compile_mark, in_flight=True),
+                },
+                # ops plane state (schema-stable when disabled)
+                "ops": {
+                    "exporter_port": (self.exporter.port
+                                      if self.exporter else None),
+                    "health": ("ok" if self._watchdog is None
+                               and self._last_error is None
+                               else self.health()[0]),
+                    "stalls": (self._watchdog.stalls
+                               if self._watchdog else 0),
+                    "flight_recorder": self._recorder.stats(),
+                },
                 # admission headroom RIGHT NOW: free + LRU-reclaimable
                 # blocks — the number the reservation check reasons
                 # about (instantaneous, not a window counter)
@@ -1292,6 +1552,11 @@ class PagedGenerationServer:
                            seq=seq, cached_tokens=cached,
                            tokens_done=len(req.gen0), warm=warm)
         _m_slot_refills.inc()
+        self._ops_progress += 1
+        self._recorder.record(
+            "admit", request_id=req.rid, slot=i, seq=seq,
+            cached_tokens=cached, resume=req.resume_ids is not None,
+            free_blocks=self.cache.available_block_count)
         _tracing.event("request_admitted", request_id=req.rid,
                        slot=i, seq=seq, cached_tokens=cached)
         return seq
@@ -1326,6 +1591,10 @@ class PagedGenerationServer:
         self._preempt_cached_tokens += cached
         _m_preemptions.labels(reason=why).inc()
         _m_preempt_cached.inc(cached)
+        self._recorder.record(
+            "preempt", request_id=req.rid, slot=i, seq=seq,
+            tokens_done=len(s["toks"]), cached_tokens=cached,
+            reason=why)
         _tracing.event("preempted", request_id=req.rid, slot=i, seq=seq,
                        tokens_done=len(s["toks"]), cached_tokens=cached,
                        reason=why)
@@ -1474,6 +1743,10 @@ class PagedGenerationServer:
         decoding = any(s is not None and j not in in_plan
                        and s["fed"] >= s["prompt"].size
                        for j, s in enumerate(self._slots))
+        self._recorder.record(
+            "prefill_chunk", packed=int(T), rows=len(plan),
+            tokens=int(sum(p[2] for p in plan)),
+            free_blocks=self.cache.available_block_count)
         t0 = time.perf_counter()
         try:
             with _tracing.span(
@@ -1535,6 +1808,9 @@ class PagedGenerationServer:
                 tok_h = np.asarray(tok)
                 stopped_h = np.asarray(stopped)
         except Exception as e:  # noqa: BLE001 — fail the chunk's requests
+            self._engine_exception("prefill", e,
+                                   [self._slots[i]["req"].rid
+                                    for i, *_ in plan])
             for i, *_ in plan:
                 s = self._slots[i]
                 seq, req = s["seq"], s["req"]
@@ -1547,11 +1823,26 @@ class PagedGenerationServer:
             return
         self.cache.swap_arrays(kc, vc)
         t_now = time.perf_counter()
+        self._ops_progress += 1
         if decoding:
             _m_decode_stall.observe(t_now - t0)
         _m_prefill_dispatches.inc()
+        # goodput: a resumed request's chunk re-feeds already-generated
+        # tokens (positions past its ORIGINAL prompt) — decoded work
+        # that emits nothing, accounted as preempt replay
+        replay = 0
+        for i, start, n, _o in plan:
+            req = self._slots[i]["req"]
+            if req.resume_ids is not None:
+                replay += max(0, start + n - max(start, req.ids.size))
         with self._lock:
             self._prefill_dispatches += 1
+            if replay:
+                self._decoded_tokens += replay
+                self._replayed_tokens += replay
+        if replay:
+            _m_decoded.inc(replay)
+            _m_replayed.inc(replay)
         for i, start, n, o in plan:
             s = self._slots[i]
             s["fed"] = start + n
@@ -1596,6 +1887,8 @@ class PagedGenerationServer:
                            cached_tokens=s["cached"])
             with self._lock:
                 self._prefills += 1
+                self._decoded_tokens += 1  # the token-0 sample
+            _m_decoded.inc()
             s["t_last"] = t_now
             self._slot_token(i, int(tok_h[r]),
                              device_stopped=bool(stopped_h[r]))
@@ -1641,6 +1934,10 @@ class PagedGenerationServer:
                 slot["req"].on_token = None
         if reason is not None:
             seq, req = slot["seq"], slot["req"]
+            self._ops_progress += 1
+            self._recorder.record("request_done", request_id=req.rid,
+                                  slot=i, new_tokens=len(slot["toks"]),
+                                  reason=reason)
             _tracing.event("request_done", request_id=req.rid,
                            new_tokens=len(slot["toks"]),
                            ttft_s=req.ttft, reason=reason)
@@ -1666,6 +1963,16 @@ class PagedGenerationServer:
                 req.future.set_result(out)
 
     def _loop(self):
+        try:
+            self._loop_body()
+        except Exception as e:  # noqa: BLE001 — an unhandled engine
+            # bug (outside the per-dispatch except paths) must leave a
+            # post-hoc record before the thread dies: health goes
+            # degraded and the flight recorder dumps
+            self._engine_exception("engine_loop", e)
+            raise
+
+    def _loop_body(self):
         jnp = self._jnp
         while True:
             with self._lock:
@@ -1745,6 +2052,10 @@ class PagedGenerationServer:
                 self._sampled_dispatches += 1
             else:
                 self._fastpath_dispatches += 1
+        self._recorder.record(
+            "decode_dispatch", slots=len(active_idx), k=k,
+            sampled=bool(sp_mode[0]),
+            free_blocks=self.cache.available_block_count)
         try:
             with _tracing.span(
                     "decode_dispatch", k=k,
@@ -1769,6 +2080,9 @@ class PagedGenerationServer:
                     toks = np.asarray(toks)        # [k, S]
                     stops = np.asarray(stopped)
         except Exception as e:  # noqa: BLE001 — fan out, drop slots
+            self._engine_exception("decode", e,
+                                   [self._slots[i]["req"].rid
+                                    for i in active_idx])
             for i in active_idx:
                 s = self._slots[i]
                 self.cache.free(s["seq"])
@@ -1780,10 +2094,15 @@ class PagedGenerationServer:
         self._sp_store.swap_counts(counts)
         self.cache.swap_arrays(kc, vc)
         t_now = time.perf_counter()
+        self._ops_progress += 1
+        decoded = toks.shape[0] * len(active_idx)
+        discarded = 0
         with self._lock:
             self._steps += 1
             self._active_integral += len(active_idx)
             self._fill_integral += self.cache.stats()["block_fill"]
+            self._decoded_tokens += decoded
+        _m_decoded.inc(decoded)
         for i in active_idx:
             s = self._slots[i]
             t_prev = s["t_last"] if s["t_last"] is not None else t_now
@@ -1794,6 +2113,7 @@ class PagedGenerationServer:
                                  device_stopped=bool(stops[j, i]))
                 if self._slots[i] is None:  # finished mid-scan: the
                     break  # remaining scan tokens are discarded
+            discarded += toks.shape[0] - consumed  # multi-step overrun
             if self._slots[i] is not None:
                 self._slots[i]["t_last"] = t_now
             # ITL: the dispatch's host-visible gap amortized over
@@ -1806,6 +2126,11 @@ class PagedGenerationServer:
                         s["req"].meta.lane, []).extend([per] * consumed)
             for _ in range(consumed):
                 _m_itl.observe(per)
+        if discarded:
+            with self._lock:
+                self._replayed_tokens += discarded
+            _m_replayed.inc(discarded)
+        _m_goodput.set(self._tokens_out / (self._decoded_tokens or 1))
 
     def _speculate(self, active_idx):
         """Propose drafts for every eligible decode-phase slot; when
@@ -1865,6 +2190,9 @@ class PagedGenerationServer:
             self._spec_rounds_per_slot += sum(
                 1 for d in plan.drafts if d.size)
         _m_spec_proposed.inc(proposed)
+        self._recorder.record(
+            "verify_dispatch", rows=plan.rows, proposed=proposed,
+            free_blocks=self.cache.available_block_count)
         P = plan.dlen.shape[0]
         try:
             with _tracing.span(
@@ -1900,6 +2228,9 @@ class PagedGenerationServer:
                 acc_h = np.asarray(accepted)
                 stop_h = np.asarray(stopped)
         except Exception as e:  # noqa: BLE001 — fan out, drop slots
+            self._engine_exception("verify", e,
+                                   [self._slots[i]["req"].rid
+                                    for i in plan.slots])
             for i in plan.slots:
                 s = self._slots[i]
                 self.cache.free(s["seq"])
@@ -1912,6 +2243,8 @@ class PagedGenerationServer:
         self.cache.swap_arrays(kc, vc)
         _m_spec_verify.inc()
         t_now = time.perf_counter()
+        self._ops_progress += 1
+        verify_discarded = 0
         with self._lock:
             self._spec_dispatches += 1
         for r, i in enumerate(plan.slots):
@@ -1941,6 +2274,13 @@ class PagedGenerationServer:
                                  device_stopped=bool(stop_h[r, j]))
                 if self._slots[i] is None:  # stopped mid-prefix: the
                     break  # remaining accepted tokens are discarded
+            # goodput: the row computed k_r+1 verify positions — a+1
+            # candidate emissions (stop-truncated remainder is replay)
+            # plus k_r-a rejected drafts (rolled back above)
+            with self._lock:
+                self._decoded_tokens += k_r + 1
+            _m_decoded.inc(k_r + 1)
+            verify_discarded += (a + 1) - consumed
             if self._slots[i] is not None:
                 self._slots[i]["t_last"] = t_now
             per = max(t_now - t_prev, 0.0) / consumed
@@ -1951,6 +2291,11 @@ class PagedGenerationServer:
                         s["req"].meta.lane, []).extend([per] * consumed)
             for _ in range(consumed):
                 _m_itl.observe(per)
+        if verify_discarded:
+            with self._lock:
+                self._replayed_tokens += verify_discarded
+            _m_replayed.inc(verify_discarded)
+        _m_goodput.set(self._tokens_out / (self._decoded_tokens or 1))
 
 
 def measure_offered_load(server, prompts, offered_rps, duration_s):

@@ -776,15 +776,29 @@ class PagedDecoder:
                     f"for argument '{name}' — build the PagedKVCache "
                     f"and the PagedDecoder with the SAME kv_dtype")
 
+    @property
+    def _shard_label(self):
+        """The `shard` label compile metrics carry (serving_dist
+        round): the bundle's mesh shape for sharded decoders, "none"
+        for the single-device path."""
+        if self._shardings is None:
+            return "none"
+        return getattr(self._shardings, "shard_label", "mesh")
+
     def _variant(self, mode):
         """(prefill, step, packed_prefill, packed_verify)
         tracing-wrapped jitted fns for one static sampling mode.
         Dispatch-boundary spans (ISSUE 2): when tracing is on, every
         jitted call shows up as its own span — the device-side cost
         inside a request's prefill/decode phases; when off, the wrapper
-        is one bool check."""
+        is one bool check. Compile tracking (ISSUE 10) wraps INSIDE
+        the span: any call that grew the jit's executable cache is
+        recorded as an XLA compile of that program, labeled with
+        whether requests were in flight — the event that lets a bench
+        window prove itself compile-clean."""
         v = self._variants.get(mode)
         if v is None:
+            from ..observability import compile_tracker as _ct
             from ..observability import tracing as _tracing
 
             if self._shardings is not None:
@@ -802,10 +816,15 @@ class PagedDecoder:
                 verify = _jitted_packed_verify(
                     self.spec, self.block_size, self._donate, mode,
                     self._kv_quant)
-            v = (_tracing.wrap("prefill_dispatch", prefill),
-                 _tracing.wrap("step_dispatch", step),
-                 _tracing.wrap("packed_prefill_dispatch", packed),
-                 _tracing.wrap("verify_dispatch", verify))
+            sh = self._shard_label
+            v = (_tracing.wrap("prefill_dispatch",
+                               _ct.wrap("prefill", prefill, sh)),
+                 _tracing.wrap("step_dispatch",
+                               _ct.wrap("decode_step", step, sh)),
+                 _tracing.wrap("packed_prefill_dispatch",
+                               _ct.wrap("packed_prefill", packed, sh)),
+                 _tracing.wrap("verify_dispatch",
+                               _ct.wrap("packed_verify", verify, sh)))
             self._variants[mode] = v
         return v
 
@@ -842,6 +861,7 @@ class PagedDecoder:
         """Fused n-token decode (see _build_multistep)."""
         import jax
 
+        from ..observability import compile_tracker as _ct
         from ..observability import tracing as _tracing
 
         if self._shardings is not None:
@@ -857,8 +877,10 @@ class PagedDecoder:
             fn = _jitted_multistep(self.spec, self.block_size,
                                    int(n_steps), self._donate, mode,
                                    self._kv_quant)
-        wrapped = _tracing.wrap("multistep_dispatch", fn,
-                                k=int(n_steps))
+        wrapped = _tracing.wrap(
+            "multistep_dispatch",
+            _ct.wrap("multistep", fn, self._shard_label),
+            k=int(n_steps))
 
         def checked(params, tok, pos, active, tables, kc, vc, sp):
             self._check_kv(kc, vc)

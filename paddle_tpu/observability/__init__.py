@@ -1,24 +1,41 @@
-"""paddle_tpu.observability — unified runtime telemetry (ISSUE 2).
+"""paddle_tpu.observability — unified runtime telemetry (ISSUE 2) and
+the serving operations plane (ISSUE 10).
 
-Three pillars, shared by serving, training, and bench:
+Pillars, shared by serving, training, and bench:
 
   * `metrics` — process-wide registry of counters/gauges/histograms
     with labels; Prometheus-text and JSON snapshot exporters; near-zero
     cost when disabled.
   * `tracing` — span API emitting a JSONL event log with monotonic
-    timestamps, plus the per-request trace assembler (queue-wait /
-    admission / prefill / decode / detokenize phases, TTFT, per-token
-    latency) and the utils/profiler.top_ops bridge.
+    timestamps (bounded/rotating sink), plus the per-request trace
+    assembler (queue-wait / admission / prefill / decode / detokenize
+    phases, TTFT, per-token latency) and the utils/profiler.top_ops
+    bridge.
+  * `exporter` — stdlib http.server daemon thread serving /metrics
+    (Prometheus text), /statusz (live JSON engine state), /healthz
+    (ok | degraded | stalled); started via
+    `PagedGenerationServer(expose_port=...)` / `FrontDoor` or
+    PADDLE_TPU_METRICS_PORT.
+  * `compile_tracker` — exact XLA compile detection at the decode jit
+    boundaries (`serving_xla_compiles_total{program,in_flight,shard}`),
+    always on, with a window API bench uses to prove measurement
+    windows compile-clean.
+  * `flight_recorder` — bounded ring buffer of structured engine
+    events + the stall watchdog that auto-dumps it (no-op when
+    disabled, like all telemetry).
   * `log` — the library logger (PADDLE_TPU_LOG_LEVEL verbosity);
     library code uses this instead of bare print()
     (scripts/check_no_print.py enforces it).
 
-One switch turns the first two on: PADDLE_TPU_TELEMETRY=1 in the
+One switch turns metrics+tracing on: PADDLE_TPU_TELEMETRY=1 in the
 environment, or `observability.enable()` at runtime.
 """
 from __future__ import annotations
 
+from . import compile_tracker, exporter, flight_recorder  # noqa: F401
 from . import log, metrics, tracing  # noqa: F401
+from .exporter import OpsEndpoint  # noqa: F401
+from .flight_recorder import FlightRecorder, StallWatchdog  # noqa: F401
 from .log import get_logger  # noqa: F401
 from .metrics import (REGISTRY, counter, gauge, histogram,  # noqa: F401
                       snapshot, to_prometheus)

@@ -49,6 +49,14 @@ import time
 
 ENV_ENABLE = "PADDLE_TPU_TELEMETRY"
 ENV_TRACE_PATH = "PADDLE_TPU_TRACE_PATH"
+ENV_TRACE_MAX_BYTES = "PADDLE_TPU_TRACE_MAX_BYTES"
+
+# Bounded sink (ISSUE 10 satellite): a long-lived serving run must not
+# grow the trace file without bound. When the sink crosses the cap it
+# rotates ONCE (path -> path + ".1", replacing any previous rotation)
+# and restarts the live file, so disk usage is bounded at ~2x the cap
+# while the most recent cap's worth of events is always on disk.
+DEFAULT_TRACE_MAX_BYTES = 64 << 20  # 64 MiB
 
 
 class Tracer:
@@ -64,6 +72,10 @@ class Tracer:
         self._events: list[dict] = []
         self._file = None
         self._path = None
+        self._bytes = 0
+        self._rotations = 0
+        self.max_bytes = int(os.environ.get(ENV_TRACE_MAX_BYTES,
+                                            DEFAULT_TRACE_MAX_BYTES))
         self._next_id = 0
         self._local = threading.local()
         # one wall-clock anchor: wall ~= _wall0 + (ts - _ts0)
@@ -73,9 +85,15 @@ class Tracer:
             self.configure(path=path or os.environ[ENV_TRACE_PATH])
 
     # -- config ----------------------------------------------------------
-    def configure(self, path=None, enabled=None, truncate=False):
-        """Set the JSONL sink (None detaches) and/or toggle tracing."""
+    def configure(self, path=None, enabled=None, truncate=False,
+                  max_bytes=None):
+        """Set the JSONL sink (None detaches) and/or toggle tracing.
+        max_bytes caps the sink file (default 64 MiB, env
+        PADDLE_TPU_TRACE_MAX_BYTES): crossing it rotates the file once
+        to `path + ".1"` and restarts the live file."""
         with self._lock:
+            if max_bytes is not None:
+                self.max_bytes = int(max_bytes)
             if self._file is not None and path != self._path:
                 self._file.close()
                 self._file = None
@@ -86,12 +104,39 @@ class Tracer:
                 self._file = open(path, "w" if truncate else "a",
                                   buffering=1)
                 self._path = path
-                self._file.write(json.dumps(
+                self._bytes = self._file.tell()
+                self._write_line(json.dumps(
                     {"name": "trace_start", "ts": self._ts0,
-                     "wall": self._wall0}) + "\n")
+                     "wall": self._wall0}))
         if enabled is not None:
             self.enabled = bool(enabled)
         return self
+
+    def _write_line(self, line):
+        """Caller holds the lock. Rotates BEFORE the write when the
+        sink would cross max_bytes, so the live file never exceeds the
+        cap and the previous cap's worth of events survives at
+        path + '.1'."""
+        n = len(line) + 1
+        if self._bytes and self._bytes + n > self.max_bytes:
+            self._rotate_locked()
+        self._file.write(line + "\n")
+        self._bytes += n
+
+    def _rotate_locked(self):
+        self._file.close()
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:  # cross-device/unwritable: truncate in place
+            pass
+        self._file = open(self._path, "w", buffering=1)
+        self._bytes = 0
+        self._rotations += 1
+        header = json.dumps({"name": "trace_start", "ts": self._ts0,
+                             "wall": self._wall0,
+                             "rotation": self._rotations})
+        self._file.write(header + "\n")
+        self._bytes += len(header) + 1
 
     @property
     def path(self):
@@ -110,7 +155,7 @@ class Tracer:
             self._next_id += 1
             self._events.append(ev)
             if self._file is not None:
-                self._file.write(json.dumps(ev) + "\n")
+                self._write_line(json.dumps(ev))
 
     def event(self, name, **attrs):
         """Point event (duration 0)."""
@@ -185,8 +230,8 @@ class Tracer:
 TRACER = Tracer()
 
 
-def configure(path=None, enabled=None, truncate=False):
-    return TRACER.configure(path, enabled, truncate)
+def configure(path=None, enabled=None, truncate=False, max_bytes=None):
+    return TRACER.configure(path, enabled, truncate, max_bytes)
 
 
 def span(name, **attrs):
@@ -262,6 +307,8 @@ def assemble_request_traces(evs=None, path=None):
         else:
             evs = load_events(path)
     reqs: dict[object, dict] = {}
+    compiles = []  # (ts, dur, program): compile-tracker events, used
+    # below to attribute TTFT/ITL outliers to in-window XLA compiles
 
     def rec(rid):
         return reqs.setdefault(rid, {"request_id": rid,
@@ -308,6 +355,9 @@ def assemble_request_traces(evs=None, path=None):
                 r["ttft_ms"] = ev["ttft_s"] * 1e3
         elif name == "detokenize" and rid is not None:
             rec(rid)["t_end"] = ev["ts"] + ev.get("dur", 0.0)
+        elif name == "compile":
+            compiles.append((ev["ts"], ev.get("dur", 0.0),
+                             ev.get("program")))
 
     out = {}
     for rid, r in reqs.items():
@@ -349,6 +399,21 @@ def assemble_request_traces(evs=None, path=None):
             # much of it was spent evicted
             out[rid]["preemptions"] = r["preemptions"]
             out[rid]["requeue_ms"] = round(r.get("requeue_ms", 0.0), 4)
+        # XLA compile attribution (ISSUE 10): compile-tracker events
+        # overlapping this request's residency explain TTFT/ITL
+        # outliers that would otherwise read as queue/prefill/decode
+        # time — the phases still tile wall clock; this is a parallel
+        # "of which, compile" annotation
+        overlap = 0.0
+        n_comp = 0
+        for cts, cdur, _prog in compiles:
+            o = min(cts + cdur, t_end) - max(cts, t_submit)
+            if o > 0:
+                overlap += o
+                n_comp += 1
+        if n_comp:
+            out[rid]["compiles_in_window"] = n_comp
+            out[rid]["compile_overlap_ms"] = round(overlap * 1e3, 4)
     return out
 
 

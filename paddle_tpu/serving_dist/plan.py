@@ -128,6 +128,15 @@ class DecodeShardings:
     def params(self):
         return dict(self._params_items)
 
+    @property
+    def shard_label(self):
+        """The `shard` label the ops plane's compile metrics carry
+        (serving_xla_compiles_total{..., shard=}): the mesh shape in
+        axis=size form, e.g. "mp2xdp1" — so a fleet scraping several
+        mesh configs can tell whose jit cache went cold."""
+        shape = dict(self.mesh.shape)
+        return f"mp{shape.get('mp', 1)}xdp{shape.get('dp', 1)}"
+
     def _key(self):
         return (self.mesh, self._params_items, self.kv, self.rep)
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Metrics <-> docs drift check (ISSUE 10 satellite).
+
+Every `serving_*` / `kv_*` / `frontdoor_*` metric name registered in
+paddle_tpu/ library code must have a row in docs/OBSERVABILITY.md's
+"What is instrumented" table, and every such name the docs claim must
+exist in code — the same drift class ADVICE.md r5 flagged for
+SURVEY.md figures. AST-based on the code side (registration calls are
+`<something>.counter("name", ...)` / gauge / histogram / gauge_fn with
+a literal first argument, the repo-wide convention), brace-expansion-
+aware on the docs side (`kv_pool_{used,free}_blocks` is two names).
+
+Exit 0 clean, 1 with the drift listing — wired into tier-1 as
+tests/test_metrics_docs.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+PREFIXES = ("serving_", "kv_", "frontdoor_")
+REGISTER_FNS = {"counter", "gauge", "histogram", "gauge_fn"}
+
+
+def _checked(name):
+    return isinstance(name, str) and name.startswith(PREFIXES)
+
+
+def collect_code_metrics(pkg_dir=PKG):
+    """{metric_name: [file:line, ...]} for every registration call in
+    library code whose first argument is a string literal with a
+    checked prefix."""
+    out = {}
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read(),
+                                 filename=rel)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in REGISTER_FNS):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and _checked(arg.value):
+                    out.setdefault(arg.value, []).append(
+                        f"{rel}:{node.lineno}")
+    return out
+
+
+def _expand_braces(name):
+    """kv_pool_{used,free,retained}_blocks -> the three names."""
+    m = re.search(r"\{([^{}]*,[^{}]*)\}", name)
+    if not m:
+        return [name]
+    out = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand_braces(name[:m.start()] + alt.strip()
+                                  + name[m.end():]))
+    return out
+
+
+def collect_doc_metrics(doc_path=DOC):
+    """Metric names documented in docs/OBSERVABILITY.md's metric table:
+    in the FIRST cell of each `| ... |` row, every backticked token
+    with a checked prefix — label sets (`{reason=eos\\|budget}`)
+    stripped, brace alternation (`kv_pool_{used,free}_blocks`)
+    expanded. Per-line parsing, so the ```-fenced examples elsewhere
+    in the doc can't desynchronize backtick pairing."""
+    out = set()
+    for line in open(doc_path, encoding="utf-8"):
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        # cells split on UNESCAPED pipes only — label alternation in
+        # markdown tables is written `{reason=eos\|budget}`
+        cells = re.split(r"(?<!\\)\|", line)
+        first_cell = cells[1] if len(cells) >= 2 else ""
+        for code in re.findall(r"`([^`]+)`", first_cell):
+            for token in re.split(r"[\s,]+(?![^{]*\})", code):
+                # a TRAILING {...} is the label set (drop it); a
+                # mid-name {a,b,c} is name alternation (expand it)
+                token = re.sub(r"\{[^}]*\}$", "", token.strip())
+                if not token.startswith(PREFIXES):
+                    continue
+                for name in _expand_braces(token):
+                    if re.fullmatch(r"[a-z0-9_]+", name):
+                        out.add(name)
+    return out
+
+
+def run_check():
+    """Returns (errors, code_names, doc_names)."""
+    code = collect_code_metrics()
+    docs = collect_doc_metrics()
+    errors = []
+    for name in sorted(set(code) - docs):
+        errors.append(
+            f"metric {name!r} (registered at {code[name][0]}) has no "
+            f"row in docs/OBSERVABILITY.md")
+    for name in sorted(docs - set(code)):
+        errors.append(
+            f"docs/OBSERVABILITY.md documents {name!r} but no library "
+            f"code registers it")
+    return errors, code, docs
+
+
+def main():
+    errors, code, docs = run_check()
+    if errors:
+        for e in errors:
+            print(e)  # cli-print
+        print(f"{len(errors)} metrics<->docs drift error(s) "  # cli-print
+              f"({len(code)} registered, {len(docs)} documented)")
+        return 1
+    print(f"metrics<->docs in sync: {len(code)} registered "  # cli-print
+          f"{PREFIXES} metrics all documented, no stale doc rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

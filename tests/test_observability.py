@@ -3,6 +3,7 @@ span nesting/ordering across jit boundaries, the per-request trace
 assembler on a real paged-serving run, the TelemetryCallback training
 hook, and the profiler satellites (percentile summary, decorator)."""
 import json
+import os
 import time
 
 import numpy as np
@@ -103,6 +104,43 @@ class TestRegistry:
         assert c.value == 0.0
         assert reg.counter("n") is c
 
+    def test_prometheus_conformance_golden(self):
+        """Golden-file conformance of the scrape text (ISSUE 10
+        satellite): HELP/TYPE lines, label escaping for quotes /
+        newlines / backslashes, histogram cumulative buckets with the
+        +Inf bucket and _sum/_count — byte-exact, so the new /metrics
+        endpoint emits parseable Prometheus text by construction."""
+        reg = M.Registry(enabled=True)
+        c = reg.counter("scrape_c_total", "a counter",
+                        labelnames=("k",))
+        c.labels(k='quo"te').inc(3)
+        c.labels(k="line\nbreak").inc()
+        c.labels(k="back\\slash").inc(2)
+        g = reg.gauge("scrape_g", "a gauge")
+        g.set(2.5)
+        h = reg.histogram("scrape_h_seconds", "a histogram",
+                          buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        golden = (
+            '# HELP scrape_c_total a counter\n'
+            '# TYPE scrape_c_total counter\n'
+            'scrape_c_total{k="quo\\"te"} 3\n'
+            'scrape_c_total{k="line\\nbreak"} 1\n'
+            'scrape_c_total{k="back\\\\slash"} 2\n'
+            '# HELP scrape_g a gauge\n'
+            '# TYPE scrape_g gauge\n'
+            'scrape_g 2.5\n'
+            '# HELP scrape_h_seconds a histogram\n'
+            '# TYPE scrape_h_seconds histogram\n'
+            'scrape_h_seconds_bucket{le="0.1"} 1\n'
+            'scrape_h_seconds_bucket{le="1"} 2\n'
+            'scrape_h_seconds_bucket{le="+Inf"} 3\n'
+            'scrape_h_seconds_sum 5.55\n'
+            'scrape_h_seconds_count 3\n'
+        )
+        assert reg.to_prometheus() == golden
+
 
 class TestTracing:
     def test_span_nesting_and_order_across_jit(self, tmp_path):
@@ -139,6 +177,31 @@ class TestTracing:
             pass
         tr.event("y")
         assert tr.events() == []
+
+    def test_sink_rotates_at_max_bytes(self, tmp_path):
+        """Bounded sink (ISSUE 10 satellite): the JSONL file never
+        exceeds max_bytes; crossing the cap rotates once to path+'.1'
+        so total disk stays ~2x the cap and the most recent events
+        survive."""
+        path = str(tmp_path / "t.jsonl")
+        tr = T.Tracer(enabled=True)
+        tr.configure(path=path, max_bytes=2048)
+        for i in range(200):
+            tr.event("ev", i=i, pad="x" * 40)
+        tr.flush()
+        assert os.path.getsize(path) <= 2048
+        assert os.path.exists(path + ".1")
+        assert os.path.getsize(path + ".1") <= 2048
+        # the live file starts with a rotation-stamped header and its
+        # events parse; the newest event is in the live file
+        live = T.load_events(path)
+        assert live[0]["name"] == "trace_start"
+        assert live[0]["rotation"] >= 1
+        assert live[-1]["i"] == 199
+        # rotation preserved the immediately-preceding events
+        prev = T.load_events(path + ".1")
+        assert prev[-1]["i"] == live[1]["i"] - 1
+        tr.close()
 
     def test_wrap_decorates_dispatch(self):
         tr = T.Tracer(enabled=True)

@@ -393,6 +393,13 @@ def test_served_bench_openloop_tiny_schema():
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
         assert "itl_p99_ms" in rec
+    # ops plane (ISSUE 10): served records carry the compile-window
+    # + goodput fields so a compile-poisoned measurement window is
+    # visible in the record instead of discovered post-hoc
+    for rec in (paged, open_rec, fd_rec):
+        assert "compiles_in_window" in rec, rec
+        assert "compiles_in_flight_window" in rec, rec
+        assert 0 < rec["goodput_ratio"] <= 1.0, rec
     # mixed-sampling axis (round 10): fixed-seed 50/50 workload whose
     # record carries the pipeline-overhead fields
     for fld in ("sampling_overhead_pct", "sampled_fraction",
