@@ -746,6 +746,13 @@ class PagedKVCache:
         self.k_blocks = k_blocks
         self.v_blocks = v_blocks
 
+    def block_fill(self):
+        """Live tokens / allocated block capacity — the
+        `stats()["block_fill"]` value without building the full stats
+        dict (both serving engines sample it every decode round)."""
+        used = self.num_blocks - 1 - len(self._free) - len(self._retained)
+        return sum(self._lens.values()) / ((used * self.block_size) or 1)
+
     def stats(self):
         used = self.num_blocks - 1 - len(self._free) - len(self._retained)
         held = sum(self._lens.values())

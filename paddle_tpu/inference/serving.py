@@ -156,6 +156,19 @@ _m_engine_exc = _metrics.counter(
     "serving_engine_exceptions_total",
     "engine dispatch exceptions fanned out to request futures, by "
     "dispatch kind", labelnames=("where",))
+# One-kernel round (r16): dispatch-per-round + async overlap accounting.
+_m_round_dispatches = _metrics.histogram(
+    "serving_dispatches_per_round",
+    "attention dispatches one scheduler round issued (split path: "
+    "chunk prefill, decode and verify can each fire; unified round: "
+    "always 1)", buckets=(1.0, 2.0, 3.0, 4.0))
+_m_round_overlap = _metrics.histogram(
+    "serving_round_overlap_seconds",
+    "host plan+dispatch time of round N+1 hidden behind round N's "
+    "device execution (async double-buffered engine loop; only "
+    "observed while a round was in flight)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.5))
 _req_ids = itertools.count()
 
 STOP_REASONS = ("eos", "stop_token", "stop_string", "budget")
@@ -652,6 +665,26 @@ class PagedGenerationServer:
     Default OFF: no port, no threads, and every recorder hook is one
     bool check — the exact pre-round engine.
 
+    ONE-KERNEL ROUND (r16): `unified_round=True` fuses each scheduler
+    round's up-to-three attention dispatches — packed chunk prefill,
+    plain decode, speculative verify — into ONE
+    `nn.decode.unified_round` dispatch over a single packed stream
+    (prefill chunks, decode rows and verify regions are all just
+    ragged segments under the same segment-causal mask; see
+    docs/SERVING.md "One-kernel round"). `async_rounds=True` (implies
+    unified) additionally DOUBLE-BUFFERS the loop: round N+1 is
+    planned on host and dispatched while round N executes on device,
+    with round N's sampled tokens feeding round N+1's decode rows
+    through a slot-indexed device carry — the only host<->device sync
+    point is the detokenize/stop-check boundary, one round behind the
+    device. Stop flags are device-computed either way; host-side stop
+    checks (stop strings, budgets) drain one round late and the
+    overshoot round is discarded, so output is TOKEN-IDENTICAL to the
+    split path across the whole composed stack (prefix cache,
+    speculation, quantization, sharding, preemption — parity-tested).
+    Requires steps_per_dispatch=1. Both default OFF: the exact split
+    scheduler path.
+
     speculation=SpecConfig(...) (or True for defaults) turns on
     SPECULATIVE DECODING (round 11): each round, eligible decode-phase
     slots ask the drafter (default: the self-drafting n-gram /
@@ -681,6 +714,7 @@ class PagedGenerationServer:
                  prefill_chunk_tokens=512, pack_align=None,
                  enable_prefix_cache=False, detokenize=None,
                  stop_tail_tokens=16, speculation=None, sharding=None,
+                 unified_round=False, async_rounds=False,
                  expose_port=None, flight_recorder=None,
                  stall_timeout_s=30.0):
         import jax
@@ -727,11 +761,31 @@ class PagedGenerationServer:
                 "dispatch already amortizes the per-dispatch floor over "
                 "up to K+1 tokens; fusing verify rounds into a scan "
                 "would need host drafting mid-scan)")
+        # one-kernel round (r16): unified_round=True fuses the whole
+        # scheduler round — chunk prefill rows, decode rows, verify
+        # regions — into ONE attention dispatch; async_rounds=True
+        # additionally double-buffers the loop (plan round N+1 on host
+        # while round N runs on device, tokens chained via the device
+        # carry). async implies unified. Default OFF: the exact
+        # split-path scheduler.
+        self._async = bool(async_rounds)
+        self._unified = bool(unified_round) or self._async
+        if self._unified and self.steps_per_dispatch > 1:
+            raise ValueError(
+                "unified_round/async_rounds require steps_per_dispatch"
+                "=1 (the fused round already amortizes the dispatch "
+                "floor over the whole round)")
+        self._uk1 = self._spec_k + 1  # pinned unified readout width
         # overrun horizon past the budget: a multi-step scan may write
         # up to k-1 discarded tokens, and a verify dispatch up to K
         # speculative positions past the last emitted token (rolled
-        # back on rejection, but the blocks must be reservable)
+        # back on rejection, but the blocks must be reservable). The
+        # async loop adds ONE round of optimistic overshoot: the host
+        # learns about stops a round late, so the device may write up
+        # to 1 + K extra positions past where the split engine stops.
         slack = max(self.steps_per_dispatch - 1, self._spec_k)
+        if self._async:
+            slack += 1 + self._spec_k
         self._overrun = slack
         self.max_prompt_len = int(
             max_prompt_len or cfg.max_position - self.max_new - slack)
@@ -866,6 +920,25 @@ class PagedGenerationServer:
         # construction at every dispatch site
         self._decoded_tokens = 0
         self._replayed_tokens = 0
+        # one-kernel round (r16): per-round dispatch accounting (both
+        # engine paths — the split path reports its 1-3 dispatches per
+        # round here too, so the fusion win is measurable), async
+        # overlap, and the double-buffer state (the in-flight round +
+        # the slot-indexed device carry; both live outside the stats
+        # window and never reset)
+        self._rounds = 0
+        self._round_dispatch_count = 0
+        self._mixed_rounds = 0
+        self._overlap_s = 0.0
+        self._pending = None
+        self._carry = None
+        self._zero_carry = None
+        # steady-state device-argument reuse (async window rounds): the
+        # whole plan argument set is round-invariant per (slots, seqs,
+        # drafts) signature — caching the uploaded arrays is most of
+        # "hide the host planner behind the device"
+        self._args_cache = None
+        self._tables_cache = None
         # front door (round 12): pluggable scheduler + preemption /
         # deadline window counters (zero + unused when no scheduler is
         # installed — the legacy submit/drain path is bit-identical)
@@ -1071,6 +1144,10 @@ class PagedGenerationServer:
             raise RuntimeError(
                 "warm_buckets must run before start() (the engine loop "
                 "owns the cache arrays once it is running)")
+        if self._unified:
+            # the unified loop never dispatches packed_prefill — its
+            # bucket space is the combined-round (T, P) family
+            return self._warm_unified_buckets(modes)
         jnp = self._jnp
         align = self._pack_align
         budget = self.prefill_chunk_tokens
@@ -1124,6 +1201,76 @@ class PagedGenerationServer:
         _logger.info("warm_buckets: compiled %d packed-prefill "
                      "variants (%d shape pairs x %d widths x %d modes)",
                      n, len(pairs), len(widths), len(modes))
+        return n
+
+    def _warm_unified_buckets(self, modes):
+        """Pre-compile the unified-round bucket space (r16): every
+        reachable (packed length T, plan rows P) pair at the pinned
+        table width, per sampling mode. The combined stream packs up
+        to max_slots chunk/decode/verify regions, so T's worst case is
+        the chunk half's worst packing plus max_slots pinned
+        decode/verify regions; both axes bucket to powers of two, so
+        the space stays small. Each bucket compiles via ONE synthetic
+        all-pad dispatch (positions -1 route every write to the trash
+        block; no sequence, sampling, carry or cache state changes)."""
+        jnp = self._jnp
+        align = self._pack_align
+        dalign = self._verify_align
+        K1 = self._uk1
+        W = -(-K1 // dalign) * dalign
+        budget = self.prefill_chunk_tokens
+        chunk_hi = 0
+        for rows in range(1, min(self.max_slots, budget) + 1):
+            chunk_hi = max(chunk_hi, (rows - 1) * align + align * (
+                -(-(budget - rows + 1) // align)))
+        off_hi = chunk_hi + W * self.max_slots
+        ts = []
+        t = align
+        while True:
+            ts.append(t)
+            if t >= off_hi:
+                break
+            t *= 2
+        ps = []
+        p = 1
+        while True:
+            ps.append(p)
+            if p >= self.max_slots:
+                break
+            p *= 2
+        zc = self._zero_carry_arrays()
+        n = 0
+
+        def one(T, P, mode, window):
+            sp = self._sp_store.warm_unified_args(P, mode)
+            (_vt, _ac, _st, kc, vc, counts, _ct, _cp,
+             _cs) = self._decoder.unified_round(
+                self._params, jnp.zeros((T,), jnp.int32),
+                jnp.zeros((T,), jnp.int32),
+                jnp.full((T,), -1, jnp.int32),
+                jnp.zeros((P, self._m_width), jnp.int32),
+                jnp.zeros((P, K1), jnp.int32),
+                jnp.full((P,), -1, jnp.int32),
+                jnp.full((P,), -1, jnp.int32),
+                jnp.full((T,), -1, jnp.int32),
+                jnp.full((T,), -1, jnp.int32),
+                jnp.full((P,), -1, jnp.int32),
+                *zc, self.cache.k_blocks, self.cache.v_blocks,
+                sp, mode, window=window)
+            self._sp_store.swap_counts(counts)
+            self.cache.swap_arrays(kc, vc)
+
+        for mode in modes:
+            for P in ps:  # chunk-free WINDOW rounds: T pinned = P * W
+                one(P * W, P, mode, True)
+                n += 1
+            for T in ts:  # mixed rounds: the packed (T, P) family
+                for P in ps:
+                    one(T, P, mode, False)
+                    n += 1
+        _logger.info("warm_buckets: compiled %d unified-round variants "
+                     "(%d window + %d T x %d P packed, %d modes)",
+                     n, len(ps), len(ts), len(ps), len(modes))
         return n
 
     # ---- client API ----------------------------------------------------
@@ -1272,6 +1419,10 @@ class PagedGenerationServer:
             self._spec_rounds_per_slot = 0
             self._decoded_tokens = 0
             self._replayed_tokens = 0
+            self._rounds = 0
+            self._round_dispatch_count = 0
+            self._mixed_rounds = 0
+            self._overlap_s = 0.0
             self._compile_mark = _compile_tracker.mark()
             self._last_error = None  # a fresh window is healthy again
             self._preemptions = 0
@@ -1377,6 +1528,25 @@ class PagedGenerationServer:
                     "replayed_tokens": self._replayed_tokens,
                     "goodput_ratio": (self._tokens_out
                                       / (self._decoded_tokens or 1)),
+                },
+                # one-kernel round (r16): dispatches-per-round on BOTH
+                # engine paths (split: up to chunk-prefill + decode +
+                # verify per round; unified: 1) plus the async loop's
+                # hidden host-plan time — zeroed-when-disabled schema,
+                # reset-coherent (mixed_rounds = rounds that contained
+                # prefill AND decode/verify work, the rounds the fusion
+                # actually collapses)
+                "rounds": {
+                    "unified": self._unified,
+                    "async": self._async,
+                    "rounds": self._rounds,
+                    "attention_dispatches": self._round_dispatch_count,
+                    "dispatches_per_round": (self._round_dispatch_count
+                                             / (self._rounds or 1)),
+                    "mixed_rounds": self._mixed_rounds,
+                    "overlap_seconds": self._overlap_s,
+                    "overlap_fraction": (self._overlap_s / dt
+                                         if dt else 0.0),
                 },
                 # XLA compiles inside THIS stats window (the process-
                 # wide compile tracker, windowed at reset_stats):
@@ -1551,6 +1721,11 @@ class PagedGenerationServer:
             _tracing.event("resumed", request_id=req.rid, slot=i,
                            seq=seq, cached_tokens=cached,
                            tokens_done=len(req.gen0), warm=warm)
+        if warm and self._async:
+            # the slot joins the next decode dispatch directly, so its
+            # device-carry entry must hold its host-known state (no
+            # unified round ever set it for this residency)
+            self._seed_carry_slot(i)
         _m_slot_refills.inc()
         self._ops_progress += 1
         self._recorder.record(
@@ -1569,7 +1744,13 @@ class PagedGenerationServer:
         release its blocks, and hand the request back for requeueing
         with its generated-so-far tokens saved as resume state. Called
         between dispatches only (no in-flight device work touches the
-        victim). Returns the request."""
+        victim) — in async mode the in-flight round is DRAINED first,
+        so the victim's token list and published K/V are
+        authoritative (the drain may complete the victim's request —
+        then there is nothing to evict and this returns None)."""
+        self._drain_pending()
+        if self._slots[i] is None:
+            return None
         s = self._slots[i]
         seq, req = s["seq"], s["req"]
         known = (np.concatenate([req.ids,
@@ -1661,7 +1842,8 @@ class PagedGenerationServer:
                             if self._slots[j] is not None]
                 for j in self._sched.victims(req, occupied, now):
                     victim = self._preempt_slot_locked(j)
-                    self._sched.requeue(victim, now)
+                    if victim is not None:
+                        self._sched.requeue(victim, now)
                     free_i = next((i for i, s in enumerate(self._slots)
                                    if s is None), None)
                     if free_i is not None and not short():
@@ -1973,31 +2155,56 @@ class PagedGenerationServer:
             raise
 
     def _loop_body(self):
-        jnp = self._jnp
         while True:
             with self._lock:
                 if self._stop:
+                    # async: resolve the in-flight round so no future
+                    # is stranded mid-stream
+                    self._drain_pending()
                     return
                 self._admit_locked()
                 if all(s is None for s in self._slots):
+                    self._drain_pending()  # defensive: no-op when idle
                     self._lock.wait(timeout=0.1)
                     continue
-            # ---- packed/chunked prefill: at most ONE chunk dispatch
-            # per round, interleaved with the decode dispatch below, so
-            # in-flight decode never stalls longer than one chunk budget
-            pre_idx = [i for i, s in enumerate(self._slots)
-                       if s is not None
-                       and s["fed"] < s["prompt"].size]
-            if pre_idx:
-                self._prefill_packed(pre_idx)
-            _m_slots_busy.labels(server="paged").set(
-                sum(s is not None for s in self._slots))
-            # decode phase: prompt fully fed (first token sampled)
-            active_idx = [i for i, s in enumerate(self._slots)
-                          if s is not None
-                          and s["fed"] >= s["prompt"].size]
-            if not active_idx:
-                continue
+            if self._unified:
+                self._round_unified()
+            else:
+                self._round_split()
+
+    def _note_round(self, n_dispatches, mixed):
+        """Per-round dispatch accounting (r16), shared by both engine
+        paths: `mixed` marks a round that carried prefill AND
+        decode/verify work — the rounds the unified kernel collapses
+        from up to 3 dispatches to 1."""
+        with self._lock:
+            self._rounds += 1
+            self._round_dispatch_count += n_dispatches
+            if mixed:
+                self._mixed_rounds += 1
+        _m_round_dispatches.observe(float(n_dispatches))
+
+    def _round_split(self):
+        """One scheduler round of the SPLIT path (the pre-r16 loop
+        body): at most one packed chunk-prefill dispatch, then one
+        verify and/or one plain decode dispatch."""
+        d0 = (self._prefill_dispatches + self._steps
+              + self._spec_dispatches)
+        # ---- packed/chunked prefill: at most ONE chunk dispatch
+        # per round, interleaved with the decode dispatch below, so
+        # in-flight decode never stalls longer than one chunk budget
+        pre_idx = [i for i, s in enumerate(self._slots)
+                   if s is not None
+                   and s["fed"] < s["prompt"].size]
+        if pre_idx:
+            self._prefill_packed(pre_idx)
+        _m_slots_busy.labels(server="paged").set(
+            sum(s is not None for s in self._slots))
+        # decode phase: prompt fully fed (first token sampled)
+        active_idx = [i for i, s in enumerate(self._slots)
+                      if s is not None
+                      and s["fed"] >= s["prompt"].size]
+        if active_idx:
             # speculative decoding (round 11): eligible slots propose
             # drafts and take ONE packed verification dispatch instead
             # of a decode step; the rest decode plainly below. With
@@ -2009,9 +2216,587 @@ class PagedGenerationServer:
             plain_idx = [i for i in active_idx
                          if i not in spec_slots
                          and self._slots[i] is not None]
-            if not plain_idx:
+            if plain_idx:
+                self._decode_plain(plain_idx)
+        d1 = (self._prefill_dispatches + self._steps
+              + self._spec_dispatches)
+        if d1 > d0:
+            self._note_round(d1 - d0,
+                             mixed=bool(pre_idx) and bool(active_idx))
+
+    # ---- one-kernel round (r16) -----------------------------------------
+
+    def _round_unified(self):
+        """One scheduler round of the UNIFIED path: build the combined
+        plan (chunk prefill rows + decode rows + verify regions), run
+        it as ONE dispatch, and process the results.
+
+        Synchronous mode processes the round immediately. ASYNC mode
+        double-buffers: the round dispatched here runs on device while
+        the NEXT loop iteration plans and dispatches its successor
+        (inputs chained through the device carry), and only then syncs
+        this round's outputs — so the host plan+dispatch work is
+        hidden behind device execution, measured as overlap."""
+        t0 = time.perf_counter()
+        plan = self._plan_round()
+        outs = self._dispatch_round(plan) if plan is not None else None
+        t1 = time.perf_counter()
+        if not self._async:
+            if outs is not None:
+                self._process_round(plan, outs)
+            return
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            # everything since the previous iteration's sync point ran
+            # while the pending round executed on device
+            overlap = t1 - t0
+            with self._lock:
+                self._overlap_s += overlap
+            _m_round_overlap.observe(overlap)
+            self._process_round(*pending)
+        if outs is not None:
+            self._pending = (plan, outs)
+        else:
+            self._carry = None  # chain broken: reseed from host state
+
+    def _drain_pending(self):
+        """Async mode: resolve the in-flight round NOW so host state is
+        authoritative (preemption swap-out, engine stop, idle). Breaks
+        the device chain — the carry reseeds from host state at the
+        next plan. No-op when nothing is in flight."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._carry = None
+            self._process_round(*pending)
+
+    def _seed_carry(self):
+        """(Re)build the slot-indexed device carry from host state —
+        the async chain's starting point after a start/drain. Only
+        decode-phase slots have meaningful carry entries; everything
+        else is written by its own round before being read."""
+        jnp = self._jnp
+        S = self.max_slots
+        tok = np.zeros((S,), np.int32)
+        posn = np.zeros((S,), np.int32)
+        st = np.zeros((S,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is not None and s["toks"] \
+                    and s["fed"] >= s["prompt"].size:
+                tok[i] = s["toks"][-1]
+                posn[i] = s["pos"] + len(s["toks"]) - 1
+                st[i] = len(s["toks"])
+        self._carry = (jnp.asarray(tok), jnp.asarray(posn),
+                       jnp.asarray(st))
+
+    def _seed_carry_slot(self, i):
+        """Install one slot's host-known decode state into the live
+        device carry — needed when a slot enters the decode phase
+        without a unified dispatch having set its carry entry (the
+        warm preempt-resume fast path joins the next decode dispatch
+        directly)."""
+        if self._carry is None:
+            return
+        s = self._slots[i]
+        ct, cp, cs = self._carry
+        self._carry = (ct.at[i].set(int(s["toks"][-1])),
+                       cp.at[i].set(int(s["pos"] + len(s["toks"]) - 1)),
+                       cs.at[i].set(len(s["toks"])))
+
+    def _plan_round(self):
+        """Build ONE combined round plan: prefill chunk rows (the
+        exact `_prefill_packed` budget/ordering policy), plain decode
+        rows, and speculative verify regions — each plan row is one
+        ragged segment of a single packed stream, host-deterministic
+        even in async mode (decode inputs are carry REFERENCES, not
+        values). Returns None when no slot has work."""
+        align = self._pack_align
+        dalign = self._verify_align
+        K1 = self._uk1
+        # pinned decode/verify region width: one compiled T per round
+        # composition, not per draft-count combination
+        W = -(-K1 // dalign) * dalign
+        rows = []
+        # ---- chunk half (the _prefill_packed policy)
+        pre_idx = [i for i, s in enumerate(self._slots)
+                   if s is not None and s["fed"] < s["prompt"].size]
+        budget = self.prefill_chunk_tokens
+        if self._sched is not None and pre_idx:
+            entries = self._sched.prefill_plan(
+                [(i, self._slots[i]) for i in pre_idx], budget)
+        else:
+            entries = [(i, None) for i in pre_idx]
+        for i, cap in entries:
+            if budget <= 0:
+                break
+            s = self._slots[i]
+            n = min(s["prompt"].size - s["fed"], budget)
+            if cap is not None:
+                n = min(n, int(cap))
+            if n <= 0:
                 continue
-            self._decode_plain(plain_idx)
+            rows.append({"kind": "chunk", "slot": i, "seq": s["seq"],
+                         "start": s["fed"], "n": n,
+                         "width": -(-n // align) * align,
+                         "done": s["fed"] + n == s["prompt"].size})
+            budget -= n
+        # ---- decode / verify half: every decode-phase slot rides the
+        # same dispatch (draft-free slots as dlen=0 rows)
+        for i, s in enumerate(self._slots):
+            if s is None or s["fed"] < s["prompt"].size:
+                continue
+            drafts = np.empty((0,), np.int32)
+            if self._drafter is not None:
+                # async note: the context is the host-KNOWN tokens —
+                # up to one round stale. Stale drafts only lower the
+                # acceptance rate; the verify math emits the target's
+                # tokens regardless, so output is unchanged.
+                remaining = s["budget"] - len(s["toks"])
+                kcap = min(self._spec_k, remaining - 1)
+                if kcap >= 1:
+                    ctx = np.concatenate(
+                        [s["req"].ids, np.asarray(s["toks"], np.int32)])
+                    drafts = np.asarray(
+                        self._drafter.propose(ctx, kcap),
+                        np.int32).reshape(-1)[:kcap]
+            rows.append({"kind": "step", "slot": i, "seq": s["seq"],
+                         "drafts": drafts, "width": W,
+                         "steps": len(s["toks"]),
+                         "wpos": s["pos"] + len(s["toks"]) - 1})
+        if not rows:
+            return None
+        if self._async and self._carry is None:
+            self._seed_carry()
+        P = 1
+        while P < len(rows):
+            P *= 2
+        # chunk-free rounds (steady-state decode/verify — the common
+        # case) take the WINDOW layout: T = P * W exactly, one pinned
+        # region per row, so the dispatch runs the dense verify-window
+        # trunk instead of paying the mixed-round packed geometry
+        window = all(row["kind"] == "step" for row in rows)
+        if window and self._async:
+            # steady-state fast path: in async mode the whole device
+            # argument set depends only on (slot, seq, drafts) — when
+            # the signature matches the args cache, skip building the
+            # plan arrays altogether (the host planner's inner loop
+            # disappears from the round)
+            akey = (P * W, P, tuple((row["slot"], row["seq"],
+                                     row["drafts"].tobytes())
+                                    for row in rows))
+            if self._args_cache is not None \
+                    and self._args_cache[0] == akey:
+                return {"rows": rows, "T": P * W, "P": P,
+                        "window": True, "akey": akey, "cached": True,
+                        "n_chunk": 0, "n_step": len(rows),
+                        "n_drafts": sum(int(r["drafts"].size)
+                                        for r in rows)}
+        if window:
+            offsets = [r * W for r in range(len(rows))]
+            T = P * W
+        else:
+            off = 0
+            offsets = []
+            for row in rows:
+                offsets.append(off)
+                off += row["width"]
+            T = align  # power-of-two bucket, the chunk-path policy
+            while T < off:
+                T *= 2
+        toks = np.zeros((T,), np.int32)
+        seg = np.zeros((T,), np.int32)
+        pos = np.full((T,), -1, np.int32)
+        carry_map = np.full((T,), -1, np.int32)
+        pos_map = np.full((T,), -1, np.int32)
+        sample_idx = np.zeros((P, K1), np.int32)
+        dlen = np.full((P,), -1, np.int32)
+        row_slot = np.full((P,), -1, np.int32)
+        steps_map = np.full((P,), -1, np.int32)
+        steps = np.zeros((P,), np.int32)
+        emit_rows = [False] * P
+        n_chunk = n_step = n_drafts = 0
+        for r, (row, o) in enumerate(zip(rows, offsets)):
+            i = row["slot"]
+            s = self._slots[i]
+            if row["kind"] == "chunk":
+                n_chunk += 1
+                n = row["n"]
+                start = row["start"]
+                toks[o:o + n] = s["prompt"][start:start + n]
+                seg[o:o + n] = r
+                pos[o:o + n] = np.arange(start, start + n,
+                                         dtype=np.int32)
+                if s["t_pre0"] is None:
+                    s["t_pre0"] = time.perf_counter()
+                sample_idx[r] = o + n - 1  # every readout clamps there
+                if row["done"]:
+                    # token-0 samples HERE: a dlen=0 row at the
+                    # resume-aware base step (0 for a fresh prompt)
+                    dlen[r] = 0
+                    row_slot[r] = i
+                    steps[r] = len(s["toks"])
+                    emit_rows[r] = True
+            else:
+                n_step += 1
+                drafts = row["drafts"]
+                k = int(drafts.size)
+                n_drafts += k
+                seg[o:o + 1 + k] = r
+                toks[o + 1:o + 1 + k] = drafts
+                if self._async:
+                    # decode input token / positions / PRNG base step
+                    # resolve from the device carry: round N's sample
+                    # feeds round N+1 without a host sync
+                    carry_map[o] = i
+                    pos[o:o + 1 + k] = np.arange(0, 1 + k,
+                                                 dtype=np.int32)
+                    pos_map[o:o + 1 + k] = i
+                    steps_map[r] = i
+                else:
+                    toks[o] = s["toks"][-1]
+                    pos[o:o + 1 + k] = np.arange(
+                        row["wpos"], row["wpos"] + 1 + k,
+                        dtype=np.int32)
+                    steps[r] = row["steps"]
+                sample_idx[r] = o + np.minimum(np.arange(K1), k)
+                dlen[r] = k
+                row_slot[r] = i
+                emit_rows[r] = True
+        return {"rows": rows, "T": T, "P": P, "window": window,
+                "toks": toks, "seg": seg,
+                "pos": pos, "carry_map": carry_map, "pos_map": pos_map,
+                "sample_idx": sample_idx, "dlen": dlen,
+                "row_slot": row_slot, "steps_map": steps_map,
+                "steps": steps, "emit_rows": emit_rows,
+                "n_chunk": n_chunk, "n_step": n_step,
+                "n_drafts": n_drafts}
+
+    def _zero_carry_arrays(self):
+        jnp = self._jnp
+        if self._zero_carry is None:
+            z = jnp.zeros((self.max_slots,), jnp.int32)
+            self._zero_carry = (z, z, z)
+        return self._zero_carry
+
+    def _dispatch_round(self, plan):
+        """Run one unified-round dispatch. Host-deterministic slot
+        bookkeeping (fed positions, dispatch counters, proposal
+        accounting) happens here; emissions wait for
+        `_process_round`. Returns the device output triple (vtok,
+        accepted, stopped) or None after a dispatch failure (the
+        plan's slots are failed and freed)."""
+        jnp = self._jnp
+        rows = plan["rows"]
+        # grow every row's table in one atomic call. Async step rows
+        # grow to the host UPPER BOUND on the device write horizon
+        # (the carry may be up to one emitted round ahead), capped by
+        # the admission reservation.
+        updates = []
+        for row in rows:
+            s = self._slots[row["slot"]]
+            if row["kind"] == "chunk":
+                updates.append((row["seq"], row["start"] + row["n"]))
+            else:
+                k = int(row["drafts"].size)
+                # the last known token writes at wpos, drafts at
+                # wpos+1..wpos+k (the split verify's horizon). Async:
+                # the device write front may be one emitted round
+                # ahead of wpos — grow by that bound too, capped at
+                # the admission reservation.
+                need = row["wpos"] + k + 1
+                if self._async:
+                    cap = s["pos"] + s["budget"] + self._overrun
+                    need = min(need + 1 + self._spec_k, cap)
+                updates.append((row["seq"], need))
+        self._recorder.record(
+            "round", packed=plan["T"], rows=len(rows),
+            chunk_rows=plan["n_chunk"], step_rows=plan["n_step"],
+            proposed=plan["n_drafts"],
+            free_blocks=self.cache.available_block_count)
+        try:
+            with _tracing.span(
+                    "round", packed=plan["T"], segments=len(rows),
+                    chunk_rows=plan["n_chunk"],
+                    step_rows=plan["n_step"],
+                    request_ids=[self._slots[row["slot"]]["req"].rid
+                                 for row in rows]):
+                self.cache.ensure_many(updates)
+                if self.enable_prefix_cache and plan["n_chunk"]:
+                    # CoW guard: a chunk starting mid-block in an
+                    # attached (shared or index-claimed) block gets a
+                    # private copy before the dispatch writes into it.
+                    # A copy SWAPS a block id without changing the
+                    # row's block count, so the table cache below
+                    # cannot key on it — drop it for CoW-risk rounds.
+                    for row in rows:
+                        if row["kind"] == "chunk":
+                            self.cache.prepare_write(row["seq"],
+                                                     row["start"])
+                    self._tables_cache = None
+                P = plan["P"]
+                seqs = tuple(rows[r]["seq"] if r < len(rows) else None
+                             for r in range(P))
+                # device-argument reuse: the table matrix changes only
+                # when a row's block count grows, and in ASYNC window
+                # rounds (steady-state decode — no chunk rows, inputs
+                # ride the carry) the ENTIRE plan argument set is
+                # invariant per (slot, seq, drafts) signature — most
+                # rounds then re-dispatch already-uploaded arrays and
+                # the host planner all but vanishes from the round
+                tkey = (seqs, tuple(self.cache.blocks_held(s)
+                                    if s is not None else 0
+                                    for s in seqs))
+                if self._tables_cache is not None \
+                        and self._tables_cache[0] == tkey:
+                    tables = self._tables_cache[1]
+                else:
+                    tables = jnp.asarray(self.cache.table_array(
+                        list(seqs), self._m_width))
+                    self._tables_cache = (tkey, tables)
+                dev = akey = None
+                if plan.get("cached"):
+                    dev = self._args_cache[1]
+                elif self._async and plan["window"]:
+                    akey = (plan["T"], P, tuple(
+                        (row["slot"], row["seq"],
+                         row["drafts"].tobytes()) for row in rows))
+                    if self._args_cache is not None \
+                            and self._args_cache[0] == akey:
+                        dev = self._args_cache[1]
+                if dev is None:
+                    slot_rows = [rows[r]["slot"] if r < len(rows)
+                                 else None for r in range(P)]
+                    sp_args, sp_mode = self._sp_store.unified_args(
+                        slot_rows, plan["emit_rows"], plan["steps"])
+                    dev = {
+                        "toks": jnp.asarray(plan["toks"]),
+                        "seg": jnp.asarray(plan["seg"]),
+                        "pos": jnp.asarray(plan["pos"]),
+                        "sample_idx": jnp.asarray(plan["sample_idx"]),
+                        "dlen": jnp.asarray(plan["dlen"]),
+                        "row_slot": jnp.asarray(plan["row_slot"]),
+                        "carry_map": jnp.asarray(plan["carry_map"]),
+                        "pos_map": jnp.asarray(plan["pos_map"]),
+                        "steps_map": jnp.asarray(plan["steps_map"]),
+                        "sp": sp_args, "mode": sp_mode,
+                    }
+                    if akey is not None:
+                        self._args_cache = (akey, dev)
+                sp_args, sp_mode = dev["sp"], dev["mode"]
+                if sp_mode[1]:
+                    # the penalty count buffer round-trips through the
+                    # dispatch — refresh that one leaf per round
+                    sp_args = dict(sp_args,
+                                   counts=self._sp_store.counts)
+                if self._async:
+                    ct, cp, cs = self._carry
+                else:
+                    ct, cp, cs = self._zero_carry_arrays()
+                (vtok, accepted, stopped, kc, vc, counts, nct, ncp,
+                 ncs) = self._decoder.unified_round(
+                    self._params, dev["toks"], dev["seg"], dev["pos"],
+                    tables, dev["sample_idx"], dev["dlen"],
+                    dev["row_slot"], dev["carry_map"], dev["pos_map"],
+                    dev["steps_map"], ct, cp, cs,
+                    self.cache.k_blocks, self.cache.v_blocks, sp_args,
+                    sp_mode, window=plan["window"])
+        except Exception as e:  # noqa: BLE001 — fan out, drop slots
+            self._engine_exception("unified_round", e,
+                                   [self._slots[row["slot"]]["req"].rid
+                                    for row in rows])
+            self._carry = None
+            for row in rows:
+                s = self._slots[row["slot"]]
+                if s is None or s["seq"] != row["seq"]:
+                    continue
+                if self.cache.has_seq(s["seq"]):
+                    self.cache.free(s["seq"])
+                self._worst.pop(s["seq"], None)
+                s["req"].future.set_exception(e)
+                self._slots[row["slot"]] = None
+                self._sp_store.clear_slot(row["slot"])
+            return None
+        self._sp_store.swap_counts(counts)
+        self.cache.swap_arrays(kc, vc)
+        if self._async:
+            self._carry = (nct, ncp, ncs)
+        self._ops_progress += 1
+        # host-deterministic bookkeeping (valid before any sync): fed
+        # positions advance, dispatch/mode counters, spec proposals
+        replay = 0
+        for row in rows:
+            if row["kind"] != "chunk":
+                continue
+            s = self._slots[row["slot"]]
+            s["fed"] = row["start"] + row["n"]
+            s["chunks"] += 1
+            req = s["req"]
+            if req.resume_ids is not None:
+                # a resumed request's chunk re-feeds already-generated
+                # tokens — decoded work that emits nothing
+                replay += max(0, row["start"] + row["n"]
+                              - max(row["start"], req.ids.size))
+        sampled = bool(sp_mode[0])
+        with self._lock:
+            if plan["n_chunk"]:
+                self._prefill_dispatches += 1
+            if plan["n_step"]:
+                self._steps += 1
+                self._active_integral += plan["n_step"]
+                self._fill_integral += self.cache.block_fill()
+            if sampled:
+                self._sampled_dispatches += 1
+            else:
+                self._fastpath_dispatches += 1
+            if plan["n_drafts"]:
+                self._spec_dispatches += 1
+                self._spec_proposed += plan["n_drafts"]
+                self._spec_rounds_per_slot += sum(
+                    1 for row in rows if row["kind"] == "step"
+                    and row["drafts"].size)
+            if replay:
+                self._decoded_tokens += replay
+                self._replayed_tokens += replay
+        if plan["n_chunk"]:
+            _m_prefill_dispatches.inc()
+        if plan["n_drafts"]:
+            _m_spec_verify.inc()
+            _m_spec_proposed.inc(plan["n_drafts"])
+        (_m_sampling_sampled if sampled else _m_sampling_fast).inc()
+        if replay:
+            _m_decoded.inc(replay)
+            _m_replayed.inc(replay)
+        _m_slots_busy.labels(server="paged").set(
+            sum(s is not None for s in self._slots))
+        self._note_round(1, mixed=bool(plan["n_chunk"]
+                                       and plan["n_step"]))
+        return (vtok, accepted, stopped)
+
+    def _process_round(self, plan, outs):
+        """Sync one unified round's outputs and emit its tokens — the
+        ONLY host<->device sync point of the unified loop (async: runs
+        one round late, while the successor executes). Rows whose slot
+        was freed since planning (async overshoot past a stop the host
+        had not yet seen) are discarded as replay, token-identically
+        to the split path."""
+        vtok_h = np.asarray(outs[0])
+        acc_h = np.asarray(outs[1])
+        stop_h = np.asarray(outs[2])
+        t_now = time.perf_counter()
+        self._ops_progress += 1
+        decoded = 0
+        discarded = 0
+        rolled = 0
+        accepted_n = 0
+        itl_updates = []
+        for r, row in enumerate(plan["rows"]):
+            i = row["slot"]
+            s = self._slots[i]
+            live = s is not None and s["seq"] == row["seq"]
+            if row["kind"] == "chunk":
+                if not row["done"]:
+                    continue
+                decoded += 1
+                if not live:
+                    discarded += 1
+                    continue
+                req = s["req"]
+                if req.ttft is None:
+                    # first token of the request's LIFETIME — a resumed
+                    # request keeps the TTFT of its first residency
+                    req.ttft = t_now - req.t_submit
+                    _m_ttft.observe(req.ttft)
+                    with self._lock:
+                        self._ttft.append(req.ttft)
+                        if req.meta is not None:
+                            lane = req.meta.lane
+                            self._lane_ttft.setdefault(
+                                lane, []).append(req.ttft)
+                            if req.meta.deadline_s is not None:
+                                self._deadline_requests[lane] = \
+                                    self._deadline_requests.get(
+                                        lane, 0) + 1
+                                if req.ttft > req.meta.deadline_s:
+                                    self._deadline_misses[lane] = \
+                                        self._deadline_misses.get(
+                                            lane, 0) + 1
+                                    _m_deadline_miss.labels(
+                                        lane=lane).inc()
+                                    _m_deadline_overage.observe(
+                                        req.ttft - req.meta.deadline_s)
+                if self.enable_prefix_cache:
+                    self.cache.publish_prefix(s["seq"], s["prompt"])
+                _tracing.event("prefill", request_id=req.rid,
+                               ts=s["t_pre0"],
+                               dur=t_now - s["t_pre0"],
+                               prompt_len=int(s["prompt"].size),
+                               seq=s["seq"], chunks=s["chunks"],
+                               cached_tokens=s["cached"])
+                with self._lock:
+                    self._prefills += 1
+                s["t_last"] = t_now
+                self._slot_token(i, int(vtok_h[r, 0]),
+                                 device_stopped=bool(stop_h[r, 0]))
+                continue
+            # decode / verify row
+            a = int(acc_h[r])
+            k_r = int(row["drafts"].size)
+            decoded += k_r + 1
+            if not live:
+                # async overshoot: the device ran one extra round for a
+                # slot the host has since stopped — pure replay, plus
+                # its drafts count as rolled back (conservation:
+                # proposed == accepted + rolled_back)
+                rolled += k_r
+                discarded += 1
+                continue
+            if k_r and not self._async:
+                # rollback FIRST (while the sequence still exists); the
+                # async chain instead overwrites rejected positions at
+                # the next rounds' write front (see docs/SERVING.md)
+                self.cache.truncate_seq(s["seq"],
+                                        row["wpos"] + a + 1)
+            if k_r:
+                rolled += k_r - a
+                accepted_n += a
+                _m_spec_accepted.inc(a)
+                _m_spec_accept_rate.observe(a / k_r)
+                _tracing.event("spec_round", request_id=s["req"].rid,
+                               proposed=k_r, accepted=a,
+                               rolled_back=k_r - a)
+            t_prev = s["t_last"] if s["t_last"] is not None else t_now
+            consumed = 0
+            for jj in range(a + 1):
+                consumed += 1
+                self._slot_token(i, int(vtok_h[r, jj]),
+                                 device_stopped=bool(stop_h[r, jj]))
+                if self._slots[i] is None:  # stopped mid-prefix
+                    break
+            discarded += (a + 1) - consumed
+            if self._slots[i] is not None:
+                self._slots[i]["t_last"] = t_now
+            per = max(t_now - t_prev, 0.0) / consumed
+            lane = (s["req"].meta.lane if s["req"].meta is not None
+                    else None)
+            itl_updates.append((per, consumed, lane))
+            for _ in range(consumed):
+                _m_itl.observe(per)
+        with self._lock:
+            for per, consumed, lane in itl_updates:
+                self._itl.extend([per] * consumed)
+                if lane is not None:
+                    self._lane_itl.setdefault(lane, []).extend(
+                        [per] * consumed)
+            self._decoded_tokens += decoded
+            self._spec_accepted += accepted_n
+            self._spec_rolled_back += rolled
+            if discarded:
+                self._replayed_tokens += discarded
+        _m_decoded.inc(decoded)
+        if rolled:
+            _m_spec_rolled_back.inc(rolled)
+        if discarded:
+            _m_replayed.inc(discarded)
+        _m_goodput.set(self._tokens_out / (self._decoded_tokens or 1))
 
     def _decode_plain(self, active_idx):
         """One plain decode dispatch (k tokens per slot with multi-step
@@ -2100,7 +2885,7 @@ class PagedGenerationServer:
         with self._lock:
             self._steps += 1
             self._active_integral += len(active_idx)
-            self._fill_integral += self.cache.stats()["block_fill"]
+            self._fill_integral += self.cache.block_fill()
             self._decoded_tokens += decoded
         _m_decoded.inc(decoded)
         for i in active_idx:

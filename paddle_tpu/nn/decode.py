@@ -30,6 +30,15 @@ transformer against the block-pool KV cache (inference/kv_cache.py):
     the counter-based PRNG makes the target's token at every step
     deterministic, so rejection sampling reduces to exact match and
     fixed-seed output is token-identical to non-speculative decode.
+  * unified_round — the ONE-KERNEL serving round (r16): prefill chunk
+    rows, plain decode rows and speculative verify regions of a whole
+    scheduler round scored in a SINGLE dispatch over the generic
+    packed trunk (the segment-causal mask generalizes all three), with
+    a slot-indexed device CARRY (next token / write position / PRNG
+    step per slot) that lets the async double-buffered engine loop
+    chain round N's samples into round N+1's decode rows without a
+    host sync.  Subsumes packed_prefill + step + packed_verify, which
+    remain the split path (default OFF in the server, parity-tested).
 
 Sampling (round 10) is PER-SLOT: every program takes a struct-of-arrays
 parameter dict `sp` (paddle_tpu/sampling/buffers.py) — temperature /
@@ -593,6 +602,164 @@ def _jitted_packed_verify(spec, block_size, donate, mode,
 
 
 @functools.lru_cache(maxsize=64)
+def _build_unified_round(spec, block_size, mode, kv_quant=False,
+                         rep_constraint=None, window=False):
+    """The ONE-KERNEL serving round (r16): score a single packed token
+    stream mixing prefill chunk rows, plain decode rows and
+    speculative verify regions — the whole scheduler round — in ONE
+    dispatch over the generic `_packed_trunk` (attention =
+    `ops.unified_stream_attention`, the segment-causal kernel that
+    already generalizes all three row kinds).
+
+    The readout generalizes `_build_packed_verify`: every plan row has
+    up to K1 = K+1 verify positions (`sample_idx` [P, K1]) and `dlen`
+    drafts — a plain decode row is dlen=0 (its one position IS its
+    decode step), a prefill row completing its prompt this round is
+    dlen=0 at base PRNG step len(generated so far), a still-feeding
+    prefill row (or a padding row) is dlen=-1 and emits nothing while
+    its K/V writes land normally.  Acceptance, stop flags and penalty
+    counting are exactly the verify program's — so the unified round
+    is token-identical to the split packed_prefill + step +
+    packed_verify sequence by construction.
+
+    DEVICE CARRY (async double-buffered loop): the round's inputs may
+    be the PREVIOUS round's device outputs, resolved on device so the
+    host never syncs between rounds.  `carry_tok/carry_pos/
+    carry_steps` [S] are slot-indexed arrays from the previous
+    dispatch; `carry_map`/`pos_map` [T] name the slot whose carry
+    value feeds a stream position (-1 = the host-provided
+    toks/pos value; carried `pos` entries hold the offset WITHIN the
+    region, added to the slot's carried write position), and
+    `steps_map` [P] likewise overrides a row's base PRNG step.  The
+    round emits the updated carry: for every emitting row, its slot's
+    next decode input token (the last token emitted this round, stop-
+    truncated), next write position and next PRNG step — chaining
+    round N's samples into round N+1's decode rows entirely on
+    device.  A synchronous unified round passes all maps as -1 and
+    zero carries: the program is then a pure function of the host
+    plan."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..sampling import processors as _proc
+
+    sampled, penalties = mode
+    hp = _layer_helpers(spec)
+    # window=True: the chunk-free round specialization — every plan
+    # row is one pinned W-token region (T = P * W exactly), so the
+    # trunk is `_verify_trunk` and off-TPU attention runs the dense
+    # per-row [P, W] fallback instead of the generic packed fallback's
+    # P-fold cross-row materialization.  The same CPU lesson the r11
+    # verify dispatch learned — and steady-state decode rounds (no
+    # admission churn) are the common case, so they must not pay the
+    # mixed-round geometry.  window=False scores the general mixed
+    # stream (chunk rows + step rows) over `_packed_trunk`.
+    trunk = (_verify_trunk if window else _packed_trunk)(
+        spec, block_size, bool(kv_quant))
+    pin = _rep_pin(rep_constraint)
+
+    def unified_fn(params, toks, seg, pos, tables, sample_idx, dlen,
+                   row_slot, carry_map, pos_map, steps_map, carry_tok,
+                   carry_pos, carry_steps, kc, vc, sp):
+        """Returns (vtok [P, K1], accepted [P], stopped [P, K1], kc,
+        vc, counts|None, carry_tok [S], carry_pos [S],
+        carry_steps [S])."""
+        P, K1 = sample_idx.shape
+        S = carry_tok.shape[0]
+        # resolve device-carried inputs (sync rounds: every map is -1
+        # and the where is the identity on the host plan)
+        cm = jnp.clip(carry_map, 0, S - 1)
+        toks_eff = jnp.where(carry_map >= 0, carry_tok[cm], toks)
+        pm = jnp.clip(pos_map, 0, S - 1)
+        pos_eff = jnp.where(pos_map >= 0, carry_pos[pm] + pos, pos)
+        x, kc, vc = trunk(params, toks_eff, seg, pos_eff, tables, kc,
+                          vc)
+        _embed, head = hp.make_embed_head(
+            params, params["ln_f.weight"].dtype)
+        xf = x[sample_idx.reshape(-1)]                    # [P*K1, E]
+        xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
+        logits = pin(head(xf))                            # [P*K1, V]
+        fed = toks_eff[sample_idx]                        # [P, K1]
+        j = jnp.arange(K1)[None, :]
+        draft_valid = (j >= 1) & (j <= dlen[:, None])     # real drafts
+        row_valid = dlen >= 0
+        sm = jnp.clip(steps_map, 0, S - 1)
+        spf = {"stop": jnp.repeat(sp["stop"], K1, axis=0)}
+        if sampled:
+            for col in ("temperature", "top_k", "top_p", "min_p",
+                        "seeds", "sample"):
+                spf[col] = jnp.repeat(sp[col], K1, axis=0)
+            # position j is generation step base+j — the SAME counter a
+            # plain decode step (or the split verify) would fold in, so
+            # fixed-seed output is invariant to the round fusion
+            base = jnp.where(steps_map >= 0, carry_steps[sm],
+                             sp["steps"])
+            spf["steps"] = (base[:, None]
+                            + jnp.arange(K1)[None, :]).reshape(-1)
+        else:
+            base = jnp.zeros((P,), jnp.int32)
+        if penalties:
+            for col in ("rep", "pres", "freq"):
+                spf[col] = jnp.repeat(sp[col], K1, axis=0)
+            # position j's "text so far" includes drafts 1..j (they ARE
+            # the emitted tokens whenever position j's verdict matters)
+            bc = sp["counts"][sp["crows"]]                # [P, V]
+            V = bc.shape[-1]
+            oh = jax.nn.one_hot(fed, V, dtype=jnp.int32) \
+                * draft_valid[..., None].astype(jnp.int32)
+            spf["counts"] = (bc[:, None]
+                             + jnp.cumsum(oh, axis=1)).reshape(P * K1, V)
+        tok = _proc.sample_tokens(logits, spf, sampled=sampled,
+                                  penalties=penalties)
+        vtok = tok.reshape(P, K1)
+        stopped = _proc.check_stops(
+            tok, spf["stop"], jnp.repeat(row_valid, K1)).reshape(P, K1)
+        matches = (fed[:, 1:] == vtok[:, :-1]) & draft_valid[:, 1:]
+        accepted = jnp.cumprod(matches.astype(jnp.int32),
+                               axis=1).sum(axis=1).astype(jnp.int32)
+        # emitted positions: the accepted prefix plus the bonus token,
+        # truncated after the first stop — exactly the tokens the host
+        # reads out (and the split path would have emitted)
+        sint = stopped.astype(jnp.int32)
+        stop_before = jnp.cumsum(sint, axis=1) - sint
+        emit = (j <= accepted[:, None]) & (stop_before == 0) \
+            & row_valid[:, None]
+        counts = None
+        if penalties:
+            counts = _proc.update_counts(
+                sp["counts"], jnp.repeat(sp["crows"], K1), tok,
+                emit.reshape(-1))
+        # device carry for the NEXT round: per emitting row, the
+        # slot's next decode input (last emitted token), next write
+        # position and next PRNG step. Rows that emit nothing (feeding
+        # prefill, pads) and slots with no row pass through unchanged,
+        # so carry values persist across rounds that skip a slot.
+        emit_n = emit.sum(axis=1)                          # >= 1 valid
+        last = vtok[jnp.arange(P), jnp.maximum(emit_n - 1, 0)]
+        p0 = pos_eff[sample_idx[:, 0]]
+        upd = row_valid & (row_slot >= 0)
+        # out-of-range index = dropped scatter: masked rows touch nothing
+        si = jnp.where(upd, jnp.clip(row_slot, 0, S - 1), S)
+        carry_tok = carry_tok.at[si].set(last, mode="drop")
+        carry_pos = carry_pos.at[si].set(p0 + emit_n, mode="drop")
+        carry_steps = carry_steps.at[si].set(base + emit_n, mode="drop")
+        return (vtok, accepted, stopped, kc, vc, counts, carry_tok,
+                carry_pos, carry_steps)
+
+    return unified_fn
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_unified_round(spec, block_size, donate, mode,
+                          kv_quant=False, window=False):
+    import jax
+
+    fn = _build_unified_round(spec, block_size, mode, kv_quant,
+                              window=window)
+    return jax.jit(fn, donate_argnums=(14, 15) if donate else ())
+
+
+@functools.lru_cache(maxsize=64)
 def _jitted_paged_fns(spec, block_size, return_logits, donate, mode,
                       kv_quant=False):
     import jax
@@ -628,6 +795,10 @@ def _sharded_jits(spec, block_size, return_logits, donate, mode,
                                       mode, kv_quant, rep)
     verify_fn = _build_packed_verify(spec, block_size, mode, kv_quant,
                                      rep)
+    unified_fn = _build_unified_round(spec, block_size, mode, kv_quant,
+                                      rep)
+    uniwin_fn = _build_unified_round(spec, block_size, mode, kv_quant,
+                                     rep, window=True)
     tail = (rep,) if return_logits else ()
     out5 = (rep, rep, kv, kv, rep) + tail
     prefill = jax.jit(
@@ -645,7 +816,13 @@ def _sharded_jits(spec, block_size, return_logits, donate, mode,
         in_shardings=(pr, rep, rep, rep, rep, rep, rep, kv, kv, rep),
         out_shardings=(rep, rep, rep, kv, kv, rep),
         donate_argnums=(7, 8) if donate else ())
-    return prefill, step, packed, verify
+    ush = dict(
+        in_shardings=(pr,) + (rep,) * 13 + (kv, kv, rep),
+        out_shardings=(rep, rep, rep, kv, kv, rep, rep, rep, rep),
+        donate_argnums=(14, 15) if donate else ())
+    unified = jax.jit(unified_fn, **ush)
+    uniwin = jax.jit(uniwin_fn, **ush)
+    return prefill, step, packed, verify, unified, uniwin
 
 
 @functools.lru_cache(maxsize=64)
@@ -786,8 +963,9 @@ class PagedDecoder:
         return getattr(self._shardings, "shard_label", "mesh")
 
     def _variant(self, mode):
-        """(prefill, step, packed_prefill, packed_verify)
-        tracing-wrapped jitted fns for one static sampling mode.
+        """(prefill, step, packed_prefill, packed_verify,
+        unified_round, unified_round_window) tracing-wrapped jitted
+        fns for one static sampling mode.
         Dispatch-boundary spans (ISSUE 2): when tracing is on, every
         jitted call shows up as its own span — the device-side cost
         inside a request's prefill/decode phases; when off, the wrapper
@@ -802,7 +980,8 @@ class PagedDecoder:
             from ..observability import tracing as _tracing
 
             if self._shardings is not None:
-                prefill, step, packed, verify = _sharded_jits(
+                (prefill, step, packed, verify, unified,
+                 uniwin) = _sharded_jits(
                     self.spec, self.block_size, self.return_logits,
                     self._donate, mode, self._kv_quant,
                     self._shardings)
@@ -816,6 +995,12 @@ class PagedDecoder:
                 verify = _jitted_packed_verify(
                     self.spec, self.block_size, self._donate, mode,
                     self._kv_quant)
+                unified = _jitted_unified_round(
+                    self.spec, self.block_size, self._donate, mode,
+                    self._kv_quant)
+                uniwin = _jitted_unified_round(
+                    self.spec, self.block_size, self._donate, mode,
+                    self._kv_quant, window=True)
             sh = self._shard_label
             v = (_tracing.wrap("prefill_dispatch",
                                _ct.wrap("prefill", prefill, sh)),
@@ -824,7 +1009,11 @@ class PagedDecoder:
                  _tracing.wrap("packed_prefill_dispatch",
                                _ct.wrap("packed_prefill", packed, sh)),
                  _tracing.wrap("verify_dispatch",
-                               _ct.wrap("packed_verify", verify, sh)))
+                               _ct.wrap("packed_verify", verify, sh)),
+                 _tracing.wrap("unified_round_dispatch",
+                               _ct.wrap("unified_round", unified, sh)),
+                 _tracing.wrap("unified_round_dispatch",
+                               _ct.wrap("unified_round", uniwin, sh)))
             self._variants[mode] = v
         return v
 
@@ -856,6 +1045,22 @@ class PagedDecoder:
         self._check_kv(kc, vc)
         return self._variant(mode)[3](params, toks, seg, pos, tables,
                                       sample_idx, dlen, kc, vc, sp)
+
+    def unified_round(self, params, toks, seg, pos, tables, sample_idx,
+                      dlen, row_slot, carry_map, pos_map, steps_map,
+                      carry_tok, carry_pos, carry_steps, kc, vc, sp,
+                      mode=GREEDY_MODE, window=False):
+        """The one-kernel serving round (see _build_unified_round):
+        prefill chunk rows, decode rows and speculative verify regions
+        in ONE dispatch, with optional device-carried inputs for the
+        async double-buffered loop. window=True selects the chunk-free
+        specialization (pinned T = P * W regions over the dense
+        verify-window trunk)."""
+        self._check_kv(kc, vc)
+        return self._variant(mode)[5 if window else 4](
+            params, toks, seg, pos, tables, sample_idx, dlen, row_slot,
+            carry_map, pos_map, steps_map, carry_tok, carry_pos,
+            carry_steps, kc, vc, sp)
 
     def multistep(self, n_steps, mode=GREEDY_MODE):
         """Fused n-token decode (see _build_multistep)."""

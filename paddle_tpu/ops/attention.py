@@ -235,11 +235,10 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     sc = (Dh ** -0.5) if scale is None else scale
     if _on_tpu():
         try:
-            from .pallas.ragged_prefill import (Q_TILE,
-                                                ragged_prefill_attention_kernel,
-                                                supported_shapes)
+            from .pallas.unified_attention import (
+                Q_TILE, supported_shapes, unified_ragged_attention_kernel)
             if supported_shapes(Dh, BS, H, T):
-                return ragged_prefill_attention_kernel(
+                return unified_ragged_attention_kernel(
                     q, k_blocks, v_blocks, block_tables,
                     seg[::Q_TILE], pos[::Q_TILE], scale=float(sc))
         except Exception as e:  # noqa: BLE001
@@ -274,6 +273,29 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     if quant:  # per-VALUE scale rides the prob tensor
         w = w * vs[:, None].astype(q.dtype)
     return jnp.einsum("htbc,hbcd->htd", w, v).transpose(1, 0, 2)
+
+
+def unified_stream_attention(q, k_blocks, v_blocks, block_tables, seg,
+                             pos, scale=None):
+    """Unified serving-round attention (one-kernel round, r16): score a
+    single packed token stream containing MIXED prefill chunks, plain
+    decode rows and speculative verify regions in one launch.
+
+    The insight of the merge (Ragged Paged Attention direction) is
+    that the segment-causal contract already generalizes all three row
+    kinds: a prefill chunk is n stream tokens at positions
+    start..start+n-1, a decode row is 1 token at its write position,
+    and a verify region is [last_token, draft_1..k] — in every case
+    token t attends exactly its own sequence's cache positions
+    [0, pos[t]].  So the unified op IS `ragged_prefill_attention` on
+    the round's combined stream: the Pallas stream kernel
+    (ops/pallas/unified_attention.py) on TPU, the row-gathered
+    head-major XLA fallback elsewhere.  This alias exists as the
+    documented entry point of the unified decode program
+    (`nn.decode` `unified_round`); the argument contract is exactly
+    `ragged_prefill_attention`'s."""
+    return ragged_prefill_attention(q, k_blocks, v_blocks, block_tables,
+                                    seg, pos, scale=scale)
 
 
 def verify_window_attention(q, k_blocks, v_blocks, block_tables, pos,

@@ -1,198 +1,19 @@
-"""Paged decode attention — Pallas TPU kernel (ragged, block-table driven).
+"""Paged decode attention — compatibility shim (r16).
 
-Single-token decode attention over a paged KV cache (Ragged Paged
-Attention, arXiv:2604.15464 direction): each sequence's keys/values live
-in pool blocks named by a per-sequence block table, so the kernel
-gathers by table instead of assuming one contiguous cache slab.
-
-Layout (matches inference/kv_cache.py):
-    q:        [B, H, Dh]                  one new token per sequence
-    k_blocks: [N, BS, H, Dh]              one layer's pool
-    tables:   [B, M] int32                block ids, 0-padded (trash)
-    ctx_lens: [B]    int32                tokens visible to the query
-
-Grid is (B, M) with the block tables SCALAR-PREFETCHED: the k/v
-BlockSpec index_map reads `tables[b, m]`, so the pipeline DMAs exactly
-the pool blocks the table names — the gather never materializes a
-[B, M*BS, ...] copy in HBM the way the XLA gather path does. Blocks past
-a sequence's length still occupy grid steps (they stream the shared
-trash block and are predicated off) — raggedness saves the gather
-traffic and the compute, not the grid iterations.
-
-Heads ride the sublane axis (the query is a single token): scores for
-one (sequence, block) step are an [H, BS] tile from a head-batched
-dot over Dh, and online-softmax state (m, l, acc) is carried in VMEM
-scratch across the M dimension exactly like flash_attention.py.
+The kernel moved into `unified_attention.py` when the serving round was
+collapsed to one launch: the one-token-per-sequence decode kernel is
+the (B, M)-grid specialization of the unified segment-causal stream
+kernel, and the two share the scalar-prefetched block-index
+construction and the int8-KV in-VMEM dequant there.  This module keeps
+the historical import path and names.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-
-try:
-    from jax.experimental.pallas import tpu as pltpu
-    _HAS_TPU_PALLAS = True
-except ImportError:  # pragma: no cover
-    pltpu = None
-    _HAS_TPU_PALLAS = False
-
-NEG_INF = -1e30
-STAT_LANES = 8  # m/l row stats broadcast over 8 lanes for (8,128) tiling
-
-
-def supported_shapes(head_dim, block_size, num_heads):
-    """Shape gate for the compiled TPU kernel (interpret mode takes any)."""
-    return (head_dim in (32, 64, 128, 256) and block_size % 128 == 0
-            and num_heads % 8 == 0)
-
-
-def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, scale, nm):
-    b = pl.program_id(0)
-    mi = pl.program_id(1)
-
-    @pl.when(mi == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-
-    ctx = lens_ref[b]
-    bs = k_ref.shape[1]
-
-    @pl.when(mi * bs < ctx)
-    def _compute():
-        q = q_ref[0]  # [H, Dh] — input dtype feeds the MXU at full rate
-        k = k_ref[0]  # [BS, H, Dh]
-        v = v_ref[0]
-        # s[h, t] = sum_d q[h, d] * k[t, h, d): batch over heads
-        s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * scale  # [H, BS]
-        pos = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx, s, NEG_INF)
-        m_prev = m_ref[:, 0:1]
-        l_prev = l_ref[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        # o[h, d] += sum_t p[h, t] * v[t, h, d]: same head-batched form
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)  # [H, Dh]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    @pl.when(mi == nm - 1)
-    def _flush():
-        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-
-
-def _kernel_quant(tables_ref, lens_ref, q_ref, k_ref, ks_ref, v_ref,
-                  vs_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, nm):
-    """int8-KV variant (quantized-serving round): the pool streams as
-    raw int8 codes + per-vector scales; dequantization happens HERE in
-    VMEM on the one block in flight — the bf16 cache never exists in
-    HBM, which is the entire point (decode is cache-READ bound)."""
-    b = pl.program_id(0)
-    mi = pl.program_id(1)
-
-    @pl.when(mi == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-
-    ctx = lens_ref[b]
-    bs = k_ref.shape[1]
-
-    @pl.when(mi * bs < ctx)
-    def _compute():
-        q = q_ref[0]  # [H, Dh]
-        dt = q.dtype
-        # per-vector dequant on the VMEM-resident block: [BS, H, Dh]
-        # codes * [BS, H, 1] scales — elementwise, lane-layout friendly
-        k = k_ref[0].astype(dt) * ks_ref[0][..., None].astype(dt)
-        v = v_ref[0].astype(dt) * vs_ref[0][..., None].astype(dt)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * scale  # [H, BS]
-        pos = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx, s, NEG_INF)
-        m_prev = m_ref[:, 0:1]
-        l_prev = l_ref[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)  # [H, Dh]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    @pl.when(mi == nm - 1)
-    def _flush():
-        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "interpret"))
-def paged_decode_attention_kernel(q, k_blocks, v_blocks, tables, ctx_lens,
-                                  *, scale=None, interpret=False):
-    """Pallas ragged paged decode attention. See module docstring for the
-    layout; returns [B, H, Dh] in q's dtype. k_blocks/v_blocks may be
-    `QuantizedKV` (codes [N, BS, H, Dh] int8, scales [N, BS, H]) — the
-    scale tiles ride the same scalar-prefetched block index as their
-    codes and dequant happens in VMEM (`_kernel_quant`)."""
-    quant = hasattr(k_blocks, "codes")
-    B, H, Dh = q.shape
-    kcodes = k_blocks.codes if quant else k_blocks
-    _, BS, _, _ = kcodes.shape
-    M = tables.shape[1]
-    scale = (Dh ** -0.5) if scale is None else float(scale)
-
-    kv_spec = pl.BlockSpec((1, BS, H, Dh),
-                           lambda b, m, tab, cl: (tab[b, m], 0, 0, 0))
-    sc_spec = pl.BlockSpec((1, BS, H),
-                           lambda b, m, tab, cl: (tab[b, m], 0, 0))
-    if quant:
-        in_specs = [
-            pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
-            kv_spec, sc_spec, kv_spec, sc_spec,
-        ]
-        kernel = functools.partial(_kernel_quant, scale=scale, nm=M)
-        operands = (q, k_blocks.codes, k_blocks.scales,
-                    v_blocks.codes, v_blocks.scales)
-    else:
-        in_specs = [
-            pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
-            kv_spec, kv_spec,
-        ]
-        kernel = functools.partial(_kernel, scale=scale, nm=M)
-        operands = (q, k_blocks, v_blocks)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # tables, ctx_lens steer the DMA pipeline
-        grid=(B, M),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((H, Dh), jnp.float32),
-            pltpu.VMEM((H, STAT_LANES), jnp.float32),
-            pltpu.VMEM((H, STAT_LANES), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
-        interpret=interpret,
-    )(tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), *operands)
+from .unified_attention import (  # noqa: F401
+    _HAS_TPU_PALLAS,
+    NEG_INF,
+    STAT_LANES,
+    paged_decode_attention_kernel,
+    pltpu,
+    supported_shapes,
+)

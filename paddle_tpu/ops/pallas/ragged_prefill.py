@@ -1,217 +1,24 @@
-"""Packed ragged prefill attention — Pallas TPU kernel (segment-causal,
-block-table driven).
+"""Packed ragged prefill attention — compatibility shim (r16).
 
-The serving scheduler concatenates every admitted prompt chunk this
-round into ONE token-packed stream (Ragged Paged Attention,
-arXiv:2604.15464 direction; Sarathi-style chunked prefill bounds the
-per-dispatch token budget). Each packed token attends its OWN sequence's
-paged-cache positions [0, pos] — which covers both the tokens this chunk
-just wrote and the K/V that earlier chunks of the same prompt left in
-the paged blocks, so chunked prefill needs no extra state carrier.
-
-Layout (matches inference/kv_cache.py):
-    q:        [T, H, Dh]              packed query stream
-    k_blocks: [N, BS, H, Dh]          one layer's pool
-    tables:   [B, M] int32            block ids per slot row, 0-padded
-    tile_seg: [T // QT] int32         slot row of each query tile
-    tile_pos: [T // QT] int32         absolute cache position of each
-                                      tile's first token; -1 = pad tile
-
-Packing contract: the scheduler aligns every segment's packed region to
-the QT=128 query tile, so ONE tile never mixes segments — that keeps
-the grid a plain (num_q_tiles, M) with the per-tile segment and start
-position SCALAR-PREFETCHED, the same trick the decode kernel uses: the
-k/v BlockSpec index map reads `tables[tile_seg[qi], m]`, so the
-pipeline DMAs exactly the pool blocks each tile's sequence names and
-never materializes the [T, M*BS, ...] gather copy the XLA fallback
-builds. KV blocks past a tile's causal horizon (and pad tiles) still
-occupy grid steps but are predicated off.
-
-Per (tile, kv-block) step the score tile is [H, QT, BS] from a
-head-batched dot over Dh; online-softmax state (m, l, acc) rides VMEM
-scratch across the M dimension exactly like paged_attention.py, with
-the extra QT query axis on the lanes.
+The kernel moved into `unified_attention.py` when the serving round was
+collapsed to one launch: segment-causal attention over a token-packed
+stream is the SAME program whether the segments are prefill chunks,
+plain decode rows or speculative verify regions, so the former
+per-case kernel copies (and their copy-pasted scalar-prefetch
+block-index construction) live once there.  This module keeps the
+historical import path and names.
 """
 from __future__ import annotations
 
-import functools
+from .unified_attention import (  # noqa: F401
+    _HAS_TPU_PALLAS,
+    NEG_INF,
+    Q_TILE,
+    pltpu,
+    supported_shapes,
+    unified_ragged_attention_kernel,
+)
 
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-
-try:
-    from jax.experimental.pallas import tpu as pltpu
-    _HAS_TPU_PALLAS = True
-except ImportError:  # pragma: no cover
-    pltpu = None
-    _HAS_TPU_PALLAS = False
-
-NEG_INF = -1e30
-Q_TILE = 128  # query-tile (and packing alignment) size
-
-
-def supported_shapes(head_dim, block_size, num_heads, total_tokens):
-    """Shape gate for the compiled TPU kernel (interpret mode takes any)."""
-    return (head_dim in (32, 64, 128, 256) and block_size % 128 == 0
-            and num_heads % 8 == 0 and total_tokens % Q_TILE == 0)
-
-
-def _kernel(tile_seg_ref, tile_pos_ref, tables_ref, q_ref, k_ref, v_ref,
-            o_ref, acc_ref, m_ref, l_ref, *, scale, nm, qt):
-    qi = pl.program_id(0)
-    mi = pl.program_id(1)
-
-    @pl.when(mi == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-
-    q0 = tile_pos_ref[qi]  # abs position of the tile's first query; -1 pad
-    bs = k_ref.shape[1]
-
-    # a kv block matters iff it starts at or before the tile's LAST
-    # query's causal horizon; pad tiles (q0 < 0) skip every block
-    @pl.when((q0 >= 0) & (mi * bs <= q0 + qt - 1))
-    def _compute():
-        q = q_ref[:]  # [H, QT, Dh] — input dtype feeds the MXU full-rate
-        k = k_ref[0]  # [BS, H, Dh]
-        v = v_ref[0]
-        # s[h, i, j] = sum_d q[h, i, d] * k[j, h, d]: batch over heads
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * scale  # [H, QT, BS]
-        row = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        col = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(col <= row, s, NEG_INF)  # segment-causal by abs pos
-        m_prev = m_ref[:]                       # [H, QT]
-        l_prev = l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
-        p = jnp.exp(s - m_new[:, :, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=2)
-        # o[h, i, d] += sum_j p[h, i, j] * v[j, h, d]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)  # [H, QT, Dh]
-        acc_ref[:] = acc_ref[:] * alpha[:, :, None] + pv
-        m_ref[:] = m_new
-
-    @pl.when(mi == nm - 1)
-    def _flush():
-        l = jnp.maximum(l_ref[:], 1e-30)  # pad tiles flush zeros
-        o_ref[:] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
-
-
-def _kernel_quant(tile_seg_ref, tile_pos_ref, tables_ref, q_ref, k_ref,
-                  ks_ref, v_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
-                  *, scale, nm, qt):
-    """int8-KV variant (quantized-serving round): the block pool
-    streams as raw int8 codes + per-vector scales and is dequantized
-    HERE on the VMEM-resident block — no bf16 cache copy in HBM."""
-    qi = pl.program_id(0)
-    mi = pl.program_id(1)
-
-    @pl.when(mi == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-
-    q0 = tile_pos_ref[qi]
-    bs = k_ref.shape[1]
-
-    @pl.when((q0 >= 0) & (mi * bs <= q0 + qt - 1))
-    def _compute():
-        q = q_ref[:]  # [H, QT, Dh]
-        dt = q.dtype
-        k = k_ref[0].astype(dt) * ks_ref[0][..., None].astype(dt)
-        v = v_ref[0].astype(dt) * vs_ref[0][..., None].astype(dt)
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * scale  # [H, QT, BS]
-        row = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        col = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(col <= row, s, NEG_INF)
-        m_prev = m_ref[:]
-        l_prev = l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
-        p = jnp.exp(s - m_new[:, :, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=2)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)  # [H, QT, Dh]
-        acc_ref[:] = acc_ref[:] * alpha[:, :, None] + pv
-        m_ref[:] = m_new
-
-    @pl.when(mi == nm - 1)
-    def _flush():
-        l = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[:] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "q_tile", "interpret"))
-def ragged_prefill_attention_kernel(q, k_blocks, v_blocks, tables,
-                                    tile_seg, tile_pos, *, scale=None,
-                                    q_tile=None, interpret=False):
-    """Pallas packed ragged prefill attention. See module docstring for
-    the layout and packing contract; returns [T, H, Dh] in q's dtype.
-    k_blocks/v_blocks may be `QuantizedKV` (codes [N, BS, H, Dh] int8,
-    scales [N, BS, H]) — the scale tiles ride the same
-    scalar-prefetched block index as their codes and dequant happens in
-    VMEM (`_kernel_quant`). q_tile defaults to the production
-    Q_TILE=128 (interpret-mode tests shrink it to exercise tiny
-    shapes)."""
-    quant = hasattr(k_blocks, "codes")
-    qt = Q_TILE if q_tile is None else int(q_tile)
-    T, H, Dh = q.shape
-    kcodes = k_blocks.codes if quant else k_blocks
-    _, BS, _, _ = kcodes.shape
-    M = tables.shape[1]
-    if T % qt:
-        raise ValueError(f"packed length {T} not a multiple of the "
-                         f"query tile {qt}")
-    NQ = T // qt
-    scale = (Dh ** -0.5) if scale is None else float(scale)
-
-    qh = q.transpose(1, 0, 2)  # [H, T, Dh]: heads ride the sublane axis
-    q_spec = pl.BlockSpec((H, qt, Dh),
-                          lambda qi, m, ts, tp, tb: (0, qi, 0))
-    kv_spec = pl.BlockSpec(
-        (1, BS, H, Dh),
-        lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0, 0))
-    sc_spec = pl.BlockSpec(
-        (1, BS, H), lambda qi, m, ts, tp, tb: (tb[ts[qi], m], 0, 0))
-    if quant:
-        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec]
-        kernel = functools.partial(_kernel_quant, scale=scale, nm=M,
-                                   qt=qt)
-        operands = (qh, k_blocks.codes, k_blocks.scales,
-                    v_blocks.codes, v_blocks.scales)
-    else:
-        in_specs = [q_spec, kv_spec, kv_spec]
-        kernel = functools.partial(_kernel, scale=scale, nm=M, qt=qt)
-        operands = (qh, k_blocks, v_blocks)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # tile_seg, tile_pos, tables steer the DMA
-        grid=(NQ, M),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((H, qt, Dh),
-                               lambda qi, m, ts, tp, tb: (0, qi, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((H, qt, Dh), jnp.float32),
-            pltpu.VMEM((H, qt), jnp.float32),
-            pltpu.VMEM((H, qt), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((H, T, Dh), q.dtype),
-        interpret=interpret,
-    )(tile_seg.astype(jnp.int32), tile_pos.astype(jnp.int32),
-      tables.astype(jnp.int32), *operands)
-    return out.transpose(1, 0, 2)
+# historical name: the packed-prefill dispatch is one caller of the
+# unified stream kernel
+ragged_prefill_attention_kernel = unified_ragged_attention_kernel

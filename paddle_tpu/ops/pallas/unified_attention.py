@@ -1,0 +1,332 @@
+"""Unified ragged paged attention — Pallas TPU kernels over the block
+pool, block-table driven (one-kernel serving round, r16).
+
+This module is the MERGE of the former `ragged_prefill.py` and
+`paged_attention.py` kernels (both files remain as thin re-export
+shims). It holds:
+
+  * the STREAM kernel (`unified_ragged_attention_kernel`) — segment-
+    causal attention for a token-packed multi-sequence stream where
+    every token attends its OWN sequence's paged-cache positions
+    [0, pos].  That one mask generalizes every query shape the serving
+    round produces: a prefill chunk (n tokens at positions
+    start..start+n-1), a plain decode row (1 token at its write
+    position) and a speculative verify region ([last_token,
+    draft_1..k]) are all just ragged segments of the same stream, so a
+    scheduler round mixing all three is ONE launch of this kernel;
+  * the DECODE kernel (`paged_decode_attention_kernel`) — the
+    one-token-per-sequence specialization (grid (B, M), heads on the
+    sublane axis) kept for the standalone `step`/offline paths, which
+    skips the stream kernel's query-tile alignment cost when every
+    sequence contributes exactly one token.
+
+Shared machinery (deduplicated here — the per-kernel copies are gone):
+
+  * `kv_operand_specs` — the scalar-prefetched block-index BlockSpec
+    construction: the k/v (and int8 scale) index maps read
+    `tables[row, m]` from a prefetched table, so the pipeline DMAs
+    exactly the pool blocks each query's sequence names and never
+    materializes the [.., M*BS, ...] gather copy the XLA fallback
+    builds.  Scale tiles ride the SAME prefetched index as their
+    codes.
+  * `_load_kv` — the int8-KV dequant (quantized-serving round): pools
+    may be `QuantizedKV` (codes [N, BS, H, Dh] int8 + per-vector
+    scales [N, BS, H]); dequantization happens HERE on the
+    VMEM-resident block in flight, so a bf16 copy of the cache never
+    exists in HBM.
+  * one online-softmax kernel body per query geometry instead of the
+    former dense/quant copy-pair per file (4 kernel bodies -> 2).
+
+Layout (matches inference/kv_cache.py):
+    q:        [T, H, Dh] stream / [B, H, Dh] decode
+    k_blocks: [N, BS, H, Dh]             one layer's pool
+    tables:   [B, M] int32               block ids, 0-padded (trash)
+    tile_seg: [T // QT] int32            slot row of each query tile
+    tile_pos: [T // QT] int32            abs cache position of each
+                                         tile's first token; -1 = pad
+    ctx_lens: [B] int32                  decode: tokens visible per row
+
+Stream packing contract: the scheduler aligns every segment's packed
+region to the QT=128 query tile, so ONE tile never mixes segments —
+that keeps the grid a plain (num_q_tiles, M) with the per-tile segment
+and start position scalar-prefetched.  KV blocks past a tile's causal
+horizon (and pad tiles) still occupy grid steps but are predicated
+off — raggedness saves the gather traffic and the compute, not the
+grid iterations.
+
+Per (tile, kv-block) step the score tile is [H, QT, BS] from a
+head-batched dot over Dh; online-softmax state (m, l, acc) rides VMEM
+scratch across the M dimension exactly like flash_attention.py, with
+the extra QT query axis on the lanes (decode: QT folded away, row
+stats broadcast over STAT_LANES for (8, 128) tiling).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_TPU_PALLAS = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_TPU_PALLAS = False
+
+NEG_INF = -1e30
+Q_TILE = 128    # stream query-tile (and packing alignment) size
+STAT_LANES = 8  # decode m/l row stats broadcast for (8, 128) tiling
+
+
+def supported_shapes(head_dim, block_size, num_heads, total_tokens=None):
+    """Shape gate for the compiled TPU kernels (interpret mode takes
+    any): head_dim lane-sized, block_size a lane multiple, heads
+    sublane-aligned; the stream kernel additionally requires the packed
+    length to be query-tile aligned."""
+    ok = (head_dim in (32, 64, 128, 256) and block_size % 128 == 0
+          and num_heads % 8 == 0)
+    if total_tokens is not None:
+        ok = ok and total_tokens % Q_TILE == 0
+    return ok
+
+
+def is_quantized(kv):
+    """Duck-typed inference.kv_quant.QuantizedKV check (no import — the
+    kernel layer must not pull the inference package)."""
+    return hasattr(kv, "codes") and hasattr(kv, "scales")
+
+
+def kv_operand_specs(BS, H, Dh, quant, block_id):
+    """The ONE scalar-prefetched block-index construction both kernels
+    steer their DMA pipeline with (formerly copy-pasted per kernel):
+    `block_id(*grid_and_prefetch_refs) -> pool block` feeds the k/v
+    BlockSpec index maps, and for int8 pools the per-vector scale tiles
+    ride the SAME index as their codes.  Returns the in_specs list for
+    (k[, ks], v[, vs])."""
+    kv = pl.BlockSpec((1, BS, H, Dh),
+                      lambda *a: (block_id(*a), 0, 0, 0))
+    if not quant:
+        return [kv, kv]
+    sc = pl.BlockSpec((1, BS, H), lambda *a: (block_id(*a), 0, 0))
+    return [kv, sc, kv, sc]
+
+
+def kv_operands(k_blocks, v_blocks):
+    """(quant, operand tuple) for a dense or QuantizedKV pool pair —
+    the argument-flattening half of `kv_operand_specs`."""
+    if is_quantized(k_blocks):
+        return True, (k_blocks.codes, k_blocks.scales,
+                      v_blocks.codes, v_blocks.scales)
+    return False, (k_blocks, v_blocks)
+
+
+def _load_kv(ref, sref, dt):
+    """One pool block from VMEM, dequantized in place when the pool is
+    int8 (codes * per-vector scales — elementwise, lane-layout
+    friendly).  The int8->dt convert happens on the ONE block in
+    flight; no bf16 cache copy ever exists in HBM."""
+    x = ref[0]
+    if sref is None:
+        return x
+    return x.astype(dt) * sref[0][..., None].astype(dt)
+
+
+# ---- stream kernel (prefill chunks / decode rows / verify regions) ----
+
+def _stream_kernel(tile_seg_ref, tile_pos_ref, tables_ref, q_ref,
+                   *refs, scale, nm, qt, quant):
+    if quant:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
+    qi = pl.program_id(0)
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q0 = tile_pos_ref[qi]  # abs position of the tile's first query; -1 pad
+    bs = k_ref.shape[1]
+
+    # a kv block matters iff it starts at or before the tile's LAST
+    # query's causal horizon; pad tiles (q0 < 0) skip every block
+    @pl.when((q0 >= 0) & (mi * bs <= q0 + qt - 1))
+    def _compute():
+        q = q_ref[:]  # [H, QT, Dh] — input dtype feeds the MXU full-rate
+        k = _load_kv(k_ref, ks_ref, q.dtype)  # [BS, H, Dh]
+        v = _load_kv(v_ref, vs_ref, q.dtype)
+        # s[h, i, j] = sum_d q[h, i, d] * k[j, h, d]: batch over heads
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [H, QT, BS]
+        row = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        col = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(col <= row, s, NEG_INF)  # segment-causal by abs pos
+        m_prev = m_ref[:]                       # [H, QT]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+        p = jnp.exp(s - m_new[:, :, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=2)
+        # o[h, i, d] += sum_j p[h, i, j] * v[j, h, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # [H, QT, Dh]
+        acc_ref[:] = acc_ref[:] * alpha[:, :, None] + pv
+        m_ref[:] = m_new
+
+    @pl.when(mi == nm - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:], 1e-30)  # pad tiles flush zeros
+        o_ref[:] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "q_tile", "interpret"))
+def unified_ragged_attention_kernel(q, k_blocks, v_blocks, tables,
+                                    tile_seg, tile_pos, *, scale=None,
+                                    q_tile=None, interpret=False):
+    """Pallas segment-causal stream attention: ONE launch scores a
+    token-packed stream mixing prefill chunks, plain decode rows and
+    speculative verify regions (see module docstring for the layout
+    and packing contract); returns [T, H, Dh] in q's dtype.
+    k_blocks/v_blocks may be `QuantizedKV` (codes [N, BS, H, Dh] int8,
+    scales [N, BS, H]) — the scale tiles ride the same
+    scalar-prefetched block index as their codes and dequant happens
+    in VMEM (`_load_kv`).  q_tile defaults to the production
+    Q_TILE=128 (interpret-mode tests shrink it to exercise tiny
+    shapes)."""
+    quant, operands = kv_operands(k_blocks, v_blocks)
+    qt = Q_TILE if q_tile is None else int(q_tile)
+    T, H, Dh = q.shape
+    _, BS, _, _ = operands[0].shape
+    M = tables.shape[1]
+    if T % qt:
+        raise ValueError(f"packed length {T} not a multiple of the "
+                         f"query tile {qt}")
+    NQ = T // qt
+    scale = (Dh ** -0.5) if scale is None else float(scale)
+
+    qh = q.transpose(1, 0, 2)  # [H, T, Dh]: heads ride the sublane axis
+    q_spec = pl.BlockSpec((H, qt, Dh),
+                          lambda qi, m, ts, tp, tb: (0, qi, 0))
+    in_specs = [q_spec] + kv_operand_specs(
+        BS, H, Dh, quant,
+        lambda qi, m, ts, tp, tb: tb[ts[qi], m])
+    kernel = functools.partial(_stream_kernel, scale=scale, nm=M,
+                               qt=qt, quant=quant)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # tile_seg, tile_pos, tables steer the DMA
+        grid=(NQ, M),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((H, qt, Dh),
+                               lambda qi, m, ts, tp, tb: (0, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, qt, Dh), jnp.float32),
+            pltpu.VMEM((H, qt), jnp.float32),
+            pltpu.VMEM((H, qt), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((H, T, Dh), q.dtype),
+        interpret=interpret,
+    )(tile_seg.astype(jnp.int32), tile_pos.astype(jnp.int32),
+      tables.astype(jnp.int32), qh, *operands)
+    return out.transpose(1, 0, 2)
+
+
+# ---- decode kernel (one token per sequence) ---------------------------
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, *refs, scale, nm, quant):
+    if quant:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    ctx = lens_ref[b]
+    bs = k_ref.shape[1]
+
+    @pl.when(mi * bs < ctx)
+    def _compute():
+        q = q_ref[0]  # [H, Dh] — input dtype feeds the MXU at full rate
+        k = _load_kv(k_ref, ks_ref, q.dtype)  # [BS, H, Dh]
+        v = _load_kv(v_ref, vs_ref, q.dtype)
+        # s[h, t] = sum_d q[h, d] * k[t, h, d]: batch over heads
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [H, BS]
+        pos = mi * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # o[h, d] += sum_t p[h, t] * v[t, h, d]: same head-batched form
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # [H, Dh]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(mi == nm - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret"))
+def paged_decode_attention_kernel(q, k_blocks, v_blocks, tables, ctx_lens,
+                                  *, scale=None, interpret=False):
+    """Pallas ragged paged decode attention — the one-token-per-sequence
+    specialization of the stream kernel (grid (B, M), no query-tile
+    alignment cost).  Returns [B, H, Dh] in q's dtype; QuantizedKV
+    pools dequantize in VMEM exactly like the stream kernel."""
+    quant, operands = kv_operands(k_blocks, v_blocks)
+    B, H, Dh = q.shape
+    _, BS, _, _ = operands[0].shape
+    M = tables.shape[1]
+    scale = (Dh ** -0.5) if scale is None else float(scale)
+
+    q_spec = pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0))
+    in_specs = [q_spec] + kv_operand_specs(
+        BS, H, Dh, quant, lambda b, m, tab, cl: tab[b, m])
+    kernel = functools.partial(_decode_kernel, scale=scale, nm=M,
+                               quant=quant)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, ctx_lens steer the DMA pipeline
+        grid=(B, M),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, Dh), lambda b, m, tab, cl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, Dh), jnp.float32),
+            pltpu.VMEM((H, STAT_LANES), jnp.float32),
+            pltpu.VMEM((H, STAT_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q, *operands)

@@ -174,6 +174,52 @@ class SlotParamStore:
             sp["crows"] = jnp.asarray(np.array(rows, np.int32))
         return sp, mode
 
+    def unified_args(self, slot_rows, emit_rows, steps):
+        """Unified-round arguments (one-kernel round, r16): compact
+        plan rows like `verify_args`, covering every row KIND the
+        fused round mixes. `slot_rows` maps plan row -> slot (None =
+        padding row); `emit_rows` marks rows whose samples are real —
+        decode rows, verify rows and prefill rows completing their
+        prompt this round; still-feeding prefill rows and padding rows
+        compute a discarded sample and are masked out of the dispatch
+        MODE selection, the sample flags and (via dlen == -1 on
+        device) the stop/penalty accounting. `steps` [P] int32 is each
+        row's base PRNG step (overridden on device by the async
+        carry where steps_map names a slot). Returns (sp dict,
+        mode)."""
+        import jax.numpy as jnp
+
+        emit = list(emit_rows)
+        real = [r for r, e in zip(slot_rows, emit) if r is not None
+                and e]
+        mode = self.mode(real)
+        rows = [r if r is not None else 0 for r in slot_rows]
+        sp = self._assemble(rows, np.asarray(steps, np.int32), mode)
+        if mode[0]:
+            # non-emitting rows must not sample (their seeds may alias
+            # another slot's stream — and their token is discarded)
+            sp["sample"] = sp["sample"] & jnp.asarray(
+                np.asarray(emit, bool))
+        if mode[1]:
+            sp["crows"] = jnp.asarray(np.array(rows, np.int32))
+        return sp, mode
+
+    def warm_unified_args(self, n_rows, mode=GREEDY_MODE):
+        """`unified_args` SHAPED like a live dispatch for `n_rows`
+        all-padding plan rows under `mode` — the unified-round half of
+        `warm_args` (same key set as a live `unified_args` call, so
+        the compiled variant is the one traffic hits)."""
+        import jax.numpy as jnp
+
+        rows = [0] * int(n_rows)
+        steps = np.zeros((len(rows),), np.int32)
+        sp = self._assemble(rows, steps, mode)
+        if mode[0]:
+            sp["sample"] = sp["sample"] & jnp.zeros((len(rows),), bool)
+        if mode[1]:
+            sp["crows"] = jnp.asarray(np.array(rows, np.int32))
+        return sp
+
     def warm_args(self, n_rows, mode=GREEDY_MODE):
         """Packed-prefill argument dict SHAPED like a live dispatch for
         `n_rows` plan rows under `mode`, built from idle-slot defaults —

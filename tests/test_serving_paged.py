@@ -318,8 +318,9 @@ def test_served_bench_axis_emits_records():
     axis, the quantization axis, and the sharded mesh axis) must emit
     all nine JSON records; slow-marked so tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 9, stdout
+    assert len(recs) == 10, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("unifiedround" in rec["metric"] for rec in recs)
     assert any("mixedsampling" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
     assert any("sharedprefix" in rec["metric"] for rec in recs)
@@ -356,6 +357,24 @@ def test_served_bench_axis_emits_records():
     assert fd["preemptions"] >= 1, fd
     assert fd["resumes"] >= 1, fd
     assert fd["preempt_cached_tokens"] > 0, fd
+    # the unified-round acceptance bars (r16): exactly ONE attention
+    # dispatch per round, >= 1.15x served tok/s and no-worse ITL p99
+    # vs the split engine at identical arrivals, with the measured
+    # window compile-clean (warm_buckets covered the bucket space)
+    un = next(r for r in recs if "unifiedround" in r["metric"])
+    assert un["dispatches_per_round"] == 1.0, un
+    # the split engine reads > 1 only on rounds that actually mixed
+    # prefill with decode — timing-dependent on the decode-heavy pool
+    # (the tier-1 dispatch-count test pins the structural claim)
+    assert un["dispatches_per_round_split"] >= 1.0, un
+    assert un["vs_baseline"] >= 1.15, un
+    # ITL p99: no regression on the single-core CPU proxy (run-to-run
+    # it straddles parity there — strict improvement is the chip-rerun
+    # claim, where the per-dispatch floor the fusion removes is
+    # 8-70ms, not ~0.3ms; PERF.md r16)
+    assert un["itl_p99_ms"] <= un["itl_p99_ms_split"] * 1.25, un
+    assert un["compiles_in_window"] == 0, un
+    assert un["overlap_fraction"] > 0.0, un
     # the sharded-serving acceptance bars (serving_dist round): token
     # parity across 1/2/4/8-device host meshes, and >= 3x max
     # concurrent slots at 4 devices vs 1 at fixed per-device pool
@@ -372,14 +391,15 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=540)
-    assert len(recs) == 8, stdout
+    assert len(recs) == 9, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
                  and "speculative" not in r["metric"]
                  and "frontdoor" not in r["metric"]
                  and "quantized" not in r["metric"]
-                 and "sharded" not in r["metric"])
+                 and "sharded" not in r["metric"]
+                 and "unifiedround" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
     sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
@@ -488,3 +508,20 @@ def test_served_bench_openloop_tiny_schema():
     assert sh_rec["devices"] == [1, 2]
     # 2 devices at fixed per-device bytes back ~2x the blocks
     assert sh_rec["slot_capacity_ratio"] >= 1.9, sh_rec
+    # unified-round axis (r16): the one-dispatch round + async loop
+    # vs the split engine at identical arrivals — the tiny smoke
+    # asserts schema + the structural invariant (exactly 1 attention
+    # dispatch per round), not the tok/s bar (slow test)
+    un_rec = next(r for r in recs if "unifiedround" in r["metric"])
+    for fld in ("vs_baseline", "tokens_per_sec_split", "itl_p99_ms",
+                "itl_p99_ms_split", "ttft_p99_ms", "ttft_p99_ms_split",
+                "dispatches_per_round", "dispatches_per_round_split",
+                "mixed_rounds", "overlap_seconds", "overlap_fraction",
+                "offered_rps", "achieved_rps", "compiles_in_window",
+                "compiles_in_flight_window", "goodput_ratio"):
+        assert fld in un_rec, un_rec
+    assert un_rec["dispatches_per_round"] == 1.0, un_rec
+    assert un_rec["dispatches_per_round_split"] >= 1.0, un_rec
+    assert 0.0 <= un_rec["overlap_fraction"] <= 1.0, un_rec
+    assert un_rec["compiles_in_window"] == 0, un_rec
+    assert 0 < un_rec["goodput_ratio"] <= 1.0, un_rec
