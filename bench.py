@@ -523,6 +523,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     pool bytes (the >= 3x-at-4-devices acceptance bar; tok/s scaling
     is a chip number, host-mesh collectives run on CPU cores).
 
+    An eleventh record is the DEGRADED-MODE axis (r17): identical
+    fixed-seed Poisson arrivals at 0% vs an injected fixed-seed
+    FaultPlan rate — tok/s retention under the recovery ladder, the
+    recovery/quarantine counts, goodput under replay, and the
+    survivor token-parity proof.
+
     tiny=True (`bench.py served --tiny`): seconds-scale smoke config
     that skips the padded comparison and telemetry — it exists so
     tier-1 can assert the served/open-loop/shared-prefix record SCHEMA
@@ -763,6 +769,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     # dispatches-per-round, overlap fraction and the compile-window
     # proof).
     st_un = _bench_served_unified(model, cfg, on_tpu, tiny)
+
+    # (k) DEGRADED-MODE axis (r17): identical fixed-seed Poisson
+    # arrivals at 0% vs an injected fixed-seed fault rate — the
+    # recovery ladder's tok/s retention, recovery/quarantine counts,
+    # goodput under replay, and the survivor token-parity proof.
+    st_dg = _bench_served_degraded(model, cfg, on_tpu, tiny)
 
     base = "gpt2tiny_served" if tiny else "gpt2s_served"
     suffix = "" if on_tpu else "_CPU_DEGRADED"
@@ -1048,6 +1060,37 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         "goodput_ratio": round(fd_stats["goodput"]["goodput_ratio"],
                                4),
     }
+    dg_c, dg_f, dg_plan = (st_dg["clean"], st_dg["faulted"],
+                           st_dg["plan"])
+    dg_rel = dg_f["reliability"]
+    rec_dg = {
+        "metric": f"{base}_degradedmode_tokens_per_sec{suffix}",
+        "value": round(dg_f["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        # <1 = serving under the injected fault rate retains that
+        # fraction of fault-free tok/s at IDENTICAL arrivals (the
+        # recovery ladder's cost: replayed prefills + backoff)
+        "vs_baseline": round(dg_f["tokens_per_sec"]
+                             / max(dg_c["tokens_per_sec"], 1e-9), 3),
+        "baseline": "same fixed-seed arrivals/prompts, no fault plan",
+        "tokens_per_sec_clean": round(dg_c["tokens_per_sec"], 1),
+        "fault_plan": dg_plan["name"],
+        "faults_injected": dg_rel["faults_injected"],
+        "faults_by_seam": dg_plan["fired_by_seam"],
+        "dispatch_retries": dg_rel["dispatch_retries"],
+        "recoveries": dg_rel["recoveries"],
+        "quarantined": dg_rel["quarantined"],
+        # the chaos parity proof: every non-quarantined request's
+        # output md5-matches the fault-free run
+        "survivor_token_parity": st_dg["survivor_parity"],
+        "n_requests": st_dg["n_req"],
+        "goodput_ratio": round(dg_f["goodput"]["goodput_ratio"], 4),
+        "goodput_ratio_clean": round(
+            dg_c["goodput"]["goodput_ratio"], 4),
+        "p99_ms": round(dg_f["p99_ms"], 1),
+        "itl_p99_ms": round(dg_f["itl_p99_ms"], 2),
+        "prefill_dispatches": dg_f["prefill_dispatches"],
+    }
     if st_pad is not None:
         rec_pad = {
             "metric": f"{base}_mixed_padded_tokens_per_sec{suffix}",
@@ -1063,12 +1106,12 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         rec_paged["baseline"] = \
             "padded static-batch GenerationServer, same traffic"
         records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp,
-                   rec_spec, rec_fd, rec_qz, rec_sh, rec_uni]
+                   rec_spec, rec_fd, rec_qz, rec_sh, rec_uni, rec_dg]
     else:
         rec_paged["vs_baseline"] = 1.0
         rec_paged["baseline"] = "self (tiny schema smoke)"
         records = [rec_paged, rec_mix, rec_open, rec_sp, rec_spec,
-                   rec_fd, rec_qz, rec_sh, rec_uni]
+                   rec_fd, rec_qz, rec_sh, rec_uni, rec_dg]
     if rec_tel is not None:
         records.append(rec_tel)
     if not on_tpu:
@@ -1364,6 +1407,100 @@ def _bench_served_unified(model, cfg, on_tpu, tiny):
         uni.stop()
     return {"split": st_split, "uni": st_uni, "rps": rps,
             "n_req": n_req, "new": new}
+
+
+def _bench_served_degraded(model, cfg, on_tpu, tiny):
+    """Degraded-mode sub-axis of `bench.py served` (r17): IDENTICAL
+    fixed-seed Poisson arrivals through a fault-free server and
+    through an identical server running a fixed-seed FaultPlan
+    (>= 1 fault at each dispatch-path seam). The recovery ladder
+    absorbs every fault — implicated requests are snapshotted through
+    the swap-out/publish machinery and retried — so the axis measures
+    what degradation COSTS: tok/s retention at the same arrivals, the
+    recovery/quarantine counts, goodput under replayed work, and the
+    survivor token-parity proof (every non-quarantined request's
+    output md5-matches the fault-free run)."""
+    import hashlib
+
+    from paddle_tpu.inference import PagedGenerationServer
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+    from paddle_tpu.reliability import FaultPlan, QuarantinedRequest
+
+    # per-seam fault horizons: scheduled occurrence indices must land
+    # BELOW the number of times the run actually reaches the seam
+    # (admission waves bound prefill dispatches; decode/ensure_many
+    # are reached every round), or a scheduled fault never fires
+    if tiny:
+        dmodel = model
+        n_req, new, slots, bs, mp, chunk = 6, 6, 2, 4, 12, 12
+        rate, horizons = 0.2, {"prefill": 3, "decode": 12,
+                               "ensure_many": 12}
+    elif on_tpu:
+        dmodel = model  # gpt2s bf16: the serving config
+        n_req, new, slots, bs, mp, chunk = 24, 48, 8, 128, 256, 256
+        rate, horizons = 0.05, {"prefill": 3, "decode": 96,
+                                "ensure_many": 96}
+    else:
+        dcfg = GPT2Config.tiny()  # dispatch-bound CPU proxy (see (f))
+        dcfg.dropout = 0.0
+        dmodel = GPT2(dcfg)
+        dmodel.eval()
+        n_req, new, slots, bs, mp, chunk = 16, 24, 4, 4, 12, 12
+        rate, horizons = 0.08, {"prefill": 4, "decode": 48,
+                                "ensure_many": 48}
+    vocab = dmodel.cfg.vocab_size
+    rng = np.random.RandomState(23)
+    pool = [rng.randint(1, vocab,
+                        (int(rng.randint(max(4, mp // 4), mp + 1)),))
+            .astype(np.int32) for _ in range(n_req)]
+    gaps = np.random.RandomState(31).exponential(0.01, size=n_req)
+
+    def drive(fault_plan=None):
+        srv = PagedGenerationServer(
+            dmodel, max_slots=slots, block_size=bs, max_prompt_len=mp,
+            max_new_tokens=new, prefill_chunk_tokens=chunk,
+            enable_prefix_cache=True, fault_plan=fault_plan).start()
+        try:
+            if fault_plan is None:  # warm/compile pass (fault-free
+                for f in [srv.submit(p) for p in pool]:  # side only:
+                    f.result(timeout=900)  # same process jit cache)
+            srv.reset_stats()
+            t0 = time.time()
+            futs, arrival = [], 0.0
+            for i, p in enumerate(pool):
+                arrival += gaps[i]
+                dt = arrival - (time.time() - t0)
+                if dt > 0:
+                    time.sleep(dt)
+                futs.append(srv.submit(p))
+            outs = []
+            for f in futs:
+                try:
+                    outs.append(hashlib.md5(
+                        np.ascontiguousarray(f.result(timeout=900))
+                        .tobytes()).hexdigest())
+                except QuarantinedRequest:
+                    outs.append(None)
+            st = srv.stats()
+        finally:
+            srv.stop()
+        return outs, st
+
+    out0, st0 = drive()
+    prng = np.random.RandomState(41)
+    entries = []
+    for seam, hor in sorted(horizons.items()):
+        idx = set(np.flatnonzero(prng.rand(hor) < rate).tolist())
+        while not idx:  # >= 1 fault per seam (the chaos-gate floor)
+            idx.add(int(prng.randint(hor)))
+        entries.extend((seam, i) for i in sorted(idx))
+    plan = FaultPlan(entries, name=f"seed=41,rate={rate}")
+    out1, st1 = drive(plan)
+    survivors = [i for i, h in enumerate(out1) if h is not None]
+    parity = all(out0[i] == out1[i] for i in survivors)
+    return {"clean": st0, "faulted": st1, "plan": plan.stats(),
+            "survivor_parity": parity, "n_req": n_req,
+            "quarantined_requests": n_req - len(survivors)}
 
 
 def _bench_served_quantization(model, cfg, prompts, slots, bs, hi, new,

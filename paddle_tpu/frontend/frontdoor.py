@@ -129,7 +129,8 @@ class FrontDoor:
     # ---- client API ------------------------------------------------------
     def submit(self, ids, *, lane="interactive", tenant="default",
                deadline_ms=None, sampling=None, max_new_tokens=None,
-               stream=True, on_token=None):
+               stream=True, on_token=None, timeout_s=None,
+               stream_timeout_s=None):
         """Submit one request; returns a `StreamHandle` (iterate for
         token/text deltas, or call `.result()` for the classic full
         array — both always work; `stream=False` skips per-token event
@@ -146,6 +147,19 @@ class FrontDoor:
             from the engine thread alongside (after) the stream's own
             delivery — for latency probes and bridges that want raw
             tokens without consuming the stream.
+        timeout_s: per-request engine deadline (r17) — queued or
+            resident past this, the request is cancelled slot-
+            freeingly and the stream terminates with
+            reason="timeout".
+        stream_timeout_s: iterator-side gap timeout — iterating the
+            returned handle raises `TimeoutError` after this many
+            seconds without an event, so a dead engine can never hang
+            the consumer thread.
+
+        When the engine was built with `shed_queue_depth=`, an
+        overloaded submit raises `reliability.AdmissionShed` (nothing
+        enqueued); its `retry_after_s` is the hint to surface as an
+        HTTP Retry-After.
         """
         if lane not in LANES:
             raise ValueError(f"unknown lane {lane!r} (lanes: {LANES})")
@@ -168,7 +182,8 @@ class FrontDoor:
         handle = StreamHandle(
             detokenize=srv._detok, stop_strings=stops,
             tail_tokens=srv.stop_tail_tokens,
-            max_buffered=self._stream_buffer)
+            max_buffered=self._stream_buffer,
+            timeout_s=stream_timeout_s)
         cb = handle._on_token if stream else None
         if on_token is not None:
             if cb is None:
@@ -178,7 +193,8 @@ class FrontDoor:
                     _h(tok, reason)
                     _u(tok, reason)
         fut = srv.submit(ids, max_new_tokens=max_new_tokens,
-                         sampling=sampling, meta=meta, on_token=cb)
+                         sampling=sampling, meta=meta, on_token=cb,
+                         timeout_s=timeout_s)
         return handle._bind(fut)
 
     # ---- introspection ---------------------------------------------------

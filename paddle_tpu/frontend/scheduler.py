@@ -334,6 +334,24 @@ class LaneScheduler:
         self._rejected = 0
         self._throttled = 0
 
+    def expire(self, now, pred):
+        """Remove and return every queued request for which
+        `pred(req)` is true — the engine's per-request timeout scan
+        (r17). Rate charges are not refunded (the request consumed its
+        admission slot); stride clocks are untouched (it never ran)."""
+        out = []
+        for lane in LANES:
+            for tname, dq in self._q[lane].items():
+                hits = [r for r in dq if pred(r)]
+                for r in hits:
+                    dq.remove(r)
+                    self._tenants[tname].queued -= 1
+                    self._depth -= 1
+                    out.append(r)
+                if hits:
+                    self._push_gauges(lane, tname)
+        return out
+
     def drain(self):
         """Remove and return every queued request (server stop)."""
         out = []

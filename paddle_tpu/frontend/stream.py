@@ -31,10 +31,12 @@ contract the engine's host-side stop-string check already relies on.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
 from ..observability import metrics as _metrics
+from ..reliability import QuarantinedRequest, RequestTimeout
 
 _m_stream_events = _metrics.counter(
     "frontdoor_stream_events_total",
@@ -155,10 +157,17 @@ class StreamHandle:
     far; `stop_reason`/`done` report final state. The producer side
     (`_on_token`, engine thread) never blocks: past `max_buffered`
     undelivered events, deltas coalesce into the newest one.
+
+    timeout_s (r17): per-GAP iterator timeout — iterating raises
+    `TimeoutError` when no event arrives for this many seconds, so a
+    dead or wedged engine can never hang a consumer thread forever
+    (the iterator-side twin of `result(timeout=)`). Streams whose
+    request was quarantined or timed out by the engine terminate with
+    `stop_reason` "quarantined" / "timeout" instead of "error".
     """
 
     def __init__(self, detokenize=None, stop_strings=(),
-                 tail_tokens=16, max_buffered=256):
+                 tail_tokens=16, max_buffered=256, timeout_s=None):
         self._asm = (DeltaAssembler(detokenize, stop_strings,
                                     tail_tokens)
                      if detokenize is not None else None)
@@ -169,6 +178,9 @@ class StreamHandle:
         self._done = False
         self._stop_reason: str | None = None
         self._max = max(1, int(max_buffered))
+        if timeout_s is not None and float(timeout_s) <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self._timeout = None if timeout_s is None else float(timeout_s)
         self.coalesced = 0
         self._future = None
 
@@ -212,17 +224,34 @@ class StreamHandle:
         with self._cv:
             if not self._done:
                 self._done = True
-                if fut.exception() is not None:
-                    self._stop_reason = "error"
+                exc = fut.exception()
+                if exc is not None:
+                    # r17: quarantine / timeout terminations are their
+                    # own stop reasons, not a generic "error"
+                    if isinstance(exc, QuarantinedRequest):
+                        reason = "quarantined"
+                    elif isinstance(exc, RequestTimeout):
+                        reason = "timeout"
+                    else:
+                        reason = "error"
+                    self._stop_reason = reason
                     self._events.append(StreamEvent(
-                        done=True, stop_reason="error"))
+                        done=True, stop_reason=reason))
             self._cv.notify_all()
 
     # ---- consumer side -------------------------------------------------
     def __iter__(self):
         while True:
+            deadline = (None if self._timeout is None
+                        else time.monotonic() + self._timeout)
             with self._cv:
                 while not self._events and not self._done:
+                    if deadline is not None \
+                            and time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"stream produced no event for "
+                            f"{self._timeout:g}s (engine dead or "
+                            f"wedged?)")
                     self._cv.wait(timeout=0.1)
                 if self._events:
                     ev = self._events.popleft()

@@ -154,7 +154,18 @@ ROOT_HASH = 0
 
 class BlockPoolExhausted(RuntimeError):
     """Raised when an allocation needs more free blocks than the pool has
-    (after reclaiming every LRU-retained prefix-cache block)."""
+    (after reclaiming every LRU-retained prefix-cache block).
+
+    Carries structured pressure fields (r17) so the reliability layer
+    can report and reason about the shortfall without parsing the
+    message: `needed` blocks requested, `available` blocks obtainable
+    (free + reclaimable) at raise time. Both default to -1 for
+    messages raised without them (e.g. injected faults)."""
+
+    def __init__(self, msg, *, needed=-1, available=-1):
+        super().__init__(msg)
+        self.needed = int(needed)
+        self.available = int(available)
 
 
 def blocks_for(num_tokens: int, block_size: int) -> int:
@@ -347,7 +358,8 @@ class PagedKVCache:
             _m_alloc_failures.labels(pool=self._name).inc()
             raise BlockPoolExhausted(
                 f"need {n} blocks, only {len(self._free)} free "
-                f"(pool {self.num_blocks - 1})")
+                f"(pool {self.num_blocks - 1})",
+                needed=n, available=len(self._free))
         taken = [self._free.pop() for _ in range(n)]
         for b in taken:
             self._ref[b] = 1
@@ -474,7 +486,8 @@ class PagedKVCache:
             raise BlockPoolExhausted(
                 f"need {total} blocks across {len(updates)} sequences, "
                 f"only {len(self._free)} free + {len(self._retained)} "
-                f"reclaimable (pool {self.num_blocks - 1})")
+                f"reclaimable (pool {self.num_blocks - 1})",
+                needed=total, available=self.available_block_count)
         for (seq_id, n), grow in zip(updates, need):
             table = self._tables.setdefault(seq_id, [])
             if grow:
@@ -697,7 +710,7 @@ class PagedKVCache:
             raise BlockPoolExhausted(
                 f"copy-on-write for sequence {seq_id!r} at position "
                 f"{pos} needs 1 block, pool exhausted "
-                f"(pool {self.num_blocks - 1})")
+                f"(pool {self.num_blocks - 1})", needed=1, available=0)
         for h in blocking:             # sole referent: cede the cache
             self._drop_entry(h)        # entries, write in place
         return False

@@ -38,7 +38,11 @@ from ..observability import flight_recorder as _flight
 from ..observability import log as _obs_log
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..reliability import (AdmissionShed, QuarantinedRequest,
+                           RecoveryPolicy, RequestTimeout,
+                           SessionJournal, resolve_fault_plan)
 from ..sampling import SamplingParams
+from .kv_cache import BlockPoolExhausted
 
 _logger = _obs_log.get_logger(__name__)
 
@@ -169,6 +173,32 @@ _m_round_overlap = _metrics.histogram(
     "observed while a round was in flight)",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.5))
+# Reliability (r17): fault injection, recovery ladder, quarantine,
+# per-request timeouts.
+_m_fault_injected = _metrics.counter(
+    "serving_fault_injected_total",
+    "deterministic FaultPlan faults fired at an engine seam "
+    "(injection is opt-in: ctor fault_plan= or PADDLE_TPU_FAULT_PLAN)",
+    labelnames=("seam",))
+_m_dispatch_retries = _metrics.counter(
+    "serving_dispatch_retries_total",
+    "failing dispatches absorbed by the recovery ladder: implicated "
+    "requests were snapshotted and requeued for retry instead of "
+    "having their futures failed")
+_m_quarantined = _metrics.counter(
+    "serving_requests_quarantined_total",
+    "requests failed by the recovery ladder after implicating "
+    "themselves in quarantine_after consecutive dispatch failures "
+    "(co-resident requests resume token-identically)")
+_m_recoveries = _metrics.counter(
+    "serving_recoveries_total",
+    "clean recoveries: first successful dispatch after >= 1 dispatch "
+    "failure — health returns degraded -> ok")
+_m_timeouts = _metrics.counter(
+    "serving_request_timeouts_total",
+    "requests cancelled by their per-request timeout_s (queued or "
+    "resident; the slot and its blocks are freed, the stream "
+    "terminates with reason='timeout')")
 _req_ids = itertools.count()
 
 STOP_REASONS = ("eos", "stop_token", "stop_string", "budget")
@@ -214,6 +244,9 @@ class _Req:
     gen0: tuple = ()
     resume_ids: np.ndarray | None = None
     preempts: int = 0  # times this request has been swapped out
+    # reliability (r17): per-request wall-clock cancellation deadline
+    # (seconds from submit; None = never)
+    timeout_s: float | None = None
 
 
 class GenerationServer:
@@ -685,6 +718,32 @@ class PagedGenerationServer:
     Requires steps_per_dispatch=1. Both default OFF: the exact split
     scheduler path.
 
+    RELIABILITY (r17, docs/RELIABILITY.md): the engine runs a RECOVERY
+    LADDER by default — a dispatch exception no longer fans out to
+    every in-flight future. Implicated requests are snapshotted
+    through the preemption swap-out machinery (tokens-so-far + resume
+    prompt; live K/V published into the prefix index when caching is
+    on), requeued at the front of their queue, and retried with
+    capped exponential backoff; a request implicated in
+    `RecoveryPolicy.quarantine_after` consecutive failures is
+    QUARANTINED (its future fails with `QuarantinedRequest` naming
+    the fault seam) while every co-resident request completes
+    token-identically. `recovery=False` restores the legacy
+    fail-everything path. `/healthz` is degraded only while
+    UNRECOVERED: the first successful dispatch after a failure counts
+    a recovery and returns health to ok. `fault_plan=` (or
+    PADDLE_TPU_FAULT_PLAN) installs a deterministic `FaultPlan` —
+    fixed-seed faults by seam x occurrence at the engine's hazard
+    seams (dispatch raise, pool exhaustion, watchdog-visible slow
+    dispatch, detokenize error, stream-consumer death) — one bool
+    check per seam when off. `journal=` (path or `SessionJournal`)
+    records every accepted request + emitted token append-only;
+    `recover_from_journal()` on a fresh server re-admits whatever a
+    crash (`kill()` in tests) interrupted, token-identically. Per-
+    request `submit(timeout_s=)` cancels overdue requests slot-
+    freeingly; `shed_queue_depth=` refuses admissions past a queue
+    depth with an `AdmissionShed.retry_after_s` hint.
+
     speculation=SpecConfig(...) (or True for defaults) turns on
     SPECULATIVE DECODING (round 11): each round, eligible decode-phase
     slots ask the drafter (default: the self-drafting n-gram /
@@ -716,7 +775,8 @@ class PagedGenerationServer:
                  stop_tail_tokens=16, speculation=None, sharding=None,
                  unified_round=False, async_rounds=False,
                  expose_port=None, flight_recorder=None,
-                 stall_timeout_s=30.0):
+                 stall_timeout_s=30.0, fault_plan=None, recovery=True,
+                 journal=None, shed_queue_depth=None):
         import jax
         import jax.numpy as jnp
 
@@ -951,6 +1011,52 @@ class PagedGenerationServer:
         self._lane_ttft: dict[str, list] = {}
         self._lane_itl: dict[str, list] = {}
         self._t0 = None
+        # ---- reliability (r17) ---------------------------------------
+        # fault_plan: deterministic seam x occurrence injection (None +
+        # unset PADDLE_TPU_FAULT_PLAN = no plan — every seam check is
+        # one `is None` branch, the r15 recorder discipline).
+        self._faults = resolve_fault_plan(fault_plan)
+        # recovery: True (default) runs the recovery ladder — a
+        # dispatch exception snapshots + requeues the implicated
+        # requests instead of failing every in-flight future; False
+        # restores the legacy fail-everything blast radius.
+        if recovery is True:
+            recovery = RecoveryPolicy()
+        elif recovery is False or recovery is None:
+            recovery = None
+        elif not isinstance(recovery, RecoveryPolicy):
+            raise TypeError(f"recovery must be a RecoveryPolicy or a "
+                            f"bool, got {type(recovery).__name__}")
+        self._recovery = recovery
+        # journal: crash-consistent session journal (path or
+        # SessionJournal); every accepted request and emitted token is
+        # recorded, recover_from_journal() re-admits the interrupted.
+        if isinstance(journal, (str, os.PathLike)):
+            journal = SessionJournal(journal)
+        elif journal is not None and not isinstance(journal,
+                                                    SessionJournal):
+            raise TypeError(f"journal must be a SessionJournal or a "
+                            f"path, got {type(journal).__name__}")
+        self._journal = journal
+        # shed_queue_depth: admission shedding — a submit arriving
+        # while >= this many requests are queued raises AdmissionShed
+        # with a retry-after hint (None = never shed).
+        if shed_queue_depth is not None and int(shed_queue_depth) < 1:
+            raise ValueError(f"shed_queue_depth must be >= 1, "
+                             f"got {shed_queue_depth}")
+        self._shed_depth = (None if shed_queue_depth is None
+                            else int(shed_queue_depth))
+        self._fault_streak: dict[str, int] = {}  # rid -> consecutive
+        self._consec_failures = 0                # failing dispatches
+        self._any_timeouts = False  # set once a timed request is seen
+        self._last_recovery = None  # {"ts","recovered_from","failures"}
+        # window counters (reset_stats-coherent)
+        self._faults_injected = 0
+        self._dispatch_retries = 0
+        self._recoveries = 0
+        self._quarantined = 0
+        self._timeouts = 0
+        self._sheds = 0
         # ---- operations plane (ISSUE 10) -----------------------------
         # expose_port: None + PADDLE_TPU_METRICS_PORT unset = no ops
         # plane (the exact pre-round path: a disabled flight recorder
@@ -1038,18 +1144,24 @@ class PagedGenerationServer:
     def health(self):
         """(status, detail) for /healthz: "stalled" while the watchdog
         sees pending work with no dispatch progress (503 — drain me),
-        "degraded" after an engine dispatch exception (sticky until
-        reset_stats), else "ok"."""
+        "degraded" after an engine dispatch exception — sticky only
+        while UNRECOVERED: a clean recovery (first successful dispatch
+        after the failure) or reset_stats() returns it to "ok", and
+        the detail then carries the degradation reason it recovered
+        from plus the recovery timestamp (r17)."""
         detail = {
             "engine_running": self._thread is not None,
             "progress": self._ops_progress,
             "stalls": self._watchdog.stalls if self._watchdog else 0,
         }
+        if self._last_recovery is not None:
+            detail["last_recovery"] = dict(self._last_recovery)
         if self._watchdog is not None and self._watchdog.stalled:
             detail["stall_timeout_s"] = self.stall_timeout_s
             return "stalled", detail
         if self._last_error is not None:
             detail["last_error"] = self._last_error
+            detail["degraded_reason"] = self._last_error
             return "degraded", detail
         return "ok", detail
 
@@ -1102,6 +1214,393 @@ class PagedGenerationServer:
                               request_ids=list(request_ids))
         if self._recorder.enabled:
             self._recorder.dump(trigger="engine_exception")
+
+    # ---- reliability (r17) ---------------------------------------------
+    def _maybe_fault(self, seam):
+        """Deterministic fault-injection point: one `is None` check
+        when no plan is installed; otherwise poll the plan's seam x
+        occurrence schedule and turn a scheduled fault into its effect
+        (raise / simulated pool exhaustion / watchdog-visible sleep)."""
+        plan = self._faults
+        if plan is None:
+            return
+        f = plan.poll(seam)
+        if f is None:
+            return
+        with self._lock:
+            self._faults_injected += 1
+        _m_fault_injected.labels(seam=seam).inc()
+        self._recorder.record("fault_injected", seam=seam, kind=f.kind,
+                              occurrence=f.index)
+        _tracing.event("fault_injected", seam=seam, kind=f.kind,
+                       occurrence=f.index)
+        if f.kind == "slow":
+            time.sleep(f.delay_s)
+            return
+        if f.kind == "exhausted":
+            raise BlockPoolExhausted(
+                f"injected fault at seam '{seam}' (occurrence "
+                f"{f.index}): simulated pool exhaustion")
+        raise plan.make_fault(f)
+
+    def _recover_slot(self, i, where):
+        """Snapshot one implicated slot for retry (the recovery
+        ladder's requeue step): roll the sequence back to its DURABLE
+        length (K/V provably written by completed dispatches — the
+        failing dispatch may not have written what `ensure_many`
+        already grew room for), publish the live prefix through the
+        swap-out machinery when prefix caching is on, free the slot,
+        and hand back the request with its resume state (generated
+        tokens + resume prompt), exactly the preemption shape the r12
+        parity suite proves token-identical. Returns None when the
+        slot already emptied (the drain completed its request)."""
+        s = self._slots[i]
+        if s is None:
+            return None
+        seq, req = s["seq"], s["req"]
+        toks = s["toks"]
+        in_decode = s["fed"] >= s["prompt"].size
+        durable = (s["pos"] + len(toks) - 1 if in_decode and toks
+                   else int(s["fed"]))
+        known = (np.concatenate([req.ids, np.asarray(toks, np.int32)])
+                 if toks else req.ids)
+        if self.cache.has_seq(seq):
+            live = self.cache.seq_len(seq)
+            durable = max(0, min(live, durable))
+            if durable < live:
+                self.cache.truncate_seq(seq, durable)
+            if self.enable_prefix_cache and durable > 0:
+                self.cache.swap_out_seq(seq, known[:durable])
+            else:
+                self.cache.free(seq)
+        self._worst.pop(seq, None)
+        self._slots[i] = None
+        self._sp_store.clear_slot(i)
+        req.gen0 = tuple(toks)
+        req.resume_ids = known
+        self._recorder.record(
+            "recover_requeue", request_id=req.rid, slot=i, seq=seq,
+            where=where, tokens_done=len(toks), durable=int(durable))
+        _tracing.event("recover_requeue", request_id=req.rid, slot=i,
+                       seq=seq, where=where)
+        return req
+
+    def _quarantine_slot(self, i, where, e, failures):
+        """Give up on ONE request: fail its future with a diagnostic
+        naming the fault seam, free its slot and blocks, and count it.
+        Everything co-resident is untouched."""
+        s = self._slots[i]
+        seq, req = s["seq"], s["req"]
+        if self.cache.has_seq(seq):
+            self.cache.free(seq)
+        self._worst.pop(seq, None)
+        self._slots[i] = None
+        self._sp_store.clear_slot(i)
+        err = QuarantinedRequest(req.rid, where, failures, e)
+        with self._lock:
+            self._quarantined += 1
+        _m_quarantined.inc()
+        if self._journal is not None:
+            self._journal.record_done(req.rid, "quarantined")
+        self._recorder.record("quarantine", request_id=req.rid, slot=i,
+                              seq=seq, seam=where, failures=failures,
+                              error=f"{type(e).__name__}: {e}")
+        _tracing.event("quarantined", request_id=req.rid, slot=i,
+                       seam=where, failures=failures)
+        _logger.error("quarantined request %s after %d consecutive "
+                      "failure(s) at seam %s: %s", req.rid, failures,
+                      where, e)
+        req.future.set_exception(err)
+
+    def _dispatch_failure(self, where, e, slot_idx):
+        """The engine's dispatch-exception path. With recovery OFF,
+        the legacy blast radius: every request in the failing dispatch
+        fails. With the recovery ladder ON (default): snapshot every
+        implicated request through the swap-out machinery and requeue
+        it at the FRONT of its queue, quarantine at most ONE request
+        whose consecutive-failure streak crossed the policy threshold
+        (highest streak, lowest slot on ties), rebuild the dispatch
+        state (async chain, device-arg caches), and back off capped-
+        exponentially before the loop retries."""
+        rids = [self._slots[i]["req"].rid for i in slot_idx
+                if self._slots[i] is not None]
+        self._engine_exception(where, e, rids)
+        if self._recovery is None:
+            for i in slot_idx:
+                s = self._slots[i]
+                if s is None:
+                    continue
+                if self.cache.has_seq(s["seq"]):
+                    self.cache.free(s["seq"])
+                self._worst.pop(s["seq"], None)
+                s["req"].future.set_exception(e)
+                self._slots[i] = None
+                self._sp_store.clear_slot(i)
+            return
+        pol = self._recovery
+        # async: resolve the round already in flight FIRST, so the
+        # resume snapshots include its tokens (it dispatched before
+        # the failure and its outputs are real)
+        self._drain_pending()
+        with self._lock:
+            self._dispatch_retries += 1
+            self._consec_failures += 1
+            consec = self._consec_failures
+        _m_dispatch_retries.inc()
+        live = [i for i in slot_idx if self._slots[i] is not None]
+        for i in live:
+            rid = self._slots[i]["req"].rid
+            self._fault_streak[rid] = self._fault_streak.get(rid, 0) + 1
+        suspects = [i for i in live
+                    if self._fault_streak[self._slots[i]["req"].rid]
+                    >= pol.quarantine_after]
+        if suspects:
+            victim = max(suspects, key=lambda i: (
+                self._fault_streak[self._slots[i]["req"].rid], -i))
+            streak = self._fault_streak.pop(
+                self._slots[victim]["req"].rid)
+            self._quarantine_slot(victim, where, e, streak)
+            live.remove(victim)
+        requeued = []
+        for i in live:
+            req = self._recover_slot(i, where)
+            if req is not None:
+                requeued.append(req)
+        with self._lock:
+            if self._sched is not None:
+                now = time.perf_counter()
+                # requeue() prepends: reversed keeps original order
+                for req in reversed(requeued):
+                    self._sched.requeue(req, now)
+            else:
+                for req in reversed(requeued):
+                    self._queue.insert(0, req)
+                _m_queue_depth.labels(server="paged").set(
+                    len(self._queue))
+            self._lock.notify()
+        # rebuild dispatch state: the double-buffer chain and the
+        # steady-state device-argument caches may name freed slots
+        self._pending = None
+        self._carry = None
+        self._args_cache = None
+        self._tables_cache = None
+        delay = pol.backoff_s(consec)
+        if delay > 0:
+            with self._lock:
+                if not self._stop:
+                    self._lock.wait(timeout=delay)
+
+    def _dispatch_ok(self, rids):
+        """Success bookkeeping of the recovery ladder: reset the
+        dispatched requests' failure streaks, and if this is the first
+        success after >= 1 failure, record a CLEAN RECOVERY — health
+        returns degraded -> ok, timestamped for /statusz."""
+        if self._recovery is None or (self._consec_failures == 0
+                                      and not self._fault_streak):
+            return
+        for rid in rids:
+            self._fault_streak.pop(rid, None)
+        if self._consec_failures:
+            with self._lock:
+                self._last_recovery = {
+                    "ts": time.time(),
+                    "recovered_from": self._last_error,
+                    "failures": self._consec_failures,
+                }
+                self._consec_failures = 0
+                self._recoveries += 1
+                self._last_error = None  # degraded -> ok
+            _m_recoveries.inc()
+            self._recorder.record(
+                "recovered",
+                failures=self._last_recovery["failures"],
+                recovered_from=self._last_recovery["recovered_from"])
+            _tracing.event("recovered",
+                           failures=self._last_recovery["failures"])
+            _logger.warning(
+                "engine recovered after %d failed dispatch(es): %s",
+                self._last_recovery["failures"],
+                self._last_recovery["recovered_from"])
+
+    def _fail_timeout_req(self, req, now):
+        """Fail one expired request (already detached from any queue
+        or slot). Caller holds the lock."""
+        self._timeouts += 1
+        _m_timeouts.inc()
+        if self._journal is not None:
+            self._journal.record_done(req.rid, "timeout")
+        self._recorder.record("request_timeout", request_id=req.rid,
+                              waited_s=round(now - req.t_submit, 4),
+                              timeout_s=req.timeout_s)
+        _tracing.event("request_timeout", request_id=req.rid,
+                       waited_s=now - req.t_submit)
+        req.future.set_exception(RequestTimeout(
+            req.rid, now - req.t_submit, req.timeout_s))
+
+    def _expire_timeouts_locked(self, now):
+        """Cancel every queued or resident request past its
+        per-request timeout_s — SLOT-FREEING: a resident victim's
+        blocks return to the pool immediately. Caller holds the
+        lock."""
+        def dead(r):
+            return (r.timeout_s is not None
+                    and now - r.t_submit > r.timeout_s)
+
+        expired = [r for r in self._queue if dead(r)]
+        if expired:
+            for r in expired:
+                self._queue.remove(r)
+            _m_queue_depth.labels(server="paged").set(len(self._queue))
+        if self._sched is not None:
+            exp = getattr(self._sched, "expire", None)
+            if exp is not None:
+                expired.extend(exp(now, dead))
+        for r in expired:
+            self._fail_timeout_req(r, now)
+        if any(s is not None and dead(s["req"]) for s in self._slots):
+            self._drain_pending()  # async: host state goes authoritative
+            for i, s in enumerate(self._slots):
+                if s is None or not dead(s["req"]):
+                    continue
+                seq, req = s["seq"], s["req"]
+                if self.cache.has_seq(seq):
+                    self.cache.free(seq)
+                self._worst.pop(seq, None)
+                self._slots[i] = None
+                self._sp_store.clear_slot(i)
+                self._fail_timeout_req(req, now)
+
+    def _retry_after_hint_locked(self, depth):
+        """Estimated seconds until the queue drains one admission
+        slot's worth of work — the AdmissionShed retry hint."""
+        lat = sorted(self._lat)
+        p50 = lat[len(lat) // 2] if lat else 0.25
+        waves = -(-int(depth) // max(1, self.max_slots))
+        return max(0.05, p50) * max(1, waves)
+
+    def kill(self):
+        """Hard-stop the engine WITHOUT resolving in-flight futures —
+        the crash-simulation half of the journal recovery story: after
+        kill(), a fresh server built over the same journal re-admits
+        every accepted-but-unfinished request via
+        `recover_from_journal`. (Graceful shutdown is `stop()`, which
+        fails queued futures so no client hangs.)"""
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=120)
+            self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self.exporter is not None:
+            self.exporter.stop()
+        if self._journal is not None:
+            self._journal.flush()
+
+    def recover_from_journal(self, journal=None):
+        """Re-admit every accepted-but-unfinished request recorded in
+        `journal` (default: the server's own). Each re-admission
+        resumes from its recorded prompt + emitted tokens with its
+        ORIGINAL seed, budget and sampling params, so — the decode
+        stack being deterministic — the completed output is
+        token-identical to a run that never crashed. Requests whose
+        recorded state already satisfies a stop condition (budget
+        reached, EOS/stop token emitted) resolve immediately.
+
+        Returns {rid: Future}. Call before or after start()."""
+        j = journal if journal is not None else self._journal
+        if j is None:
+            raise ValueError("no journal: pass one or build the "
+                             "server with journal=")
+        out = {}
+        for ent in j.interrupted():
+            req = self._build_resume_req(ent)
+            done = self._journal_terminal_reason(req)
+            if done is not None:
+                # the crash lost only the terminal record: the request
+                # is already complete — resolve without re-admitting
+                if self._journal is not None:
+                    self._journal.record_done(req.rid, done)
+                req.future.set_result(np.concatenate(
+                    [req.ids, np.asarray(req.gen0, np.int32)])
+                    if req.gen0 else req.ids.copy())
+                out[req.rid] = req.future
+                continue
+            with self._lock:
+                if self._stop:
+                    raise RuntimeError("server stopped")
+                if self._sched is not None:
+                    self._sched.on_submit(req, time.perf_counter())
+                else:
+                    self._queue.append(req)
+                    _m_queue_depth.labels(server="paged").set(
+                        len(self._queue))
+                if self._journal is not None:
+                    # re-accept (under the lock, before the loop can
+                    # admit) with gen0 folded, so a second crash
+                    # resumes from here, not from the original prompt
+                    self._journal.record_accept(req)
+                self._lock.notify()
+            self._recorder.record("journal_readmit", request_id=req.rid,
+                                  tokens_done=len(req.gen0))
+            _tracing.event("journal_readmit", request_id=req.rid,
+                           tokens_done=len(req.gen0))
+            out[req.rid] = req.future
+        return out
+
+    def _build_resume_req(self, ent):
+        """One journal entry -> a resume-state `_Req` (bypasses
+        submit(): the recorded seed must win over auto-derivation)."""
+        sampling = (SamplingParams(**{k: tuple(v) if isinstance(v, list)
+                                      else v
+                                      for k, v in ent["sampling"].items()})
+                    if ent.get("sampling") else self._default_sampling)
+        if sampling.stop_strings and self._detok is None:
+            raise ValueError(
+                f"journal request {ent['rid']!r} uses stop_strings but "
+                f"this server has no detokenizer (pass detokenize=)")
+        meta = None
+        if ent.get("meta"):
+            m = ent["meta"]
+            meta = RequestMeta(lane=m.get("lane", "interactive"),
+                               tenant=m.get("tenant", "default"),
+                               deadline_s=m.get("deadline_s"),
+                               cost=int(m.get("cost", 0)))
+        req = _Req(ids=np.asarray(ent["ids"], np.int32),
+                   future=Future(), t_submit=time.perf_counter(),
+                   rid=ent["rid"], sampling=sampling, meta=meta,
+                   timeout_s=ent.get("timeout_s"))
+        req.seed = int(ent["seed"])
+        req.budget = int(ent["budget"])
+        gen0 = [int(t) for t in ent.get("gen0", [])]
+        if gen0:
+            req.gen0 = tuple(gen0)
+            req.resume_ids = np.concatenate(
+                [req.ids, np.asarray(gen0, np.int32)])
+        if req.timeout_s is not None:
+            self._any_timeouts = True
+        return req
+
+    def _journal_terminal_reason(self, req):
+        """Whether a journal-recovered request's recorded tokens
+        already satisfy a stop condition (the crash lost only the
+        terminal record): returns the stop reason or None."""
+        if not req.gen0:
+            return None
+        if len(req.gen0) >= req.budget:
+            return "budget"
+        last = int(req.gen0[-1])
+        sp = req.sampling
+        if self.eos >= 0 and last == self.eos:
+            return "eos"
+        if sp is not None and last in getattr(sp, "stop_token_ids", ()):
+            return "stop_token"
+        if sp is not None and sp.stop_strings and self._detok is not None:
+            tail = self._detok(list(req.gen0)[-self.stop_tail_tokens:])
+            if any(s in tail for s in sp.stop_strings):
+                return "stop_string"
+        return None
 
     def set_scheduler(self, sched):
         """Install a front-door scheduler (round 12) — an object owning
@@ -1275,7 +1774,7 @@ class PagedGenerationServer:
 
     # ---- client API ----------------------------------------------------
     def submit(self, ids, max_new_tokens=None, sampling=None, *,
-               meta=None, on_token=None):
+               meta=None, on_token=None, timeout_s=None):
         """Enqueue one prompt (any length <= max_prompt_len; NO padding
         needed). Returns a Future resolving to the UNPADDED
         [len + generated] int32 sequence (generation stops at EOS, a
@@ -1300,7 +1799,16 @@ class PagedGenerationServer:
         invoked from the engine thread for every generated token
         (reason is None mid-stream, the stop reason on the final
         token). It must be fast and non-blocking; exceptions are
-        logged and dropped, never propagated into the engine loop."""
+        logged and dropped, never propagated into the engine loop.
+        timeout_s: per-request wall-clock deadline (r17) — a request
+        still queued or resident past this many seconds after submit
+        is CANCELLED: its slot and blocks are freed and its future
+        fails with `RequestTimeout` (streams see reason="timeout").
+        Enforced by the engine loop, so it needs a started server.
+
+        When the server was built with `shed_queue_depth=`, a submit
+        arriving at a queue already that deep raises `AdmissionShed`
+        (nothing enqueued) carrying a `retry_after_s` hint."""
         if sampling is None:
             sampling = self._default_sampling
         elif not isinstance(sampling, SamplingParams):
@@ -1324,10 +1832,16 @@ class PagedGenerationServer:
         if meta is not None and not isinstance(meta, RequestMeta):
             raise TypeError(f"meta must be a RequestMeta, "
                             f"got {type(meta).__name__}")
+        if timeout_s is not None:
+            timeout_s = float(timeout_s)
+            if timeout_s <= 0:
+                raise ValueError(f"timeout_s must be > 0, "
+                                 f"got {timeout_s}")
+            self._any_timeouts = True
         req = _Req(ids=ids, future=Future(),
                    t_submit=time.perf_counter(),
                    rid=f"p{next(_req_ids)}", sampling=sampling,
-                   meta=meta, on_token=on_token)
+                   meta=meta, on_token=on_token, timeout_s=timeout_s)
         # per-request PRNG stream seed: explicit seeds reproduce tokens
         # regardless of batch composition; auto seeds derive from the
         # server seed + a submission counter (distinct streams per
@@ -1339,6 +1853,18 @@ class PagedGenerationServer:
         with self._lock:
             if self._stop:
                 raise RuntimeError("server stopped")
+            if self._shed_depth is not None:
+                # admission shedding (r17): refuse — with a retry
+                # hint — instead of queueing past the shed depth
+                depth = (self._sched.depth() if self._sched is not None
+                         else len(self._queue))
+                if depth >= self._shed_depth:
+                    self._sheds += 1
+                    hint = self._retry_after_hint_locked(depth)
+                    self._recorder.record(
+                        "shed", request_id=req.rid, depth=depth,
+                        retry_after_s=round(hint, 3))
+                    raise AdmissionShed(depth, self._shed_depth, hint)
             if self._sched is not None:
                 # scheduler-owned queues: on_submit may raise (bounded
                 # queue rejection) — nothing is enqueued in that case
@@ -1353,6 +1879,11 @@ class PagedGenerationServer:
                 self._queue.append(req)
                 _m_queue_depth.labels(server="paged").set(
                     len(self._queue))
+            if self._journal is not None:
+                # under the lock: the engine loop admits under this
+                # lock too, so the accept record always precedes the
+                # request's first token record
+                self._journal.record_accept(req)
             self._lock.notify()
         self._recorder.record(
             "submit", request_id=req.rid, prompt_len=int(ids.size),
@@ -1393,6 +1924,10 @@ class PagedGenerationServer:
             self._watchdog.stop()
         if self.exporter is not None:
             self.exporter.stop()
+        if self._journal is not None:
+            # queued requests failed above stay journal-live on
+            # purpose: a restarted server may still re-admit them
+            self._journal.flush()
 
     def reset_stats(self):
         """Zero the measurement window — latency AND the TTFT samples
@@ -1425,6 +1960,13 @@ class PagedGenerationServer:
             self._overlap_s = 0.0
             self._compile_mark = _compile_tracker.mark()
             self._last_error = None  # a fresh window is healthy again
+            self._consec_failures = 0
+            self._faults_injected = 0
+            self._dispatch_retries = 0
+            self._recoveries = 0
+            self._quarantined = 0
+            self._timeouts = 0
+            self._sheds = 0
             self._preemptions = 0
             self._resumes = 0
             self._preempt_cached_tokens = 0
@@ -1547,6 +2089,26 @@ class PagedGenerationServer:
                     "overlap_seconds": self._overlap_s,
                     "overlap_fraction": (self._overlap_s / dt
                                          if dt else 0.0),
+                },
+                # reliability (r17): fault injection + recovery ladder
+                # + timeout/shed window counters — schema-stable
+                # (zeros when nothing ever failed), reset-coherent
+                "reliability": {
+                    "recovery_enabled": self._recovery is not None,
+                    "fault_plan": (self._faults.describe()
+                                   if self._faults is not None
+                                   else None),
+                    "faults_injected": self._faults_injected,
+                    "dispatch_retries": self._dispatch_retries,
+                    "recoveries": self._recoveries,
+                    "quarantined": self._quarantined,
+                    "timeouts": self._timeouts,
+                    "shed": self._sheds,
+                    "consecutive_failures": self._consec_failures,
+                    "last_recovery": (dict(self._last_recovery)
+                                      if self._last_recovery else None),
+                    "journal": (self._journal.stats()
+                                if self._journal is not None else None),
                 },
                 # XLA compiles inside THIS stats window (the process-
                 # wide compile tracker, windowed at reset_stats):
@@ -1936,6 +2498,8 @@ class PagedGenerationServer:
                     tokens=int(sum(p[2] for p in plan)),
                     request_ids=[self._slots[i]["req"].rid
                                  for i, *_ in plan]):
+                self._maybe_fault("slow_dispatch")
+                self._maybe_fault("ensure_many")
                 # bulk multi-sequence allocation: the whole chunk plan's
                 # tables grow atomically (reservation-backed, so this
                 # cannot exhaust the pool mid-plan)
@@ -1980,6 +2544,7 @@ class PagedGenerationServer:
                     [plan[r][0] if r < len(plan) else None
                      for r in range(P)],
                     [r in done_set for r in range(P)], base_steps)
+                self._maybe_fault("prefill")
                 tok, stopped, kc, vc, counts = \
                     self._decoder.packed_prefill(
                         self._params, jnp.asarray(toks),
@@ -1989,21 +2554,15 @@ class PagedGenerationServer:
                 self._sp_store.swap_counts(counts)
                 tok_h = np.asarray(tok)
                 stopped_h = np.asarray(stopped)
-        except Exception as e:  # noqa: BLE001 — fail the chunk's requests
-            self._engine_exception("prefill", e,
-                                   [self._slots[i]["req"].rid
-                                    for i, *_ in plan])
-            for i, *_ in plan:
-                s = self._slots[i]
-                seq, req = s["seq"], s["req"]
-                if self.cache.has_seq(seq):
-                    self.cache.free(seq)
-                self._worst.pop(seq, None)
-                self._slots[i] = None
-                self._sp_store.clear_slot(i)
-                req.future.set_exception(e)
+        except Exception as e:  # noqa: BLE001 — the recovery ladder
+            # (or, with recovery off, the legacy fail-the-chunk path)
+            self._dispatch_failure("prefill", e,
+                                   [i for i, *_ in plan])
             return
         self.cache.swap_arrays(kc, vc)
+        self._dispatch_ok([self._slots[i]["req"].rid
+                           for i, *_ in plan
+                           if self._slots[i] is not None])
         t_now = time.perf_counter()
         self._ops_progress += 1
         if decoding:
@@ -2087,6 +2646,8 @@ class PagedGenerationServer:
           * budget — the request's token budget is exhausted."""
         slot = self._slots[i]
         slot["toks"].append(tok)
+        if self._journal is not None:
+            self._journal.record_token(slot["req"].rid, tok)
         sp = slot["req"].sampling
         reason = None
         if device_stopped:
@@ -2096,7 +2657,16 @@ class PagedGenerationServer:
             # the token list spans preemption boundaries (a resumed
             # slot is re-seeded with its prior tokens), so a stop
             # string straddling a swap-out still matches
-            tail = self._detok(slot["toks"][-self.stop_tail_tokens:])
+            try:
+                if self._faults is not None:
+                    self._maybe_fault("detokenize")
+                tail = self._detok(slot["toks"][-self.stop_tail_tokens:])
+            except Exception as e:  # noqa: BLE001 — a broken
+                # detokenizer implicates exactly ONE request: fail it
+                # with the seam named and keep every co-resident alive
+                # (before r17 this killed the whole engine thread)
+                self._quarantine_slot(i, "detokenize", e, 1)
+                return
             if any(s in tail for s in sp.stop_strings):
                 reason = "stop_string"
         if reason is None and len(slot["toks"]) >= slot["budget"]:
@@ -2107,6 +2677,8 @@ class PagedGenerationServer:
             # the consumer side (frontend.stream) is bounded and
             # non-blocking; a broken callback must not kill the loop
             try:
+                if self._faults is not None:
+                    self._maybe_fault("stream_consumer")
                 cb(tok, reason)
             except Exception:  # noqa: BLE001 — stream is best-effort
                 _logger.exception(
@@ -2117,6 +2689,9 @@ class PagedGenerationServer:
         if reason is not None:
             seq, req = slot["seq"], slot["req"]
             self._ops_progress += 1
+            self._fault_streak.pop(req.rid, None)
+            if self._journal is not None:
+                self._journal.record_done(req.rid, reason)
             self._recorder.record("request_done", request_id=req.rid,
                                   slot=i, new_tokens=len(slot["toks"]),
                                   reason=reason)
@@ -2162,6 +2737,8 @@ class PagedGenerationServer:
                     # is stranded mid-stream
                     self._drain_pending()
                     return
+                if self._any_timeouts:
+                    self._expire_timeouts_locked(time.perf_counter())
                 self._admit_locked()
                 if all(s is None for s in self._slots):
                     self._drain_pending()  # defensive: no-op when idle
@@ -2519,6 +3096,8 @@ class PagedGenerationServer:
                     step_rows=plan["n_step"],
                     request_ids=[self._slots[row["slot"]]["req"].rid
                                  for row in rows]):
+                self._maybe_fault("slow_dispatch")
+                self._maybe_fault("ensure_many")
                 self.cache.ensure_many(updates)
                 if self.enable_prefix_cache and plan["n_chunk"]:
                     # CoW guard: a chunk starting mid-block in an
@@ -2591,6 +3170,7 @@ class PagedGenerationServer:
                     ct, cp, cs = self._carry
                 else:
                     ct, cp, cs = self._zero_carry_arrays()
+                self._maybe_fault("unified_round")
                 (vtok, accepted, stopped, kc, vc, counts, nct, ncp,
                  ncs) = self._decoder.unified_round(
                     self._params, dev["toks"], dev["seg"], dev["pos"],
@@ -2599,24 +3179,17 @@ class PagedGenerationServer:
                     dev["steps_map"], ct, cp, cs,
                     self.cache.k_blocks, self.cache.v_blocks, sp_args,
                     sp_mode, window=plan["window"])
-        except Exception as e:  # noqa: BLE001 — fan out, drop slots
-            self._engine_exception("unified_round", e,
-                                   [self._slots[row["slot"]]["req"].rid
-                                    for row in rows])
+        except Exception as e:  # noqa: BLE001 — the recovery ladder
+            # (or, with recovery off, the legacy fail-all path)
             self._carry = None
-            for row in rows:
-                s = self._slots[row["slot"]]
-                if s is None or s["seq"] != row["seq"]:
-                    continue
-                if self.cache.has_seq(s["seq"]):
-                    self.cache.free(s["seq"])
-                self._worst.pop(s["seq"], None)
-                s["req"].future.set_exception(e)
-                self._slots[row["slot"]] = None
-                self._sp_store.clear_slot(row["slot"])
+            self._dispatch_failure("unified_round", e,
+                                   [row["slot"] for row in rows])
             return None
         self._sp_store.swap_counts(counts)
         self.cache.swap_arrays(kc, vc)
+        self._dispatch_ok([self._slots[row["slot"]]["req"].rid
+                           for row in rows
+                           if self._slots[row["slot"]] is not None])
         if self._async:
             self._carry = (nct, ncp, ncs)
         self._ops_progress += 1
@@ -2805,12 +3378,6 @@ class PagedGenerationServer:
         the speculative verify dispatch."""
         jnp = self._jnp
         k = self.steps_per_dispatch
-        # grow tables for the incoming token(s) BEFORE the step
-        # writes them (k tokens starting at the feed position)
-        self.cache.ensure_many(
-            [(self._slots[i]["seq"], self._slots[i]["pos"]
-              + len(self._slots[i]["toks"]) - 1 + k)
-             for i in active_idx])
         tok = np.zeros((self.max_slots,), np.int32)
         pos = np.zeros((self.max_slots,), np.int32)
         act = np.zeros((self.max_slots,), bool)
@@ -2821,9 +3388,6 @@ class PagedGenerationServer:
             pos[i] = s["pos"] + len(s["toks"]) - 1
             act[i] = True
             steps[i] = len(s["toks"])  # PRNG step counter
-        tables = jnp.asarray(self.cache.table_array(
-            [s["seq"] if s is not None else None
-             for s in self._slots], self._m_width))
         # per-slot sampling buffers + the static dispatch mode: ONE
         # jitted dispatch serves the whole mixed batch; all-greedy
         # residents take the argmax fast path
@@ -2846,6 +3410,20 @@ class PagedGenerationServer:
                     "decode_dispatch", k=k,
                     request_ids=[self._slots[i]["req"].rid
                                  for i in active_idx]):
+                self._maybe_fault("slow_dispatch")
+                self._maybe_fault("ensure_many")
+                # grow tables for the incoming token(s) BEFORE the
+                # step writes them (k tokens starting at the feed
+                # position) — inside the try so a pool error takes the
+                # recovery path instead of killing the engine thread
+                self.cache.ensure_many(
+                    [(self._slots[i]["seq"], self._slots[i]["pos"]
+                      + len(self._slots[i]["toks"]) - 1 + k)
+                     for i in active_idx])
+                tables = jnp.asarray(self.cache.table_array(
+                    [s["seq"] if s is not None else None
+                     for s in self._slots], self._m_width))
+                self._maybe_fault("decode")
                 if k == 1:
                     nxt, stopped, kc, vc, counts = \
                         self._decoder.step(
@@ -2864,20 +3442,15 @@ class PagedGenerationServer:
                             self.cache.v_blocks, sp_args)
                     toks = np.asarray(toks)        # [k, S]
                     stops = np.asarray(stopped)
-        except Exception as e:  # noqa: BLE001 — fan out, drop slots
-            self._engine_exception("decode", e,
-                                   [self._slots[i]["req"].rid
-                                    for i in active_idx])
-            for i in active_idx:
-                s = self._slots[i]
-                self.cache.free(s["seq"])
-                del self._worst[s["seq"]]
-                s["req"].future.set_exception(e)
-                self._slots[i] = None
-                self._sp_store.clear_slot(i)
+        except Exception as e:  # noqa: BLE001 — the recovery ladder
+            # (or, with recovery off, the legacy fail-all path)
+            self._dispatch_failure("decode", e, list(active_idx))
             return
         self._sp_store.swap_counts(counts)
         self.cache.swap_arrays(kc, vc)
+        self._dispatch_ok([self._slots[i]["req"].rid
+                           for i in active_idx
+                           if self._slots[i] is not None])
         t_now = time.perf_counter()
         self._ops_progress += 1
         decoded = toks.shape[0] * len(active_idx)
@@ -2985,6 +3558,8 @@ class PagedGenerationServer:
                     proposed=proposed,
                     request_ids=[self._slots[i]["req"].rid
                                  for i in plan.slots]):
+                self._maybe_fault("slow_dispatch")
+                self._maybe_fault("ensure_many")
                 # grow every row's table to its speculative write
                 # horizon in one atomic call (reservation-backed: the
                 # admission worst case includes the K-token overrun)
@@ -3002,6 +3577,7 @@ class PagedGenerationServer:
                 sp_args, sp_mode = self._sp_store.verify_args(
                     [plan.slots[r] if r < plan.rows else None
                      for r in range(P)], plan.steps)
+                self._maybe_fault("verify")
                 vtok, accepted, stopped, kc, vc, counts = \
                     self._decoder.packed_verify(
                         self._params, jnp.asarray(plan.toks),
@@ -3012,20 +3588,15 @@ class PagedGenerationServer:
                 vtok_h = np.asarray(vtok)
                 acc_h = np.asarray(accepted)
                 stop_h = np.asarray(stopped)
-        except Exception as e:  # noqa: BLE001 — fan out, drop slots
-            self._engine_exception("verify", e,
-                                   [self._slots[i]["req"].rid
-                                    for i in plan.slots])
-            for i in plan.slots:
-                s = self._slots[i]
-                self.cache.free(s["seq"])
-                del self._worst[s["seq"]]
-                s["req"].future.set_exception(e)
-                self._slots[i] = None
-                self._sp_store.clear_slot(i)
+        except Exception as e:  # noqa: BLE001 — the recovery ladder
+            # (or, with recovery off, the legacy fail-all path)
+            self._dispatch_failure("verify", e, list(plan.slots))
             return
         self._sp_store.swap_counts(counts)
         self.cache.swap_arrays(kc, vc)
+        self._dispatch_ok([self._slots[i]["req"].rid
+                           for i in plan.slots
+                           if self._slots[i] is not None])
         _m_spec_verify.inc()
         t_now = time.perf_counter()
         self._ops_progress += 1

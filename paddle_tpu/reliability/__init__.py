@@ -1,0 +1,37 @@
+"""Fault-tolerant serving (r17): deterministic fault injection,
+dispatch recovery with request quarantine, and the crash-consistent
+session journal.
+
+Three layers (docs/RELIABILITY.md):
+
+  * `FaultPlan` — a fixed-seed schedule of faults by named seam x
+    occurrence index, wired through explicit injection points at the
+    engine's hazard seams (`PagedGenerationServer(fault_plan=...)` or
+    the PADDLE_TPU_FAULT_PLAN env var; one `is None` check when off);
+  * `RecoveryPolicy` — the recovery ladder the engine runs instead of
+    fanning a dispatch exception to every in-flight future: snapshot
+    implicated requests through the swap-out/publish machinery,
+    requeue, retry with capped exponential backoff, and quarantine a
+    request only after it is implicated in N consecutive failures;
+  * `SessionJournal` — a bounded append-only record of accepted
+    requests + emitted tokens from which a fresh engine re-admits
+    whatever a crash interrupted, token-identically
+    (`PagedGenerationServer.recover_from_journal`).
+
+This package is deliberately light (stdlib + numpy, no jax, no
+imports from the inference stack) so its exceptions and plans can be
+used anywhere — client code, front door streams, tests — without
+pulling in the engine.
+"""
+from .errors import (AdmissionShed, InjectedFault, QuarantinedRequest,
+                     RequestTimeout)
+from .faults import (ENV_FAULT_PLAN, SEAMS, Fault, FaultPlan,
+                     resolve_fault_plan)
+from .journal import SessionJournal
+from .recovery import RecoveryPolicy
+
+__all__ = [
+    "AdmissionShed", "InjectedFault", "QuarantinedRequest",
+    "RequestTimeout", "ENV_FAULT_PLAN", "SEAMS", "Fault", "FaultPlan",
+    "resolve_fault_plan", "SessionJournal", "RecoveryPolicy",
+]

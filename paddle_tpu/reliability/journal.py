@@ -1,0 +1,223 @@
+"""Crash-consistent session journal (r17, tentpole part c).
+
+A bounded APPEND-ONLY record of every accepted request and every token
+it emitted, from which a fresh `PagedGenerationServer` can re-admit
+whatever a dead engine left unfinished — an engine restart loses zero
+accepted requests, and because the whole decode stack is deterministic
+(counter-based per-request PRNG, residency-invariant positions), the
+re-admitted requests complete with tokens IDENTICAL to the run that
+never crashed.
+
+Record stream (JSON lines, one flush per line so a crash tears at most
+the final line — the loader skips a torn tail):
+
+    {"t":"accept","rid":...,"ids":[...],"gen0":[...],"budget":...,
+     "seed":...,"sampling":{...},...}     request accepted (gen0
+                                          non-empty when re-accepted
+                                          after a previous restart)
+    {"t":"tok","rid":...,"tok":N}         one emitted token
+    {"t":"done","rid":...,"reason":...}   terminal: completed,
+                                          quarantined, or timed out
+
+Boundedness: when the file grows past `max_bytes` it is COMPACTED —
+rewritten (atomically, via os.replace) with one `accept` record per
+still-live request, its emitted tokens folded into `gen0`, and every
+finished request dropped. The journal therefore costs O(live requests
++ recent tokens) disk, not O(session length).
+
+What is recoverable: accepted requests that have not reached a
+terminal record — they re-admit with their original prompt, recorded
+seed, budget and sampling params, resuming at PRNG step len(gen0).
+What is NOT: quarantined / timed-out / completed requests (terminal by
+design), per-tenant rate-bucket levels, and the stats window — see
+docs/RELIABILITY.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import asdict, is_dataclass
+
+DEFAULT_MAX_BYTES = 4 << 20  # 4 MiB before compaction
+
+
+class SessionJournal:
+    """Append-only request journal with compaction.
+
+    path: journal file (created on first append; an existing file is
+        LOADED first, so a restarted process keeps appending to the
+        same session).
+    max_bytes: compaction threshold for the on-disk file.
+    fsync: fsync after every line (true crash-consistency against
+        power loss; default off — flush-per-line already survives
+        process death, which is the failure mode tests exercise).
+    """
+
+    def __init__(self, path, max_bytes=DEFAULT_MAX_BYTES, fsync=False):
+        self.path = os.fspath(path)
+        self.max_bytes = int(max_bytes)
+        if self.max_bytes < 1024:
+            raise ValueError(f"max_bytes must be >= 1024, "
+                             f"got {max_bytes}")
+        self.fsync = bool(fsync)
+        self._lock = threading.Lock()
+        # rid -> {"ent": accept-dict, "toks": [...], "done": reason|None}
+        # (insertion-ordered: interrupted() re-admits in accept order)
+        self._state: dict[str, dict] = {}
+        self._f = None
+        self._bytes = 0
+        self._torn_lines = 0
+        if os.path.exists(self.path):
+            self._load()
+
+    # -- loading ---------------------------------------------------------
+    def _load(self):
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    self._torn_lines += 1  # torn tail of a crashed run
+                    continue
+                self._apply(rec)
+                self._bytes += len(line) + 1
+
+    def _apply(self, rec):
+        t = rec.get("t")
+        rid = rec.get("rid")
+        if t == "accept":
+            self._state[rid] = {"ent": rec, "toks": [], "done": None}
+        elif t == "tok" and rid in self._state:
+            self._state[rid]["toks"].append(int(rec["tok"]))
+        elif t == "done" and rid in self._state:
+            self._state[rid]["done"] = rec.get("reason", "done")
+
+    # -- appending -------------------------------------------------------
+    def _append_locked(self, rec):
+        if self._f is None:
+            self._f = open(self.path, "a", encoding="utf-8")
+        line = json.dumps(rec, separators=(",", ":"))
+        self._f.write(line + "\n")
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self._bytes += len(line) + 1
+        if self._bytes > self.max_bytes:
+            self._compact_locked()
+
+    def record_accept(self, req):
+        """Journal one accepted request (an engine `_Req`: rid, ids,
+        gen0, budget, seed, sampling, meta, timeout_s are read)."""
+        sampling = getattr(req, "sampling", None)
+        meta = getattr(req, "meta", None)
+        rec = {
+            "t": "accept",
+            "rid": req.rid,
+            "ids": [int(x) for x in req.ids],
+            "gen0": [int(x) for x in getattr(req, "gen0", ())],
+            "budget": int(req.budget),
+            "seed": int(req.seed),
+            "timeout_s": getattr(req, "timeout_s", None),
+            "sampling": (asdict(sampling) if is_dataclass(sampling)
+                         else None),
+        }
+        if meta is not None:
+            rec["meta"] = {"lane": meta.lane, "tenant": meta.tenant,
+                           "deadline_s": meta.deadline_s,
+                           "cost": meta.cost}
+        with self._lock:
+            self._apply(rec)
+            self._append_locked(rec)
+
+    def record_token(self, rid, tok):
+        with self._lock:
+            rec = {"t": "tok", "rid": rid, "tok": int(tok)}
+            self._apply(rec)
+            self._append_locked(rec)
+
+    def record_done(self, rid, reason):
+        with self._lock:
+            rec = {"t": "done", "rid": rid, "reason": str(reason)}
+            self._apply(rec)
+            self._append_locked(rec)
+
+    # -- compaction ------------------------------------------------------
+    def _compact_locked(self):
+        live = {rid: st for rid, st in self._state.items()
+                if st["done"] is None}
+        tmp = self.path + ".compact"
+        nbytes = 0
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rid, st in live.items():
+                ent = dict(st["ent"])
+                ent["gen0"] = list(ent.get("gen0", [])) + st["toks"]
+                line = json.dumps(ent, separators=(",", ":"))
+                f.write(line + "\n")
+                nbytes += len(line) + 1
+            f.flush()
+            os.fsync(f.fileno())
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        os.replace(tmp, self.path)
+        self._state = {rid: {"ent": {**st["ent"], "gen0":
+                                     list(st["ent"].get("gen0", []))
+                                     + st["toks"]},
+                             "toks": [], "done": None}
+                       for rid, st in live.items()}
+        self._bytes = nbytes
+
+    def compact(self):
+        """Force a compaction now (normally automatic past
+        max_bytes)."""
+        with self._lock:
+            self._compact_locked()
+
+    # -- recovery --------------------------------------------------------
+    def interrupted(self):
+        """Every accepted request with no terminal record, in accept
+        order: [{rid, ids, gen0, budget, seed, sampling, timeout_s,
+        meta?}] with emitted tokens folded into gen0 — exactly the
+        resume state `PagedGenerationServer.recover_from_journal`
+        re-admits."""
+        with self._lock:
+            out = []
+            for rid, st in self._state.items():
+                if st["done"] is not None:
+                    continue
+                ent = dict(st["ent"])
+                ent["gen0"] = list(ent.get("gen0", [])) + st["toks"]
+                ent.pop("t", None)
+                out.append(ent)
+            return out
+
+    def stats(self):
+        with self._lock:
+            done = sum(1 for st in self._state.values()
+                       if st["done"] is not None)
+            return {
+                "path": self.path,
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "accepted": len(self._state),
+                "finished": done,
+                "interrupted": len(self._state) - done,
+                "torn_lines": self._torn_lines,
+            }
+
+    def flush(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                if self.fsync:
+                    os.fsync(self._f.fileno())
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

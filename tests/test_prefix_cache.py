@@ -345,6 +345,156 @@ class TestPoolInvariantsFuzz:
         assert st["evictions"] > 0      # ... and hit pool pressure
 
 
+class TestRecoveryInterleavingFuzz:
+    """r17 satellite: the recovery ladder's pool choreography —
+    truncate-to-durable, swap-out publish, re-attach resume, and
+    injected BlockPoolExhausted (atomic, no side effects) — interleaved
+    with the regular alloc/grow/publish/CoW/free mix. The partition,
+    refcount and token-accounting invariants must hold after EVERY op,
+    and a refused ensure_many must leave the pool byte-identical."""
+
+    def _fuzz(self, n_ops, seed):
+        rs = np.random.RandomState(seed)
+        c = _cache(num_blocks=14, block_size=4)
+        master = rs.randint(1, 50, size=64).astype(np.int32)
+        live = {}          # seq -> full known token stream
+        next_seq = [0]
+        counters = {"swap_cycles": 0, "refused": 0, "truncates": 0}
+
+        def stream_for(seq):
+            """Known tokens covering the sequence's live length (the
+            recovery paths need ids for every live position)."""
+            n = c.seq_len(seq)
+            t = live[seq]
+            if t.size < n:
+                t = np.concatenate([t, rs.randint(
+                    1, 50, size=n - t.size).astype(np.int32)])
+                live[seq] = t
+            return t[:n]
+
+        def op_admit():
+            seq = next_seq[0]
+            next_seq[0] += 1
+            n = int(rs.randint(1, 30))
+            toks = master[:n].copy()
+            if rs.rand() < 0.4:
+                toks = np.concatenate([toks, rs.randint(
+                    1, 50, size=int(rs.randint(1, 7))).astype(np.int32)])
+            try:
+                cached = c.attach_prefix(seq, toks)
+                if cached == 0:
+                    c.allocate(seq, toks.size)
+                else:
+                    c.prepare_write(seq, cached)
+                    c.ensure(seq, toks.size)
+            except BlockPoolExhausted:
+                if c.has_seq(seq):
+                    c.free(seq)
+                return
+            live[seq] = toks
+
+        def op_grow():
+            if not live:
+                return
+            seq = list(live)[int(rs.randint(len(live)))]
+            try:
+                c.append(seq, int(rs.randint(1, 6)))
+            except BlockPoolExhausted:
+                pass
+
+        def op_recover_cycle():
+            """The engine's _recover_slot shape: roll back to a
+            durable length, publish + free through swap_out, then
+            re-attach the SAME stream and regrow (the resume)."""
+            if not live:
+                return
+            seq = list(live)[int(rs.randint(len(live)))]
+            ids = stream_for(seq)
+            durable = int(rs.randint(0, c.seq_len(seq) + 1))
+            if durable < c.seq_len(seq):
+                c.truncate_seq(seq, durable)
+                counters["truncates"] += 1
+            check_invariants(c)
+            c.swap_out_seq(seq, ids[:durable])
+            check_invariants(c)
+            del live[seq]
+            counters["swap_cycles"] += 1
+            if durable < 2 or rs.rand() < 0.3:
+                return  # resumed elsewhere / given up
+            rseq = next_seq[0]
+            next_seq[0] += 1
+            try:
+                cached = c.attach_prefix(rseq, ids[:durable])
+                if cached == 0:
+                    c.allocate(rseq, durable)
+                else:
+                    c.prepare_write(rseq, cached)
+                    c.ensure(rseq, durable)
+            except BlockPoolExhausted:
+                if c.has_seq(rseq):
+                    c.free(rseq)
+                return
+            live[rseq] = ids[:durable].copy()
+
+        def op_injected_exhaustion():
+            """An ensure_many asking for more than the pool can ever
+            cover must refuse ATOMICALLY: identical free/retained/
+            table state before and after."""
+            if not live:
+                return
+            seqs = list(live)[:3]
+            before = (list(c._free), list(c._retained),
+                      {s: list(t) for s, t in c._tables.items()},
+                      dict(c._lens))
+            demand = [(s, c.seq_len(s) + c.num_blocks * c.block_size)
+                      for s in seqs]
+            with pytest.raises(BlockPoolExhausted):
+                c.ensure_many(demand)
+            counters["refused"] += 1
+            assert before == (list(c._free), list(c._retained),
+                              {s: list(t) for s, t in c._tables.items()},
+                              dict(c._lens))
+
+        def op_publish():
+            if not live:
+                return
+            seq = list(live)[int(rs.randint(len(live)))]
+            c.publish_prefix(seq, stream_for(seq))
+
+        def op_free():
+            if not live:
+                return
+            seq = list(live)[int(rs.randint(len(live)))]
+            c.free(seq)
+            del live[seq]
+
+        ops = [op_admit, op_admit, op_grow, op_recover_cycle,
+               op_recover_cycle, op_injected_exhaustion, op_publish,
+               op_free]
+        for _ in range(n_ops):
+            ops[int(rs.randint(len(ops)))]()
+            check_invariants(c)
+        for seq in list(live):
+            c.free(seq)
+            check_invariants(c)
+        assert c._ref == {}
+        assert c.free_block_count + c.retained_block_count \
+            == c.num_blocks - 1
+        # the fuzz actually exercised every recovery path
+        assert counters["swap_cycles"] > 10
+        assert counters["truncates"] > 5
+        assert counters["refused"] > 5
+        st = c.stats()["prefix_cache"]
+        assert st["hits"] > 5
+
+    def test_recovery_interleaving_keeps_invariants(self):
+        self._fuzz(400, seed=4321)
+
+    @pytest.mark.slow
+    def test_recovery_interleaving_long(self):
+        self._fuzz(2000, seed=9876)
+
+
 class TestCachedPrefillLogitParity:
     """Acceptance bar: the final-step logits of a cached-prefix resume
     (attach + packed prefill from the first uncached token) must match

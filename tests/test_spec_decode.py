@@ -416,16 +416,18 @@ class TestSpecParity:
 
     def test_verify_failure_cleans_up_and_serves_on(self, tiny_model,
                                                     monkeypatch):
-        """A verify dispatch that raises must fail exactly the
-        speculating requests, release their blocks, and leave the
-        server serving later requests."""
+        """With the recovery ladder DISABLED (r17: recovery=False pins
+        the legacy blast radius — the default now retries instead), a
+        verify dispatch that raises must fail exactly the speculating
+        requests, release their blocks, and leave the server serving
+        later requests."""
         from paddle_tpu.inference import PagedGenerationServer
 
         model, cfg = tiny_model
         rs = np.random.RandomState(8)
         srv = PagedGenerationServer(
             model, max_slots=2, block_size=4, max_prompt_len=16,
-            max_new_tokens=4,
+            max_new_tokens=4, recovery=False,
             speculation=SpecConfig(max_draft_tokens=3))
         boom = {"armed": True}
         real = srv._decoder.packed_verify
