@@ -21,6 +21,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -776,6 +777,13 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
     # goodput under replay, and the survivor token-parity proof.
     st_dg = _bench_served_degraded(model, cfg, on_tpu, tiny)
 
+    # (l) FLEET axis (r18): IDENTICAL fixed-seed Poisson arrivals
+    # through 1/2/4-replica fleets with one forced mid-run replica
+    # kill (the replica_kill seam) and one planned live migration —
+    # aggregate tok/s, p99 TTFT, failover/migration counts, and the
+    # survivor token-parity md5 proof across replica counts.
+    st_fl = _bench_served_fleet(model, cfg, on_tpu, tiny)
+
     base = "gpt2tiny_served" if tiny else "gpt2s_served"
     suffix = "" if on_tpu else "_CPU_DEGRADED"
     rec_paged = {
@@ -1091,6 +1099,43 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         "itl_p99_ms": round(dg_f["itl_p99_ms"], 2),
         "prefill_dispatches": dg_f["prefill_dispatches"],
     }
+    fl_max = max(st_fl["replica_counts"])
+    rec_fl = {
+        "metric": f"{base}_fleet_tokens_per_sec{suffix}",
+        "value": round(st_fl["tokens_per_sec_by_replicas"]
+                       [str(fl_max)], 1),
+        "unit": "tokens/s",
+        # aggregate tok/s at the max replica count (with one forced
+        # mid-run replica kill absorbed) vs the clean single replica.
+        # On the single-core CPU proxy replicas share the core, so
+        # ~1.0x is expected; scaling is a chip/multi-host number.
+        "vs_baseline": round(
+            st_fl["tokens_per_sec_by_replicas"][str(fl_max)]
+            / max(st_fl["tokens_per_sec_by_replicas"]["1"], 1e-9), 3),
+        "baseline": "same fixed-seed arrivals, 1 replica, no kill",
+        "replica_counts": st_fl["replica_counts"],
+        "tokens_per_sec_by_replicas":
+            st_fl["tokens_per_sec_by_replicas"],
+        "ttft_p99_ms_by_replicas": st_fl["ttft_p99_ms_by_replicas"],
+        "ttft_p99_ms": round(st_fl["ttft_p99_ms_by_replicas"]
+                             [str(fl_max)], 2),
+        "failover_count": st_fl["failover_count"],
+        "failover_sessions": st_fl["failover_sessions"],
+        "replica_kills": st_fl["replica_kills"],
+        "migrated_sessions": st_fl["migrated_sessions"],
+        "prefix_routed": st_fl["prefix_routed"],
+        # the chaos parity proof: every request's output md5 is
+        # IDENTICAL at every replica count, across the forced kill
+        # and the live migration
+        "survivor_token_parity": st_fl["survivor_token_parity"],
+        "parity_md5": st_fl["parity_md5"],
+        "n_requests": st_fl["n_req"],
+        # schema-congruence fields shared by every served record
+        # (worst replica's ITL, fleet-total prefill dispatches at the
+        # max replica count)
+        "itl_p99_ms": round(st_fl["itl_p99_ms"], 2),
+        "prefill_dispatches": st_fl["prefill_dispatches"],
+    }
     if st_pad is not None:
         rec_pad = {
             "metric": f"{base}_mixed_padded_tokens_per_sec{suffix}",
@@ -1106,12 +1151,13 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
         rec_paged["baseline"] = \
             "padded static-batch GenerationServer, same traffic"
         records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp,
-                   rec_spec, rec_fd, rec_qz, rec_sh, rec_uni, rec_dg]
+                   rec_spec, rec_fd, rec_qz, rec_sh, rec_uni, rec_dg,
+                   rec_fl]
     else:
         rec_paged["vs_baseline"] = 1.0
         rec_paged["baseline"] = "self (tiny schema smoke)"
         records = [rec_paged, rec_mix, rec_open, rec_sp, rec_spec,
-                   rec_fd, rec_qz, rec_sh, rec_uni, rec_dg]
+                   rec_fd, rec_qz, rec_sh, rec_uni, rec_dg, rec_fl]
     if rec_tel is not None:
         records.append(rec_tel)
     if not on_tpu:
@@ -1201,6 +1247,16 @@ def _bench_served(on_tpu, telemetry=False, tiny=False):
           f"({rec_qz['slot_capacity_ratio']:.2f}x), token match "
           f"{rec_qz['greedy_token_match']:.4f}, logit mae "
           f"{rec_qz['logit_mae']:.4g}", file=sys.stderr)
+    fl_counts = rec_fl["replica_counts"]
+    print(f"# served fleet(replicas {fl_counts}, 1 forced kill + 1 "
+          f"live migration): tok/s "
+          f"{' / '.join(str(round(rec_fl['tokens_per_sec_by_replicas'][str(n)], 1)) for n in fl_counts)}, "
+          f"ttft p99 "
+          f"{' / '.join(str(round(rec_fl['ttft_p99_ms_by_replicas'][str(n)], 1)) for n in fl_counts)}ms, "
+          f"{rec_fl['failover_sessions']} sessions failed over "
+          f"({rec_fl['replica_kills']} kills), "
+          f"{rec_fl['migrated_sessions']} migrated, token parity "
+          f"{rec_fl['survivor_token_parity']}", file=sys.stderr)
     return records
 
 
@@ -1501,6 +1557,153 @@ def _bench_served_degraded(model, cfg, on_tpu, tiny):
     return {"clean": st0, "faulted": st1, "plan": plan.stats(),
             "survivor_parity": parity, "n_req": n_req,
             "quarantined_requests": n_req - len(survivors)}
+
+
+def _bench_served_fleet(model, cfg, on_tpu, tiny):
+    """Fleet sub-axis of `bench.py served` (r18): IDENTICAL fixed-seed
+    Poisson arrivals driven through 1/2/4-replica fleets (tiny: 1/2).
+    At every count >= 2 one replica is hard-killed mid-run by the
+    router's replica_kill fault seam (its sessions fail over via
+    router-journal replay) and one live session is migrated between
+    replicas through the KV wire format. The proof carried by the
+    record: the md5 over every request's output tokens is IDENTICAL
+    at every replica count — failover and migration are
+    token-invisible."""
+    import hashlib
+    import tempfile
+
+    from paddle_tpu.fleet import FleetRouter, Replica
+    from paddle_tpu.inference import PagedGenerationServer
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+    from paddle_tpu.reliability import FaultPlan
+    from paddle_tpu.sampling import SamplingParams
+
+    if tiny:
+        fmodel = model
+        counts = [1, 2]
+        n_req, new, slots, bs, mp, chunk = 6, 8, 2, 4, 12, 12
+        mig_budget = 16
+    elif on_tpu:
+        fmodel = model
+        counts = [1, 2, 4]
+        n_req, new, slots, bs, mp, chunk = 24, 32, 4, 128, 256, 256
+        mig_budget = 64
+    else:
+        fcfg = GPT2Config.tiny()  # dispatch-bound CPU proxy
+        fcfg.dropout = 0.0
+        fmodel = GPT2(fcfg)
+        fmodel.eval()
+        counts = [1, 2, 4]
+        n_req, new, slots, bs, mp, chunk = 12, 16, 2, 4, 12, 12
+        mig_budget = 48
+    vocab = fmodel.cfg.vocab_size
+    rng = np.random.RandomState(57)
+    pool = [rng.randint(1, vocab,
+                        (int(rng.randint(4, mp + 1)),)).astype(np.int32)
+            for _ in range(n_req)]
+    # half greedy, half fixed-seed sampled: parity must hold for both
+    samplings = [None if i % 2 == 0 else
+                 SamplingParams(temperature=0.8, top_p=0.9,
+                                seed=1000 + i)
+                 for i in range(n_req)]
+    gaps = np.random.RandomState(61).exponential(0.01, size=n_req)
+    max_budget = max(new, mig_budget)
+
+    def drive(n_replicas):
+        reps = [Replica(f"b{i}", PagedGenerationServer(
+            fmodel, max_slots=slots, block_size=bs, max_prompt_len=mp,
+            max_new_tokens=max_budget, prefill_chunk_tokens=chunk,
+            enable_prefix_cache=True)) for i in range(n_replicas)]
+        plan = (FaultPlan([("replica_kill", n_req // 3)],
+                          name="bench-kill") if n_replicas >= 2
+                else None)
+        jpath = tempfile.NamedTemporaryFile(
+            suffix=".journal", delete=False).name
+        router = FleetRouter(reps, journal=jpath, fault_plan=plan,
+                             probe_interval_s=0.25, seed=5).start()
+        try:
+            t0 = time.time()
+            futs, arrival = [], 0.0
+            mig_first = threading.Event()
+            for i, p in enumerate(pool):
+                arrival += gaps[i]
+                dt = arrival - (time.time() - t0)
+                if dt > 0:
+                    time.sleep(dt)
+                # request 0 is the migration candidate: a longer
+                # budget keeps it live until the mid-run migrate call
+                kw = {}
+                if i == 0:
+                    kw = {"max_new_tokens": mig_budget,
+                          "on_token":
+                              lambda t, r: mig_first.set()}
+                else:
+                    kw = {"max_new_tokens": new}
+                futs.append(router.submit(
+                    p, sampling=samplings[i], **kw))
+                if i == n_req // 2 and n_replicas >= 2:
+                    # planned live migration mid-run (first token
+                    # already streamed, so the session is resident)
+                    mig_first.wait(timeout=120)
+                    try:
+                        router.migrate_session(
+                            list(router._sessions)[0])
+                    except KeyError:
+                        pass  # finished early: nothing to migrate
+            hashes = [hashlib.md5(np.ascontiguousarray(
+                f.result(timeout=900)).tobytes()).hexdigest()
+                for f in futs]
+            st = router.stats()
+            eng = [rep.server.stats() for rep in reps
+                   if not rep.dead]
+        finally:
+            router.stop()
+            try:
+                os.unlink(jpath)
+            except OSError:
+                pass
+        return hashes, st, eng
+
+    drive(counts[0])  # discarded warm pass: compiles stay out of the
+    # measured windows (every drive shares the in-process jit caches)
+    by_tok, by_ttft = {}, {}
+    parity = True
+    base_hashes = None
+    fail_ct = fail_sess = kills = migs = prefix_routed = 0
+    itl_p99 = 0.0
+    prefill_disp = 0
+    for n in counts:
+        hashes, st, eng = drive(n)
+        if base_hashes is None:
+            base_hashes = hashes
+        elif hashes != base_hashes:
+            parity = False
+        by_tok[str(n)] = st["new_tokens"] / max(st["wall_s"], 1e-9)
+        by_ttft[str(n)] = st["ttft_p99_ms"]
+        if n == counts[-1]:
+            fail_ct = st["failovers"]
+            fail_sess = st["failover_sessions"]
+            kills = st["replica_kills"]
+            migs = st["migrations"]
+            prefix_routed = st["prefix_routed"]
+            itl_p99 = max((e["itl_p99_ms"] for e in eng), default=0.0)
+            prefill_disp = sum(e["prefill_dispatches"] for e in eng)
+    return {
+        "replica_counts": counts,
+        "n_req": n_req,
+        "tokens_per_sec_by_replicas": by_tok,
+        "ttft_p99_ms_by_replicas": by_ttft,
+        "failover_count": fail_ct,
+        "failover_sessions": fail_sess,
+        "replica_kills": kills,
+        "migrated_sessions": migs,
+        "prefix_routed": prefix_routed,
+        "survivor_token_parity": parity,
+        "parity_md5": hashlib.md5(
+            "".join(base_hashes).encode()).hexdigest(),
+        "itl_p99_ms": itl_p99,
+        "prefill_dispatches": prefill_disp,
+    }
 
 
 def _bench_served_quantization(model, cfg, prompts, slots, bs, hi, new,

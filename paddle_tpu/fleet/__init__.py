@@ -1,0 +1,39 @@
+"""Resilient serving fleet (r18, ROADMAP item 4): replicated
+`PagedGenerationServer` engines behind a failover `FleetRouter` with
+journal-backed session takeover.
+
+    from paddle_tpu.fleet import FleetRouter, Replica
+
+    reps = [Replica(f"r{i}", PagedGenerationServer(
+                model, enable_prefix_cache=True, ...))
+            for i in range(4)]
+    router = FleetRouter(reps, journal="fleet.journal").start()
+    fut = router.submit(ids)                  # placed prefix-aware
+    h = router.submit(ids, stream=True)       # survives replica death
+    router.migrate_session(rid, target="r2")  # zero-recompute move
+    router.stop()
+
+A replica dying mid-stream is a recoverable, TESTED path: every
+accepted request is journaled at the router (resolved seed, sampling,
+every delivered token), the dead replica's sessions re-admit on
+survivors via `PagedGenerationServer.admit_journal_entry`, and the
+deterministic decode stack resumes them at PRNG step len(gen0) —
+completed output is token-identical to a run that was never
+interrupted. See docs/FLEET.md for the replica state machine, the
+failover-vs-migration decision table, the parity guarantee and what
+is NOT recoverable.
+"""
+from ..reliability import ReplicaUnavailable  # noqa: F401 (re-export)
+from .federation import (add_label_to_prom_text,  # noqa: F401
+                         federate_metrics, http_fetcher)
+from .health import ReplicaHealth  # noqa: F401
+from .migration import (deserialize_kv_payload,  # noqa: F401
+                        serialize_kv_payload)
+from .replica import Replica  # noqa: F401
+from .router import FleetRouter  # noqa: F401
+
+__all__ = [
+    "FleetRouter", "Replica", "ReplicaHealth", "ReplicaUnavailable",
+    "federate_metrics", "add_label_to_prom_text", "http_fetcher",
+    "serialize_kv_payload", "deserialize_kv_payload",
+]

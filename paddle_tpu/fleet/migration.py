@@ -1,0 +1,86 @@
+"""KV-payload wire format for planned session migration (fleet round,
+tentpole part c).
+
+`PagedKVCache.export_prefix` hands back a host-side payload (numpy
+block contents — int8 codes + scales ride together under a quantized
+pool — plus fills and the pool layout); this module is the WIRE half:
+a self-describing bytes encoding (`serialize_kv_payload` /
+`deserialize_kv_payload`) so a session's published K/V can cross a
+process or host boundary and be re-published on the target pool via
+`PagedKVCache.import_prefix`. In-process fleets round-trip through it
+too — the router migrates through bytes on purpose, so the format
+stays exercised.
+
+Encoding: one uncompressed .npz (numpy's own container) holding a
+JSON header under `__meta__` and each block leaf under a positional
+key (`k{i}` / `v{i}` for a dense pool, `k{i}_codes` / `k{i}_scales`
+etc. for int8 — the leaf structure is implied by kv_dtype, so no
+pickling and no treedef on the wire).
+"""
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+
+_META = "__meta__"
+_FIELDS = ("tokens", "block_size", "kv_dtype", "num_layers",
+           "num_heads", "head_dim", "fills")
+
+
+def _leaves(kv_dtype, arr):
+    """Positional leaf list of one block's K or V content."""
+    if kv_dtype == "int8":
+        return [("codes", np.asarray(arr.codes)),
+                ("scales", np.asarray(arr.scales))]
+    return [("", np.asarray(arr))]
+
+
+def _unleaves(kv_dtype, parts):
+    if kv_dtype == "int8":
+        from ..inference.kv_quant import QuantizedKV
+
+        return QuantizedKV(parts["codes"], parts["scales"])
+    return parts[""]
+
+
+def serialize_kv_payload(payload):
+    """`export_prefix` payload -> bytes (None passes through as b"" —
+    a session with nothing cached migrates by journal replay)."""
+    if payload is None:
+        return b""
+    meta = {f: payload[f] for f in _FIELDS}
+    arrays = {}
+    for side in ("k", "v"):
+        for i, block in enumerate(payload[side]):
+            for suffix, arr in _leaves(payload["kv_dtype"], block):
+                key = f"{side}{i}" + (f"_{suffix}" if suffix else "")
+                arrays[key] = arr
+    buf = io.BytesIO()
+    np.savez(buf, **arrays,
+             **{_META: np.frombuffer(
+                 json.dumps(meta).encode("utf-8"), np.uint8)})
+    return buf.getvalue()
+
+
+def deserialize_kv_payload(data):
+    """bytes -> `import_prefix` payload (b"" -> None)."""
+    if not data:
+        return None
+    with np.load(io.BytesIO(data)) as z:
+        meta = json.loads(bytes(z[_META]).decode("utf-8"))
+        kv_dtype = meta["kv_dtype"]
+        n = len(meta["fills"])
+        out = dict(meta)
+        for side in ("k", "v"):
+            blocks = []
+            for i in range(n):
+                if kv_dtype == "int8":
+                    parts = {"codes": z[f"{side}{i}_codes"],
+                             "scales": z[f"{side}{i}_scales"]}
+                else:
+                    parts = {"": z[f"{side}{i}"]}
+                blocks.append(_unleaves(kv_dtype, parts))
+            out[side] = blocks
+    return out

@@ -183,6 +183,7 @@ class StreamHandle:
         self._timeout = None if timeout_s is None else float(timeout_s)
         self.coalesced = 0
         self._future = None
+        self._bind_gen = 0  # rebind epoch: stale futures are ignored
 
     # ---- producer side (engine thread) --------------------------------
     def _on_token(self, tok, reason):
@@ -216,12 +217,29 @@ class StreamHandle:
         """Attach the engine future; a request that dies without a
         final token (dispatch failure, server stop) still terminates
         the stream via the future's done callback."""
-        self._future = future
-        future.add_done_callback(self._on_future_done)
+        with self._cv:
+            self._future = future
+            self._bind_gen += 1
+            gen = self._bind_gen
+        future.add_done_callback(
+            lambda f: self._on_future_done(f, gen))
         return self
 
-    def _on_future_done(self, fut):
+    def rebind(self, future):
+        """RE-ATTACH the stream to a new engine future (fleet round:
+        failover/migration moved the session to another replica).
+        Token delivery simply continues — the new replica resumes at
+        the next undelivered token, so the consumer sees one
+        uninterrupted stream — and any terminal outcome of the OLD
+        future after this point is ignored (its generation is stale).
+        `result()` now reports the new future's outcome. No-op safe
+        on a stream that already finished."""
+        return self._bind(future)
+
+    def _on_future_done(self, fut, gen=None):
         with self._cv:
+            if gen is not None and gen != self._bind_gen:
+                return  # stale binding: the stream was rebound
             if not self._done:
                 self._done = True
                 exc = fut.exception()

@@ -570,6 +570,58 @@ class PagedKVCache:
         return seq_id in self._tables
 
     # ---- prefix caching (round 9) -------------------------------------
+    def _match_chain(self, ids, max_match):
+        """Walk the content index along `ids`: the longest chain of
+        cached blocks covering a prefix of ids[:max_match]. Returns
+        (blocks, fills, pos) — fills[i] is how many tokens block i
+        contributes (== block_size for interior blocks; the final
+        block may be a partial-tail entry or capped by max_match,
+        either of which ends the chain). READ-ONLY: no refcounts,
+        counters or gauges move — `attach_prefix` claims on top of
+        this, `match_prefix_len`/`export_prefix` (fleet round) just
+        read."""
+        matched: list[int] = []
+        fills: list[int] = []
+        h = ROOT_HASH
+        pos = 0
+        n = int(ids.size)
+        while pos < max_match:
+            cand = self._child_fills.get(h)
+            if not cand:
+                break
+            avail = n - pos            # tokens we can hash from here
+            hit = None
+            for f in sorted(cand, reverse=True):  # longest match first
+                if f > avail:
+                    continue
+                hh = prefix_block_hash(h, ids[pos:pos + f])
+                ent = self._index.get(hh)
+                if ent is not None:
+                    hit = (hh, ent, f)
+                    break
+            if hit is None:
+                break
+            hh, (block, _fill, _parent), f = hit
+            use = min(f, max_match - pos)
+            matched.append(block)
+            fills.append(use)
+            pos += use
+            if f < self.block_size or use < f:
+                break                  # partial block ends the chain
+            h = hh
+        return matched, fills, pos
+
+    def match_prefix_len(self, token_ids):
+        """Read-only longest-cached-prefix probe: how many tokens of
+        `token_ids` an `attach_prefix` with the same stream would
+        serve from cache RIGHT NOW (same len-1 cap — the last token is
+        always recomputed), with zero side effects: nothing is
+        claimed, no hit/lookup counter moves. The fleet router's
+        prefix-aware placement signal (route a request to the replica
+        already holding its longest prefix)."""
+        ids = np.asarray(token_ids).reshape(-1)
+        return self._match_chain(ids, int(ids.size) - 1)[2]
+
     def attach_prefix(self, seq_id, token_ids):
         """Content-addressed prefix attach: find the longest chain of
         cached blocks matching `token_ids` and start `seq_id` on them by
@@ -596,32 +648,7 @@ class PagedKVCache:
             _m_prefix_lookups.labels(pool=self._name).inc()
             _m_prefix_lookup_tokens.labels(pool=self._name).inc(
                 max(0, max_match))
-        matched: list[int] = []
-        h = ROOT_HASH
-        pos = 0
-        while pos < max_match:
-            fills = self._child_fills.get(h)
-            if not fills:
-                break
-            avail = n - pos            # tokens we can hash from here
-            hit = None
-            for f in sorted(fills, reverse=True):  # longest match first
-                if f > avail:
-                    continue
-                hh = prefix_block_hash(h, ids[pos:pos + f])
-                ent = self._index.get(hh)
-                if ent is not None:
-                    hit = (hh, ent, f)
-                    break
-            if hit is None:
-                break
-            hh, (block, _fill, _parent), f = hit
-            use = min(f, max_match - pos)  # cap: last token never cached
-            matched.append(block)
-            pos += use
-            if f < self.block_size or use < f:
-                break                  # partial block ends the chain
-            h = hh
+        matched, _fills, pos = self._match_chain(ids, max_match)
         if pos == 0:
             return 0
         for b in matched:              # claim the chain
@@ -736,6 +763,91 @@ class PagedKVCache:
             self.publish_prefix(seq_id, ids[:live])
         self.free(seq_id)
         return live
+
+    # ---- cross-pool migration (fleet round) ---------------------------
+    def export_prefix(self, token_ids):
+        """Serialize the longest cached chain matching `token_ids` for
+        migration to ANOTHER pool: host-side numpy copies of the block
+        contents (int8 codes + scales travel together under a
+        quantized pool) plus per-block fills and the pool layout.
+        Returns None when the index covers nothing. The inverse,
+        `import_prefix`, re-publishes the chain into a
+        layout-identical pool so a later `attach_prefix` there resumes
+        the session with zero prefill recompute. Read-only here — the
+        source blocks stay exactly as retained/shared as they were."""
+        import jax
+
+        ids = np.asarray(token_ids).reshape(-1)
+        blocks, fills, pos = self._match_chain(ids, int(ids.size))
+        if pos == 0:
+            return None
+
+        def grab(arr, b):
+            return jax.tree.map(lambda a: np.asarray(a[:, b]), arr)
+
+        return {
+            "tokens": [int(t) for t in ids[:pos]],
+            "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype,
+            "num_layers": self.num_layers,
+            "num_heads": self.num_heads,
+            "head_dim": self.head_dim,
+            "fills": list(fills),
+            "k": [grab(self.k_blocks, b) for b in blocks],
+            "v": [grab(self.v_blocks, b) for b in blocks],
+        }
+
+    def import_prefix(self, payload):
+        """Install an `export_prefix` payload into THIS pool: allocate
+        blocks, write the K/V contents on device, and register the
+        chain in the content index exactly as `publish_prefix` would
+        have — the imported blocks park in the LRU retention list
+        (refcount 0, indexed) until an `attach_prefix` claims them.
+        First publisher wins: a chain entry whose hash this pool
+        already holds keeps the existing block and the redundant
+        import block returns to the free list. Raises
+        BlockPoolExhausted when the pool cannot cover the chain (the
+        caller falls back to journal-replay resume) and ValueError on
+        a layout mismatch. Returns the number of tokens published."""
+        import jax
+
+        for field in ("block_size", "kv_dtype", "num_layers",
+                      "num_heads", "head_dim"):
+            if payload[field] != getattr(self, field):
+                raise ValueError(
+                    f"import_prefix layout mismatch on {field}: "
+                    f"payload has {payload[field]!r}, pool has "
+                    f"{getattr(self, field)!r}")
+        ids = np.asarray(payload["tokens"], np.int64).reshape(-1)
+        fills = [int(f) for f in payload["fills"]]
+        if not fills or int(ids.size) != sum(fills):
+            raise ValueError(
+                f"import_prefix payload inconsistent: {ids.size} "
+                f"tokens vs fills {fills}")
+        new_blocks = self._take_blocks(len(fills))  # may raise
+        for b, pk, pv in zip(new_blocks, payload["k"], payload["v"]):
+            self.k_blocks = jax.tree.map(
+                lambda a, p, _b=b: a.at[:, _b].set(p),
+                self.k_blocks, pk)
+            self.v_blocks = jax.tree.map(
+                lambda a, p, _b=b: a.at[:, _b].set(p),
+                self.v_blocks, pv)
+        h = ROOT_HASH
+        pos = 0
+        for b, f in zip(new_blocks, fills):
+            hh = prefix_block_hash(h, ids[pos:pos + f])
+            if hh not in self._index:
+                self._register_entry(hh, b, f, h)
+            # release the construction refcount: indexed blocks park
+            # in retention, an already-published duplicate frees
+            # outright (first publisher wins)
+            self._release_block(b)
+            pos += f
+            if f < self.block_size:
+                break                  # partial tail ends the chain
+            h = hh
+        self._push_gauges()
+        return pos
 
     def table_array(self, seq_ids, width=None):
         """Dense int32 [len(seq_ids), width] block-table matrix for the

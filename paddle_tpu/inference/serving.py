@@ -1050,6 +1050,14 @@ class PagedGenerationServer:
         self._consec_failures = 0                # failing dispatches
         self._any_timeouts = False  # set once a timed request is seen
         self._last_recovery = None  # {"ts","recovered_from","failures"}
+        self._last_error_info = None  # structured degraded_reason
+        # fleet round (r18): host ops the ENGINE THREAD executes at the
+        # next round boundary (device state is only ever touched from
+        # that thread — migration imports/exports queue here), and the
+        # drain flag readiness() reports (live but not accepting new
+        # placements).
+        self._host_ops: list = []
+        self._draining = False
         # window counters (reset_stats-coherent)
         self._faults_injected = 0
         self._dispatch_retries = 0
@@ -1097,7 +1105,9 @@ class PagedGenerationServer:
 
             self.exporter = OpsEndpoint(
                 statusz_fn=self.statusz,
-                healthz_fn=self.health).start(port=expose_port)
+                healthz_fn=self.health,
+                livez_fn=self.liveness,
+                readyz_fn=self.readiness).start(port=expose_port)
             # pull-time health gauge; like the watchdog heartbeat
             # gauge, it follows the most recently built ops-plane
             # server when several are live
@@ -1162,8 +1172,49 @@ class PagedGenerationServer:
         if self._last_error is not None:
             detail["last_error"] = self._last_error
             detail["degraded_reason"] = self._last_error
+            if self._last_error_info is not None:
+                # machine-readable degradation (r18 satellite): the
+                # seam, type, and — for pool exhaustion — the
+                # structured needed/available shortfall
+                detail["last_error_info"] = dict(self._last_error_info)
             return "degraded", detail
         return "ok", detail
+
+    def liveness(self):
+        """(live, detail) for /healthz/live — the ENGINE LOOP is alive
+        (started, not stopped, thread running). Degraded or stalled is
+        still live; dead is the fleet router's FAIL-OVER signal (its
+        resident sessions re-admit elsewhere), where not-ready is
+        merely its stop-routing signal. Split-health satellite, r18."""
+        alive = (not self._stop and self._thread is not None
+                 and self._thread.is_alive())
+        return alive, {"engine_running": alive,
+                       "stopped": self._stop,
+                       "progress": self._ops_progress}
+
+    def readiness(self):
+        """(ready, detail) for /healthz/ready — alive AND accepting
+        admissions: not draining (`set_draining`), not stalled. A
+        router keeps sessions ON a not-ready replica (they finish or
+        drain) but places no new ones — "drain, don't route" vs the
+        liveness signal's "dead, fail over"."""
+        alive, detail = self.liveness()
+        stalled = self._watchdog is not None and self._watchdog.stalled
+        ready = alive and not stalled and not self._draining
+        detail = dict(detail, stalled=stalled, draining=self._draining,
+                      queue_depth=(self._sched.depth()
+                                   if self._sched is not None
+                                   else len(self._queue)))
+        return ready, detail
+
+    def set_draining(self, draining=True):
+        """Mark the engine drain-only: /healthz/ready answers 503 (a
+        router stops placing NEW sessions here) while residents keep
+        decoding to completion. Liveness and the legacy /healthz are
+        untouched. Returns self."""
+        self._draining = bool(draining)
+        self._recorder.record("draining", draining=self._draining)
+        return self
 
     def statusz(self):
         """Live JSON engine state for /statusz: per-slot residency plus
@@ -1187,9 +1238,15 @@ class PagedGenerationServer:
                     "tenant": meta.tenant if meta else None,
                 })
         status, detail = self.health()
+        live, live_detail = self.liveness()
+        ready, ready_detail = self.readiness()
         return {
             "server": "paged",
             "health": {"status": status, **detail},
+            # split health semantics (r18): what /healthz/live and
+            # /healthz/ready answer, inlined for one-stop debugging
+            "liveness": {"live": live, **live_detail},
+            "readiness": {"ready": ready, **ready_detail},
             "slots": slots,
             "max_slots": self.max_slots,
             "engine": self.stats(),
@@ -1208,6 +1265,16 @@ class PagedGenerationServer:
         kind, and the flight recorder auto-dumps — the post-hoc record
         of the rounds that led here."""
         self._last_error = f"{where}: {type(e).__name__}: {e}"
+        # structured twin of the string (r18 satellite): /statusz and
+        # /healthz carry machine-readable fields — a router's passive
+        # health signal parses these, not the message. Pool exhaustion
+        # additionally carries its needed/available pressure fields.
+        info = {"where": where, "error_type": type(e).__name__,
+                "message": str(e)}
+        if isinstance(e, BlockPoolExhausted):
+            info["needed"] = e.needed
+            info["available"] = e.available
+        self._last_error_info = info
         _m_engine_exc.labels(where=where).inc()
         self._recorder.record("engine_exception", where=where,
                               error=self._last_error,
@@ -1410,6 +1477,7 @@ class PagedGenerationServer:
                 self._consec_failures = 0
                 self._recoveries += 1
                 self._last_error = None  # degraded -> ok
+                self._last_error_info = None
             _m_recoveries.inc()
             self._recorder.record(
                 "recovered",
@@ -1513,41 +1581,164 @@ class PagedGenerationServer:
         if j is None:
             raise ValueError("no journal: pass one or build the "
                              "server with journal=")
-        out = {}
-        for ent in j.interrupted():
-            req = self._build_resume_req(ent)
-            done = self._journal_terminal_reason(req)
-            if done is not None:
-                # the crash lost only the terminal record: the request
-                # is already complete — resolve without re-admitting
-                if self._journal is not None:
-                    self._journal.record_done(req.rid, done)
-                req.future.set_result(np.concatenate(
-                    [req.ids, np.asarray(req.gen0, np.int32)])
-                    if req.gen0 else req.ids.copy())
-                out[req.rid] = req.future
-                continue
-            with self._lock:
-                if self._stop:
-                    raise RuntimeError("server stopped")
-                if self._sched is not None:
-                    self._sched.on_submit(req, time.perf_counter())
-                else:
-                    self._queue.append(req)
+        return {ent["rid"]: self.admit_journal_entry(ent)
+                for ent in j.interrupted()}
+
+    def admit_journal_entry(self, ent, on_token=None):
+        """Re-admit ONE journal-shape session entry (the dict
+        `SessionJournal.entry_for`/`interrupted()` produce: rid, ids,
+        gen0, budget, seed, sampling, timeout_s, meta?) and return its
+        Future — the replica-facing takeover hook (fleet round): a
+        router re-places a dead or drained replica's session here with
+        the ROUTER-journaled tokens folded into gen0, and the decode
+        stack's determinism (counter-based PRNG resuming at step
+        len(gen0), residency-invariant positions) makes the completed
+        output token-identical to the run that was never interrupted.
+        An entry whose recorded tokens already satisfy a stop
+        condition resolves immediately. `on_token` streams the
+        REMAINING tokens (the re-admission generates from len(gen0)
+        on, so nothing already delivered is replayed to the client)."""
+        req = self._build_resume_req(ent)
+        req.on_token = on_token
+        done = self._journal_terminal_reason(req)
+        if done is not None:
+            # the interruption lost only the terminal record: the
+            # request is already complete — resolve without admitting
+            if self._journal is not None:
+                self._journal.record_done(req.rid, done)
+            req.future.set_result(np.concatenate(
+                [req.ids, np.asarray(req.gen0, np.int32)])
+                if req.gen0 else req.ids.copy())
+            return req.future
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("server stopped")
+            if self._sched is not None:
+                self._sched.on_submit(req, time.perf_counter())
+            else:
+                self._queue.append(req)
+                _m_queue_depth.labels(server="paged").set(
+                    len(self._queue))
+            if self._journal is not None:
+                # re-accept (under the lock, before the loop can
+                # admit) with gen0 folded, so a second crash
+                # resumes from here, not from the original prompt
+                self._journal.record_accept(req)
+            self._lock.notify()
+        self._recorder.record("journal_readmit", request_id=req.rid,
+                              tokens_done=len(req.gen0))
+        _tracing.event("journal_readmit", request_id=req.rid,
+                       tokens_done=len(req.gen0))
+        return req.future
+
+    # ---- fleet host ops (r18) ------------------------------------------
+    def _run_host_ops_locked(self):
+        """Execute queued host ops on the engine thread (caller holds
+        the lock, the in-flight round is drained): each op may touch
+        the cache device arrays safely because nothing else ever does
+        between round boundaries."""
+        ops, self._host_ops = self._host_ops, []
+        for fn, fut in ops:
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — the op's
+                fut.set_exception(e)    # error belongs to its caller
+
+    def _fail_host_ops_locked(self, exc):
+        ops, self._host_ops = self._host_ops, []
+        for _fn, fut in ops:
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def run_host_op(self, fn, timeout=None):
+        """Run `fn()` on the ENGINE thread at the next round boundary
+        (under the engine lock, with any async round drained) and
+        return its result — the safe way for another thread to touch
+        the paged cache's device arrays (migration import/export). On
+        a not-yet-started server the op runs inline. Never call from
+        an engine callback (on_token/scheduler) — that deadlocks."""
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("server stopped")
+            if self._thread is None:
+                return fn()
+            f = Future()
+            self._host_ops.append((fn, f))
+            self._lock.notify()
+        return f.result(timeout=timeout)
+
+    def export_session(self, rid, include_kv=True):
+        """Planned-migration SOURCE hook (fleet round): atomically
+        detach one request — resident (preempt-style swap-out, its
+        live K/V published through the prefix index when caching is
+        on) or still queued — and return `(entry, kv_payload)`.
+        `entry` is the journal-shape resume state
+        `admit_journal_entry` re-admits on the target replica;
+        `kv_payload` is `PagedKVCache.export_prefix` of the swapped-
+        out chain (None when caching is off, the request never
+        prefilled, or include_kv=False) — imported on the target, the
+        session resumes with ZERO prefill recompute. The request's
+        future on THIS server is abandoned (the router owns the
+        client-facing future) and its journal entry closes with
+        reason "migrated". Raises KeyError for an unknown or already-
+        finished rid."""
+        def op():
+            for i, s in enumerate(self._slots):
+                if s is not None and s["req"].rid == rid:
+                    req = self._preempt_slot_locked(i, why="migration")
+                    if req is None:
+                        break  # the drain completed it: fall through
+                    ent = SessionJournal.entry_for(req)
+                    payload = None
+                    if include_kv and self.enable_prefix_cache:
+                        payload = self.cache.export_prefix(
+                            req.resume_ids)
+                    if self._journal is not None:
+                        self._journal.record_done(rid, "migrated")
+                    self._recorder.record(
+                        "migrate_out", request_id=rid,
+                        tokens_done=len(req.gen0),
+                        kv_tokens=(len(payload["tokens"])
+                                   if payload else 0))
+                    _tracing.event("migrate_out", request_id=rid,
+                                   tokens_done=len(req.gen0))
+                    return ent, payload
+            req = None
+            if self._sched is not None:
+                exp = getattr(self._sched, "expire", None)
+                if exp is not None:
+                    hits = exp(time.perf_counter(),
+                               lambda r: r.rid == rid)
+                    req = hits[0] if hits else None
+            else:
+                req = next((q for q in self._queue if q.rid == rid),
+                           None)
+                if req is not None:
+                    self._queue.remove(req)
                     _m_queue_depth.labels(server="paged").set(
                         len(self._queue))
-                if self._journal is not None:
-                    # re-accept (under the lock, before the loop can
-                    # admit) with gen0 folded, so a second crash
-                    # resumes from here, not from the original prompt
-                    self._journal.record_accept(req)
-                self._lock.notify()
-            self._recorder.record("journal_readmit", request_id=req.rid,
-                                  tokens_done=len(req.gen0))
-            _tracing.event("journal_readmit", request_id=req.rid,
-                           tokens_done=len(req.gen0))
-            out[req.rid] = req.future
-        return out
+            if req is None:
+                raise KeyError(
+                    f"unknown or already-finished request {rid!r} in "
+                    f"export_session()")
+            ent = SessionJournal.entry_for(req)
+            if self._journal is not None:
+                self._journal.record_done(rid, "migrated")
+            self._recorder.record("migrate_out", request_id=rid,
+                                  tokens_done=len(req.gen0),
+                                  kv_tokens=0)
+            return ent, None
+        return self.run_host_op(op)
+
+    def import_kv_payload(self, payload):
+        """Planned-migration TARGET hook: install an `export_prefix`
+        payload into this server's pool (on the engine thread — see
+        `run_host_op`) so the follow-up `admit_journal_entry` attaches
+        it instead of re-prefilling. Returns tokens imported; raises
+        BlockPoolExhausted when the pool cannot hold the chain (the
+        router then falls back to plain journal replay)."""
+        return self.run_host_op(
+            lambda: self.cache.import_prefix(payload))
 
     def _build_resume_req(self, ent):
         """One journal entry -> a resume-state `_Req` (bypasses
@@ -1774,7 +1965,7 @@ class PagedGenerationServer:
 
     # ---- client API ----------------------------------------------------
     def submit(self, ids, max_new_tokens=None, sampling=None, *,
-               meta=None, on_token=None, timeout_s=None):
+               meta=None, on_token=None, timeout_s=None, rid=None):
         """Enqueue one prompt (any length <= max_prompt_len; NO padding
         needed). Returns a Future resolving to the UNPADDED
         [len + generated] int32 sequence (generation stops at EOS, a
@@ -1805,6 +1996,10 @@ class PagedGenerationServer:
         is CANCELLED: its slot and blocks are freed and its future
         fails with `RequestTimeout` (streams see reason="timeout").
         Enforced by the engine loop, so it needs a started server.
+        rid: caller-pinned request id (fleet round) — a router names
+        the session once and every replica-facing hook
+        (`export_session`, journal records, quarantine diagnostics)
+        speaks the same id. Default: auto-assigned "pN".
 
         When the server was built with `shed_queue_depth=`, a submit
         arriving at a queue already that deep raises `AdmissionShed`
@@ -1840,7 +2035,8 @@ class PagedGenerationServer:
             self._any_timeouts = True
         req = _Req(ids=ids, future=Future(),
                    t_submit=time.perf_counter(),
-                   rid=f"p{next(_req_ids)}", sampling=sampling,
+                   rid=(str(rid) if rid is not None
+                        else f"p{next(_req_ids)}"), sampling=sampling,
                    meta=meta, on_token=on_token, timeout_s=timeout_s)
         # per-request PRNG stream seed: explicit seeds reproduce tokens
         # regardless of batch composition; auto seeds derive from the
@@ -1960,6 +2156,7 @@ class PagedGenerationServer:
             self._overlap_s = 0.0
             self._compile_mark = _compile_tracker.mark()
             self._last_error = None  # a fresh window is healthy again
+            self._last_error_info = None
             self._consec_failures = 0
             self._faults_injected = 0
             self._dispatch_retries = 0
@@ -2736,7 +2933,16 @@ class PagedGenerationServer:
                     # async: resolve the in-flight round so no future
                     # is stranded mid-stream
                     self._drain_pending()
+                    self._fail_host_ops_locked(
+                        RuntimeError("server stopped"))
                     return
+                if self._host_ops:
+                    # fleet host ops (r18): run queued migration
+                    # exports/imports on THIS thread at the round
+                    # boundary — the in-flight round is drained first
+                    # so its write-back cannot overwrite an import
+                    self._drain_pending()
+                    self._run_host_ops_locked()
                 if self._any_timeouts:
                     self._expire_timeouts_locked(time.perf_counter())
                 self._admit_locked()

@@ -13,7 +13,15 @@ watchable from outside the process:
     summaries);
   * `/healthz`  — ok | degraded | stalled from a provider callable;
     ok and degraded answer 200 (the process still serves), stalled
-    answers 503 so load balancers drain it.
+    answers 503 so load balancers drain it. (Legacy shape, kept
+    backward-compatible.)
+  * `/healthz/live` and `/healthz/ready` — the SPLIT health semantics
+    the fleet router routes on (r18 satellite): liveness = the engine
+    loop is alive (dead -> 503 -> fail over, re-admit its sessions
+    elsewhere); readiness = alive AND accepting admissions (a
+    draining or stalled engine answers 503 ready=false -> stop
+    routing NEW sessions there, but do NOT fail over the residents).
+    Both return {"live"/"ready": bool, ...detail}.
 
 Binding is ephemeral-port friendly (`port=0` → the kernel picks; the
 bound port is on `.port`/`.url` after `start()` returns), which is how
@@ -38,7 +46,8 @@ HEALTH_STATES = ("ok", "degraded", "stalled")
 _m_scrapes = _metrics.counter(
     "serving_ops_scrapes_total",
     "ops-endpoint requests served, by endpoint "
-    "(metrics | statusz | healthz)", labelnames=("endpoint",))
+    "(metrics | statusz | healthz | livez | readyz)",
+    labelnames=("endpoint",))
 
 
 class OpsEndpoint:
@@ -49,12 +58,22 @@ class OpsEndpoint:
     healthz_fn: zero-arg callable returning either a status string or
         a (status, detail_dict) pair; status must be one of
         ok | degraded | stalled.
+    livez_fn / readyz_fn: zero-arg callables returning (bool, detail)
+        for the split /healthz/live and /healthz/ready endpoints
+        (absent -> those paths answer 404, the pre-split shape).
+    metrics_fn: zero-arg callable returning Prometheus text to serve
+        at /metrics INSTEAD of the registry (the fleet router's
+        federated, replica-labeled view).
     """
 
-    def __init__(self, registry=None, statusz_fn=None, healthz_fn=None):
+    def __init__(self, registry=None, statusz_fn=None, healthz_fn=None,
+                 livez_fn=None, readyz_fn=None, metrics_fn=None):
         self._registry = registry or _metrics.REGISTRY
         self._statusz_fn = statusz_fn
         self._healthz_fn = healthz_fn
+        self._livez_fn = livez_fn
+        self._readyz_fn = readyz_fn
+        self._metrics_fn = metrics_fn
         self._httpd = None
         self._thread = None
         self.port = None
@@ -86,8 +105,24 @@ class OpsEndpoint:
                 try:
                     if path == "/metrics":
                         _m_scrapes.labels(endpoint="metrics").inc()
-                        self._send(200, endpoint._registry.to_prometheus(),
-                                   PROM_CONTENT_TYPE)
+                        body = (endpoint._metrics_fn()
+                                if endpoint._metrics_fn
+                                else endpoint._registry.to_prometheus())
+                        self._send(200, body, PROM_CONTENT_TYPE)
+                    elif path == "/healthz/live" \
+                            and endpoint._livez_fn is not None:
+                        _m_scrapes.labels(endpoint="livez").inc()
+                        ok, detail = endpoint._livez_fn()
+                        self._send(200 if ok else 503, json.dumps(
+                            {"live": bool(ok), **dict(detail)}),
+                            "application/json")
+                    elif path == "/healthz/ready" \
+                            and endpoint._readyz_fn is not None:
+                        _m_scrapes.labels(endpoint="readyz").inc()
+                        ok, detail = endpoint._readyz_fn()
+                        self._send(200 if ok else 503, json.dumps(
+                            {"ready": bool(ok), **dict(detail)}),
+                            "application/json")
                     elif path == "/statusz":
                         _m_scrapes.labels(endpoint="statusz").inc()
                         body = (endpoint._statusz_fn()
@@ -102,10 +137,14 @@ class OpsEndpoint:
                             json.dumps({"status": status, **detail}),
                             "application/json")
                     else:
+                        paths = ["/metrics", "/statusz", "/healthz"]
+                        if endpoint._livez_fn is not None:
+                            paths.append("/healthz/live")
+                        if endpoint._readyz_fn is not None:
+                            paths.append("/healthz/ready")
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
-                             "paths": ["/metrics", "/statusz",
-                                       "/healthz"]}),
+                             "paths": paths}),
                             "application/json")
                 except Exception as e:  # noqa: BLE001 — a provider bug
                     # must answer 500, not kill the listener thread

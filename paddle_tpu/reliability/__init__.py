@@ -24,7 +24,7 @@ used anywhere — client code, front door streams, tests — without
 pulling in the engine.
 """
 from .errors import (AdmissionShed, InjectedFault, QuarantinedRequest,
-                     RequestTimeout)
+                     ReplicaUnavailable, RequestTimeout)
 from .faults import (ENV_FAULT_PLAN, SEAMS, Fault, FaultPlan,
                      resolve_fault_plan)
 from .journal import SessionJournal
@@ -32,6 +32,7 @@ from .recovery import RecoveryPolicy
 
 __all__ = [
     "AdmissionShed", "InjectedFault", "QuarantinedRequest",
-    "RequestTimeout", "ENV_FAULT_PLAN", "SEAMS", "Fault", "FaultPlan",
-    "resolve_fault_plan", "SessionJournal", "RecoveryPolicy",
+    "ReplicaUnavailable", "RequestTimeout", "ENV_FAULT_PLAN", "SEAMS",
+    "Fault", "FaultPlan", "resolve_fault_plan", "SessionJournal",
+    "RecoveryPolicy",
 ]

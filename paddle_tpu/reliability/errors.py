@@ -55,6 +55,22 @@ class RequestTimeout(RuntimeError):
             f"(timeout_s={self.timeout_s:g}); slot and blocks freed")
 
 
+class ReplicaUnavailable(RuntimeError):
+    """The fleet router could not place (or re-place) a request: no
+    replica is routable — every replica is dead, circuit-open, or
+    draining. For a request that was already streaming, this is the
+    failover path's terminal error: its journaled state stays live in
+    the router journal, so a later `recover_from_journal` on a healed
+    fleet still completes it token-identically."""
+
+    def __init__(self, rid, detail=""):
+        self.rid = str(rid)
+        self.detail = str(detail)
+        super().__init__(
+            f"no routable replica for request {self.rid}"
+            + (f": {self.detail}" if self.detail else ""))
+
+
 class AdmissionShed(RuntimeError):
     """Pool-pressure admission shedding: the submit was refused because
     the engine's queue depth crossed `shed_queue_depth`. Carries a

@@ -27,6 +27,15 @@ Seams (the engine's hazard points — see docs/RELIABILITY.md):
   stream_consumer
       raise in place of the request's `on_token` callback (exercises
       the engine's stream-isolation guard — generation continues).
+  replica_kill
+      a FLEET-level seam (r18): polled by `fleet.FleetRouter` once per
+      placement decision, never by the engine. When it fires, the
+      router hard-kills the chosen replica (`kill()` — the crash
+      simulation, no futures resolved) and fails its resident sessions
+      over to survivors via the router journal. Give the router its
+      own plan: seam occurrence counters are plan state, and sharing
+      one plan between the router and its replicas would interleave
+      their counters nondeterministically.
 
 Plans come from three places: an explicit `Fault` list, a fixed seed
 (`FaultPlan.from_seed` — Bernoulli(rate) per occurrence up to
@@ -47,9 +56,11 @@ from .errors import InjectedFault
 
 ENV_FAULT_PLAN = "PADDLE_TPU_FAULT_PLAN"
 
-#: every seam the engine exposes an injection point for.
+#: every seam an injection point exists for (replica_kill is polled by
+#: the fleet router; the rest by the engine).
 SEAMS = ("prefill", "decode", "verify", "unified_round", "ensure_many",
-         "slow_dispatch", "detokenize", "stream_consumer")
+         "slow_dispatch", "detokenize", "stream_consumer",
+         "replica_kill")
 
 #: seams whose fault is not a plain raise.
 _SEAM_KIND = {"ensure_many": "exhausted", "slow_dispatch": "slow"}

@@ -25,6 +25,16 @@ still-live request, its emitted tokens folded into `gen0`, and every
 finished request dropped. The journal therefore costs O(live requests
 + recent tokens) disk, not O(session length).
 
+Concurrency (r18 satellite): appends and compaction are safe to race
+from any number of threads. Appends serialize on the state lock;
+compaction is COPY-ON-COMPACT — it snapshots the live state under the
+lock, writes the replacement file OUTSIDE the lock (appends keep
+landing in the old file meanwhile, and are buffered), then atomically
+replays the buffered records into the new file and swaps it in. A
+record can therefore never be torn or lost by a concurrent
+compaction, and appends are never blocked for the duration of the
+rewrite (threaded stress test in tests/test_reliability.py).
+
 What is recoverable: accepted requests that have not reached a
 terminal record — they re-admit with their original prompt, recorded
 seed, budget and sampling params, resuming at PRNG step len(gen0).
@@ -62,6 +72,13 @@ class SessionJournal:
                              f"got {max_bytes}")
         self.fsync = bool(fsync)
         self._lock = threading.Lock()
+        # one compaction at a time; a second thread finding the file
+        # still over budget after the gate simply compacts again
+        self._compact_gate = threading.Lock()
+        # while a compaction is writing the replacement file, every
+        # appended line is also buffered here and replayed into the
+        # new file before the atomic swap (copy-on-compact)
+        self._compact_buf: list | None = None
         # rid -> {"ent": accept-dict, "toks": [...], "done": reason|None}
         # (insertion-ordered: interrupted() re-admits in accept order)
         self._state: dict[str, dict] = {}
@@ -106,16 +123,33 @@ class SessionJournal:
         if self.fsync:
             os.fsync(self._f.fileno())
         self._bytes += len(line) + 1
-        if self._bytes > self.max_bytes:
-            self._compact_locked()
+        if self._compact_buf is not None:
+            # a compaction is rewriting the file right now: this line
+            # landed in the old file (about to be replaced), so buffer
+            # it for verbatim replay into the new one
+            self._compact_buf.append(line)
 
-    def record_accept(self, req):
-        """Journal one accepted request (an engine `_Req`: rid, ids,
-        gen0, budget, seed, sampling, meta, timeout_s are read)."""
+    def _record(self, rec):
+        with self._lock:
+            self._apply(rec)
+            self._append_locked(rec)
+            over = self._bytes > self.max_bytes
+        if over:
+            # OUTSIDE the state lock: copy-on-compact never blocks a
+            # concurrent append on the rewrite I/O
+            self._compact(force=False)
+
+    @staticmethod
+    def entry_for(req):
+        """The journal-shape resume state of one engine request (rid,
+        ids, gen0, budget, seed, sampling, timeout_s, meta) — exactly
+        what `PagedGenerationServer.admit_journal_entry` consumes.
+        Shared by `record_accept` and the fleet router/migration path,
+        so a session serialized for replica takeover is byte-for-byte
+        the state a journal recovery would rebuild."""
         sampling = getattr(req, "sampling", None)
         meta = getattr(req, "meta", None)
-        rec = {
-            "t": "accept",
+        ent = {
             "rid": req.rid,
             "ids": [int(x) for x in req.ids],
             "gen0": [int(x) for x in getattr(req, "gen0", ())],
@@ -126,56 +160,91 @@ class SessionJournal:
                          else None),
         }
         if meta is not None:
-            rec["meta"] = {"lane": meta.lane, "tenant": meta.tenant,
+            ent["meta"] = {"lane": meta.lane, "tenant": meta.tenant,
                            "deadline_s": meta.deadline_s,
                            "cost": meta.cost}
-        with self._lock:
-            self._apply(rec)
-            self._append_locked(rec)
+        return ent
+
+    def record_accept(self, req):
+        """Journal one accepted request (an engine `_Req`: rid, ids,
+        gen0, budget, seed, sampling, meta, timeout_s are read)."""
+        self._record({"t": "accept", **self.entry_for(req)})
 
     def record_token(self, rid, tok):
-        with self._lock:
-            rec = {"t": "tok", "rid": rid, "tok": int(tok)}
-            self._apply(rec)
-            self._append_locked(rec)
+        self._record({"t": "tok", "rid": rid, "tok": int(tok)})
 
     def record_done(self, rid, reason):
-        with self._lock:
-            rec = {"t": "done", "rid": rid, "reason": str(reason)}
-            self._apply(rec)
-            self._append_locked(rec)
+        self._record({"t": "done", "rid": rid, "reason": str(reason)})
 
     # -- compaction ------------------------------------------------------
-    def _compact_locked(self):
-        live = {rid: st for rid, st in self._state.items()
-                if st["done"] is None}
-        tmp = self.path + ".compact"
-        nbytes = 0
-        with open(tmp, "w", encoding="utf-8") as f:
-            for rid, st in live.items():
-                ent = dict(st["ent"])
-                ent["gen0"] = list(ent.get("gen0", [])) + st["toks"]
-                line = json.dumps(ent, separators=(",", ":"))
-                f.write(line + "\n")
-                nbytes += len(line) + 1
-            f.flush()
-            os.fsync(f.fileno())
-        if self._f is not None:
-            self._f.close()
-            self._f = None
-        os.replace(tmp, self.path)
-        self._state = {rid: {"ent": {**st["ent"], "gen0":
-                                     list(st["ent"].get("gen0", []))
-                                     + st["toks"]},
-                             "toks": [], "done": None}
-                       for rid, st in live.items()}
-        self._bytes = nbytes
-
     def compact(self):
-        """Force a compaction now (normally automatic past
-        max_bytes)."""
-        with self._lock:
-            self._compact_locked()
+        """Force a compaction now (normally automatic past max_bytes).
+        Safe to race appends from other threads: copy-on-compact."""
+        self._compact(force=True)
+
+    def _compact(self, force):
+        with self._compact_gate:
+            with self._lock:
+                if not force and self._bytes <= self.max_bytes:
+                    return  # a racing compactor already did the work
+                # snapshot (st ref + copies): the copies feed the
+                # rewrite outside the lock, the ref detects a re-accept
+                # replacing the entry mid-compaction
+                snap = [(st, dict(st["ent"]), list(st["toks"]))
+                        for st in self._state.values()
+                        if st["done"] is None]
+                self._compact_buf = []
+            tmp = self.path + ".compact"
+            f = open(tmp, "w", encoding="utf-8")
+            try:
+                nbytes = 0
+                for _st, ent, toks in snap:
+                    ent["gen0"] = list(ent.get("gen0", [])) + toks
+                    line = json.dumps(ent, separators=(",", ":"))
+                    f.write(line + "\n")
+                    nbytes += len(line) + 1
+                with self._lock:
+                    # records appended while the rewrite ran: replay
+                    # them verbatim, then swap atomically — nothing a
+                    # racing writer appended is ever lost or torn
+                    for line in self._compact_buf:
+                        f.write(line + "\n")
+                        nbytes += len(line) + 1
+                    self._compact_buf = None
+                    f.flush()
+                    os.fsync(f.fileno())
+                    f.close()
+                    if self._f is not None:
+                        self._f.close()
+                        self._f = None
+                    os.replace(tmp, self.path)
+                    # fold ONLY the snapshotted tokens into each
+                    # entry's gen0; tokens that raced the rewrite were
+                    # replayed above and stay in toks. An entry a
+                    # re-accept replaced mid-compaction keeps its new
+                    # state (its accept line was replayed too).
+                    for st, ent, toks in snap:
+                        cur = self._state.get(ent.get("rid"))
+                        if cur is not st:
+                            continue
+                        st["ent"] = ent
+                        del st["toks"][:len(toks)]
+                    self._state = {rid: st for rid, st
+                                   in self._state.items()
+                                   if st["done"] is None}
+                    self._bytes = nbytes
+            except BaseException:
+                with self._lock:
+                    self._compact_buf = None
+                try:
+                    f.close()
+                except Exception:  # noqa: BLE001 — already closed
+                    pass
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
 
     # -- recovery --------------------------------------------------------
     def interrupted(self):

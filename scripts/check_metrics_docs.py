@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Metrics <-> docs drift check (ISSUE 10 satellite).
 
-Every `serving_*` / `kv_*` / `frontdoor_*` metric name registered in
+Every `serving_*` / `kv_*` / `frontdoor_*` / `fleet_*` metric name
+registered in
 paddle_tpu/ library code must have a row in docs/OBSERVABILITY.md's
 "What is instrumented" table, and every such name the docs claim must
 exist in code — the same drift class ADVICE.md r5 flagged for
@@ -24,7 +25,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "paddle_tpu")
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
-PREFIXES = ("serving_", "kv_", "frontdoor_")
+PREFIXES = ("serving_", "kv_", "frontdoor_", "fleet_")
 REGISTER_FNS = {"counter", "gauge", "histogram", "gauge_fn"}
 
 

@@ -1,0 +1,544 @@
+"""Resilient serving fleet (r18): replica state machine unit
+semantics, prefix-aware placement, /metrics federation, the KV wire
+format, stream re-attach, and the CHAOS GATE — a seeded replica kill
+mid-stream at 2 and 4 replicas with every interrupted session
+completing on a survivor md5-token-identically (greedy AND fixed-seed
+sampled), plus planned migration with zero prefill recompute on the
+target."""
+import hashlib
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fleet import (FleetRouter, Replica, ReplicaHealth,
+                              add_label_to_prom_text,
+                              deserialize_kv_payload, federate_metrics,
+                              serialize_kv_payload)
+from paddle_tpu.reliability import (AdmissionShed, FaultPlan,
+                                    ReplicaUnavailable)
+from paddle_tpu.sampling import SamplingParams
+
+
+@pytest.fixture(autouse=True)
+def _registry_guard():
+    from paddle_tpu.observability import metrics as M
+
+    was = M.REGISTRY.enabled
+    yield
+    M.REGISTRY.enabled = was
+    M.REGISTRY.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+    paddle.seed(100)
+    cfg = GPT2Config(vocab_size=512, hidden_size=128, num_layers=2,
+                     num_heads=4, max_position=128)
+    cfg.dropout = 0.0
+    m = GPT2(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _replica(m, name, **kw):
+    from paddle_tpu.inference import PagedGenerationServer
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_prompt_len", 24)
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("enable_prefix_cache", True)
+    return Replica(name, PagedGenerationServer(m, **kw))
+
+
+def _fleet(m, n, **router_kw):
+    reps = [_replica(m, f"r{i}") for i in range(n)]
+    return FleetRouter(reps, **router_kw), reps
+
+
+def _md5(arr):
+    return hashlib.md5(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+WORK = [
+    (np.array([3, 5, 7, 9], np.int32), {}),
+    (np.array([1, 2, 3], np.int32),
+     {"sampling": SamplingParams(temperature=0.8, top_p=0.9,
+                                 seed=77)}),
+    (np.array([8, 8, 1, 4, 2], np.int32), {}),
+    (np.array([6, 6, 6], np.int32),
+     {"sampling": SamplingParams(temperature=1.1, top_k=40,
+                                 seed=123)}),
+    (np.array([2, 7, 1, 8], np.int32), {}),
+    (np.array([9, 1, 9], np.int32),
+     {"sampling": SamplingParams(temperature=0.7, seed=31)}),
+]
+
+
+def _drive(router, work=WORK, timeout=300):
+    futs = [router.submit(ids, **kw) for ids, kw in work]
+    return [f.result(timeout=timeout) for f in futs]
+
+
+class TestReplicaHealth:
+    def test_ok_degraded_open_ladder(self):
+        h = ReplicaHealth(open_after=3, backoff_base_s=1.0,
+                          backoff_cap_s=8.0)
+        assert h.state == "ok" and h.routing_weight(0.0) == 1.0
+        h.note_failure(1.0)
+        assert h.state == "degraded"
+        assert h.routing_weight(1.0) == pytest.approx(0.25)
+        h.note_ok(2.0)  # success resets the streak
+        assert h.state == "ok" and h.consecutive_failures == 0
+        for t in (3.0, 4.0, 5.0):
+            h.note_failure(t)
+        assert h.state == "open"
+        assert h.routing_weight(5.5) == 0.0  # backoff not elapsed
+
+    def test_half_open_single_trial_and_backoff_doubling(self):
+        h = ReplicaHealth(open_after=1, backoff_base_s=1.0,
+                          backoff_cap_s=8.0)
+        h.note_failure(0.0)
+        assert h.state == "open" and h.backoff_s() == 1.0
+        assert not h.probe_due(0.5)
+        assert h.probe_due(1.5)
+        # backoff elapsed: exactly ONE trial weight is handed out
+        w1 = h.routing_weight(1.5)
+        assert h.state == "half_open" and 0 < w1 < 1
+        assert h.routing_weight(1.6) == 0.0  # trial in flight
+        h.note_failure(1.7)  # trial failed: re-open, backoff doubles
+        assert h.state == "open" and h.backoff_s() == 2.0
+        assert h.routing_weight(2.0) == 0.0
+        w2 = h.routing_weight(3.8)  # 1.7 + 2.0 elapsed
+        assert 0 < w2 < 1
+        h.note_ok(3.9)  # trial success closes the circuit
+        assert h.state == "ok" and h.routing_weight(4.0) == 1.0
+        assert h.open_episodes == 0
+
+    def test_backoff_caps(self):
+        h = ReplicaHealth(open_after=1, backoff_base_s=1.0,
+                          backoff_cap_s=4.0)
+        t = 0.0
+        for _ in range(5):
+            h.note_failure(t)
+            t += 100.0
+            h.routing_weight(t)  # half-open trial
+        assert h.backoff_s() == 4.0  # capped, not 16
+
+    def test_not_ready_and_dead_are_weight_zero(self):
+        h = ReplicaHealth()
+        h.note_not_ready(0.0, "draining")
+        assert h.state == "not_ready"
+        assert h.routing_weight(0.0) == 0.0
+        h.note_ok(1.0)
+        assert h.state == "ok"
+        h.mark_dead("killed")
+        assert h.routing_weight(2.0) == 0.0
+        h.note_ok(3.0)  # dead is terminal
+        assert h.state == "dead"
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="open_after"):
+            ReplicaHealth(open_after=0)
+        with pytest.raises(ValueError, match="backoff_cap_s"):
+            ReplicaHealth(backoff_base_s=2.0, backoff_cap_s=1.0)
+
+
+class TestFederation:
+    def test_label_injection_all_sample_shapes(self):
+        text = "\n".join([
+            "# HELP m_total help text",
+            "# TYPE m_total counter",
+            "m_total 3.0",
+            'm_labeled{a="b",c="d"} 1.5',
+            'hist_bucket{le="+Inf"} 7',
+            "",
+        ])
+        out = add_label_to_prom_text(text, "replica", "r0")
+        lines = out.splitlines()
+        assert 'm_total{replica="r0"} 3.0' in lines
+        assert 'm_labeled{replica="r0",a="b",c="d"} 1.5' in lines
+        assert 'hist_bucket{replica="r0",le="+Inf"} 7' in lines
+        assert lines[0] == "# HELP m_total help text"  # untouched
+
+    def test_federate_dedupes_comments_and_survives_dead_source(self):
+        a = "# TYPE x counter\nx 1"
+        b = "# TYPE x counter\nx 2"
+
+        def boom():
+            raise OSError("connection refused")
+
+        page = federate_metrics(
+            [("r0", a), ("r1", b), ("r2", boom)],
+            extra="# TYPE fleet_y gauge\nfleet_y 9")
+        assert page.count("# TYPE x counter") == 1
+        assert 'x{replica="r0"} 1' in page
+        assert 'x{replica="r1"} 2' in page
+        assert "# replica r2: unreachable" in page
+        assert "fleet_y 9" in page          # extra NOT relabeled
+        assert 'fleet_y{replica=' not in page
+
+    def test_router_metrics_endpoint_is_federated(self, tiny_model):
+        m, cfg = tiny_model
+        router, reps = _fleet(m, 2, expose_port=0)
+        router.start()
+        try:
+            _drive(router, WORK[:2])
+            url = router.exporter.url
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=10) as r:
+                page = r.read().decode()
+            assert 'replica="r0"' in page
+            assert 'replica="r1"' in page
+            assert "fleet_requests_total" in page
+            # fleet health endpoint answers the fleet view
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=10) as r:
+                h = json.loads(r.read().decode())
+            assert h["status"] == "ok"
+            assert h["routable"] == 2
+        finally:
+            router.stop()
+
+
+class TestKVWireFormat:
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_payload_bytes_roundtrip(self, kv_dtype):
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+
+        a = PagedKVCache(2, 2, 4, block_size=4, num_blocks=8,
+                         kv_dtype=kv_dtype)
+        b = PagedKVCache(2, 2, 4, block_size=4, num_blocks=8,
+                         kv_dtype=kv_dtype)
+        ids = np.arange(1, 11, dtype=np.int32)  # 2 full + fill-2 tail
+        a.allocate("s", 10)
+        a.publish_prefix("s", ids)
+        payload = a.export_prefix(ids)
+        wire = serialize_kv_payload(payload)
+        assert isinstance(wire, bytes) and len(wire) > 0
+        back = deserialize_kv_payload(wire)
+        assert back["fills"] == payload["fills"] == [4, 4, 2]
+        assert b.import_prefix(back) == 10
+        assert b.match_prefix_len(ids) == 9
+        # none round-trips as empty bytes (journal-replay fallback)
+        assert serialize_kv_payload(None) == b""
+        assert deserialize_kv_payload(b"") is None
+
+    def test_import_rejects_layout_mismatch(self):
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+
+        a = PagedKVCache(2, 2, 4, block_size=4, num_blocks=8)
+        b = PagedKVCache(2, 2, 4, block_size=8, num_blocks=8)
+        ids = np.arange(1, 9, dtype=np.int32)
+        a.allocate("s", 8)
+        a.publish_prefix("s", ids)
+        with pytest.raises(ValueError, match="block_size"):
+            b.import_prefix(a.export_prefix(ids))
+
+    def test_match_prefix_len_is_side_effect_free(self):
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+
+        c = PagedKVCache(2, 2, 4, block_size=4, num_blocks=8)
+        ids = np.arange(1, 9, dtype=np.int32)
+        c.allocate("s", 8)
+        c.publish_prefix("s", ids)
+        s0 = c.stats()["prefix_cache"]
+        assert c.match_prefix_len(ids) == 7
+        assert c.match_prefix_len(np.array([99, 98], np.int32)) == 0
+        s1 = c.stats()["prefix_cache"]
+        assert s0 == s1  # no lookup/hit counter moved, nothing claimed
+
+
+class TestStreamRebind:
+    def test_rebind_ignores_stale_future_and_continues(self):
+        from concurrent.futures import Future
+
+        from paddle_tpu.frontend.stream import StreamHandle
+
+        h = StreamHandle()
+        f1, f2 = Future(), Future()
+        h._bind(f1)
+        h._on_token(11, None)
+        h.rebind(f2)
+        # the OLD future dying after rebind must NOT terminate the
+        # stream (its generation is stale)
+        f1.set_exception(RuntimeError("replica died"))
+        assert not h.done
+        h._on_token(12, None)
+        h._on_token(13, "budget")
+        f2.set_result(np.array([11, 12, 13], np.int32))
+        assert h.done and h.stop_reason == "budget"
+        assert h.tokens == [11, 12, 13]
+        np.testing.assert_array_equal(h.result(timeout=1),
+                                      [11, 12, 13])
+
+
+class TestPlacement:
+    def test_prefix_aware_with_least_loaded_tiebreak(self, tiny_model):
+        m, cfg = tiny_model
+        router, reps = _fleet(m, 2)
+        router.start()
+        try:
+            shared = np.array([4, 2, 4, 2, 4, 2, 4, 2, 4], np.int32)
+            # place + finish once: the serving replica publishes the
+            # prompt prefix into ITS cache
+            router.submit(shared).result(timeout=300)
+            first = next(r for r in reps
+                         if r.prefix_match_len(shared) > 0)
+            # the same prompt now routes to the replica holding it
+            for _ in range(2):
+                rep, match = router._place(shared)
+                assert rep is first and match > 0
+            st = router.stats()
+            assert st["prefix_routed"] >= 0  # counter exists
+            # an unseen prompt tiebreaks by load (both idle: first
+            # listed wins)
+            rep, match = router._place(np.array([9, 9, 9], np.int32))
+            assert match == 0 and rep is reps[0]
+        finally:
+            router.stop()
+
+    def test_draining_replica_is_not_routed_sessions_stay(
+            self, tiny_model):
+        m, cfg = tiny_model
+        router, reps = _fleet(m, 2, probe_interval_s=30.0)
+        router.start()
+        try:
+            reps[0].server.set_draining(True)
+            router.check_replicas()  # probe: r0 not_ready, r1 ok
+            assert reps[0].health.state == "not_ready"
+            rep, _ = router._place(np.array([1, 2, 3], np.int32))
+            assert rep is reps[1]
+            out = router.submit(
+                np.array([5, 6, 7], np.int32)).result(timeout=300)
+            assert list(out[:3]) == [5, 6, 7]
+            st = router.stats()
+            # nothing failed over: draining is not death
+            assert st["failovers"] == 0
+            reps[0].server.set_draining(False)
+            router.check_replicas()
+            assert reps[0].health.state == "ok"
+        finally:
+            router.stop()
+
+    def test_global_shed_when_all_replicas_saturated(self, tiny_model):
+        m, cfg = tiny_model
+        router, reps = _fleet(m, 2, shed_queue_depth=1)
+        # NOT started: queues only fill, so saturation is deterministic
+        for rep in reps:
+            for _ in range(2):
+                rep.server.submit([1, 2, 3])
+        with pytest.raises(AdmissionShed) as ei:
+            router._started = True  # allow submit without engines
+            router.submit(np.array([4, 5, 6], np.int32))
+        assert ei.value.retry_after_s > 0
+        assert router.stats()["sheds"] == 1
+        for rep in reps:
+            rep.server.stop()
+
+    def test_no_routable_replica_raises(self, tiny_model):
+        m, cfg = tiny_model
+        router, reps = _fleet(m, 1)
+        router.start()
+        try:
+            reps[0].kill()
+            with pytest.raises(ReplicaUnavailable):
+                router.submit(np.array([1, 2], np.int32))
+        finally:
+            router.stop()
+
+
+class TestChaosGate:
+    """Acceptance: a seeded FaultPlan kills one replica mid-stream at
+    2 and 4 replicas — every interrupted session completes on a
+    survivor with md5-identical tokens (greedy and fixed-seed
+    sampled), no request fails with anything else, and a planned
+    migration moves a live session with zero prefill recompute."""
+
+    def _reference(self, m):
+        router, _ = _fleet(m, 1)
+        router.start()
+        try:
+            return [_md5(o) for o in _drive(router)]
+        finally:
+            router.stop()
+
+    @pytest.mark.parametrize("n_replicas", [2, 4])
+    def test_mid_stream_replica_kill_survivor_parity(
+            self, tiny_model, n_replicas):
+        m, cfg = tiny_model
+        ref = self._reference(m)
+        # kill at occurrence n_replicas: the first n placements gave
+        # every replica a resident, so the kill's victim (the least-
+        # loaded pick for request n, which round-robins back to a
+        # busy replica) holds a mid-stream session that MUST fail
+        # over
+        plan = FaultPlan([("replica_kill", n_replicas)],
+                         name="chaos-kill")
+        router, reps = _fleet(m, n_replicas, fault_plan=plan,
+                              probe_interval_s=0.2)
+        router.start()
+        try:
+            outs = _drive(router)   # nobody may fail
+            st = router.stats()
+        finally:
+            router.stop()
+        assert [_md5(o) for o in outs] == ref
+        assert st["replica_kills"] == 1
+        assert sum(1 for r in reps if r.dead) == 1
+        assert st["failover_sessions"] >= 1
+        assert st["failovers"] >= 1
+
+    def test_externally_killed_replica_fails_over_via_probe(
+            self, tiny_model):
+        """No fault plan: the replica dies behind the router's back
+        and the PROBE loop detects + fails over (the passive path the
+        seam shortcuts)."""
+        m, cfg = tiny_model
+        ref = self._reference(m)
+        router, reps = _fleet(m, 2, probe_interval_s=0.05)
+        router.start()
+        try:
+            seen = []
+            futs = [router.submit(ids, on_token=(
+                lambda t, r: seen.append(t)) if i == 0 else None,
+                **kw) for i, (ids, kw) in enumerate(WORK)]
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and not seen:
+                time.sleep(0.002)
+            victim = router._sessions[
+                sorted(router._sessions)[0]].replica
+            victim.server.kill()  # behind the router's back
+            outs = [f.result(timeout=300) for f in futs]
+            st = router.stats()
+        finally:
+            router.stop()
+        assert [_md5(o) for o in outs] == ref
+        assert st["failover_sessions"] >= 1
+
+    def test_planned_migration_zero_prefill_recompute(self,
+                                                      tiny_model):
+        m, cfg = tiny_model
+        prompt = np.array([3, 5, 7, 9, 11, 2], np.int32)
+        ref_router = FleetRouter([_replica(m, "ref",
+                                           max_new_tokens=24)])
+        ref_router.start()
+        try:
+            ref = ref_router.submit(
+                prompt, max_new_tokens=20).result(timeout=300)
+        finally:
+            ref_router.stop()
+        reps = [_replica(m, f"r{i}", max_new_tokens=24)
+                for i in range(2)]
+        router = FleetRouter(reps)
+        router.start()
+        try:
+            first = threading.Event()
+            fut = router.submit(prompt, max_new_tokens=20,
+                                on_token=lambda t, r: first.set())
+            assert first.wait(timeout=120)
+            rid = next(iter(router._sessions))
+            source = router._sessions[rid].replica
+            target_reps = [r for r in reps if r is not source]
+            before = {r.name: r.server.stats()["prefills"]
+                      for r in reps}
+            target_name = router.migrate_session(rid)
+            out = fut.result(timeout=300)
+            st = router.stats()
+            target = next(r for r in reps if r.name == target_name)
+            after = target.server.stats()
+        finally:
+            router.stop()
+        assert target_name != source.name
+        assert target in target_reps
+        np.testing.assert_array_equal(ref, out)
+        assert st["migrations"] == 1
+        # ZERO prefill recompute: the imported chain warm-attaches
+        assert after["prefills"] - before[target_name] == 0
+        assert after["frontdoor"]["resumes"] >= 1
+
+    def test_migration_fallback_when_source_dead(self, tiny_model):
+        """migrate_session on a dead source degrades to journal
+        replay — still token-identical, just re-prefilled."""
+        m, cfg = tiny_model
+        prompt = np.array([4, 4, 2, 9], np.int32)
+        ref_router = FleetRouter([_replica(m, "ref",
+                                           max_new_tokens=16)])
+        ref_router.start()
+        try:
+            ref = ref_router.submit(
+                prompt, max_new_tokens=12).result(timeout=300)
+        finally:
+            ref_router.stop()
+        reps = [_replica(m, f"r{i}", max_new_tokens=16)
+                for i in range(2)]
+        router = FleetRouter(reps, probe_interval_s=30.0)
+        router.start()
+        try:
+            first = threading.Event()
+            fut = router.submit(prompt, max_new_tokens=12,
+                                on_token=lambda t, r: first.set())
+            assert first.wait(timeout=120)
+            rid = next(iter(router._sessions))
+            source = router._sessions[rid].replica
+            source.kill()
+            target = router.migrate_session(rid)
+            out = fut.result(timeout=300)
+            st = router.stats()
+        finally:
+            router.stop()
+        assert target != source.name
+        np.testing.assert_array_equal(ref, out)
+        assert st["migrations"] == 1
+        assert st["failover_sessions"] == 1  # the fallback path
+
+
+class TestRouterJournalRecovery:
+    def test_router_restart_recovers_sessions_token_identically(
+            self, tiny_model, tmp_path):
+        m, cfg = tiny_model
+        prompt = np.array([3, 5, 7, 9, 11, 2], np.int32)
+        sp = SamplingParams(temperature=0.9, top_p=0.95, seed=55)
+        ref_router = FleetRouter([_replica(m, "ref",
+                                           max_new_tokens=16)])
+        ref_router.start()
+        try:
+            ref = ref_router.submit(
+                prompt, max_new_tokens=16,
+                sampling=sp).result(timeout=300)
+        finally:
+            ref_router.stop()
+        jp = tmp_path / "fleet.jsonl"
+        reps = [_replica(m, "jr0", max_new_tokens=16)]
+        # long probe interval: the dead replica must NOT be noticed
+        # before the "router crash" (we abandon the router unstopped)
+        router = FleetRouter(reps, journal=str(jp),
+                             probe_interval_s=300.0)
+        router.start()
+        first = threading.Event()
+        fut = router.submit(prompt, max_new_tokens=16, sampling=sp,
+                            on_token=lambda t, r: first.set())
+        assert first.wait(timeout=120)
+        reps[0].kill()          # replica crash...
+        del fut                 # ...and the router "crashes" too
+        router._stop = True     # (silence its probe thread)
+
+        jr = FleetRouter([_replica(m, "n0", max_new_tokens=16),
+                          _replica(m, "n1", max_new_tokens=16)],
+                         journal=str(jp))
+        jr.start()
+        try:
+            recovered = jr.recover_from_journal()
+            assert len(recovered) == 1
+            (out,) = [f.result(timeout=300)
+                      for f in recovered.values()]
+        finally:
+            jr.stop()
+        np.testing.assert_array_equal(ref, out)

@@ -409,3 +409,101 @@ class TestGoodput:
             srv.stop()
         assert g["decoded_tokens"] == g["goodput_tokens"] == 4
         assert g["goodput_ratio"] == 1.0
+
+
+class TestSplitHealth:
+    """r18 satellite: /healthz split into liveness vs readiness so a
+    router can tell 'dead, fail over' from 'drain, don't route' —
+    with the legacy /healthz shape untouched."""
+
+    def test_live_and_ready_endpoints_roundtrip(self):
+        m, cfg = _model(salt=21)
+        srv = _server(m, expose_port=0)
+        url = srv.exporter.url
+        try:
+            # before start(): the loop is NOT alive -> live 503;
+            # legacy /healthz still answers its old ok/200 shape
+            code, body = _get(url + "/healthz/live")
+            assert code == 503 and json.loads(body)["live"] is False
+            code, body = _get(url + "/healthz")
+            assert code == 200 and json.loads(body)["status"] == "ok"
+
+            srv.start()
+            assert _wait_for(
+                lambda: _get(url + "/healthz/live")[0] == 200)
+            code, body = _get(url + "/healthz/ready")
+            r = json.loads(body)
+            assert code == 200 and r["ready"] is True
+            assert r["draining"] is False
+
+            # draining: ready flips 503, live stays 200, legacy
+            # /healthz stays ok — residents finish, nothing routes
+            srv.set_draining(True)
+            code, body = _get(url + "/healthz/ready")
+            r = json.loads(body)
+            assert code == 503 and r["ready"] is False
+            assert r["draining"] is True
+            assert _get(url + "/healthz/live")[0] == 200
+            assert _get(url + "/healthz")[0] == 200
+            srv.set_draining(False)
+            assert _get(url + "/healthz/ready")[0] == 200
+
+            # the 404 listing now names the split endpoints
+            code, body = _get(url + "/nope")
+            assert code == 404
+            assert "/healthz/live" in body and "/healthz/ready" in body
+
+            # /statusz inlines both blocks
+            sz = json.loads(_get(url + "/statusz")[1])
+            assert sz["liveness"]["live"] is True
+            assert sz["readiness"]["ready"] is True
+        finally:
+            srv.stop()
+        # after stop(): dead — the router's fail-over signal
+        live, detail = srv.liveness()
+        assert live is False
+
+    def test_statusz_carries_structured_pool_exhaustion(self):
+        """r18 satellite: BlockPoolExhausted.needed/available and the
+        degraded reason are machine-readable in health/statusz — the
+        router's passive signal parses fields, not messages."""
+        from paddle_tpu.inference.kv_cache import BlockPoolExhausted
+
+        m, cfg = _model(salt=22)
+        srv = _server(m)
+        try:
+            e = BlockPoolExhausted("synthetic", needed=7, available=2)
+            srv._engine_exception("ensure_many", e, ["p0"])
+            status, detail = srv.health()
+            assert status == "degraded"
+            info = detail["last_error_info"]
+            assert info["where"] == "ensure_many"
+            assert info["error_type"] == "BlockPoolExhausted"
+            assert info["needed"] == 7 and info["available"] == 2
+            sz = srv.statusz()
+            assert sz["health"]["last_error_info"]["needed"] == 7
+            # reset clears the structured info with the string
+            srv.reset_stats()
+            status, detail = srv.health()
+            assert status == "ok" and "last_error_info" not in detail
+        finally:
+            srv.stop()
+
+    def test_clean_recovery_clears_structured_info(self):
+        """The structured error info follows the degraded->ok
+        transition: present while unrecovered, gone after the first
+        clean dispatch (r17 recovery semantics, r18 field)."""
+        from paddle_tpu.reliability import FaultPlan
+
+        m, cfg = _model(salt=23)
+        srv = _server(m, fault_plan=FaultPlan([("ensure_many", 0)]))
+        srv.start()
+        try:
+            out = srv.submit([3, 4, 5]).result(timeout=300)
+            assert list(out[:3]) == [3, 4, 5]
+            assert _wait_for(lambda: srv.health()[0] == "ok")
+            _status, detail = srv.health()
+            assert "last_error_info" not in detail
+            assert srv.stats()["reliability"]["recoveries"] >= 1
+        finally:
+            srv.stop()

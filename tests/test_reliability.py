@@ -791,3 +791,197 @@ class TestChaosParityGate:
             np.testing.assert_array_equal(a, b)
         assert st["reliability"]["faults_injected"] == 2
         assert st["reliability"]["recoveries"] >= 1
+
+
+class TestJournalCompactionConcurrency:
+    """r18 satellite: compaction racing appends can never tear or
+    lose a record — copy-on-compact snapshots under the lock, writes
+    outside it, and replays buffered appends before the atomic
+    swap."""
+
+    def test_threaded_append_vs_compact_stress(self, tmp_path):
+        import threading
+
+        jp = tmp_path / "stress.jsonl"
+        j = SessionJournal(jp, max_bytes=2048)  # tiny: compacts often
+
+        class R:
+            timeout_s = None
+            sampling = None
+            meta = None
+
+            def __init__(self, rid):
+                self.rid = rid
+                self.ids = [1, 2, 3]
+                self.gen0 = ()
+                self.budget = 8
+                self.seed = 7
+
+        stop = threading.Event()
+        truth = {}
+        tl = threading.Lock()
+        errors = []
+
+        def writer(k):
+            try:
+                i = 0
+                while not stop.is_set():
+                    rid = f"w{k}-{i}"
+                    j.record_accept(R(rid))
+                    with tl:
+                        truth[rid] = []
+                    for t in range(5):
+                        j.record_token(rid, t)
+                        with tl:
+                            truth[rid].append(t)
+                    if i % 2 == 0:  # half the requests finish
+                        j.record_done(rid, "budget")
+                        with tl:
+                            del truth[rid]
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — collected
+                errors.append(e)
+
+        def compactor():
+            try:
+                while not stop.is_set():
+                    j.compact()  # force: races every append above
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(4)]
+        threads.append(threading.Thread(target=compactor))
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        j.close()
+        assert not errors, errors
+        # a fresh loader sees ZERO torn lines and EXACTLY the live
+        # state the writers produced — token lists intact, finished
+        # requests gone
+        j2 = SessionJournal(jp)
+        assert j2.stats()["torn_lines"] == 0
+        live = {e["rid"]: e["gen0"] for e in j2.interrupted()}
+        assert live == truth
+        assert len(live) > 10  # the stress actually produced work
+        j2.close()
+
+    def test_forced_compact_while_appending_single_thread(
+            self, tmp_path):
+        """compact() between appends folds tokens into gen0 and drops
+        finished entries — the copy-on-compact rewrite preserves the
+        pre-satellite semantics exactly."""
+        jp = tmp_path / "fold.jsonl"
+        j = SessionJournal(jp)
+
+        class R:
+            rid, ids, gen0, budget, seed = "a", [4, 5], (), 6, 3
+            timeout_s = sampling = meta = None
+
+        j.record_accept(R())
+        j.record_token("a", 11)
+        j.record_token("a", 12)
+        j.compact()
+        j.record_token("a", 13)
+        j.close()
+        j2 = SessionJournal(jp)
+        (ent,) = j2.interrupted()
+        assert ent["gen0"] == [11, 12, 13]
+        j2.close()
+
+
+class TestJournalRecoveryWithPrefixCache:
+    """r18 satellite: recovered sessions RE-ATTACH published prefixes
+    instead of re-prefilling from scratch — attach counters asserted,
+    including the mid-block partial-tail case."""
+
+    def test_recovery_attaches_published_prefix_mid_block(
+            self, tiny_model, tmp_path):
+        m, cfg = tiny_model
+        # block_size 4, prompt length 10: publishing it indexes 2 full
+        # blocks + a fill-2 partial tail; attach may serve 9 = 8 + 1
+        # tokens (len-1 cap), PROVING the mid-block tail attached
+        prompt = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3], np.int32)
+        ref, _, _ = _run_server(
+            m, [(prompt, {})],
+            {"max_new_tokens": 6, "enable_prefix_cache": True,
+             "max_prompt_len": 16})
+        jp = tmp_path / "pfx.jsonl"
+        a = _server(m, max_new_tokens=6, enable_prefix_cache=True,
+                    max_prompt_len=16, journal=str(jp))
+        fut = a.submit(prompt)           # never started: queued
+        a.kill()                         # crash before any prefill
+        assert not fut.done()
+
+        b = _server(m, max_new_tokens=6, enable_prefix_cache=True,
+                    max_prompt_len=16, journal=str(jp))
+        b.start()
+        try:
+            # warm b's content index with the SAME prompt (publishes
+            # 2 full blocks + the fill-2 partial tail), then recover
+            b.submit(prompt).result(timeout=300)
+            pc0 = b.cache.stats()["prefix_cache"]
+            pre0 = b.stats()["prefill_dispatches"]
+            recovered = b.recover_from_journal()
+            (out,) = [f.result(timeout=300)
+                      for f in recovered.values()]
+            pc1 = b.cache.stats()["prefix_cache"]
+            pre1 = b.stats()["prefill_dispatches"]
+        finally:
+            b.stop()
+        np.testing.assert_array_equal(ref[0][1], out)
+        # the recovered admission ATTACHED instead of re-prefilling:
+        # one lookup, one hit, and 9 = 2 full blocks + 1 mid-block
+        # token served from cache (the len-1 cap leaves exactly the
+        # final token for the single prefill dispatch)
+        assert pc1["lookups"] == pc0["lookups"] + 1
+        assert pc1["hits"] == pc0["hits"] + 1
+        assert pc1["hit_tokens"] - pc0["hit_tokens"] == 9
+        assert pre1 - pre0 == 1  # one chunk for the 1 uncached token
+
+    def test_recovery_warm_attach_with_generated_tokens(
+            self, tiny_model, tmp_path):
+        """A session interrupted MID-decode re-attaches its own
+        swap-out-published prefix on the restarted server when the
+        pool arrays survive — here we emulate the fleet shape: the
+        prefix is republished on the new server via export/import,
+        and the resumed request warm-attaches (zero prefill work for
+        the cached positions)."""
+        m, cfg = tiny_model
+        prompt = np.array([7, 2, 7, 2, 7, 2], np.int32)
+        ref, _, _ = _run_server(
+            m, [(prompt, {})],
+            {"max_new_tokens": 8, "enable_prefix_cache": True})
+        jp = tmp_path / "warm.jsonl"
+        a = _server(m, max_new_tokens=8, enable_prefix_cache=True,
+                    journal=str(jp))
+        seen = []
+        a.start()
+        fut = a.submit(prompt, on_token=lambda t, r: seen.append(t))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and len(seen) < 3:
+            time.sleep(0.002)
+        assert len(seen) >= 3
+        # export the live session's K/V BEFORE the crash (the fleet
+        # router does this for planned migration)
+        ent, payload = a.export_session(
+            next(e["rid"] for e in SessionJournal(jp).interrupted()))
+        assert payload is not None
+        a.kill()
+        assert not fut.done()
+
+        b = _server(m, max_new_tokens=8, enable_prefix_cache=True)
+        b.start()
+        try:
+            b.import_kv_payload(payload)
+            pre0 = b.stats()["prefills"]
+            out = b.admit_journal_entry(ent).result(timeout=300)
+            pre1 = b.stats()["prefills"]
+        finally:
+            b.stop()
+        np.testing.assert_array_equal(ref[0][1], out)
+        assert pre1 - pre0 == 0  # warm attach: ZERO prefill work

@@ -318,11 +318,13 @@ def test_served_bench_axis_emits_records():
     """`bench.py served` (mixed-length traffic: padded vs paged
     closed-loop, the open-loop Poisson axis, the shared-prefix caching
     axis, the round-11 speculation axis, the round-12 front-door
-    axis, the quantization axis, and the sharded mesh axis) must emit
-    all nine JSON records; slow-marked so tier-1 stays fast."""
+    axis, the quantization axis, the sharded mesh axis, and the r18
+    fleet axis) must emit all the JSON records; slow-marked so tier-1
+    stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 10, stdout
+    assert len(recs) == 11, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("fleet" in rec["metric"] for rec in recs)
     assert any("unifiedround" in rec["metric"] for rec in recs)
     assert any("mixedsampling" in rec["metric"] for rec in recs)
     assert any("openloop" in rec["metric"] for rec in recs)
@@ -397,6 +399,15 @@ def test_served_bench_axis_emits_records():
     # retention floor: recovery (backoff + replayed prefills) may not
     # eat more than 3/4 of fault-free tok/s at this fault rate
     assert dg["vs_baseline"] >= 0.25, dg
+    # the fleet acceptance bars (r18): ZERO token divergence across
+    # the forced mid-run replica kill and the live migration — every
+    # request's output md5 is identical at every replica count
+    fl = next(r for r in recs if "fleet" in r["metric"])
+    assert fl["survivor_token_parity"] is True, fl
+    assert fl["replica_kills"] >= 1, fl
+    assert fl["failover_sessions"] >= 1, fl
+    assert fl["migrated_sessions"] >= 1, fl
+    assert fl["replica_counts"] == [1, 2, 4], fl
 
 
 def test_served_bench_openloop_tiny_schema():
@@ -405,7 +416,7 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=540)
-    assert len(recs) == 10, stdout
+    assert len(recs) == 11, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
@@ -414,7 +425,8 @@ def test_served_bench_openloop_tiny_schema():
                  and "quantized" not in r["metric"]
                  and "sharded" not in r["metric"]
                  and "unifiedround" not in r["metric"]
-                 and "degradedmode" not in r["metric"])
+                 and "degradedmode" not in r["metric"]
+                 and "fleet" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
     sp_rec = next(r for r in recs if "sharedprefix" in r["metric"])
@@ -423,8 +435,9 @@ def test_served_bench_openloop_tiny_schema():
     qz_rec = next(r for r in recs if "quantized" in r["metric"])
     sh_rec = next(r for r in recs if "sharded" in r["metric"])
     dg_rec = next(r for r in recs if "degradedmode" in r["metric"])
+    fl_rec = next(r for r in recs if "fleet" in r["metric"])
     for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec,
-                qz_rec, sh_rec, dg_rec):
+                qz_rec, sh_rec, dg_rec, fl_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -556,3 +569,19 @@ def test_served_bench_openloop_tiny_schema():
     assert set(dg_rec["faults_by_seam"]) == {
         "prefill", "decode", "ensure_many"}, dg_rec
     assert 0 < dg_rec["goodput_ratio"] <= 1.0, dg_rec
+    # fleet axis (r18): identical fixed-seed arrivals at 1/2 replicas
+    # (tiny) with one forced mid-run replica kill + one live
+    # migration — schema + the md5 token-parity proof across counts
+    for fld in ("vs_baseline", "replica_counts",
+                "tokens_per_sec_by_replicas",
+                "ttft_p99_ms_by_replicas", "ttft_p99_ms",
+                "failover_count", "failover_sessions",
+                "replica_kills", "migrated_sessions", "prefix_routed",
+                "survivor_token_parity", "parity_md5", "n_requests"):
+        assert fld in fl_rec, fl_rec
+    assert fl_rec["survivor_token_parity"] is True, fl_rec
+    assert fl_rec["replica_counts"] == [1, 2], fl_rec
+    assert fl_rec["replica_kills"] >= 1, fl_rec
+    assert fl_rec["failover_sessions"] >= 1, fl_rec
+    assert fl_rec["migrated_sessions"] >= 1, fl_rec
+    assert len(fl_rec["parity_md5"]) == 32, fl_rec
