@@ -34,6 +34,10 @@ class Replica:
         self.name = str(name)
         self.server = server
         self.health = health if health is not None else ReplicaHealth()
+        # causal tracing (ISSUE 14): the engine stamps its trace
+        # events/spans/ring entries with this name, so the shared
+        # in-process span sink still attributes per replica
+        server.trace_name = self.name
         self._killed = False
         self._started = False
         self._lock = threading.Lock()

@@ -56,6 +56,8 @@ import numpy as np
 from ..observability import log as _obs_log
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..observability.slo import SLOEngine
+from ..observability.trace_context import TraceContext
 from ..reliability import (AdmissionShed, QuarantinedRequest,
                            ReplicaUnavailable, RequestTimeout,
                            SessionJournal, resolve_fault_plan)
@@ -124,10 +126,10 @@ class _Session:
     __slots__ = ("rid", "ids", "budget", "seed", "sampling", "meta",
                  "timeout_s", "future", "on_token", "toks", "done",
                  "stop_reason", "replica", "epoch", "failovers",
-                 "t_submit", "t_first")
+                 "t_submit", "t_first", "trace")
 
     def __init__(self, rid, ids, budget, seed, sampling, meta,
-                 timeout_s, on_token):
+                 timeout_s, on_token, trace=None):
         self.rid = rid
         self.ids = ids
         self.budget = budget
@@ -147,10 +149,19 @@ class _Session:
         self.failovers = 0           # stale replica callbacks no-op
         self.t_submit = time.perf_counter()
         self.t_first = None
+        # causal tracing (ISSUE 14): minted HERE — the router's
+        # context wins over any replica-minted one, so every hop of
+        # the session shares one trace_id; bumped with cause
+        # "failover"/"migration" as the session moves
+        self.trace = trace if trace is not None else TraceContext.mint()
 
     @property
     def gen0(self):
         return tuple(self.toks)
+
+    def _tr(self, replica=None):
+        return self.trace.attrs(replica=replica) \
+            if self.trace is not None else {}
 
 
 class FleetRouter:
@@ -184,13 +195,25 @@ class FleetRouter:
     detokenize: tokenizer for streamed text deltas (stream=True).
     expose_port: fleet ops endpoint — /metrics serves the FEDERATED
         per-replica page (replica label), /statusz the fleet view,
-        /healthz ok|degraded|stalled (stalled = nothing routable).
+        /healthz ok|degraded|stalled (stalled = nothing routable),
+        /slo the fleet burn-rate report when `slos=` is given.
+    slos: iterable of `observability.SLO` (or True for
+        `default_slos()`) — the FLEET-level burn-rate engine (ISSUE
+        14), fed from router-observed TTFT and session outcomes
+        (tagged per lane/tenant/replica). Evaluated every probe pass;
+        a replica-scoped SLO in sustained `page`
+        (>= slo_degrade_sustain_s of continuous page burn) degrades
+        that replica to not_ready via the r18 state machine — the
+        "stop routing new work at a latency-burning replica" hook.
+    slo_degrade_sustain_s: how long a replica-scoped SLO must page
+        continuously before the degrade hook fires.
     """
 
     def __init__(self, replicas, *, journal=None, seed=0,
                  probe_interval_s=1.0, shed_queue_depth=None,
                  submit_retries=2, fault_plan=None, detokenize=None,
-                 stream_buffer=256, expose_port=None):
+                 stream_buffer=256, expose_port=None, slos=None,
+                 slo_degrade_sustain_s=2.0):
         reps = []
         for i, r in enumerate(replicas):
             if isinstance(r, Replica):
@@ -222,6 +245,16 @@ class FleetRouter:
         self._faults = resolve_fault_plan(fault_plan)
         self._detok = detokenize
         self._stream_buffer = int(stream_buffer)
+        # SLO burn-rate engine (ISSUE 14): None = every feed site is
+        # one `is None` branch (the telemetry discipline)
+        if slos is None or slos is False:
+            self._slo = None
+        elif isinstance(slos, SLOEngine):
+            self._slo = slos
+        else:
+            self._slo = SLOEngine(slos)
+        self.slo_degrade_sustain_s = float(slo_degrade_sustain_s)
+        self._slo_degraded: dict[str, float] = {}  # replica -> since
         self._lock = threading.RLock()
         self._sessions: dict[str, _Session] = {}
         self._stop = False
@@ -264,8 +297,9 @@ class FleetRouter:
             _metrics.REGISTRY.enable()
             self.exporter = OpsEndpoint(
                 statusz_fn=self.statusz, healthz_fn=self.health,
-                metrics_fn=self.metrics_text).start(
-                    port=self._expose_port)
+                metrics_fn=self.metrics_text,
+                slo_fn=(self.slo_report if self._slo is not None
+                        else None)).start(port=self._expose_port)
         return self
 
     def stop(self):
@@ -333,7 +367,7 @@ class FleetRouter:
     # ---- client API ----------------------------------------------------
     def submit(self, ids, max_new_tokens=None, sampling=None, *,
                meta=None, on_token=None, timeout_s=None,
-               stream=False, stream_timeout_s=None):
+               stream=False, stream_timeout_s=None, trace_ctx=None):
         """Route one prompt onto the fleet. Returns the session's
         Future (resolving to the full [prompt + generated] int32
         array regardless of how many replicas it crossed), or a
@@ -369,7 +403,8 @@ class FleetRouter:
         if budget is None:
             budget = self.replicas[0].server.max_new
         sess = _Session(f"f{next(_rids)}", ids, int(budget), seed,
-                        sampling, meta, timeout_s, on_token)
+                        sampling, meta, timeout_s, on_token,
+                        trace=trace_ctx)
         handle = None
         if stream:
             from ..frontend.stream import StreamHandle
@@ -460,7 +495,7 @@ class FleetRouter:
                         sess.ids, max_new_tokens=sess.budget,
                         sampling=sess.sampling, meta=sess.meta,
                         on_token=cb, timeout_s=sess.timeout_s,
-                        rid=sess.rid)
+                        rid=sess.rid, trace_ctx=sess.trace)
                 else:
                     fut = rep.server.admit_journal_entry(
                         SessionJournal.entry_for(sess), on_token=cb)
@@ -489,8 +524,9 @@ class FleetRouter:
                 lambda f, s=sess, r=rep, g=epoch:
                 self._on_replica_done(s, r, g, f))
             _tracing.event("fleet_place", request_id=sess.rid,
-                           replica=rep.name, prefix_match=int(match),
-                           resume=bool(sess.toks))
+                           prefix_match=int(match),
+                           resume=bool(sess.toks),
+                           **sess._tr(replica=rep.name))
             return
         if sheds:
             # every candidate shed: propagate the largest retry hint
@@ -514,6 +550,7 @@ class FleetRouter:
     # ---- token + completion plumbing -----------------------------------
     def _make_token_cb(self, sess, epoch):
         def cb(tok, reason):
+            first = False
             with self._lock:
                 if sess.done or epoch != sess.epoch:
                     return  # stale replica still flushing: ignore
@@ -521,9 +558,15 @@ class FleetRouter:
                 if sess.t_first is None:
                     sess.t_first = time.perf_counter()
                     self._ttft.append(sess.t_first - sess.t_submit)
+                    first = True
                 self._tokens_out += 1
                 if reason is not None:
                     sess.stop_reason = reason
+            if first and self._slo is not None:
+                # router-observed TTFT: spans queueing, placement,
+                # any failover requeue gap — the client's number
+                self._slo_observe_latency(
+                    "ttft", sess.t_first - sess.t_submit, sess)
             if self._journal is not None:
                 self._journal.record_token(sess.rid, tok)
                 if reason is not None:
@@ -545,6 +588,7 @@ class FleetRouter:
         now = time.monotonic()
         if exc is None:
             rep.health.note_ok(now)
+            self._slo_observe_avail(sess, True, rep)
             if self._journal is not None and sess.stop_reason is None:
                 # terminal token never streamed (e.g. an immediate
                 # journal-terminal resolution): close the entry
@@ -559,6 +603,7 @@ class FleetRouter:
                       else "timeout")
             if self._journal is not None:
                 self._journal.record_done(sess.rid, reason)
+            self._slo_observe_avail(sess, False, rep)
             sess.future.set_exception(exc)
             return
         if self._stop:
@@ -574,6 +619,86 @@ class FleetRouter:
                         "over", rep.name, sess.rid, exc)
         self._failover_session(sess, exclude={rep})
 
+    # ---- SLO burn-rate engine (ISSUE 14) -------------------------------
+    def _slo_observe_latency(self, kind, value_s, sess):
+        """Feed one router-observed latency (caller checked _slo)."""
+        meta = sess.meta
+        rep = sess.replica
+        self._slo.observe(
+            kind, value_s=value_s,
+            lane=meta.lane if meta is not None else None,
+            tenant=meta.tenant if meta is not None else None,
+            replica=rep.name if rep is not None else None)
+
+    def _slo_observe_avail(self, sess, ok, rep=None):
+        """Feed one session outcome (finished vs terminally failed)."""
+        if self._slo is None:
+            return
+        if rep is None:
+            rep = sess.replica
+        meta = sess.meta
+        self._slo.observe(
+            "availability", good=ok,
+            lane=meta.lane if meta is not None else None,
+            tenant=meta.tenant if meta is not None else None,
+            replica=rep.name if rep is not None else None)
+
+    def _slo_degrade_check(self, now):
+        """The degrade hook: a replica-scoped SLO in SUSTAINED page
+        burn (>= slo_degrade_sustain_s continuous) marks its replica
+        not_ready in the r18 state machine — residents keep decoding,
+        new placements go elsewhere until the burn clears."""
+        if self._slo is None:
+            return
+        paging = self._slo.paging(now, self.slo_degrade_sustain_s)
+        for rep in self.replicas:
+            hit = sorted(n for n in paging
+                         for s in self._slo.slos
+                         if s.name == n and s.replica == rep.name)
+            if not hit:
+                self._slo_degraded.pop(rep.name, None)
+                continue
+            if rep.dead:
+                continue
+            if rep.name not in self._slo_degraded:
+                self._slo_degraded[rep.name] = now
+                _tracing.event("slo_degrade", replica=rep.name,
+                               slos=hit)
+                _logger.warning(
+                    "replica %s degraded to not_ready: sustained SLO "
+                    "page burn (%s)", rep.name, ", ".join(hit))
+            rep.health.note_not_ready(
+                now, f"slo page burn: {', '.join(hit)}")
+            _m_state.labels(replica=rep.name).set(
+                _STATE_CODE["not_ready"])
+
+    def slo_report(self):
+        """The fleet /slo endpoint payload."""
+        if self._slo is None:
+            return {"slos": [], "worst": "ok", "paging": []}
+        report = self._slo.report()
+        report["degraded_replicas"] = sorted(self._slo_degraded)
+        return report
+
+    # ---- timeline export (ISSUE 14) ------------------------------------
+    def export_timeline(self, path):
+        """Write the FLEET Chrome/Perfetto timeline: the shared span
+        sink laid out per replica (events are stamped with `replica`
+        by the engines) plus every replica's flight-recorder ring on
+        its own track, and the router's own events on a `router`
+        process. Open in chrome://tracing or ui.perfetto.dev. Returns
+        the event count."""
+        from ..observability import timeline as _timeline
+
+        recorders = {}
+        for rep in self.replicas:
+            try:
+                recorders[rep.name] = rep.server._recorder.events()
+            except Exception:  # noqa: BLE001 — a dead replica's ring
+                continue      # is best-effort
+        return _timeline.write_chrome_trace(
+            path, recorders=recorders, default_name="router")
+
     # ---- failover ------------------------------------------------------
     def _failover_session(self, sess, exclude=frozenset()):
         with self._lock:
@@ -582,9 +707,13 @@ class FleetRouter:
             sess.epoch += 1
             sess.failovers += 1
             self._failover_sessions += 1
+            if sess.trace is not None:
+                # causal tracing: the re-admission on a survivor is a
+                # new hop of the same trace, cause "failover"
+                sess.trace = sess.trace.child("failover")
         _m_failover_sessions.inc()
         _tracing.event("fleet_failover_session", request_id=sess.rid,
-                       tokens_done=len(sess.toks))
+                       tokens_done=len(sess.toks), **sess._tr())
         self._dispatch(sess, first=False)
 
     def _failover_replica(self, rep, why=""):
@@ -642,6 +771,12 @@ class FleetRouter:
         with self._lock:
             sess.epoch += 1          # stale source callbacks no-op
             epoch = sess.epoch
+            if sess.trace is not None:
+                # causal tracing: the warm re-admission on the target
+                # is a new hop, cause "migration" (the engine's
+                # migrate_out event on the source closes the old hop)
+                sess.trace = sess.trace.child("migration")
+                ent["trace"] = sess.trace.to_dict()
         wire = serialize_kv_payload(payload)
         payload = deserialize_kv_payload(wire)  # the wire round-trip
         if target is None or target is source:
@@ -680,7 +815,8 @@ class FleetRouter:
         _tracing.event("fleet_migrate", request_id=rid,
                        source=source.name, to=target.name,
                        kv_tokens=int(imported),
-                       wire_bytes=len(wire))
+                       wire_bytes=len(wire),
+                       **sess._tr(replica=target.name))
         return target.name
 
     # ---- probes --------------------------------------------------------
@@ -736,6 +872,9 @@ class FleetRouter:
                 h.note_not_ready(now, "readiness probe false")
             _m_state.labels(replica=rep.name).set(
                 _STATE_CODE.get(h.state, 4.0))
+        # SLO degrade hook (ISSUE 14): AFTER the probes, so a healthy
+        # readiness probe cannot mask a sustained page burn this pass
+        self._slo_degrade_check(now)
 
     # ---- recovery ------------------------------------------------------
     def recover_from_journal(self, journal=None):
@@ -764,11 +903,14 @@ class FleetRouter:
                     tenant=m.get("tenant", "default"),
                     deadline_s=m.get("deadline_s"),
                     cost=int(m.get("cost", 0)))
+            trace = (TraceContext.from_dict(ent["trace"])
+                     .child("failover")
+                     if ent.get("trace") else None)
             sess = _Session(ent["rid"],
                             np.asarray(ent["ids"], np.int32),
                             int(ent["budget"]), int(ent["seed"]),
                             sampling, meta, ent.get("timeout_s"),
-                            None)
+                            None, trace=trace)
             sess.toks = [int(t) for t in ent.get("gen0", [])]
             with self._lock:
                 self._sessions[sess.rid] = sess
@@ -888,4 +1030,8 @@ class FleetRouter:
                 "journal": (self._journal.stats()
                             if self._journal is not None else None),
                 "wall_s": dt,
+                "slo": {
+                    "enabled": self._slo is not None,
+                    "degraded_replicas": sorted(self._slo_degraded),
+                },
             }

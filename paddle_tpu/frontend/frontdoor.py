@@ -224,3 +224,13 @@ class FrontDoor:
 
     def dump_flight_recorder(self):
         return self.server.dump_flight_recorder()
+
+    def slo_report(self):
+        """The engine's SLO burn-rate report (ISSUE 14) — pass
+        `slos=[SLO(...), ...]` (an engine kwarg) to attach objectives;
+        the report is also served at the ops endpoint's /slo."""
+        return self.server.slo_report()
+
+    def export_timeline(self, path):
+        """Write the engine's Chrome/Perfetto timeline (ISSUE 14)."""
+        return self.server.export_timeline(path)

@@ -38,6 +38,8 @@ from ..observability import flight_recorder as _flight
 from ..observability import log as _obs_log
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..observability.slo import SLO, SLOEngine
+from ..observability.trace_context import TraceContext
 from ..reliability import (AdmissionShed, QuarantinedRequest,
                            RecoveryPolicy, RequestTimeout,
                            SessionJournal, resolve_fault_plan)
@@ -247,6 +249,10 @@ class _Req:
     # reliability (r17): per-request wall-clock cancellation deadline
     # (seconds from submit; None = never)
     timeout_s: float | None = None
+    # causal tracing (ISSUE 14): the TraceContext stamped onto every
+    # event/span/ring entry/journal record this request touches; hop
+    # bumps on retry requeue (engine) and failover/migration (router)
+    trace: TraceContext | None = None
 
 
 class GenerationServer:
@@ -744,6 +750,20 @@ class PagedGenerationServer:
     freeingly; `shed_queue_depth=` refuses admissions past a queue
     depth with an `AdmissionShed.retry_after_s` hint.
 
+    OBSERVABILITY, FLEET-GRADE (ISSUE 14): every request carries a
+    `TraceContext` (minted at submit or passed by a router via
+    `submit(trace_ctx=)`) whose trace_id / hop / cause stamp every
+    trace event, span, flight-recorder entry and journal record the
+    request touches — `observability.assemble_causal_traces` stitches
+    a request's whole fleet lifetime (retries, failover, migration)
+    into one causal tree. `slos=` (list of `observability.SLO`, or
+    True for `default_slos()`) attaches an SLO burn-rate engine fed
+    from the TTFT/ITL/availability/goodput hot paths: multi-window
+    ok|warn|page states, `slo_*` gauges, a `/slo` ops endpoint, and a
+    `stats()["slo"]` block (schema-stable zeros when off).
+    `export_timeline(path)` writes the Chrome/Perfetto timeline of
+    the span sink + flight-recorder ring.
+
     speculation=SpecConfig(...) (or True for defaults) turns on
     SPECULATIVE DECODING (round 11): each round, eligible decode-phase
     slots ask the drafter (default: the self-drafting n-gram /
@@ -776,7 +796,7 @@ class PagedGenerationServer:
                  unified_round=False, async_rounds=False,
                  expose_port=None, flight_recorder=None,
                  stall_timeout_s=30.0, fault_plan=None, recovery=True,
-                 journal=None, shed_queue_depth=None):
+                 journal=None, shed_queue_depth=None, slos=None):
         import jax
         import jax.numpy as jnp
 
@@ -1049,6 +1069,25 @@ class PagedGenerationServer:
         self._fault_streak: dict[str, int] = {}  # rid -> consecutive
         self._consec_failures = 0                # failing dispatches
         self._any_timeouts = False  # set once a timed request is seen
+        # SLO engine (ISSUE 14): declarative objectives over
+        # TTFT/ITL/availability/goodput evaluated from sliding-window
+        # reservoirs with multi-window burn rates; None (default) =
+        # every feed site is one `is None` branch, the telemetry
+        # discipline. True = observability.slo.default_slos().
+        if slos is None or slos is False:
+            self._slo = None
+        elif isinstance(slos, SLOEngine):
+            self._slo = slos
+        elif slos is True:
+            self._slo = SLOEngine(True)
+        else:
+            self._slo = SLOEngine(slos)
+        # goodput-delta marks for the per-round SLO feed
+        self._slo_good_mark = (0, 0)  # (tokens_out, decoded)
+        # replica name a fleet wrapper sets (fleet.Replica) — stamps
+        # trace events/spans so cross-replica assembly can tell the
+        # in-process engines apart
+        self.trace_name = None
         self._last_recovery = None  # {"ts","recovered_from","failures"}
         self._last_error_info = None  # structured degraded_reason
         # fleet round (r18): host ops the ENGINE THREAD executes at the
@@ -1107,7 +1146,9 @@ class PagedGenerationServer:
                 statusz_fn=self.statusz,
                 healthz_fn=self.health,
                 livez_fn=self.liveness,
-                readyz_fn=self.readiness).start(port=expose_port)
+                readyz_fn=self.readiness,
+                slo_fn=(self.slo_report if self._slo is not None
+                        else None)).start(port=expose_port)
             # pull-time health gauge; like the watchdog heartbeat
             # gauge, it follows the most recently built ops-plane
             # server when several are live
@@ -1122,6 +1163,13 @@ class PagedGenerationServer:
         """True while the engine has live work: busy slots or queued
         requests. Read lock-free from watchdog/compile-tracker threads
         (GIL-atomic loads; staleness only delays detection one poll)."""
+        if self._stop:
+            # a stopped/killed engine can never dispatch again — a
+            # kill() leaves its slots occupied by design (futures
+            # unresolved for journal takeover), and reporting that as
+            # "in flight" forever would poison the process-wide
+            # compile tracker's in_flight label for every later server
+            return False
         if any(s is not None for s in self._slots):
             return True
         if self._queue:
@@ -1150,6 +1198,77 @@ class PagedGenerationServer:
                               available_block_count)
         if self._recorder.enabled:
             self._recorder.dump(trigger="stall")
+
+    # ---- causal tracing + SLOs (ISSUE 14) -------------------------------
+    def _tr(self, req):
+        """The trace-stamping attrs (trace_id / hop / cause / replica)
+        one request's events, spans, and flight-recorder entries
+        carry."""
+        t = req.trace
+        if t is None:
+            return {}
+        return t.attrs(replica=self.trace_name)
+
+    def _rattr(self):
+        """Replica attr for batch dispatch spans — lets the timeline
+        exporter and cross-replica assembly tell in-process engines
+        apart (empty off-fleet: no noise on a bare server)."""
+        return ({"replica": self.trace_name}
+                if self.trace_name is not None else {})
+
+    def _slo_latency(self, kind, value_s, req, n=1):
+        """Feed one ttft/itl observation (caller checked _slo)."""
+        meta = req.meta
+        self._slo.observe(kind, value_s=value_s, n=n,
+                          lane=meta.lane if meta is not None else None,
+                          tenant=(meta.tenant if meta is not None
+                                  else None),
+                          replica=self.trace_name)
+
+    def _slo_avail(self, req, ok):
+        """Feed one availability outcome (request finished vs failed
+        terminally: quarantine / timeout / legacy dispatch failure)."""
+        if self._slo is None:
+            return
+        meta = req.meta
+        self._slo.observe("availability", good=ok,
+                          lane=meta.lane if meta is not None else None,
+                          tenant=(meta.tenant if meta is not None
+                                  else None),
+                          replica=self.trace_name)
+
+    def _slo_goodput_round(self):
+        """Per-round goodput feed: deltas of emitted vs decoded tokens
+        since the last round (caller holds the lock and checked
+        _slo)."""
+        good0, dec0 = self._slo_good_mark
+        good = max(0, self._tokens_out - good0)
+        waste = max(0, (self._decoded_tokens - dec0)
+                    - (self._tokens_out - good0))
+        self._slo_good_mark = (self._tokens_out, self._decoded_tokens)
+        if good or waste:
+            self._slo.observe_counts("goodput", good, waste,
+                                     replica=self.trace_name)
+
+    def slo_report(self):
+        """The /slo endpoint payload (`SLOEngine.report()` shape); the
+        empty all-ok shape when the server runs without SLOs."""
+        if self._slo is None:
+            return {"slos": [], "worst": "ok", "paging": []}
+        return self._slo.report()
+
+    def export_timeline(self, path):
+        """Write this engine's Chrome/Perfetto trace-event timeline
+        (span sink + flight-recorder ring) to `path`; returns the
+        event count. Fleet-wide timelines come from
+        `FleetRouter.export_timeline`, which lays every replica out as
+        its own process track."""
+        from ..observability import timeline as _timeline
+
+        name = self.trace_name or "engine"
+        return _timeline.write_chrome_trace(
+            path, recorders={name: self._recorder.events()},
+            default_name=name)
 
     def health(self):
         """(status, detail) for /healthz: "stalled" while the watchdog
@@ -1345,11 +1464,16 @@ class PagedGenerationServer:
         self._sp_store.clear_slot(i)
         req.gen0 = tuple(toks)
         req.resume_ids = known
+        if req.trace is not None:
+            # causal tracing: a fault-retry requeue starts a new hop —
+            # the next residency's events carry hop+1 / cause "retry"
+            req.trace = req.trace.child("retry")
         self._recorder.record(
             "recover_requeue", request_id=req.rid, slot=i, seq=seq,
-            where=where, tokens_done=len(toks), durable=int(durable))
+            where=where, tokens_done=len(toks), durable=int(durable),
+            **self._tr(req))
         _tracing.event("recover_requeue", request_id=req.rid, slot=i,
-                       seq=seq, where=where)
+                       seq=seq, where=where, **self._tr(req))
         return req
 
     def _quarantine_slot(self, i, where, e, failures):
@@ -1371,9 +1495,11 @@ class PagedGenerationServer:
             self._journal.record_done(req.rid, "quarantined")
         self._recorder.record("quarantine", request_id=req.rid, slot=i,
                               seq=seq, seam=where, failures=failures,
-                              error=f"{type(e).__name__}: {e}")
+                              error=f"{type(e).__name__}: {e}",
+                              **self._tr(req))
         _tracing.event("quarantined", request_id=req.rid, slot=i,
-                       seam=where, failures=failures)
+                       seam=where, failures=failures, **self._tr(req))
+        self._slo_avail(req, False)
         _logger.error("quarantined request %s after %d consecutive "
                       "failure(s) at seam %s: %s", req.rid, failures,
                       where, e)
@@ -1400,6 +1526,7 @@ class PagedGenerationServer:
                 if self.cache.has_seq(s["seq"]):
                     self.cache.free(s["seq"])
                 self._worst.pop(s["seq"], None)
+                self._slo_avail(s["req"], False)
                 s["req"].future.set_exception(e)
                 self._slots[i] = None
                 self._sp_store.clear_slot(i)
@@ -1499,9 +1626,10 @@ class PagedGenerationServer:
             self._journal.record_done(req.rid, "timeout")
         self._recorder.record("request_timeout", request_id=req.rid,
                               waited_s=round(now - req.t_submit, 4),
-                              timeout_s=req.timeout_s)
+                              timeout_s=req.timeout_s, **self._tr(req))
         _tracing.event("request_timeout", request_id=req.rid,
-                       waited_s=now - req.t_submit)
+                       waited_s=now - req.t_submit, **self._tr(req))
+        self._slo_avail(req, False)
         req.future.set_exception(RequestTimeout(
             req.rid, now - req.t_submit, req.timeout_s))
 
@@ -1581,8 +1709,16 @@ class PagedGenerationServer:
         if j is None:
             raise ValueError("no journal: pass one or build the "
                              "server with journal=")
-        return {ent["rid"]: self.admit_journal_entry(ent)
-                for ent in j.interrupted()}
+        out = {}
+        for ent in j.interrupted():
+            if ent.get("trace"):
+                # causal tracing: a crash-restart re-admission is a
+                # new hop of the SAME trace (cause "retry")
+                ent = dict(ent)
+                ent["trace"] = TraceContext.from_dict(
+                    ent["trace"]).child("retry").to_dict()
+            out[ent["rid"]] = self.admit_journal_entry(ent)
+        return out
 
     def admit_journal_entry(self, ent, on_token=None):
         """Re-admit ONE journal-shape session entry (the dict
@@ -1626,9 +1762,10 @@ class PagedGenerationServer:
                 self._journal.record_accept(req)
             self._lock.notify()
         self._recorder.record("journal_readmit", request_id=req.rid,
-                              tokens_done=len(req.gen0))
+                              tokens_done=len(req.gen0),
+                              **self._tr(req))
         _tracing.event("journal_readmit", request_id=req.rid,
-                       tokens_done=len(req.gen0))
+                       tokens_done=len(req.gen0), **self._tr(req))
         return req.future
 
     # ---- fleet host ops (r18) ------------------------------------------
@@ -1699,9 +1836,10 @@ class PagedGenerationServer:
                         "migrate_out", request_id=rid,
                         tokens_done=len(req.gen0),
                         kv_tokens=(len(payload["tokens"])
-                                   if payload else 0))
+                                   if payload else 0), **self._tr(req))
                     _tracing.event("migrate_out", request_id=rid,
-                                   tokens_done=len(req.gen0))
+                                   tokens_done=len(req.gen0),
+                                   **self._tr(req))
                     return ent, payload
             req = None
             if self._sched is not None:
@@ -1726,7 +1864,7 @@ class PagedGenerationServer:
                 self._journal.record_done(rid, "migrated")
             self._recorder.record("migrate_out", request_id=rid,
                                   tokens_done=len(req.gen0),
-                                  kv_tokens=0)
+                                  kv_tokens=0, **self._tr(req))
             return ent, None
         return self.run_host_op(op)
 
@@ -1764,6 +1902,11 @@ class PagedGenerationServer:
                    timeout_s=ent.get("timeout_s"))
         req.seed = int(ent["seed"])
         req.budget = int(ent["budget"])
+        # causal tracing: a journal-shape entry carries the trace
+        # context across restarts / replicas / migrations; without one
+        # (pre-r19 journal) the resumed request starts a fresh trace
+        req.trace = (TraceContext.from_dict(ent["trace"])
+                     if ent.get("trace") else TraceContext.mint())
         gen0 = [int(t) for t in ent.get("gen0", [])]
         if gen0:
             req.gen0 = tuple(gen0)
@@ -1965,7 +2108,8 @@ class PagedGenerationServer:
 
     # ---- client API ----------------------------------------------------
     def submit(self, ids, max_new_tokens=None, sampling=None, *,
-               meta=None, on_token=None, timeout_s=None, rid=None):
+               meta=None, on_token=None, timeout_s=None, rid=None,
+               trace_ctx=None):
         """Enqueue one prompt (any length <= max_prompt_len; NO padding
         needed). Returns a Future resolving to the UNPADDED
         [len + generated] int32 sequence (generation stops at EOS, a
@@ -2000,6 +2144,12 @@ class PagedGenerationServer:
         the session once and every replica-facing hook
         (`export_session`, journal records, quarantine diagnostics)
         speaks the same id. Default: auto-assigned "pN".
+        trace_ctx: caller-minted `TraceContext` (ISSUE 14) — the fleet
+        router/front door mints once at ITS submit so the request's
+        whole fleet lifetime shares one trace_id; a bare engine mints
+        its own hop-0 context here. Every event, span, flight-recorder
+        entry and journal record the request touches is stamped with
+        trace_id / hop / cause (+ the replica name on a fleet).
 
         When the server was built with `shed_queue_depth=`, a submit
         arriving at a queue already that deep raises `AdmissionShed`
@@ -2033,11 +2183,17 @@ class PagedGenerationServer:
                 raise ValueError(f"timeout_s must be > 0, "
                                  f"got {timeout_s}")
             self._any_timeouts = True
+        if trace_ctx is not None and not isinstance(trace_ctx,
+                                                    TraceContext):
+            raise TypeError(f"trace_ctx must be a TraceContext, "
+                            f"got {type(trace_ctx).__name__}")
         req = _Req(ids=ids, future=Future(),
                    t_submit=time.perf_counter(),
                    rid=(str(rid) if rid is not None
                         else f"p{next(_req_ids)}"), sampling=sampling,
-                   meta=meta, on_token=on_token, timeout_s=timeout_s)
+                   meta=meta, on_token=on_token, timeout_s=timeout_s,
+                   trace=(trace_ctx if trace_ctx is not None
+                          else TraceContext.mint()))
         # per-request PRNG stream seed: explicit seeds reproduce tokens
         # regardless of batch composition; auto seeds derive from the
         # server seed + a submission counter (distinct streams per
@@ -2085,9 +2241,11 @@ class PagedGenerationServer:
             "submit", request_id=req.rid, prompt_len=int(ids.size),
             budget=budget,
             lane=meta.lane if meta is not None else None,
-            tenant=meta.tenant if meta is not None else None)
+            tenant=meta.tenant if meta is not None else None,
+            **self._tr(req))
         _tracing.event("request_submitted", request_id=req.rid,
-                       prompt_len=int(ids.size), budget=budget)
+                       prompt_len=int(ids.size), budget=budget,
+                       **self._tr(req))
         return req.future
 
     def start(self):
@@ -2171,6 +2329,7 @@ class PagedGenerationServer:
             self._deadline_misses = {}
             self._lane_ttft = {}
             self._lane_itl = {}
+            self._slo_good_mark = (0, 0)
             if self._sched is not None:
                 self._sched.reset_window()
             self._t0 = time.perf_counter()
@@ -2350,7 +2509,15 @@ class PagedGenerationServer:
                 "wall_s": dt,
             }
             out["kv_cache"] = self.cache.stats()
-            return out
+        # SLO burn-rate block (ISSUE 14): evaluated OUTSIDE the engine
+        # lock (the SLO engine has its own) — schema-stable zeroed
+        # shape when the server runs without SLOs
+        out["slo"] = {
+            "enabled": self._slo is not None,
+            "slos": (self._slo.evaluate()
+                     if self._slo is not None else []),
+        }
+        return out
 
     def _sharding_stats(self):
         """The stats()["sharding"] block: the ShardedEngineConfig's
@@ -2479,7 +2646,8 @@ class PagedGenerationServer:
             _m_resumes.inc()
             _tracing.event("resumed", request_id=req.rid, slot=i,
                            seq=seq, cached_tokens=cached,
-                           tokens_done=len(req.gen0), warm=warm)
+                           tokens_done=len(req.gen0), warm=warm,
+                           **self._tr(req))
         if warm and self._async:
             # the slot joins the next decode dispatch directly, so its
             # device-carry entry must hold its host-known state (no
@@ -2490,9 +2658,11 @@ class PagedGenerationServer:
         self._recorder.record(
             "admit", request_id=req.rid, slot=i, seq=seq,
             cached_tokens=cached, resume=req.resume_ids is not None,
-            free_blocks=self.cache.available_block_count)
+            free_blocks=self.cache.available_block_count,
+            **self._tr(req))
         _tracing.event("request_admitted", request_id=req.rid,
-                       slot=i, seq=seq, cached_tokens=cached)
+                       slot=i, seq=seq, cached_tokens=cached,
+                       **self._tr(req))
         return seq
 
     def _preempt_slot_locked(self, i, why="pressure"):
@@ -2534,10 +2704,10 @@ class PagedGenerationServer:
         self._recorder.record(
             "preempt", request_id=req.rid, slot=i, seq=seq,
             tokens_done=len(s["toks"]), cached_tokens=cached,
-            reason=why)
+            reason=why, **self._tr(req))
         _tracing.event("preempted", request_id=req.rid, slot=i, seq=seq,
                        tokens_done=len(s["toks"]), cached_tokens=cached,
-                       reason=why)
+                       reason=why, **self._tr(req))
         return req
 
     def _admit_locked(self):
@@ -2694,7 +2864,7 @@ class PagedGenerationServer:
                     "prefill_chunk", packed=T, segments=len(plan),
                     tokens=int(sum(p[2] for p in plan)),
                     request_ids=[self._slots[i]["req"].rid
-                                 for i, *_ in plan]):
+                                 for i, *_ in plan], **self._rattr()):
                 self._maybe_fault("slow_dispatch")
                 self._maybe_fault("ensure_many")
                 # bulk multi-sequence allocation: the whole chunk plan's
@@ -2793,6 +2963,8 @@ class PagedGenerationServer:
                 # request keeps the TTFT of its first residency
                 req.ttft = t_now - req.t_submit
                 _m_ttft.observe(req.ttft)
+                if self._slo is not None:
+                    self._slo_latency("ttft", req.ttft, req)
                 with self._lock:
                     self._ttft.append(req.ttft)
                     if req.meta is not None:
@@ -2822,7 +2994,7 @@ class PagedGenerationServer:
                            ts=s["t_pre0"], dur=t_now - s["t_pre0"],
                            prompt_len=int(s["prompt"].size),
                            seq=s["seq"], chunks=s["chunks"],
-                           cached_tokens=s["cached"])
+                           cached_tokens=s["cached"], **self._tr(req))
             with self._lock:
                 self._prefills += 1
                 self._decoded_tokens += 1  # the token-0 sample
@@ -2891,11 +3063,14 @@ class PagedGenerationServer:
                 self._journal.record_done(req.rid, reason)
             self._recorder.record("request_done", request_id=req.rid,
                                   slot=i, new_tokens=len(slot["toks"]),
-                                  reason=reason)
+                                  reason=reason, **self._tr(req))
             _tracing.event("request_done", request_id=req.rid,
                            new_tokens=len(slot["toks"]),
-                           ttft_s=req.ttft, reason=reason)
-            with _tracing.span("detokenize", request_id=req.rid):
+                           ttft_s=req.ttft, reason=reason,
+                           **self._tr(req))
+            self._slo_avail(req, True)
+            with _tracing.span("detokenize", request_id=req.rid,
+                               **self._tr(req)):
                 out = np.concatenate([req.ids,
                                       np.asarray(slot["toks"], np.int32)])
                 self.cache.free(seq)
@@ -2965,6 +3140,8 @@ class PagedGenerationServer:
             self._round_dispatch_count += n_dispatches
             if mixed:
                 self._mixed_rounds += 1
+            if self._slo is not None:
+                self._slo_goodput_round()
         _m_round_dispatches.observe(float(n_dispatches))
 
     def _round_split(self):
@@ -3301,7 +3478,7 @@ class PagedGenerationServer:
                     chunk_rows=plan["n_chunk"],
                     step_rows=plan["n_step"],
                     request_ids=[self._slots[row["slot"]]["req"].rid
-                                 for row in rows]):
+                                 for row in rows], **self._rattr()):
                 self._maybe_fault("slow_dispatch")
                 self._maybe_fault("ensure_many")
                 self.cache.ensure_many(updates)
@@ -3484,6 +3661,8 @@ class PagedGenerationServer:
                     # request keeps the TTFT of its first residency
                     req.ttft = t_now - req.t_submit
                     _m_ttft.observe(req.ttft)
+                    if self._slo is not None:
+                        self._slo_latency("ttft", req.ttft, req)
                     with self._lock:
                         self._ttft.append(req.ttft)
                         if req.meta is not None:
@@ -3557,6 +3736,8 @@ class PagedGenerationServer:
             lane = (s["req"].meta.lane if s["req"].meta is not None
                     else None)
             itl_updates.append((per, consumed, lane))
+            if self._slo is not None:
+                self._slo_latency("itl", per, s["req"], n=consumed)
             for _ in range(consumed):
                 _m_itl.observe(per)
         with self._lock:
@@ -3615,7 +3796,7 @@ class PagedGenerationServer:
             with _tracing.span(
                     "decode_dispatch", k=k,
                     request_ids=[self._slots[i]["req"].rid
-                                 for i in active_idx]):
+                                 for i in active_idx], **self._rattr()):
                 self._maybe_fault("slow_dispatch")
                 self._maybe_fault("ensure_many")
                 # grow tables for the incoming token(s) BEFORE the
@@ -3688,6 +3869,8 @@ class PagedGenerationServer:
                 if s["req"].meta is not None:
                     self._lane_itl.setdefault(
                         s["req"].meta.lane, []).extend([per] * consumed)
+            if self._slo is not None:
+                self._slo_latency("itl", per, s["req"], n=consumed)
             for _ in range(consumed):
                 _m_itl.observe(per)
         if discarded:
@@ -3763,7 +3946,7 @@ class PagedGenerationServer:
                     "verify_dispatch", segments=plan.rows,
                     proposed=proposed,
                     request_ids=[self._slots[i]["req"].rid
-                                 for i in plan.slots]):
+                                 for i in plan.slots], **self._rattr()):
                 self._maybe_fault("slow_dispatch")
                 self._maybe_fault("ensure_many")
                 # grow every row's table to its speculative write
@@ -3851,6 +4034,8 @@ class PagedGenerationServer:
                 if s["req"].meta is not None:
                     self._lane_itl.setdefault(
                         s["req"].meta.lane, []).extend([per] * consumed)
+            if self._slo is not None:
+                self._slo_latency("itl", per, s["req"], n=consumed)
             for _ in range(consumed):
                 _m_itl.observe(per)
         if verify_discarded:

@@ -26,6 +26,18 @@ Pillars, shared by serving, training, and bench:
   * `log` — the library logger (PADDLE_TPU_LOG_LEVEL verbosity);
     library code uses this instead of bare print()
     (scripts/check_no_print.py enforces it).
+  * `trace_context` — fleet-wide causal tracing (ISSUE 14): a
+    `TraceContext` (trace_id + hop + cause) minted at submit and
+    carried through retries, failover, and migration; the causal
+    assembler stitches one request's whole fleet lifetime into a
+    single span tree whose phases tile wall-clock exactly.
+  * `slo` — declarative `SLO(objective, target, window)` specs over
+    TTFT/ITL/availability/goodput with sliding-window reservoirs and
+    multi-window fast/slow burn-rate states (ok | warn | page),
+    exported as `slo_*` gauges and the `/slo` ops endpoint.
+  * `timeline` — Chrome/Perfetto trace-event JSON export of the span
+    sink + flight-recorder rings, per-replica-per-track
+    (`FleetRouter.export_timeline`, `bench.py served --timeline`).
 
 One switch turns metrics+tracing on: PADDLE_TPU_TELEMETRY=1 in the
 environment, or `observability.enable()` at runtime.
@@ -33,12 +45,17 @@ environment, or `observability.enable()` at runtime.
 from __future__ import annotations
 
 from . import compile_tracker, exporter, flight_recorder  # noqa: F401
-from . import log, metrics, tracing  # noqa: F401
+from . import log, metrics, slo, timeline, trace_context  # noqa: F401
+from . import tracing  # noqa: F401
 from .exporter import OpsEndpoint  # noqa: F401
 from .flight_recorder import FlightRecorder, StallWatchdog  # noqa: F401
 from .log import get_logger  # noqa: F401
 from .metrics import (REGISTRY, counter, gauge, histogram,  # noqa: F401
                       snapshot, to_prometheus)
+from .slo import SLO, SLOEngine, default_slos  # noqa: F401
+from .timeline import write_chrome_trace  # noqa: F401
+from .trace_context import (TraceContext,  # noqa: F401
+                            assemble_causal_traces)
 from .tracing import (TRACER, assemble_request_traces,  # noqa: F401
                       attach_device_ops, span, summarize_traces)
 

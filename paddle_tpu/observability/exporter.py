@@ -22,6 +22,11 @@ watchable from outside the process:
     draining or stalled engine answers 503 ready=false -> stop
     routing NEW sessions there, but do NOT fail over the residents).
     Both return {"live"/"ready": bool, ...detail}.
+  * `/slo`      — the burn-rate report of the attached SLO engine
+    (`observability.slo`): per-SLO state ok | warn | page with fast/
+    slow burn rates and error-budget accounting; a paging report
+    answers 503. Served only when the owner wired an SLO engine
+    (engine `slos=` / router `slos=`).
 
 Binding is ephemeral-port friendly (`port=0` → the kernel picks; the
 bound port is on `.port`/`.url` after `start()` returns), which is how
@@ -46,7 +51,7 @@ HEALTH_STATES = ("ok", "degraded", "stalled")
 _m_scrapes = _metrics.counter(
     "serving_ops_scrapes_total",
     "ops-endpoint requests served, by endpoint "
-    "(metrics | statusz | healthz | livez | readyz)",
+    "(metrics | statusz | healthz | livez | readyz | slo)",
     labelnames=("endpoint",))
 
 
@@ -64,16 +69,23 @@ class OpsEndpoint:
     metrics_fn: zero-arg callable returning Prometheus text to serve
         at /metrics INSTEAD of the registry (the fleet router's
         federated, replica-labeled view).
+    slo_fn: zero-arg callable returning the SLO burn-rate report dict
+        (`observability.slo.SLOEngine.report()` shape: {"slos": [...],
+        "worst": ok|warn|page, "paging": [...]}) served at /slo —
+        answers 200 while worst is ok or warn, 503 on page (the
+        load-balancer drain signal); absent -> /slo answers 404.
     """
 
     def __init__(self, registry=None, statusz_fn=None, healthz_fn=None,
-                 livez_fn=None, readyz_fn=None, metrics_fn=None):
+                 livez_fn=None, readyz_fn=None, metrics_fn=None,
+                 slo_fn=None):
         self._registry = registry or _metrics.REGISTRY
         self._statusz_fn = statusz_fn
         self._healthz_fn = healthz_fn
         self._livez_fn = livez_fn
         self._readyz_fn = readyz_fn
         self._metrics_fn = metrics_fn
+        self._slo_fn = slo_fn
         self._httpd = None
         self._thread = None
         self.port = None
@@ -136,12 +148,22 @@ class OpsEndpoint:
                             503 if status == "stalled" else 200,
                             json.dumps({"status": status, **detail}),
                             "application/json")
+                    elif path == "/slo" \
+                            and endpoint._slo_fn is not None:
+                        _m_scrapes.labels(endpoint="slo").inc()
+                        report = endpoint._slo_fn()
+                        code = (503 if report.get("worst") == "page"
+                                else 200)
+                        self._send(code, json.dumps(report),
+                                   "application/json")
                     else:
                         paths = ["/metrics", "/statusz", "/healthz"]
                         if endpoint._livez_fn is not None:
                             paths.append("/healthz/live")
                         if endpoint._readyz_fn is not None:
                             paths.append("/healthz/ready")
+                        if endpoint._slo_fn is not None:
+                            paths.append("/slo")
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
                              "paths": paths}),

@@ -163,6 +163,13 @@ class SessionJournal:
             ent["meta"] = {"lane": meta.lane, "tenant": meta.tenant,
                            "deadline_s": meta.deadline_s,
                            "cost": meta.cost}
+        trace = getattr(req, "trace", None)
+        if trace is not None:
+            # causal tracing (ISSUE 14): the TraceContext rides the
+            # journal-shape entry, so a ring dump, a journal replay,
+            # a failover re-admission, and a migration all correlate
+            # with the live trace stream by trace_id
+            ent["trace"] = trace.to_dict()
         return ent
 
     def record_accept(self, req):

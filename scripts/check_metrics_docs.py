@@ -25,8 +25,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "paddle_tpu")
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
-PREFIXES = ("serving_", "kv_", "frontdoor_", "fleet_")
+PREFIXES = ("serving_", "kv_", "frontdoor_", "fleet_", "slo_")
 REGISTER_FNS = {"counter", "gauge", "histogram", "gauge_fn"}
+
+# span/trace-event registry check (ISSUE 14 satellite): every name
+# emitted through the tracer (`_tracing.event("x", ...)` /
+# `_tracing.span("x", ...)`) or a flight recorder
+# (`<...>._recorder.record("x", ...)`) must have a row in
+# docs/OBSERVABILITY.md's span-name registry table, and vice versa.
+SPAN_DOC_HEADING = "### Span and event name registry"
+_TRACING_NAMES = {"_tracing", "tracing"}
+_RECORDER_ATTRS = {"_recorder", "recorder"}
 
 
 def _checked(name):
@@ -81,9 +90,17 @@ def collect_doc_metrics(doc_path=DOC):
     expanded. Per-line parsing, so the ```-fenced examples elsewhere
     in the doc can't desynchronize backtick pairing."""
     out = set()
+    in_span_section = False
     for line in open(doc_path, encoding="utf-8"):
         line = line.strip()
-        if not line.startswith("|"):
+        if line.startswith(SPAN_DOC_HEADING):
+            # the span-name registry is a different namespace — a span
+            # named fleet_migrate is not an undocumented metric
+            in_span_section = True
+            continue
+        if in_span_section and line.startswith("#"):
+            in_span_section = False
+        if in_span_section or not line.startswith("|"):
             continue
         # cells split on UNESCAPED pipes only — label alternation in
         # markdown tables is written `{reason=eos\|budget}`
@@ -118,16 +135,103 @@ def run_check():
     return errors, code, docs
 
 
+def collect_code_spans(pkg_dir=PKG):
+    """{span/event name: [file:line, ...]} for every tracer emission
+    (`_tracing.event`/`_tracing.span` with a literal first argument)
+    and flight-recorder entry (`<x>._recorder.record(...)`) in library
+    code."""
+    out = {}
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read(),
+                                 filename=rel)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                f = node.func
+                is_trace = (f.attr in ("event", "span")
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id in _TRACING_NAMES)
+                is_ring = (f.attr == "record"
+                           and isinstance(f.value, ast.Attribute)
+                           and f.value.attr in _RECORDER_ATTRS)
+                if not (is_trace or is_ring):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and re.fullmatch(r"[a-z0-9_]+", arg.value):
+                    out.setdefault(arg.value, []).append(
+                        f"{rel}:{node.lineno}")
+    return out
+
+
+def collect_doc_spans(doc_path=DOC):
+    """Span/event names documented in docs/OBSERVABILITY.md: the
+    first-cell backticked tokens of the table under
+    SPAN_DOC_HEADING (brace alternation expanded), up to the next
+    heading."""
+    out = set()
+    in_section = False
+    for line in open(doc_path, encoding="utf-8"):
+        stripped = line.strip()
+        if stripped.startswith(SPAN_DOC_HEADING):
+            in_section = True
+            continue
+        if in_section and stripped.startswith("#"):
+            break
+        if not in_section or not stripped.startswith("|"):
+            continue
+        cells = re.split(r"(?<!\\)\|", stripped)
+        first_cell = cells[1] if len(cells) >= 2 else ""
+        for code in re.findall(r"`([^`]+)`", first_cell):
+            for token in re.split(r"[\s,]+(?![^{]*\})", code):
+                for name in _expand_braces(token.strip()):
+                    if re.fullmatch(r"[a-z0-9_]+", name):
+                        out.add(name)
+    return out
+
+
+def run_span_check():
+    """Returns (errors, code_names, doc_names) for the span/event name
+    registry."""
+    code = collect_code_spans()
+    docs = collect_doc_spans()
+    errors = []
+    for name in sorted(set(code) - docs):
+        errors.append(
+            f"span/event {name!r} (emitted at {code[name][0]}) has no "
+            f"row in docs/OBSERVABILITY.md's span-name registry")
+    for name in sorted(docs - set(code)):
+        errors.append(
+            f"docs/OBSERVABILITY.md's span-name registry documents "
+            f"{name!r} but no library code emits it")
+    return errors, code, docs
+
+
 def main():
     errors, code, docs = run_check()
+    span_errors, spans, span_docs = run_span_check()
+    errors = errors + span_errors
     if errors:
         for e in errors:
             print(e)  # cli-print
-        print(f"{len(errors)} metrics<->docs drift error(s) "  # cli-print
-              f"({len(code)} registered, {len(docs)} documented)")
+        print(f"{len(errors)} metrics/spans<->docs drift error(s) "  # cli-print
+              f"({len(code)} metrics registered, {len(docs)} "
+              f"documented; {len(spans)} spans emitted, "
+              f"{len(span_docs)} documented)")
         return 1
     print(f"metrics<->docs in sync: {len(code)} registered "  # cli-print
-          f"{PREFIXES} metrics all documented, no stale doc rows")
+          f"{PREFIXES} metrics all documented, no stale doc rows; "
+          f"{len(spans)} span/event names all in the registry")
     return 0
 
 

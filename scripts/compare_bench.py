@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Diff the two newest BENCH_*.json records axis-by-axis (ISSUE 14
+satellite).
+
+Every bench round appends a BENCH_rNN.json capture whose parsed
+records each carry `metric` / `value` / `unit`. This script matches
+the two newest captures by metric name and flags regressions beyond a
+relative threshold, direction-aware:
+
+  * throughput-like metrics (tok/s, samples/s, goodput, hit rates,
+    slots, MFU) regress when the value DROPS;
+  * latency-like metrics (TTFT / ITL / p50 / p99 / anything in ms or
+    seconds) regress when the value RISES.
+
+Usage:
+    python scripts/compare_bench.py [--threshold 0.10] [dir]
+    python scripts/compare_bench.py --tiny      # self-check (tier-1)
+
+Exit 0 when no regression crosses the threshold (improvements and
+new/retired axes are reported informationally), 1 otherwise. `--tiny`
+runs the comparator over two embedded synthetic captures engineered to
+contain one regression per direction and asserts the verdicts —
+the tier-1 wiring (tests/test_compare_bench.py) that keeps the
+comparator itself from regressing silently.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+DEFAULT_THRESHOLD = 0.10
+
+# substrings that mark a lower-is-better metric; unit fallback below
+_LOWER_BETTER_PAT = re.compile(
+    r"ttft|itl|latency|p50|p90|p99|overhead|stall|_ms\b|_s\b")
+_LOWER_BETTER_UNITS = {"ms", "s", "seconds", "milliseconds"}
+
+
+def lower_is_better(metric, unit=""):
+    """Direction of goodness for one bench metric."""
+    if _LOWER_BETTER_PAT.search(metric or ""):
+        return True
+    return (unit or "").strip().lower() in _LOWER_BETTER_UNITS
+
+
+def extract_records(doc):
+    """Pull the record list out of one BENCH_*.json capture. Handles
+    every shape the harness has produced: a top-level record list, a
+    {"parsed": {... "parsed_all": [...]}} capture, and captures where
+    the parsed records only survive as JSON lines inside "tail"."""
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict) and "metric" in r]
+    if not isinstance(doc, dict):
+        return []
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and isinstance(
+            parsed.get("parsed_all"), list):
+        return [r for r in parsed["parsed_all"]
+                if isinstance(r, dict) and "metric" in r]
+    if isinstance(doc.get("parsed_all"), list):
+        return [r for r in doc["parsed_all"]
+                if isinstance(r, dict) and "metric" in r]
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return [parsed]
+    records = []
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            rec = dict(rec)
+            inner = rec.pop("parsed_all", None)
+            records.append(rec)
+            if isinstance(inner, list):
+                records.extend(r for r in inner
+                               if isinstance(r, dict) and "metric" in r)
+    # dedupe by metric, last occurrence wins (the harness echoes the
+    # final summary line with parsed_all embedded)
+    by_metric = {}
+    for r in records:
+        by_metric[r["metric"]] = r
+    return list(by_metric.values())
+
+
+def find_latest_pair(bench_dir):
+    """The two newest BENCH_*.json paths, by the rNN number in the
+    name (mtime tiebreak), oldest first."""
+    names = [n for n in os.listdir(bench_dir)
+             if re.fullmatch(r"BENCH_r\d+\.json", n)]
+
+    def key(n):
+        return (int(re.search(r"r(\d+)", n).group(1)),
+                os.path.getmtime(os.path.join(bench_dir, n)))
+
+    names.sort(key=key)
+    if len(names) < 2:
+        raise FileNotFoundError(
+            f"need >= 2 BENCH_*.json records in {bench_dir}, "
+            f"found {names}")
+    return (os.path.join(bench_dir, names[-2]),
+            os.path.join(bench_dir, names[-1]))
+
+
+def compare(old_records, new_records, threshold=DEFAULT_THRESHOLD):
+    """Axis-by-axis diff. Returns a report dict:
+    {"regressions": [...], "improvements": [...], "unchanged": [...],
+     "added": [...], "removed": [...]} — each entry carries metric,
+    old/new value, relative change, and direction."""
+    old = {r["metric"]: r for r in old_records}
+    new = {r["metric"]: r for r in new_records}
+    report = {"regressions": [], "improvements": [], "unchanged": [],
+              "added": sorted(set(new) - set(old)),
+              "removed": sorted(set(old) - set(new))}
+    for metric in sorted(set(old) & set(new)):
+        try:
+            ov = float(old[metric]["value"])
+            nv = float(new[metric]["value"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        lower = lower_is_better(metric, new[metric].get("unit", ""))
+        if ov == 0:
+            rel = 0.0 if nv == 0 else float("inf")
+        else:
+            rel = (nv - ov) / abs(ov)
+        # regression magnitude in the "bad" direction
+        bad = rel if lower else -rel
+        entry = {
+            "metric": metric, "old": ov, "new": nv,
+            "rel_change": round(rel, 4),
+            "direction": "lower_better" if lower else "higher_better",
+            "unit": new[metric].get("unit", ""),
+        }
+        if bad > threshold:
+            report["regressions"].append(entry)
+        elif bad < -threshold:
+            report["improvements"].append(entry)
+        else:
+            report["unchanged"].append(entry)
+    return report
+
+
+def format_report(report, old_path="old", new_path="new",
+                  threshold=DEFAULT_THRESHOLD):
+    lines = [f"compare_bench: {os.path.basename(str(old_path))} -> "
+             f"{os.path.basename(str(new_path))} "
+             f"(threshold {threshold:.0%})"]
+    for e in report["regressions"]:
+        lines.append(
+            f"  REGRESSION {e['metric']}: {e['old']:g} -> {e['new']:g} "
+            f"({e['rel_change']:+.1%}, {e['direction']})")
+    for e in report["improvements"]:
+        lines.append(
+            f"  improved   {e['metric']}: {e['old']:g} -> {e['new']:g} "
+            f"({e['rel_change']:+.1%})")
+    lines.append(
+        f"  {len(report['unchanged'])} within threshold, "
+        f"{len(report['added'])} new axis(es), "
+        f"{len(report['removed'])} retired")
+    return "\n".join(lines)
+
+
+# ---- --tiny self-check ---------------------------------------------------
+
+_TINY_OLD = [
+    {"metric": "gpt2s_served_paged_tokens_per_sec", "value": 100.0,
+     "unit": "tokens/s"},
+    {"metric": "gpt2s_served_ttft_p99_ms", "value": 50.0, "unit": "ms"},
+    {"metric": "gpt2s_served_goodput_ratio", "value": 0.95, "unit": ""},
+    {"metric": "gpt2s_served_itl_p99_ms", "value": 12.0, "unit": "ms"},
+    {"metric": "retired_axis", "value": 1.0, "unit": ""},
+]
+_TINY_NEW = [
+    # tok/s drop 20% -> regression (higher_better)
+    {"metric": "gpt2s_served_paged_tokens_per_sec", "value": 80.0,
+     "unit": "tokens/s"},
+    # ttft rise 40% -> regression (lower_better)
+    {"metric": "gpt2s_served_ttft_p99_ms", "value": 70.0, "unit": "ms"},
+    # goodput within threshold
+    {"metric": "gpt2s_served_goodput_ratio", "value": 0.94, "unit": ""},
+    # itl IMPROVED 50% -> not a regression
+    {"metric": "gpt2s_served_itl_p99_ms", "value": 6.0, "unit": "ms"},
+    {"metric": "new_axis", "value": 2.0, "unit": ""},
+]
+
+
+def run_tiny():
+    """Self-check over the embedded synthetic captures: exactly the
+    two engineered regressions flag, the improvement and the
+    within-threshold axis do not, added/removed axes are seen. Returns
+    the report; raises AssertionError on any miss."""
+    report = compare(_TINY_OLD, _TINY_NEW, threshold=0.10)
+    flagged = {e["metric"] for e in report["regressions"]}
+    assert flagged == {"gpt2s_served_paged_tokens_per_sec",
+                       "gpt2s_served_ttft_p99_ms"}, flagged
+    improved = {e["metric"] for e in report["improvements"]}
+    assert improved == {"gpt2s_served_itl_p99_ms"}, improved
+    assert [e["metric"] for e in report["unchanged"]] \
+        == ["gpt2s_served_goodput_ratio"], report["unchanged"]
+    assert report["added"] == ["new_axis"]
+    assert report["removed"] == ["retired_axis"]
+    # direction inference sanity
+    assert lower_is_better("x_ttft_p99_ms")
+    assert lower_is_better("whatever", "ms")
+    assert not lower_is_better("x_tokens_per_sec", "tokens/s")
+    # record extraction handles the harness capture shape (tail lines
+    # with an embedded parsed_all)
+    capture = {"n": 1, "cmd": "bench", "rc": 0, "tail": "\n".join(
+        [json.dumps(_TINY_OLD[0]),
+         json.dumps({**_TINY_OLD[1], "parsed_all": _TINY_OLD})])}
+    got = {r["metric"] for r in extract_records(capture)}
+    assert {r["metric"] for r in _TINY_OLD} == got, got
+    return report
+
+
+def main(argv):
+    args = [a for a in argv if not a.startswith("--")]
+    flags = {a for a in argv if a.startswith("--")}
+    threshold = DEFAULT_THRESHOLD
+    for a in list(flags):
+        if a.startswith("--threshold="):
+            threshold = float(a.split("=", 1)[1])
+            flags.remove(a)
+    if "--threshold" in flags:  # space-separated form
+        flags.remove("--threshold")
+        threshold = float(args.pop(0))
+    if "--tiny" in flags:
+        flags.remove("--tiny")
+        report = run_tiny()
+        print("compare_bench --tiny self-check passed: "  # cli-print
+              f"{len(report['regressions'])} engineered regressions "
+              f"flagged, improvements/unchanged/added/removed all "
+              f"classified")
+        return 0
+    if flags:
+        print(f"unknown flag(s) {sorted(flags)}; supported: "  # cli-print
+              f"--threshold=X, --tiny")
+        return 2
+    bench_dir = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    old_path, new_path = find_latest_pair(bench_dir)
+    old = extract_records(json.load(open(old_path)))
+    new = extract_records(json.load(open(new_path)))
+    report = compare(old, new, threshold=threshold)
+    print(format_report(report, old_path, new_path,  # cli-print
+                        threshold))
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

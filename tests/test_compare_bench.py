@@ -1,0 +1,91 @@
+"""scripts/compare_bench.py (ISSUE 14 satellite): direction-aware
+axis-by-axis bench diffing, capture-shape extraction, and the --tiny
+self-check wired tier-1."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SCRIPT = os.path.join(os.path.dirname(HERE), "scripts",
+                      "compare_bench.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("compare_bench",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tiny_self_check_subprocess():
+    out = subprocess.run([sys.executable, SCRIPT, "--tiny"],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "self-check passed" in out.stdout
+
+
+def test_direction_inference():
+    m = _load()
+    assert m.lower_is_better("gpt2s_served_ttft_p99_ms")
+    assert m.lower_is_better("x_itl_p50_ms")
+    assert m.lower_is_better("telemetry_overhead_pct")
+    assert m.lower_is_better("anything", "ms")
+    assert not m.lower_is_better("gpt2s_served_tokens_per_sec",
+                                 "tokens/s")
+    assert not m.lower_is_better("goodput_ratio")
+
+
+def test_compare_flags_only_true_regressions():
+    m = _load()
+    old = [{"metric": "a_tokens_per_sec", "value": 100.0,
+            "unit": "tokens/s"},
+           {"metric": "b_ttft_p99_ms", "value": 10.0, "unit": "ms"}]
+    new = [{"metric": "a_tokens_per_sec", "value": 95.0,
+            "unit": "tokens/s"},          # -5%: within 10%
+           {"metric": "b_ttft_p99_ms", "value": 30.0, "unit": "ms"}]
+    rep = m.compare(old, new, threshold=0.10)
+    assert [e["metric"] for e in rep["regressions"]] \
+        == ["b_ttft_p99_ms"]
+    assert [e["metric"] for e in rep["unchanged"]] \
+        == ["a_tokens_per_sec"]
+    # tighter threshold flags the tok/s drop too
+    rep = m.compare(old, new, threshold=0.02)
+    assert {e["metric"] for e in rep["regressions"]} \
+        == {"a_tokens_per_sec", "b_ttft_p99_ms"}
+
+
+def test_extract_records_all_capture_shapes():
+    m = _load()
+    recs = [{"metric": "x", "value": 1.0}, {"metric": "y", "value": 2}]
+    assert {r["metric"] for r in m.extract_records(recs)} == {"x", "y"}
+    assert {r["metric"] for r in m.extract_records(
+        {"parsed": {"metric": "x", "value": 1.0,
+                    "parsed_all": recs}})} == {"x", "y"}
+    tail = "\n".join(["noise", json.dumps(recs[0]),
+                      json.dumps({**recs[1], "parsed_all": recs})])
+    assert {r["metric"] for r in m.extract_records(
+        {"tail": tail})} == {"x", "y"}
+    assert m.extract_records({"tail": "no json here"}) == []
+
+
+def test_find_latest_pair_and_main(tmp_path):
+    m = _load()
+    old = [{"metric": "a_tokens_per_sec", "value": 100.0,
+            "unit": "tokens/s"}]
+    new_ok = [{"metric": "a_tokens_per_sec", "value": 99.0,
+               "unit": "tokens/s"}]
+    new_bad = [{"metric": "a_tokens_per_sec", "value": 50.0,
+                "unit": "tokens/s"}]
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(new_ok))
+    a, b = m.find_latest_pair(str(tmp_path))
+    assert a.endswith("r01.json") and b.endswith("r02.json")
+    assert m.main([str(tmp_path)]) == 0
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(new_bad))
+    a, b = m.find_latest_pair(str(tmp_path))
+    assert a.endswith("r02.json") and b.endswith("r03.json")
+    assert m.main([str(tmp_path)]) == 1  # 49% tok/s drop flags
+    assert m.main(["--threshold=0.6", str(tmp_path)]) == 0

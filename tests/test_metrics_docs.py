@@ -42,3 +42,28 @@ def test_scan_sees_known_anchors():
     # brace expansion on the docs side: the {used,free,retained} row
     assert {"kv_pool_used_blocks", "kv_pool_free_blocks",
             "kv_pool_retained_blocks"} <= docs
+
+
+def test_spans_and_docs_in_sync():
+    """ISSUE 14 satellite: every emitted span/trace-event/ring-entry
+    name has a row in docs/OBSERVABILITY.md's span-name registry and
+    vice versa."""
+    mod = _load()
+    errors, code, docs = mod.run_span_check()
+    assert not errors, "\n".join(errors)
+    assert len(code) >= 30, sorted(code)
+    assert len(docs) >= 30, sorted(docs)
+
+
+def test_span_scan_sees_known_anchors():
+    mod = _load()
+    code = mod.collect_code_spans()
+    docs = mod.collect_doc_spans()
+    for name in ("request_submitted", "prefill_chunk", "round",
+                 "fleet_place", "slo_degrade", "migrate_out",
+                 "recover_requeue"):
+        assert name in code, name
+        assert name in docs, name
+    # the span registry table lives in its own namespace: span names
+    # with metric-looking prefixes must NOT leak into the metric scan
+    assert "fleet_place" not in mod.collect_doc_metrics()

@@ -1,0 +1,353 @@
+"""SLO burn-rate engine (ISSUE 14): declarative objective validation,
+sliding-window reservoirs, multi-window ok -> warn -> page states with
+error-budget accounting, the /slo ops endpoint (503 on page), and the
+fleet router's sustained-page replica-degrade hook — including the
+acceptance gate: an INDUCED latency degradation (seeded slow_dispatch
+faults) drives a live server's /slo through page."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics as M
+from paddle_tpu.observability.slo import (SLO, SLOEngine, STATES,
+                                          default_slos)
+from paddle_tpu.reliability import FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def _registry_guard():
+    was = M.REGISTRY.enabled
+    yield
+    M.REGISTRY.enabled = was
+    M.REGISTRY.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+    paddle.seed(100)
+    cfg = GPT2Config(vocab_size=512, hidden_size=128, num_layers=2,
+                     num_heads=4, max_position=128)
+    cfg.dropout = 0.0
+    m = GPT2(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _server(m, **kw):
+    from paddle_tpu.inference import PagedGenerationServer
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_prompt_len", 24)
+    kw.setdefault("max_new_tokens", 8)
+    return PagedGenerationServer(m, **kw)
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestSLOValidation:
+    def test_field_validation_names_the_field(self):
+        with pytest.raises(ValueError, match="objective"):
+            SLO("latency", 0.9, threshold_s=1.0)
+        with pytest.raises(ValueError, match="target"):
+            SLO("ttft", 1.0, threshold_s=1.0)
+        with pytest.raises(ValueError, match="threshold_s"):
+            SLO("ttft", 0.9)  # latency objective needs a bound
+        with pytest.raises(ValueError, match="threshold_s"):
+            SLO("availability", 0.9, threshold_s=1.0)  # outcome: none
+        with pytest.raises(ValueError, match="window_s"):
+            SLO("availability", 0.9, window_s=0)
+        with pytest.raises(ValueError, match="fast_window_s"):
+            SLO("ttft", 0.9, threshold_s=1.0, window_s=10,
+                fast_window_s=20)
+        with pytest.raises(ValueError, match="warn_burn"):
+            SLO("ttft", 0.9, threshold_s=1.0, warn_burn=5.0,
+                page_burn=2.0)
+        with pytest.raises(ValueError, match="min_events"):
+            SLO("ttft", 0.9, threshold_s=1.0, min_events=0)
+
+    def test_engine_validation(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            SLOEngine([])
+        with pytest.raises(TypeError, match="SLO"):
+            SLOEngine(["ttft"])
+        s = SLO("ttft", 0.9, threshold_s=1.0, name="dup")
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine([s, SLO("itl", 0.9, threshold_s=1.0,
+                              name="dup")])
+        assert len(SLOEngine(True).slos) == len(default_slos())
+
+    def test_scope_matching_and_default_name(self):
+        s = SLO("ttft", 0.99, threshold_s=0.5, lane="interactive")
+        assert s.matches(lane="interactive", tenant="x", replica="r0")
+        assert not s.matches(lane="batch")
+        assert "ttft" in s.name and "interactive" in s.name
+        everywhere = SLO("availability", 0.99)
+        assert everywhere.matches(lane=None) and everywhere.matches(
+            lane="batch", replica="r9")
+
+
+def _slo(name="t", target=0.9, **kw):
+    kw.setdefault("threshold_s", 0.5)
+    kw.setdefault("window_s", 60.0)
+    kw.setdefault("fast_window_s", 6.0)
+    kw.setdefault("min_events", 5)
+    return SLO("ttft", target, name=name, **kw)
+
+
+class TestBurnStates:
+    def test_ok_warn_page_progression_with_budget_accounting(self):
+        """The acceptance progression, deterministic clocks: good
+        traffic -> ok; ~33% violations once the good era pruned ->
+        burn ~3.3 -> warn; 100% violations dominating the slow window
+        -> burn 10 -> page, error budget overspent."""
+        eng = SLOEngine([_slo()])
+        for i in range(40):                      # era 1: all good
+            eng.observe("ttft", value_s=0.1, now=0.0 + i * 0.25)
+        (rec,) = eng.evaluate(now=10.0)
+        assert rec["state"] == "ok"
+        assert rec["burn_slow"] == 0.0
+        assert rec["budget_remaining"] == 1.0
+        for i in range(40):                      # era 2 (era 1 pruned)
+            eng.observe("ttft", value_s=(2.0 if i % 3 == 0 else 0.1),
+                        now=70.0 + i * 0.25)
+        (rec,) = eng.evaluate(now=80.0)
+        assert rec["state"] == "warn"
+        assert 2.0 <= rec["burn_fast"] <= 5.0
+        assert 2.0 <= rec["burn_slow"] <= 5.0
+        assert rec["budget_remaining"] < 0  # already overspending
+        for i in range(40):                      # era 3: all bad
+            eng.observe("ttft", value_s=2.0, now=150.0 + i * 0.25)
+        (rec,) = eng.evaluate(now=160.0)
+        assert rec["state"] == "page"
+        assert rec["burn_fast"] == pytest.approx(10.0)
+        assert rec["burn_slow"] == pytest.approx(10.0)
+        assert rec["budget_remaining"] == pytest.approx(-9.0)
+        assert rec["page_for_s"] == 0.0
+        # budget recovers to ok once the bad era ages out unseen
+        (rec,) = eng.evaluate(now=300.0)
+        assert rec["state"] == "ok" and rec["events_slow"] == 0
+
+    def test_fast_spike_alone_never_pages(self):
+        """Multi-window AND: a brief 100%-bad burst maxes the fast
+        burn but the slow window still holds the good history — warn
+        at most, no page."""
+        eng = SLOEngine([_slo()])
+        for i in range(200):
+            eng.observe("ttft", value_s=0.1, now=100.0 + i * 0.25)
+        for i in range(10):                      # 3s burst of bad
+            eng.observe("ttft", value_s=2.0, now=151.0 + i * 0.3)
+        (rec,) = eng.evaluate(now=154.5)
+        assert rec["burn_fast"] >= 5.0
+        assert rec["burn_slow"] < 1.0
+        assert rec["state"] == "ok"
+
+    def test_min_events_gates_cold_start(self):
+        eng = SLOEngine([_slo(min_events=50)])
+        for i in range(10):
+            eng.observe("ttft", value_s=9.0, now=100.0 + i * 0.1)
+        (rec,) = eng.evaluate(now=101.5)
+        assert rec["state"] == "ok" and rec["events_slow"] == 10
+
+    def test_paging_sustain_and_worst_state(self):
+        eng = SLOEngine([_slo(), SLO("availability", 0.9,
+                                     window_s=60.0, fast_window_s=6.0,
+                                     min_events=5, name="a")])
+        for i in range(64):  # continuous bad traffic through t=116
+            eng.observe("ttft", value_s=2.0, now=100.0 + i * 0.25)
+            eng.observe("availability", good=True, now=100.0 + i * 0.25)
+        # first paging evaluation stamps page_since; sustain not met
+        assert eng.paging(now=110.0, sustain_s=5.0) == set()
+        assert eng.paging(now=115.5, sustain_s=5.0) == {"t"}
+        assert eng.worst_state(now=112.0) == "page"
+        rep = eng.report(now=112.0)
+        assert rep["worst"] == "page" and rep["paging"] == ["t"]
+        assert {r["name"]: r["state"] for r in rep["slos"]} == \
+            {"t": "page", "a": "ok"}
+        assert set(STATES) == {"ok", "warn", "page"}
+
+    def test_observation_validation(self):
+        eng = SLOEngine([_slo()])
+        with pytest.raises(ValueError, match="objective"):
+            eng.observe("latency", value_s=1.0)
+        with pytest.raises(ValueError, match="value_s"):
+            eng.observe("ttft", good=True, now=1.0)
+
+    def test_gauges_exported_on_evaluate(self):
+        M.REGISTRY.enable()
+        eng = SLOEngine([_slo(name="gauged")])
+        for i in range(20):
+            eng.observe("ttft", value_s=2.0, now=50.0 + i * 0.25)
+        eng.evaluate(now=56.0)
+        snap = M.snapshot()
+        burn = {tuple(sorted(s["labels"].items())): s["value"]
+                for s in snap["slo_burn_rate"]["series"]}
+        assert burn[(("slo", "gauged"), ("window", "fast"))] \
+            == pytest.approx(10.0)
+        state = {s["labels"]["slo"]: s["value"]
+                 for s in snap["slo_state"]["series"]}
+        assert state["gauged"] == 2.0
+        budget = {s["labels"]["slo"]: s["value"]
+                  for s in snap["slo_error_budget_remaining"]["series"]}
+        assert budget["gauged"] == pytest.approx(-9.0)
+
+
+class TestEngineIntegration:
+    def test_slo_endpoint_ok_and_stats_block(self, tiny_model):
+        m, _ = tiny_model
+        srv = _server(m, expose_port=0, slos=[
+            SLO("ttft", 0.9, threshold_s=120.0, window_s=30.0,
+                min_events=2, name="ttft_generous"),
+            SLO("availability", 0.9, window_s=30.0, min_events=2,
+                name="avail"),
+        ]).start()
+        try:
+            futs = [srv.submit(np.array([3, 5, 7], np.int32))
+                    for _ in range(4)]
+            for f in futs:
+                f.result(timeout=300)
+            code, rep = _get(f"{srv.exporter.url}/slo")
+            st = srv.stats()["slo"]
+            # the endpoint is listed for discovery
+            code404, listing = _get(f"{srv.exporter.url}/nope")
+        finally:
+            srv.stop()
+        assert code == 200 and rep["worst"] == "ok"
+        by_name = {s["name"]: s for s in rep["slos"]}
+        assert by_name["ttft_generous"]["state"] == "ok"
+        assert by_name["ttft_generous"]["events_slow"] == 4
+        assert by_name["avail"]["events_slow"] == 4
+        assert st["enabled"] and len(st["slos"]) == 2
+        assert code404 == 404 and "/slo" in listing["paths"]
+
+    def test_induced_latency_drives_page_and_503(self, tiny_model):
+        """ACCEPTANCE: seeded slow_dispatch faults inject real latency;
+        with a tight threshold the live /slo endpoint pages (503) with
+        the error budget overspent."""
+        m, _ = tiny_model
+        plan = FaultPlan([("slow_dispatch", i) for i in range(8)],
+                         name="slow", slow_s=0.05)
+        srv = _server(m, expose_port=0, fault_plan=plan, slos=[
+            SLO("ttft", 0.9, threshold_s=1e-4, window_s=30.0,
+                fast_window_s=3.0, min_events=2, name="tight"),
+        ]).start()
+        try:
+            futs = [srv.submit(np.array([3, 5, 7], np.int32))
+                    for _ in range(4)]
+            for f in futs:
+                f.result(timeout=300)
+            code, rep = _get(f"{srv.exporter.url}/slo")
+            st = srv.stats()
+        finally:
+            srv.stop()
+        assert st["reliability"]["faults_injected"] >= 1
+        assert code == 503
+        assert rep["worst"] == "page"
+        (rec,) = rep["slos"]
+        assert rec["state"] == "page"
+        assert rec["burn_slow"] == pytest.approx(10.0)
+        assert rec["budget_remaining"] == pytest.approx(-9.0)
+        assert rep["paging"] == ["tight"]
+
+    def test_disabled_schema_and_no_endpoint(self, tiny_model):
+        m, _ = tiny_model
+        srv = _server(m, expose_port=0).start()
+        try:
+            srv.submit(np.array([3, 5], np.int32),
+                       max_new_tokens=2).result(timeout=300)
+            assert srv.stats()["slo"] == {"enabled": False, "slos": []}
+            assert srv.slo_report()["worst"] == "ok"
+            code, listing = _get(f"{srv.exporter.url}/slo")
+        finally:
+            srv.stop()
+        assert code == 404  # no SLO engine -> no endpoint
+
+
+class TestRouterDegradeHook:
+    def test_sustained_replica_page_marks_not_ready(self, tiny_model):
+        from paddle_tpu.fleet import FleetRouter, Replica
+
+        m, _ = tiny_model
+        reps = [Replica(f"r{i}", _server(m, enable_prefix_cache=True))
+                for i in range(2)]
+        router = FleetRouter(
+            reps, probe_interval_s=30.0,
+            slos=[SLO("ttft", 0.9, threshold_s=0.5, window_s=60.0,
+                      fast_window_s=6.0, min_events=5,
+                      replica="r0", name="r0_ttft"),
+                  SLO("ttft", 0.9, threshold_s=0.5, window_s=60.0,
+                      fast_window_s=6.0, min_events=5,
+                      name="fleet_ttft")],
+            slo_degrade_sustain_s=2.0)
+        router.start()
+        try:
+            now = time.monotonic()
+            for i in range(40):  # r0 burns its budget; r1 unobserved
+                router._slo.observe("ttft", value_s=9.0,
+                                    now=now + i * 0.1, replica="r0")
+            router.check_replicas(now=now + 4.0)   # page_since set
+            assert reps[0].health.state != "not_ready"
+            router.check_replicas(now=now + 6.5)   # sustained -> fire
+            assert reps[0].health.state == "not_ready"
+            assert reps[1].health.state == "ok"
+            st = router.stats()
+            assert st["slo"] == {"enabled": True,
+                                 "degraded_replicas": ["r0"]}
+            rep = router.slo_report()
+            assert rep["degraded_replicas"] == ["r0"]
+            # the fleet-wide SLO pages too but degrades NOBODY (no
+            # single culprit)
+            assert {r["name"] for r in rep["slos"]
+                    if r["state"] == "page"} \
+                == {"r0_ttft", "fleet_ttft"}
+            # new placements avoid the degraded replica
+            out = router.submit(np.array([4, 2], np.int32),
+                                max_new_tokens=2).result(timeout=300)
+            assert out.size == 4
+            assert router._sessions and all(
+                s.replica is reps[1]
+                for s in router._sessions.values())
+            # burn clears (windows age out) -> next pass releases it
+            router.check_replicas(now=now + 300.0)
+            router.check_replicas(now=now + 330.0)
+            assert reps[0].health.state == "ok"
+            assert router.stats()["slo"]["degraded_replicas"] == []
+        finally:
+            router.stop()
+
+    def test_router_feeds_ttft_and_availability(self, tiny_model):
+        from paddle_tpu.fleet import FleetRouter, Replica
+
+        m, _ = tiny_model
+        reps = [Replica("r0", _server(m, enable_prefix_cache=True))]
+        router = FleetRouter(
+            reps, probe_interval_s=30.0,
+            slos=[SLO("ttft", 0.9, threshold_s=120.0, min_events=2,
+                      name="wide"),
+                  SLO("availability", 0.9, min_events=2, name="av")])
+        router.start()
+        try:
+            futs = [router.submit(np.array([3, 5, 7], np.int32))
+                    for _ in range(3)]
+            for f in futs:
+                f.result(timeout=300)
+            rep = router.slo_report()
+        finally:
+            router.stop()
+        by = {r["name"]: r for r in rep["slos"]}
+        assert by["wide"]["events_slow"] == 3
+        assert by["av"]["events_slow"] == 3
+        assert rep["worst"] == "ok"

@@ -1,0 +1,143 @@
+"""Chrome/Perfetto timeline export (ISSUE 14): event shaping (spans ->
+"X", points -> "i", per-replica processes, named tracks), engine and
+fleet export surfaces, and bench's --timeline artifact routing."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import timeline, tracing
+
+
+@pytest.fixture(autouse=True)
+def _tracer_guard():
+    was = tracing.enabled()
+    tracing.enable()
+    tracing.reset()
+    yield
+    tracing.reset()
+    if not was:
+        tracing.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+    paddle.seed(100)
+    cfg = GPT2Config(vocab_size=512, hidden_size=128, num_layers=2,
+                     num_heads=4, max_position=128)
+    cfg.dropout = 0.0
+    m = GPT2(cfg)
+    m.eval()
+    return m, cfg
+
+
+class TestEventShaping:
+    def test_spans_points_processes_tracks(self):
+        span_events = [
+            {"name": "decode_dispatch", "ts": 10.0, "dur": 0.5,
+             "replica": "r0", "request_ids": ["a"]},
+            {"name": "request_done", "ts": 10.6, "replica": "r0",
+             "request_id": "a", "trace_id": "tX", "hop": 0,
+             "cause": "admit"},
+            {"name": "fleet_place", "ts": 9.9, "request_id": "a"},
+            {"name": "trace_start", "ts": 0.0},  # skipped
+        ]
+        recorders = {"r0": [{"name": "admit", "ts": 10.1, "seq": 0,
+                             "request_id": "a"}]}
+        evs, t0 = timeline.chrome_trace_events(
+            span_events, recorders, default_name="router")
+        assert t0 == 9.9
+        meta = [e for e in evs if e["ph"] == "M"]
+        names = {e["args"]["name"] for e in meta
+                 if e["name"] == "process_name"}
+        assert names == {"r0", "router"}
+        tracks = {e["args"]["name"] for e in meta
+                  if e["name"] == "thread_name"}
+        assert {"dispatch", "requests", "lifecycle", "ring"} <= tracks
+        x = [e for e in evs if e["ph"] == "X"]
+        assert len(x) == 1 and x[0]["name"] == "decode_dispatch"
+        assert x[0]["dur"] == pytest.approx(0.5e6)
+        assert x[0]["ts"] == pytest.approx((10.0 - 9.9) * 1e6)
+        inst = {e["name"] for e in evs if e["ph"] == "i"}
+        assert inst == {"request_done", "fleet_place", "admit"}
+        done = next(e for e in evs if e["name"] == "request_done")
+        assert done["args"]["trace_id"] == "tX"  # stamps survive
+        assert "trace_start" not in {e["name"] for e in evs}
+
+    def test_write_is_valid_json_with_display_unit(self, tmp_path):
+        path = tmp_path / "tl.json"
+        n = timeline.write_chrome_trace(
+            str(path), span_events=[{"name": "round", "ts": 1.0,
+                                     "dur": 0.1, "replica": "r0"}])
+        doc = json.loads(path.read_text())
+        assert n == 1
+        assert doc["displayTimeUnit"] == "ms"
+        assert [e for e in doc["traceEvents"] if e["ph"] == "X"]
+
+    def test_empty_stream(self, tmp_path):
+        path = tmp_path / "empty.json"
+        assert timeline.write_chrome_trace(str(path),
+                                           span_events=[]) == 0
+        assert json.loads(path.read_text())["traceEvents"] == []
+
+
+class TestServingExport:
+    def test_engine_export_timeline(self, tiny_model, tmp_path):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        m, _ = tiny_model
+        srv = PagedGenerationServer(
+            m, max_slots=2, block_size=4, max_prompt_len=24,
+            max_new_tokens=8, flight_recorder=True).start()
+        try:
+            srv.submit(np.array([3, 5, 7], np.int32),
+                       max_new_tokens=4).result(timeout=300)
+        finally:
+            srv.stop()
+        path = tmp_path / "engine.json"
+        n = srv.export_timeline(str(path))
+        doc = json.loads(path.read_text())
+        assert n > 0
+        evs = doc["traceEvents"]
+        names = {e["name"] for e in evs}
+        # span sink spans + flight-recorder ring instants both present
+        assert "detokenize" in names
+        assert "submit" in names  # ring entry
+        procs = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert procs == {"engine"}
+
+    def test_fleet_export_lays_replicas_on_own_processes(
+            self, tiny_model, tmp_path):
+        from paddle_tpu.fleet import FleetRouter, Replica
+        from paddle_tpu.inference import PagedGenerationServer
+
+        m, _ = tiny_model
+        reps = [Replica(f"r{i}", PagedGenerationServer(
+            m, max_slots=2, block_size=4, max_prompt_len=24,
+            max_new_tokens=8, enable_prefix_cache=True,
+            flight_recorder=True)) for i in range(2)]
+        router = FleetRouter(reps).start()
+        try:
+            futs = [router.submit(np.array([3 + i, 5, 7], np.int32))
+                    for i in range(4)]
+            for f in futs:
+                f.result(timeout=300)
+        finally:
+            router.stop()
+        path = tmp_path / "fleet.json"
+        n = router.export_timeline(str(path))
+        doc = json.loads(path.read_text())
+        assert n > 0
+        procs = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        # every replica that served work gets its own process; at
+        # least one engine process plus the rings must be present
+        assert procs & {"r0", "r1"}
+        pid_of = {e["args"]["name"]: e["pid"]
+                  for e in doc["traceEvents"]
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+        assert len(set(pid_of.values())) == len(pid_of)

@@ -1,0 +1,309 @@
+"""Fleet-wide causal tracing (ISSUE 14): TraceContext semantics,
+event/ring/journal stamping, and the causal assembler — including the
+acceptance gate: a request failed over between replicas (seeded
+replica_kill) assembles into ONE causal tree spanning both replicas
+with phases tiling wall-clock, and a planned migration's hops link
+source -> target."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fleet import FleetRouter, Replica
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.trace_context import (
+    TraceContext, assemble_causal_traces, check_tiling)
+from paddle_tpu.reliability import FaultPlan
+from paddle_tpu.sampling import SamplingParams
+
+TILE_TOL_MS = 0.05  # float-rounding tolerance on exact tiling
+
+
+@pytest.fixture(autouse=True)
+def _tracer_guard():
+    was = tracing.enabled()
+    tracing.enable()
+    tracing.reset()
+    yield
+    tracing.reset()
+    if not was:
+        tracing.disable()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+
+    paddle.seed(100)
+    cfg = GPT2Config(vocab_size=512, hidden_size=128, num_layers=2,
+                     num_heads=4, max_position=128)
+    cfg.dropout = 0.0
+    m = GPT2(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _server(m, **kw):
+    from paddle_tpu.inference import PagedGenerationServer
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_prompt_len", 24)
+    kw.setdefault("max_new_tokens", 8)
+    return PagedGenerationServer(m, **kw)
+
+
+def _replica(m, name, **kw):
+    kw.setdefault("enable_prefix_cache", True)
+    return Replica(name, _server(m, **kw))
+
+
+WORK = [
+    (np.array([3, 5, 7, 9], np.int32), {}),
+    (np.array([1, 2, 3], np.int32),
+     {"sampling": SamplingParams(temperature=0.8, top_p=0.9,
+                                 seed=77)}),
+    (np.array([8, 8, 1, 4, 2], np.int32), {}),
+    (np.array([6, 6, 6], np.int32), {}),
+]
+
+
+class TestTraceContext:
+    def test_mint_is_unique_hop0_admit(self):
+        a, b = TraceContext.mint(), TraceContext.mint()
+        assert a.trace_id != b.trace_id
+        assert a.hop == 0 and a.cause == "admit"
+
+    def test_child_bumps_hop_and_sets_cause(self):
+        c = TraceContext.mint()
+        f = c.child("failover")
+        assert (f.trace_id, f.hop, f.cause) == (c.trace_id, 1,
+                                                "failover")
+        assert f.child("retry").hop == 2
+
+    def test_immutable_and_validated(self):
+        c = TraceContext.mint()
+        with pytest.raises(AttributeError):
+            c.hop = 3
+        with pytest.raises(ValueError, match="cause"):
+            c.child("teleport")
+        with pytest.raises(ValueError, match="hop"):
+            TraceContext("t", hop=-1)
+
+    def test_dict_round_trip(self):
+        c = TraceContext("tX", 2, "migration")
+        assert TraceContext.from_dict(c.to_dict()) == c
+        assert TraceContext.from_dict(None) is None
+
+    def test_attrs_carry_replica(self):
+        d = TraceContext("tX", 1, "retry").attrs(replica="r3")
+        assert d == {"trace_id": "tX", "hop": 1, "cause": "retry",
+                     "replica": "r3"}
+        assert "replica" not in TraceContext("tX").attrs()
+
+
+class TestEngineStamping:
+    def test_events_ring_and_journal_share_one_trace_id(
+            self, tiny_model, tmp_path):
+        m, _ = tiny_model
+        srv = _server(m, journal=str(tmp_path / "j.jsonl"),
+                      flight_recorder=True).start()
+        try:
+            out = srv.submit(np.array([3, 5, 7], np.int32),
+                             max_new_tokens=4).result(timeout=300)
+        finally:
+            srv.stop()
+        assert out.size == 7
+        evs = [e for e in tracing.events() if e.get("trace_id")]
+        tids = {e["trace_id"] for e in evs}
+        assert len(tids) == 1
+        names = {e["name"] for e in evs}
+        assert {"request_submitted", "request_admitted", "prefill",
+                "request_done", "detokenize"} <= names
+        for e in evs:
+            assert e["hop"] == 0 and e["cause"] == "admit"
+        # satellite: flight-recorder ring entries carry the stamp
+        ring = {e["name"]: e for e in srv._recorder.events()}
+        tid = tids.pop()
+        for name in ("submit", "admit", "request_done"):
+            assert ring[name]["trace_id"] == tid, name
+            assert ring[name]["cause"] == "admit"
+        # satellite: the journal accept record carries it too
+        accepts = [st["ent"] for st in srv._journal._state.values()]
+        assert accepts and accepts[0]["trace"]["trace_id"] == tid
+
+    def test_single_hop_assembly_tiles_wall_clock(self, tiny_model):
+        m, _ = tiny_model
+        srv = _server(m).start()
+        try:
+            futs = [srv.submit(ids, max_new_tokens=4)
+                    for ids, _ in WORK[:3]]
+            for f in futs:
+                f.result(timeout=300)
+        finally:
+            srv.stop()
+        recs = assemble_causal_traces()
+        assert len(recs) == 3
+        for r in recs.values():
+            assert r["n_hops"] == 1
+            assert r["causes"] == ["admit"]
+            assert r["complete"]
+            assert r["tree"]["name"] == "request"
+            assert check_tiling(r) < TILE_TOL_MS
+            phases = [c["name"] for c
+                      in r["hops"][0]["children"]]
+            assert phases == ["queue_wait", "admission", "prefill",
+                              "decode", "detokenize"]
+            for leaf in r["hops"][0]["children"]:
+                assert leaf["hop"] == 0 and leaf["cause"] == "admit"
+
+    def test_fault_retry_starts_a_retry_hop(self, tiny_model):
+        m, _ = tiny_model
+        from paddle_tpu.reliability import RecoveryPolicy
+
+        plan = FaultPlan([("decode", 0)], name="one-decode-fault")
+        srv = _server(m, fault_plan=plan,
+                      recovery=RecoveryPolicy(backoff_base_s=0.0))
+        srv.start()
+        try:
+            out = srv.submit(np.array([3, 5, 7, 9], np.int32),
+                             max_new_tokens=6).result(timeout=300)
+        finally:
+            srv.stop()
+        assert out.size == 10
+        recs = assemble_causal_traces()
+        (rec,) = recs.values()
+        assert rec["n_hops"] == 2
+        assert rec["causes"] == ["admit", "retry"]
+        assert [h["hop"] for h in rec["hops"]] == [0, 1]
+        assert rec["complete"]
+        assert check_tiling(rec) < TILE_TOL_MS
+
+    def test_trace_ctx_passthrough_and_validation(self, tiny_model):
+        m, _ = tiny_model
+        srv = _server(m)
+        with pytest.raises(TypeError, match="TraceContext"):
+            srv.submit(np.array([1, 2], np.int32), trace_ctx="nope")
+        ctx = TraceContext.mint().child("failover")
+        srv.start()
+        try:
+            srv.submit(np.array([1, 2], np.int32), max_new_tokens=2,
+                       trace_ctx=ctx).result(timeout=300)
+        finally:
+            srv.stop()
+        evs = [e for e in tracing.events()
+               if e.get("trace_id") == ctx.trace_id]
+        assert evs and all(e["hop"] == 1 and e["cause"] == "failover"
+                           for e in evs)
+
+
+class TestFleetCausalTree:
+    """The acceptance gate: ONE tree spanning both replicas, phases
+    tiling wall-clock, hop ordering correct."""
+
+    def test_failover_assembles_one_tree_across_replicas(
+            self, tiny_model):
+        m, _ = tiny_model
+        plan = FaultPlan([("replica_kill", 2)], name="chaos-kill")
+        reps = [_replica(m, f"r{i}") for i in range(2)]
+        router = FleetRouter(reps, fault_plan=plan,
+                             probe_interval_s=0.2)
+        router.start()
+        try:
+            futs = [router.submit(ids, **kw) for ids, kw in WORK]
+            outs = [f.result(timeout=300) for f in futs]
+            st = router.stats()
+        finally:
+            router.stop()
+        assert st["replica_kills"] == 1
+        assert st["failover_sessions"] >= 1
+        assert all(o.size for o in outs)
+        recs = assemble_causal_traces()
+        assert len(recs) == len(WORK)
+        failed_over = [r for r in recs.values() if r["n_hops"] > 1]
+        assert failed_over, "no multi-hop trace despite a failover"
+        for rec in failed_over:
+            # one root spanning the whole lifetime
+            assert rec["tree"]["name"] == "request"
+            assert rec["complete"]
+            # hop ordering: contiguous from 0, admit first, then
+            # failover hops only (no engine faults in this plan)
+            assert [h["hop"] for h in rec["hops"]] == \
+                list(range(rec["n_hops"]))
+            assert rec["causes"][0] == "admit"
+            assert set(rec["causes"][1:]) == {"failover"}
+            # the tree SPANS replicas: the failover hop runs on a
+            # different replica than the killed one, and is linked
+            assert len(set(rec["replicas"])) > 1
+            for prev, nxt in zip(rec["hops"], rec["hops"][1:]):
+                assert nxt["from_replica"] == prev["replica"]
+            # leaf phases tile wall-clock exactly (requeue gaps and
+            # zombie-overlap truncation included)
+            assert check_tiling(rec) < TILE_TOL_MS
+            for hop in rec["hops"]:
+                assert sum(c["dur"] for c in hop["children"]) == \
+                    pytest.approx(hop["dur"], abs=TILE_TOL_MS * 1e-3)
+        # single-hop traces still assemble cleanly alongside
+        for rec in recs.values():
+            assert check_tiling(rec) < TILE_TOL_MS
+
+    def test_migration_hops_link_source_to_target(self, tiny_model):
+        m, _ = tiny_model
+        reps = [_replica(m, f"r{i}", max_new_tokens=24)
+                for i in range(2)]
+        router = FleetRouter(reps)
+        router.start()
+        try:
+            first = threading.Event()
+            prompt = np.array([3, 5, 7, 9, 11, 2], np.int32)
+            fut = router.submit(prompt, max_new_tokens=20,
+                                on_token=lambda t, r: first.set())
+            assert first.wait(timeout=120)
+            rid = next(iter(router._sessions))
+            source = router._sessions[rid].replica
+            target_name = router.migrate_session(rid)
+            out = fut.result(timeout=300)
+        finally:
+            router.stop()
+        assert out.size == prompt.size + 20
+        recs = assemble_causal_traces()
+        (rec,) = [r for r in recs.values() if r["request_id"] == rid]
+        assert rec["n_hops"] == 2
+        assert rec["causes"] == ["admit", "migration"]
+        mig = rec["hops"][1]
+        assert mig["from_replica"] == source.name == \
+            rec["hops"][0]["replica"]
+        assert mig["replica"] == target_name != source.name
+        # the source hop recorded its detach
+        assert rec["hops"][0].get("migrated_out")
+        assert rec["complete"]
+        assert check_tiling(rec) < TILE_TOL_MS
+
+    def test_journal_recovery_resumes_the_same_trace(
+            self, tiny_model, tmp_path):
+        """Kill + recover_from_journal: the re-admission is a new hop
+        of the SAME trace (cause retry), and the journal entry is what
+        carried it."""
+        m, _ = tiny_model
+        path = str(tmp_path / "j.jsonl")
+        srv = _server(m, journal=path, max_new_tokens=16).start()
+        first = threading.Event()
+        fut = srv.submit(np.array([3, 5, 7, 9], np.int32),
+                         max_new_tokens=16,
+                         on_token=lambda t, r: first.set())
+        assert first.wait(timeout=120)
+        srv.kill()
+        assert not fut.done()
+        srv2 = _server(m, journal=path, max_new_tokens=16).start()
+        try:
+            futs = srv2.recover_from_journal()
+            (out,) = [f.result(timeout=300) for f in futs.values()]
+        finally:
+            srv2.stop()
+        assert out.size == 20
+        recs = assemble_causal_traces()
+        multi = [r for r in recs.values() if r["n_hops"] == 2]
+        assert len(multi) == 1
+        assert multi[0]["causes"] == ["admit", "retry"]
+        assert check_tiling(multi[0]) < TILE_TOL_MS
