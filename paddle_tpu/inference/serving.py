@@ -945,15 +945,23 @@ class PagedGenerationServer:
         self.sharding = None
         self._mesh = None
         decode_shardings = None
+        collective_quant = None
         if sharding is not None:
-            from ..serving_dist import apply_sharding
+            from ..serving_dist import (apply_sharding,
+                                        build_collective_quant)
 
             decode_shardings = apply_sharding(self, sharding)
+            # quantized collectives (this round): int8/int4-group wire
+            # for the mp-axis decode collectives — None (or tp=1, no
+            # wire) keeps the exact r16 sharded programs
+            collective_quant = build_collective_quant(sharding,
+                                                      self._mesh)
         # the decoder's kv_dtype MUST match the cache's — PagedDecoder
         # re-checks the pairing eagerly on every dispatch
         self._decoder = PagedDecoder.for_config(
             cfg, self.block_size, kv_dtype=kv_dtype,
-            shardings=decode_shardings)
+            shardings=decode_shardings,
+            collective_quant=collective_quant)
         # per-slot sampling state (round 10): struct-of-arrays param
         # buffers + the [slots, V] penalty count buffer, scattered on
         # admit/refill. Constructor temperature is the DEFAULT for
@@ -2332,6 +2340,7 @@ class PagedGenerationServer:
             self._slo_good_mark = (0, 0)
             if self._sched is not None:
                 self._sched.reset_window()
+            self._decoder.reset_wire_stats()
             self._t0 = time.perf_counter()
 
     def stats(self):
@@ -2413,6 +2422,14 @@ class PagedGenerationServer:
                 # trivially reset-coherent: it is construction config,
                 # not a window counter)
                 "sharding": self._sharding_stats(),
+                # quantized collectives (this round): analytic wire-byte
+                # accounting of the sharded decode collectives this
+                # window — bytes_total is the dispatched path,
+                # bytes_baseline what bf16 would have shipped (equal
+                # when quantization is off; all-zero schema for
+                # unsharded / tp=1 servers), reset-coherent via
+                # reset_stats -> decoder.reset_wire_stats
+                "collectives": self._collectives_stats(),
                 # goodput accounting (ISSUE 10): decoded device tokens
                 # = emitted + speculation-rolled-back + replayed
                 # (multi-step overrun discards, stop-truncated verify
@@ -2525,8 +2542,23 @@ class PagedGenerationServer:
         off (without importing serving_dist on the disabled path)."""
         if self.sharding is None:
             return {"enabled": False, "mesh_shape": {}, "tp_degree": 0,
-                    "dp_degree": 0}
+                    "dp_degree": 0, "collective_quant": "none"}
         return self.sharding.stats_block()
+
+    def _collectives_stats(self):
+        """The stats()["collectives"] block: the decoder's window wire
+        bytes + the quantization config — zeroed congruent schema when
+        sharding is off or tp=1 (no inter-chip wire)."""
+        cq = getattr(self._decoder, "_cq", None)
+        wire = self._decoder.wire_stats()
+        return {
+            "enabled": cq is not None,
+            "mode": cq.mode if cq is not None else "none",
+            "tp": self._decoder._tp,
+            "bytes_total": wire["bytes_total"],
+            "bytes_baseline": wire["bytes_baseline"],
+            "by_collective": wire["by_collective"],
+        }
 
     def _frontdoor_stats_locked(self):
         """The stats()["frontdoor"] block; caller holds the lock."""

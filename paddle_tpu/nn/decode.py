@@ -119,11 +119,19 @@ def _kv_io(kv_quant):
 
 
 @functools.lru_cache(maxsize=32)
-def _layer_helpers(spec):
+def _layer_helpers(spec, cq=None):
     """Shared GPT-2-layout building blocks (layernorm, int8-aware matmul,
     qkv split, embed/head, residual+MLP) used by every paged program
     builder below. spec = (L, H, Dh, E, eps, tied) — the tuple
-    models/gpt2.py builds."""
+    models/gpt2.py builds.
+
+    cq (quantized-collectives round): a STATIC
+    `serving_dist.collectives.CollectiveQuant` makes the row-split
+    projections (out_proj / fc2) and the vocab-parallel embedding
+    reduce through explicit quantized shard_map seams instead of the
+    XLA-inserted compute-dtype collectives; None (the default) traces
+    the exact pre-round program — cq is part of this cache's key, so
+    flipping it never mutates an existing program family."""
     import jax
     import jax.numpy as jnp
 
@@ -140,6 +148,19 @@ def _layer_helpers(spec):
             return x @ p[name]
         return (x @ codes.astype(dt)) * p[name + "::w8s"].astype(dt)
 
+    def matw_row(p, name, x, dt):
+        """matw for the ROW-SPLIT projections (out_proj / fc2): under a
+        CollectiveQuant the contraction's psum goes through the
+        quantized wire (the per-output-column W8A16 scales apply AFTER
+        the reduction, outside the seam — they are replicated)."""
+        if cq is None:
+            return matw(p, name, x, dt)
+        codes = p.get(name + "::w8c")
+        if codes is None:
+            return cq.matmul_psum(x, p[name])
+        return cq.matmul_psum(x, codes, cast=dt) \
+            * p[name + "::w8s"].astype(dt)
+
     def qkv_split(p, i, a):
         qkv = matw(p, f"h.{i}.qkv_proj.weight", a, a.dtype) \
             + p[f"h.{i}.qkv_proj.bias"]
@@ -152,14 +173,23 @@ def _layer_helpers(spec):
         if wte_codes is None:
             wte_full = params["wte.weight"]
 
-            def embed(t):
-                return wte_full[t]
+            if cq is not None and cq.vocab_sharded(wte_full.shape[0]):
+                def embed(t):
+                    return cq.embed_psum(t, wte_full, dt=dt)
+            else:
+                def embed(t):
+                    return wte_full[t]
         else:
             wte_rs = params["wte.weight::w8s"]
 
-            def embed(t):
-                return wte_codes[t].astype(dt) * wte_rs[t][..., None] \
-                    .astype(dt)
+            if cq is not None and cq.vocab_sharded(wte_codes.shape[0]):
+                def embed(t):
+                    return cq.embed_psum(t, wte_codes, scales=wte_rs,
+                                         dt=dt)
+            else:
+                def embed(t):
+                    return wte_codes[t].astype(dt) \
+                        * wte_rs[t][..., None].astype(dt)
 
         def head(xf):
             if tied:
@@ -173,20 +203,50 @@ def _layer_helpers(spec):
         return embed, head
 
     def block_and_mlp(params, i, x, o, dt):
-        x = x + matw(params, f"h.{i}.out_proj.weight", o, dt) \
+        x = x + matw_row(params, f"h.{i}.out_proj.weight", o, dt) \
             + params[f"h.{i}.out_proj.bias"]
         m = ln(x, params[f"h.{i}.ln_2.weight"],
                params[f"h.{i}.ln_2.bias"])
         hdn = jax.nn.gelu(
             matw(params, f"h.{i}.fc1.weight", m, dt)
             + params[f"h.{i}.fc1.bias"], approximate=True)
-        return x + matw(params, f"h.{i}.fc2.weight", hdn, dt) \
+        return x + matw_row(params, f"h.{i}.fc2.weight", hdn, dt) \
             + params[f"h.{i}.fc2.bias"]
 
     ns = type("LayerHelpers", (), {})()
     ns.ln, ns.matw, ns.qkv_split = ln, matw, qkv_split
     ns.make_embed_head, ns.block_and_mlp = make_embed_head, block_and_mlp
     return ns
+
+
+def _make_readout(cq, pin, mode, proc):
+    """The head readout every program builder shares: logits -> token.
+
+    Unquantized (cq None): pin the head logits replicated (`_rep_pin`)
+    and run the sampling pipeline — the exact pre-round path.  Under a
+    CollectiveQuant with the vocab actually sharded, the all-greedy
+    no-logits fast path replaces the f32 logits all-gather with the
+    LOSSLESS per-shard argmax exchange (8 bytes/row/peer), and every
+    other mode ships the logits through the quantized codes+scales
+    gather before the unchanged sampling pipeline (still pinned
+    replicated — the r14 partitioner guard).  Returns (tok, logits);
+    logits is None exactly when the fast path skipped materializing
+    them (callers that return logits pass need_logits=True)."""
+    sampled, penalties = mode
+
+    def readout(head, xf, sp, need_logits):
+        lg = head(xf)
+        if cq is not None and cq.vocab_sharded(lg.shape[-1]):
+            if not sampled and not penalties and not need_logits:
+                return pin(cq.greedy_tokens(lg)), None
+            logits = pin(cq.gather_logits(lg))
+        else:
+            logits = pin(lg)
+        tok = proc.sample_tokens(logits, sp, sampled=sampled,
+                                 penalties=penalties)
+        return tok, logits
+
+    return readout
 
 
 def _rep_pin(rep_constraint):
@@ -209,7 +269,7 @@ def _rep_pin(rep_constraint):
 
 @functools.lru_cache(maxsize=64)
 def _build_paged_fns(spec, block_size, return_logits, mode,
-                     kv_quant=False, rep_constraint=None):
+                     kv_quant=False, rep_constraint=None, cq=None):
     """(spec, block_size, mode, kv_quant) -> (prefill_fn, step_fn), raw
     and jittable. mode = (any_sampled, any_penalties): the static
     variant pair of the sampling pipeline (see module docstring).
@@ -217,7 +277,9 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
     quantize on write, attention dequantizes in-kernel.
     rep_constraint: replicated NamedSharding for the logits pin of
     sharded programs (see _rep_pin); None traces the exact unsharded
-    program."""
+    program. cq: a CollectiveQuant routes the TP collectives through
+    the quantized shard_map seams (quantized-collectives round); None
+    traces the exact pre-round program."""
     import jax
     import jax.numpy as jnp
 
@@ -230,9 +292,10 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
     BS = int(block_size)
     sampled, penalties = mode
     kv_write, kv_layer = _kv_io(bool(kv_quant))
-    hp = _layer_helpers(spec)
+    hp = _layer_helpers(spec, cq)
     ln, qkv_split, make_embed_head, block_and_mlp = (
         hp.ln, hp.qkv_split, hp.make_embed_head, hp.block_and_mlp)
+    readout = _make_readout(cq, pin, mode, _proc)
 
     def prefill_fn(params, ids, lens, tables, kc, vc, sp):
         """ids [B, S0] right-padded; lens [B]; tables [B, M]. Returns
@@ -266,9 +329,7 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
             x = block_and_mlp(params, i, x, o, dt)
         xf = x[jnp.arange(B), lens - 1]                # true last token
         xf = ln(xf, params["ln_f.weight"], params["ln_f.bias"])
-        logits = pin(head(xf))
-        tok = _proc.sample_tokens(logits, sp, sampled=sampled,
-                                  penalties=penalties)
+        tok, logits = readout(head, xf, sp, return_logits)
         stopped = _proc.check_stops(tok, sp["stop"],
                                     jnp.ones((B,), bool))
         counts = None
@@ -303,10 +364,8 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
                                        scale=scale).reshape(B, E)
             x = block_and_mlp(params, i, x, o, dt)
         xf = ln(x, params["ln_f.weight"], params["ln_f.bias"])
-        logits = pin(head(xf))
-        nxt = jnp.where(active,
-                        _proc.sample_tokens(logits, sp, sampled=sampled,
-                                            penalties=penalties), 0)
+        tok, logits = readout(head, xf, sp, return_logits)
+        nxt = jnp.where(active, tok, 0)
         stopped = _proc.check_stops(nxt, sp["stop"], active)
         counts = None
         if penalties:
@@ -320,7 +379,7 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
 
 
 @functools.lru_cache(maxsize=32)
-def _packed_trunk(spec, block_size, kv_quant=False):
+def _packed_trunk(spec, block_size, kv_quant=False, cq=None):
     """Shared packed ragged forward trunk: embed a token-packed
     multi-sequence stream, write each token's K/V into its paged block
     rows, and run segment-causal attention per layer. Returns the final
@@ -335,7 +394,7 @@ def _packed_trunk(spec, block_size, kv_quant=False):
     scale = Dh ** -0.5
     BS = int(block_size)
     kv_write, kv_layer = _kv_io(bool(kv_quant))
-    hp = _layer_helpers(spec)
+    hp = _layer_helpers(spec, cq)
 
     def trunk(params, toks, seg, pos, tables, kc, vc):
         from ..ops.attention import ragged_prefill_attention
@@ -368,7 +427,7 @@ def _packed_trunk(spec, block_size, kv_quant=False):
 
 @functools.lru_cache(maxsize=64)
 def _build_packed_prefill(spec, block_size, return_logits, mode,
-                          kv_quant=False, rep_constraint=None):
+                          kv_quant=False, rep_constraint=None, cq=None):
     """Packed ragged prefill: ONE dispatch prefills a token-packed
     multi-sequence chunk stream (the tentpole of the chunked-prefill
     scheduler, inference/serving.py). Raw and jittable."""
@@ -377,9 +436,10 @@ def _build_packed_prefill(spec, block_size, return_logits, mode,
     from ..sampling import processors as _proc
 
     sampled, penalties = mode
-    hp = _layer_helpers(spec)
-    trunk = _packed_trunk(spec, block_size, bool(kv_quant))
+    hp = _layer_helpers(spec, cq)
+    trunk = _packed_trunk(spec, block_size, bool(kv_quant), cq)
     pin = _rep_pin(rep_constraint)
+    readout = _make_readout(cq, pin, mode, _proc)
 
     def packed_prefill_fn(params, toks, seg, pos, tables, sample_idx,
                           kc, vc, sp):
@@ -408,9 +468,7 @@ def _build_packed_prefill(spec, block_size, return_logits, mode,
             params, params["ln_f.weight"].dtype)
         xf = x[sample_idx]                                # [B, E]
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
-        logits = pin(head(xf))
-        tok = _proc.sample_tokens(logits, sp, sampled=sampled,
-                                  penalties=penalties)
+        tok, logits = readout(head, xf, sp, return_logits)
         B = sample_idx.shape[0]
         stopped = _proc.check_stops(tok, sp["stop"],
                                     jnp.ones((B,), bool))
@@ -436,7 +494,7 @@ def _jitted_packed_prefill(spec, block_size, return_logits, donate, mode,
 
 
 @functools.lru_cache(maxsize=32)
-def _verify_trunk(spec, block_size, kv_quant=False):
+def _verify_trunk(spec, block_size, kv_quant=False, cq=None):
     """The packed trunk specialized to the verify plan's PINNED layout:
     T = P * W with one W-token region per plan row (verifier.py). Same
     embed/scatter/MLP as `_packed_trunk`, but attention goes through
@@ -451,7 +509,7 @@ def _verify_trunk(spec, block_size, kv_quant=False):
     scale = Dh ** -0.5
     BS = int(block_size)
     kv_write, kv_layer = _kv_io(bool(kv_quant))
-    hp = _layer_helpers(spec)
+    hp = _layer_helpers(spec, cq)
 
     def trunk(params, toks, seg, pos, tables, kc, vc):
         from ..ops.attention import verify_window_attention
@@ -485,7 +543,7 @@ def _verify_trunk(spec, block_size, kv_quant=False):
 
 @functools.lru_cache(maxsize=64)
 def _build_packed_verify(spec, block_size, mode, kv_quant=False,
-                         rep_constraint=None):
+                         rep_constraint=None, cq=None):
     """Speculative verification (spec_decode round): score a packed
     stream of [last_token, draft_1 .. draft_k] regions — one region per
     speculating slot — in ONE ragged dispatch, and decide acceptance ON
@@ -507,9 +565,10 @@ def _build_packed_verify(spec, block_size, mode, kv_quant=False,
     from ..sampling import processors as _proc
 
     sampled, penalties = mode
-    hp = _layer_helpers(spec)
-    trunk = _verify_trunk(spec, block_size, bool(kv_quant))
+    hp = _layer_helpers(spec, cq)
+    trunk = _verify_trunk(spec, block_size, bool(kv_quant), cq)
     pin = _rep_pin(rep_constraint)
+    readout = _make_readout(cq, pin, mode, _proc)
 
     def verify_fn(params, toks, seg, pos, tables, sample_idx, dlen,
                   kc, vc, sp):
@@ -537,7 +596,6 @@ def _build_packed_verify(spec, block_size, mode, kv_quant=False,
             params, params["ln_f.weight"].dtype)
         xf = x[sample_idx.reshape(-1)]                    # [P*K1, E]
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
-        logits = pin(head(xf))                            # [P*K1, V]
         fed = toks[sample_idx]                            # [P, K1]
         j = jnp.arange(K1)[None, :]
         draft_valid = (j >= 1) & (j <= dlen[:, None])     # real drafts
@@ -564,8 +622,7 @@ def _build_packed_verify(spec, block_size, mode, kv_quant=False,
                 * draft_valid[..., None].astype(jnp.int32)
             spf["counts"] = (base[:, None]
                              + jnp.cumsum(oh, axis=1)).reshape(P * K1, V)
-        tok = _proc.sample_tokens(logits, spf, sampled=sampled,
-                                  penalties=penalties)
+        tok, _logits = readout(head, xf, spf, False)      # [P*K1]
         vtok = tok.reshape(P, K1)
         stopped = _proc.check_stops(
             tok, spf["stop"], jnp.repeat(row_valid, K1)).reshape(P, K1)
@@ -603,7 +660,7 @@ def _jitted_packed_verify(spec, block_size, donate, mode,
 
 @functools.lru_cache(maxsize=64)
 def _build_unified_round(spec, block_size, mode, kv_quant=False,
-                         rep_constraint=None, window=False):
+                         rep_constraint=None, window=False, cq=None):
     """The ONE-KERNEL serving round (r16): score a single packed token
     stream mixing prefill chunk rows, plain decode rows and
     speculative verify regions — the whole scheduler round — in ONE
@@ -644,7 +701,7 @@ def _build_unified_round(spec, block_size, mode, kv_quant=False,
     from ..sampling import processors as _proc
 
     sampled, penalties = mode
-    hp = _layer_helpers(spec)
+    hp = _layer_helpers(spec, cq)
     # window=True: the chunk-free round specialization — every plan
     # row is one pinned W-token region (T = P * W exactly), so the
     # trunk is `_verify_trunk` and off-TPU attention runs the dense
@@ -655,8 +712,9 @@ def _build_unified_round(spec, block_size, mode, kv_quant=False,
     # mixed-round geometry.  window=False scores the general mixed
     # stream (chunk rows + step rows) over `_packed_trunk`.
     trunk = (_verify_trunk if window else _packed_trunk)(
-        spec, block_size, bool(kv_quant))
+        spec, block_size, bool(kv_quant), cq)
     pin = _rep_pin(rep_constraint)
+    readout = _make_readout(cq, pin, mode, _proc)
 
     def unified_fn(params, toks, seg, pos, tables, sample_idx, dlen,
                    row_slot, carry_map, pos_map, steps_map, carry_tok,
@@ -678,7 +736,6 @@ def _build_unified_round(spec, block_size, mode, kv_quant=False,
             params, params["ln_f.weight"].dtype)
         xf = x[sample_idx.reshape(-1)]                    # [P*K1, E]
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
-        logits = pin(head(xf))                            # [P*K1, V]
         fed = toks_eff[sample_idx]                        # [P, K1]
         j = jnp.arange(K1)[None, :]
         draft_valid = (j >= 1) & (j <= dlen[:, None])     # real drafts
@@ -709,8 +766,7 @@ def _build_unified_round(spec, block_size, mode, kv_quant=False,
                 * draft_valid[..., None].astype(jnp.int32)
             spf["counts"] = (bc[:, None]
                              + jnp.cumsum(oh, axis=1)).reshape(P * K1, V)
-        tok = _proc.sample_tokens(logits, spf, sampled=sampled,
-                                  penalties=penalties)
+        tok, _logits = readout(head, xf, spf, False)      # [P*K1]
         vtok = tok.reshape(P, K1)
         stopped = _proc.check_stops(
             tok, spf["stop"], jnp.repeat(row_valid, K1)).reshape(P, K1)
@@ -774,7 +830,7 @@ def _jitted_paged_fns(spec, block_size, return_logits, donate, mode,
 
 @functools.lru_cache(maxsize=32)
 def _sharded_jits(spec, block_size, return_logits, donate, mode,
-                  kv_quant, sh):
+                  kv_quant, sh, cq=None):
     """The four decode programs jitted with EXPLICIT in/out shardings
     (sharded-serving round): params per the serving_dist plan, kc/vc
     pinned to the per-shard pool layout on BOTH sides (so the pool
@@ -790,15 +846,15 @@ def _sharded_jits(spec, block_size, return_logits, donate, mode,
     pr, kv, rep = sh.params, sh.kv, sh.rep
     prefill_fn, step_fn = _build_paged_fns(spec, block_size,
                                            return_logits, mode, kv_quant,
-                                           rep)
+                                           rep, cq)
     packed_fn = _build_packed_prefill(spec, block_size, return_logits,
-                                      mode, kv_quant, rep)
+                                      mode, kv_quant, rep, cq)
     verify_fn = _build_packed_verify(spec, block_size, mode, kv_quant,
-                                     rep)
+                                     rep, cq)
     unified_fn = _build_unified_round(spec, block_size, mode, kv_quant,
-                                      rep)
+                                      rep, cq=cq)
     uniwin_fn = _build_unified_round(spec, block_size, mode, kv_quant,
-                                     rep, window=True)
+                                     rep, window=True, cq=cq)
     tail = (rep,) if return_logits else ()
     out5 = (rep, rep, kv, kv, rep) + tail
     prefill = jax.jit(
@@ -827,7 +883,7 @@ def _sharded_jits(spec, block_size, return_logits, donate, mode,
 
 @functools.lru_cache(maxsize=64)
 def _build_multistep(spec, block_size, n_steps, mode, kv_quant=False,
-                     rep_constraint=None):
+                     rep_constraint=None, cq=None):
     """`n_steps` decode tokens in ONE dispatch (a lax.scan over step_fn):
     multi-step scheduling for dispatch-latency-bound serving — at the
     measured 8-70ms tunnel floor a strict token-per-dispatch loop is
@@ -840,7 +896,7 @@ def _build_multistep(spec, block_size, n_steps, mode, kv_quant=False,
     import jax
 
     _, step_fn = _build_paged_fns(spec, block_size, False, mode,
-                                  kv_quant, rep_constraint)
+                                  kv_quant, rep_constraint, cq)
     sampled, penalties = mode
 
     def multi(params, tok, pos, active, tables, kc, vc, sp):
@@ -877,7 +933,7 @@ def _jitted_multistep(spec, block_size, n_steps, donate, mode,
 
 @functools.lru_cache(maxsize=32)
 def _sharded_multistep(spec, block_size, n_steps, donate, mode,
-                       kv_quant, sh):
+                       kv_quant, sh, cq=None):
     """Explicit-in/out-sharded multistep jit, cached process-wide per
     shardings bundle (see _sharded_jits)."""
     import jax
@@ -885,7 +941,7 @@ def _sharded_multistep(spec, block_size, n_steps, donate, mode,
     pr, kv, rep = sh.params, sh.kv, sh.rep
     return jax.jit(
         _build_multistep(spec, block_size, n_steps, mode, kv_quant,
-                         rep),
+                         rep, cq),
         in_shardings=(pr, rep, rep, rep, rep, kv, kv, rep),
         out_shardings=(rep, rep, kv, kv, rep),
         donate_argnums=(5, 6) if donate else ())
@@ -914,10 +970,19 @@ class PagedDecoder:
     round-trip, host-side inputs/outputs replicated, and the head
     logits pinned replicated before the sampling pipeline
     (`_rep_pin`). These jits are cached per decoder INSTANCE; None
-    (the default) uses the exact pre-round process-wide caches."""
+    (the default) uses the exact pre-round process-wide caches.
+
+    collective_quant (quantized-collectives round): a
+    `serving_dist.collectives.CollectiveQuant` routes the sharded
+    programs' mp-axis collectives (row-split psums, embed psum,
+    vocab-parallel logits) through the quantized shard_map seams.
+    Requires `shardings`; None keeps the exact r16 programs.  Sharded
+    decoders additionally keep HOST-SIDE wire-byte accounting per
+    dispatch (`wire_stats()` — analytic formulas mirroring the seams,
+    counted for the actual path AND the bf16 baseline)."""
 
     def __init__(self, spec, block_size, return_logits=False, donate=None,
-                 kv_dtype=None, shardings=None):
+                 kv_dtype=None, shardings=None, collective_quant=None):
         import jax
 
         if donate is None:  # CPU donation is a no-op warning in jaxlib
@@ -925,6 +990,10 @@ class PagedDecoder:
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
                              "(supported: None, 'int8')")
+        if collective_quant is not None and shardings is None:
+            raise ValueError(
+                "collective_quant requires shardings: quantized "
+                "collectives only exist on a sharded mesh")
         self.spec = tuple(spec)
         self.block_size = int(block_size)
         self.return_logits = bool(return_logits)
@@ -935,6 +1004,17 @@ class PagedDecoder:
         # every program an explicit-in/out-sharded jit over the bundle's
         # mesh (None = the exact pre-round process-cached jits)
         self._shardings = shardings
+        self._cq = collective_quant
+        # wire-byte accounting (sharded decoders only): {(collective,
+        # dtype): bytes} incremented host-side per dispatch, the
+        # "baseline" dtype carrying what bf16 would have shipped
+        import threading
+
+        self._wire_lock = threading.Lock()
+        self._wire = {}
+        self._tp = 1
+        if shardings is not None:
+            self._tp = int(dict(shardings.mesh.shape).get("mp", 1))
         self._variants = {}
         self._msteps = {}
 
@@ -984,7 +1064,7 @@ class PagedDecoder:
                  uniwin) = _sharded_jits(
                     self.spec, self.block_size, self.return_logits,
                     self._donate, mode, self._kv_quant,
-                    self._shardings)
+                    self._shardings, self._cq)
             else:
                 prefill, step = _jitted_paged_fns(
                     self.spec, self.block_size, self.return_logits,
@@ -1014,8 +1094,82 @@ class PagedDecoder:
                                _ct.wrap("unified_round", unified, sh)),
                  _tracing.wrap("unified_round_dispatch",
                                _ct.wrap("unified_round", uniwin, sh)))
+            if self._tp > 1:
+                # wire-byte accounting (quantized-collectives round):
+                # analytic per-dispatch bytes from the host-visible
+                # shapes — rows through the trunk and head readout rows
+                # per program (prefill pads count: they cross the wire)
+                v = (self._acct_wrap(v[0], mode, lambda a: (
+                        a[1].shape[0] * a[1].shape[1], a[1].shape[0])),
+                     self._acct_wrap(v[1], mode, lambda a: (
+                        a[1].shape[0], a[1].shape[0])),
+                     self._acct_wrap(v[2], mode, lambda a: (
+                        a[1].shape[0], a[5].shape[0])),
+                     self._acct_wrap(v[3], mode, lambda a: (
+                        a[1].shape[0],
+                        a[5].shape[0] * a[5].shape[1])),
+                     self._acct_wrap(v[4], mode, lambda a: (
+                        a[1].shape[0],
+                        a[5].shape[0] * a[5].shape[1])),
+                     self._acct_wrap(v[5], mode, lambda a: (
+                        a[1].shape[0],
+                        a[5].shape[0] * a[5].shape[1])))
             self._variants[mode] = v
         return v
+
+    # ---- wire-byte accounting (quantized-collectives round) ----------
+
+    def _acct_wrap(self, fn, mode, rows_fn):
+        def wrapped(*args):
+            trunk_rows, logit_rows = rows_fn(args)
+            self._account(args[0], mode, trunk_rows, logit_rows)
+            return fn(*args)
+
+        return wrapped
+
+    def _account(self, params, mode, trunk_rows, logit_rows):
+        from ..serving_dist import collectives as _coll
+
+        wte = params.get("wte.weight")
+        if wte is None:
+            wte = params["wte.weight::w8c"]
+        dt = params["ln_f.weight"].dtype
+        greedy_fast = (self._cq is not None and mode == GREEDY_MODE
+                       and not self.return_logits)
+        bytes_by_key = _coll.dispatch_wire_bytes(
+            spec=self.spec, vocab=wte.shape[0], tp=self._tp,
+            mode=(self._cq.mode if self._cq is not None else None),
+            group=(self._cq.group if self._cq is not None else 32),
+            trunk_rows=int(trunk_rows), logit_rows=int(logit_rows),
+            greedy_fast=greedy_fast, base_itemsize=dt.itemsize)
+        with self._wire_lock:
+            for key, nbytes in bytes_by_key.items():
+                self._wire[key] = self._wire.get(key, 0) + nbytes
+        _coll.record_wire_bytes(bytes_by_key)
+
+    def wire_stats(self):
+        """Accumulated per-device collective wire bytes since the last
+        `reset_wire_stats()`: {"bytes_total", "bytes_baseline",
+        "by_collective"} — bytes_total is the path actually dispatched
+        (= bytes_baseline when collective_quant is off), bytes_baseline
+        what the bf16 collectives would have shipped for the same
+        dispatches. Zeros for unsharded / tp=1 decoders."""
+        with self._wire_lock:
+            items = list(self._wire.items())
+        total = baseline = 0
+        by = {}
+        for (name, dtype), nbytes in items:
+            if dtype == "baseline":
+                baseline += nbytes
+            else:
+                total += nbytes
+                by[name] = by.get(name, 0) + nbytes
+        return {"bytes_total": total, "bytes_baseline": baseline,
+                "by_collective": by}
+
+    def reset_wire_stats(self):
+        with self._wire_lock:
+            self._wire.clear()
 
     def prefill(self, params, ids, lens, tables, kc, vc, sp,
                 mode=GREEDY_MODE):
@@ -1076,7 +1230,7 @@ class PagedDecoder:
                 fn = _sharded_multistep(self.spec, self.block_size,
                                         int(n_steps), self._donate,
                                         mode, self._kv_quant,
-                                        self._shardings)
+                                        self._shardings, self._cq)
                 self._msteps[key] = fn
         else:
             fn = _jitted_multistep(self.spec, self.block_size,
@@ -1086,6 +1240,12 @@ class PagedDecoder:
             "multistep_dispatch",
             _ct.wrap("multistep", fn, self._shard_label),
             k=int(n_steps))
+        if self._tp > 1:
+            # n_steps scanned decode steps = n_steps [B, E] psum rounds
+            # and n_steps head readouts
+            wrapped = self._acct_wrap(wrapped, mode, lambda a: (
+                int(n_steps) * a[1].shape[0],
+                int(n_steps) * a[1].shape[0]))
 
         def checked(params, tok, pos, active, tables, kc, vc, sp):
             self._check_kv(kc, vc)

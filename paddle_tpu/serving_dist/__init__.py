@@ -54,10 +54,13 @@ from .plan import (DecodeShardings, build_decode_shardings,
                    place_kv_pool)
 from .engine import (apply_sharding, max_slots_for_budget,
                      pool_blocks_for_budget)
+from .collectives import (CollectiveQuant, build_collective_quant,
+                          normalize_collective_quant)
 
 __all__ = [
     "ShardedEngineConfig", "normalize_sharding", "disabled_stats_block", "DecodeShardings", "decode_spec_for",
     "kv_pool_specs", "build_decode_shardings", "place_decode_params",
     "place_kv_pool", "apply_sharding", "pool_blocks_for_budget",
-    "max_slots_for_budget",
+    "max_slots_for_budget", "CollectiveQuant", "build_collective_quant",
+    "normalize_collective_quant",
 ]

@@ -25,19 +25,32 @@ class ShardedEngineConfig:
         bytes divide by tp*dp).  Weights are replicated over dp.
     devices: explicit device list (tests / subsets); None = the first
         tp*dp of `jax.devices()`.
+    collective_quant: None (default — the exact pre-round bf16
+        collectives) | "int8" | "int4g": quantize the decode hot
+        path's mp-axis collectives (row-split psums, embed psum,
+        vocab-parallel logits) through the serving_dist.collectives
+        shard_map seams.  Static — flipping it re-traces the decode
+        programs.  tp=1 meshes ignore it (no inter-chip wire).
+    int4_group: scale-group width of the "int4g" wire (snapped to a
+        divisor of each chunk; ignored by "int8").
     """
 
     tp: int = 1
     dp: int = 1
     devices: tuple = None
+    collective_quant: str = None
+    int4_group: int = 32
 
     def __post_init__(self):
-        for field_name in ("tp", "dp"):
+        for field_name in ("tp", "dp", "int4_group"):
             v = getattr(self, field_name)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
                 raise ValueError(
                     f"ShardedEngineConfig.{field_name}={v!r} must be a "
                     f"positive int")
+        from .collectives import normalize_collective_quant
+
+        normalize_collective_quant(self.collective_quant)
         if self.devices is not None:
             object.__setattr__(self, "devices", tuple(self.devices))
 
@@ -81,6 +94,7 @@ class ShardedEngineConfig:
             "mesh_shape": {"dp": self.dp, "mp": self.tp},
             "tp_degree": self.tp,
             "dp_degree": self.dp,
+            "collective_quant": self.collective_quant or "none",
         }
 
 
@@ -114,4 +128,5 @@ def disabled_stats_block():
         "mesh_shape": {},
         "tp_degree": 0,
         "dp_degree": 0,
+        "collective_quant": "none",
     }

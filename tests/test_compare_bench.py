@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 SCRIPT = os.path.join(os.path.dirname(HERE), "scripts",
                       "compare_bench.py")
@@ -89,3 +91,26 @@ def test_find_latest_pair_and_main(tmp_path):
     assert a.endswith("r02.json") and b.endswith("r03.json")
     assert m.main([str(tmp_path)]) == 1  # 49% tok/s drop flags
     assert m.main(["--threshold=0.6", str(tmp_path)]) == 0
+
+
+@pytest.mark.slow
+def test_regression_gate_over_newest_full_records():
+    """Slow regression gate (quantized-collectives round satellite):
+    `compare_bench.py --threshold` over the two newest FULL bench
+    captures checked into the repo — a chip/bench round that tanks a
+    headline axis past 50% fails here instead of being discovered
+    rounds later. The generous threshold reflects that successive
+    captures come from different (often CPU-degraded, shared) boxes;
+    the gate is for collapses, not noise."""
+    repo = os.path.dirname(HERE)
+    import re as _re
+
+    names = [n for n in os.listdir(repo)
+             if _re.fullmatch(r"BENCH_r\d+\.json", n)]
+    if len(names) < 2:
+        pytest.skip("fewer than 2 BENCH_*.json captures in the repo")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--threshold=0.5", repo],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, \
+        f"bench regression past threshold:\n{out.stdout}{out.stderr}"

@@ -177,7 +177,8 @@ class TestOneDeviceMeshBitwise:
         assert out == ref
         assert st["sharding"] == {"enabled": True,
                                   "mesh_shape": {"dp": 1, "mp": 1},
-                                  "tp_degree": 1, "dp_degree": 1}
+                                  "tp_degree": 1, "dp_degree": 1,
+                                  "collective_quant": "none"}
 
     def test_decoder_logits_bitwise(self, tiny_model):
         """Zero logit drift on a 1-device mesh — not just same argmax:
@@ -349,7 +350,8 @@ class TestStatsAndTelemetry:
                                     max_prompt_len=16, max_new_tokens=4)
         st = srv.stats()["sharding"]
         assert st == {"enabled": False, "mesh_shape": {},
-                      "tp_degree": 0, "dp_degree": 0}
+                      "tp_degree": 0, "dp_degree": 0,
+                      "collective_quant": "none"}
 
     def test_sharding_block_reset_coherent(self, tiny_model):
         model, _ = tiny_model

