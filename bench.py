@@ -801,6 +801,18 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
     # survivor token-parity md5 proof across replica counts.
     st_fl = _bench_served_fleet(model, cfg, on_tpu, tiny)
 
+    # (m) LONG-CONTEXT axis (r21): fixed-seed huge prompts through the
+    # sequence-parallel packed prefill at sp∈{1,2,4} forced-host
+    # devices (tiny: 1/2) — subprocesses, because the device count must
+    # precede jax init. Reports prefill TTFT scaling with sp (the
+    # dispatch division is the structural/exact half; the wall-clock
+    # ratio is a chip number on the shared-core host mesh) plus the
+    # host-RAM KV tier's long-context session capacity: resumable
+    # sessions per device at the no-recompute ITL bar and FIXED pool
+    # bytes, tier ON vs OFF, with the churn mechanism proven
+    # empirically (demotion/promotion counts + resume parity).
+    st_lc = _bench_served_longctx(on_tpu, tiny)
+
     base = "gpt2tiny_served" if tiny else "gpt2s_served"
     suffix = "" if on_tpu else "_CPU_DEGRADED"
     rec_paged = {
@@ -1207,6 +1219,80 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
         "itl_p99_ms": round(st_fl["itl_p99_ms"], 2),
         "prefill_dispatches": st_fl["prefill_dispatches"],
     }
+    lc_counts = sorted(st_lc)
+    lc1, lc_hi = st_lc[lc_counts[0]], st_lc[lc_counts[-1]]
+    lc_tier = lc1["tier"]
+    lc_sigs = {st_lc[n]["token_sig"] for n in lc_counts}
+    rec_lc = {
+        "metric": f"{base}_longcontext_ttft_p50_ms{suffix}",
+        "value": round(lc_hi["ttft_p50_ms"], 2),
+        "unit": "ms",
+        # >1 = sp=max prefills the same fixed-seed huge prompts that
+        # many times faster (TTFT p50) than the unsharded chunk
+        # stream. The dispatch division below is the exact structural
+        # half; this wall-clock ratio is the chip half — the forced
+        # host mesh shares one core across sp shards, so ~1.0x is
+        # expected off TPU (rerun queued)
+        "vs_baseline": round(lc1["ttft_p50_ms"]
+                             / max(lc_hi["ttft_p50_ms"], 1e-9), 3),
+        "baseline": "same fixed-seed huge prompts, sp=1 "
+                    "(unsharded packed prefill stream)",
+        "sp_degrees": lc_counts,
+        "prompt_tokens": lc1["prompt_tokens"],
+        "ttft_p50_ms_by_sp": {str(n): round(st_lc[n]["ttft_p50_ms"], 2)
+                              for n in lc_counts},
+        # the structural proof: sp multiplies the per-dispatch chunk
+        # budget, so the SAME prompts take ~1/sp the prefill
+        # dispatches — exact, deterministic, asserted by the slow test
+        "prefill_dispatches_by_sp": {
+            str(n): st_lc[n]["prefill_dispatches"] for n in lc_counts},
+        # md5 proof: identical token streams at every sp degree
+        "token_parity": len(lc_sigs) == 1,
+        "parity_md5": lc1["token_sig"],
+        # ---- host-RAM KV tier half: long-context session capacity.
+        # "sessions at the ITL bar" = sessions whose history stays
+        # RESIDENT (device or host tier), so a resume re-attaches the
+        # prefix instead of recomputing it — recompute is the ITL/TTFT
+        # cliff the churn probe measures. Capacity is the
+        # reservation-backed count at FIXED per-device pool bytes
+        # (host tier provisioned at 4x the device budget); the
+        # mechanism (demote on churn, promote on resume, token parity)
+        # is proven empirically on a deliberately small pool.
+        "sessions_at_itl_bar_tier_on": lc_tier["sessions_at_bar_on"],
+        "sessions_at_itl_bar_tier_off": lc_tier["sessions_at_bar_off"],
+        "session_capacity_ratio": round(
+            lc_tier["sessions_at_bar_on"]
+            / max(lc_tier["sessions_at_bar_off"], 1), 2),
+        "max_resident_context_tokens_tier_on":
+            lc_tier["max_ctx_tokens_on"],
+        "max_resident_context_tokens_tier_off":
+            lc_tier["max_ctx_tokens_off"],
+        "pool_budget_bytes": lc_tier["pool_budget_bytes"],
+        "host_budget_bytes": lc_tier["host_budget_bytes"],
+        # churn-probe empirics: resuming n_sessions round-robin
+        # histories through a pool sized for ~1.5 of them
+        "resume_ttft_p50_ms_tier_on":
+            round(lc_tier["resume_ttft_p50_ms_on"], 2),
+        "resume_ttft_p50_ms_tier_off":
+            round(lc_tier["resume_ttft_p50_ms_off"], 2),
+        "resume_prefill_dispatches_tier_on":
+            lc_tier["resume_prefill_dispatches_on"],
+        "resume_prefill_dispatches_tier_off":
+            lc_tier["resume_prefill_dispatches_off"],
+        "tier_demotions": lc_tier["demotions"],
+        "tier_promotions": lc_tier["promotions"],
+        "tier_hit_tokens": lc_tier["hit_tokens"],
+        # tier ON streams byte-identical to tier OFF on the resumes
+        "tier_token_parity": lc_tier["sig_on"] == lc_tier["sig_off"],
+        "n_sessions": lc_tier["n_sessions"],
+        # schema-congruence fields shared by every served record
+        "tokens_per_sec": round(lc_hi["tokens_per_sec"], 1),
+        "p99_ms": round(lc_hi["p99_ms"], 1),
+        "itl_p99_ms": round(lc_hi["itl_p99_ms"], 2),
+        "prefill_dispatches": lc_hi["prefill_dispatches"],
+        "cpu_host_mesh": True,
+        "degraded": True,  # host-mesh numbers even on a chip session
+    }
     if st_pad is not None:
         rec_pad = {
             "metric": f"{base}_mixed_padded_tokens_per_sec{suffix}",
@@ -1223,13 +1309,13 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
             "padded static-batch GenerationServer, same traffic"
         records = [rec_pad, rec_paged, rec_mix, rec_open, rec_sp,
                    rec_spec, rec_fd, rec_qz, rec_sh, rec_cq, rec_uni,
-                   rec_dg, rec_fl]
+                   rec_dg, rec_fl, rec_lc]
     else:
         rec_paged["vs_baseline"] = 1.0
         rec_paged["baseline"] = "self (tiny schema smoke)"
         records = [rec_paged, rec_mix, rec_open, rec_sp, rec_spec,
                    rec_fd, rec_qz, rec_sh, rec_cq, rec_uni, rec_dg,
-                   rec_fl]
+                   rec_fl, rec_lc]
     if rec_tel is not None:
         records.append(rec_tel)
     if not on_tpu:
@@ -1340,6 +1426,19 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
           f"({rec_fl['replica_kills']} kills), "
           f"{rec_fl['migrated_sessions']} migrated, token parity "
           f"{rec_fl['survivor_token_parity']}", file=sys.stderr)
+    print(f"# served long-context(sp {lc_counts}): ttft p50 "
+          f"{' / '.join(str(rec_lc['ttft_p50_ms_by_sp'][str(n)]) for n in lc_counts)}ms, "
+          f"prefill dispatches "
+          f"{' / '.join(str(rec_lc['prefill_dispatches_by_sp'][str(n)]) for n in lc_counts)}, "
+          f"token parity {rec_lc['token_parity']} | tier sessions@bar "
+          f"{rec_lc['sessions_at_itl_bar_tier_on']} on vs "
+          f"{rec_lc['sessions_at_itl_bar_tier_off']} off "
+          f"({rec_lc['session_capacity_ratio']:.1f}x), resume prefill "
+          f"dispatches {rec_lc['resume_prefill_dispatches_tier_on']} vs "
+          f"{rec_lc['resume_prefill_dispatches_tier_off']}, "
+          f"{rec_lc['tier_demotions']} demotions / "
+          f"{rec_lc['tier_promotions']} promotions, tier parity "
+          f"{rec_lc['tier_token_parity']}", file=sys.stderr)
     return records
 
 
@@ -2024,6 +2123,219 @@ def _bench_served_sharded(on_tpu, tiny):
     return results
 
 
+def _longctx_tier_probe(model, cfg, tiny):
+    """Host-RAM KV tier half of the long-context axis (runs inside the
+    sp=1 worker). n_sessions long-history conversations resume
+    round-robin through a device pool deliberately sized for ~1.5 of
+    them: with the tier OFF the pool must EVICT an idle session's
+    retained history to serve the next one, so its resume recomputes
+    the whole prefix (the ITL/TTFT cliff); with the tier ON the same
+    churn DEMOTES the history to host RAM and the resume PROMOTES it
+    back — no recompute, byte-identical tokens. Returns the empirical
+    churn numbers plus the reservation-backed session capacity at a
+    FIXED per-device pool byte budget (host tier provisioned at 4x the
+    device budget), the CPU-provable half of the capacity claim."""
+    import hashlib
+    import time as _time
+
+    from paddle_tpu.inference import PagedGenerationServer
+    from paddle_tpu.inference.kv_cache import blocks_for
+    from paddle_tpu.inference.kv_tier import HostKVTier
+    from paddle_tpu.serving_dist import pool_blocks_for_budget
+
+    rng = np.random.RandomState(23)
+    n_sess = 3 if tiny else 4
+    hist_len, bs, new, chunk = 40, 8, 6, 16
+    histories = [rng.randint(1, cfg.vocab_size,
+                             (hist_len,)).astype(np.int32)
+                 for _ in range(n_sess)]
+    tails = [rng.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+             for _ in range(n_sess)]
+    nb = 16  # ~1.5 sessions' retained blocks + the active working set
+
+    def run(tier):
+        srv = PagedGenerationServer(
+            model, max_slots=1, block_size=bs, max_prompt_len=64,
+            max_new_tokens=new, prefill_chunk_tokens=chunk,
+            num_blocks=nb, enable_prefix_cache=True, kv_dtype="int8",
+            kv_tier=tier, temperature=0.0).start()
+        try:
+            # turn 1: each session's history lands in the prefix cache
+            turn1 = [np.asarray(srv.submit(h).result(timeout=600))
+                     for h in histories]
+            srv.reset_stats()
+            # turn 2: round-robin resumes — every resume follows the
+            # OTHER sessions' turns, so the churn already displaced
+            # this session's retained blocks (evicted vs demoted)
+            t_res, outs = [], []
+            for i in range(n_sess):
+                p = np.concatenate([turn1[i], tails[i]])
+                t0 = _time.perf_counter()
+                outs.append(np.asarray(
+                    srv.submit(p).result(timeout=600)))
+                t_res.append((_time.perf_counter() - t0) * 1e3)
+            st = srv.stats()
+        finally:
+            srv.stop()
+        sig = hashlib.md5(
+            b"|".join(o.astype(np.int64).tobytes()
+                      for o in outs)).hexdigest()
+        return {"resume_ms": sorted(t_res),
+                "prefill_dispatches": st["prefill_dispatches"],
+                "itl_p99_ms": st["itl_p99_ms"],
+                "tier": st["kv_cache"]["tier"], "sig": sig}
+
+    off = run(None)
+    on = run(HostKVTier(capacity_blocks=64, watermark=0.5))
+    # reservation-backed capacity at FIXED per-device pool bytes: a
+    # session is "at the ITL bar" when its history is resident
+    # (device or host), so a resume re-attaches instead of recomputing
+    budget = 1 << 20
+    host_x = 4
+    nbb = pool_blocks_for_budget(cfg, bs, budget, kv_dtype="int8")
+    sess_blocks = blocks_for(hist_len, bs)
+    active = blocks_for(64 + new + 3, bs) + 1  # working set + spare
+    resident_off = max(0, nbb - 1 - active) // sess_blocks
+    resident_on = resident_off + host_x * (nbb - 1) // sess_blocks
+    return {
+        "n_sessions": n_sess, "history_tokens": hist_len,
+        "device_blocks": nb,
+        "resume_ttft_p50_ms_on": on["resume_ms"][len(on["resume_ms"])
+                                                 // 2],
+        "resume_ttft_p50_ms_off": off["resume_ms"][
+            len(off["resume_ms"]) // 2],
+        "resume_prefill_dispatches_on": on["prefill_dispatches"],
+        "resume_prefill_dispatches_off": off["prefill_dispatches"],
+        "itl_p99_ms_on": on["itl_p99_ms"],
+        "itl_p99_ms_off": off["itl_p99_ms"],
+        "demotions": on["tier"]["demotions"],
+        "promotions": on["tier"]["promotions"],
+        "hit_tokens": on["tier"]["hit_tokens"],
+        "sig_on": on["sig"], "sig_off": off["sig"],
+        "pool_budget_bytes": budget,
+        "host_budget_bytes": host_x * budget,
+        "sessions_at_bar_on": int(resident_on),
+        "sessions_at_bar_off": int(resident_off),
+        "max_ctx_tokens_on": int((nbb - 1) * bs
+                                 + host_x * (nbb - 1) * bs),
+        "max_ctx_tokens_off": int((nbb - 1) * bs),
+    }
+
+
+def _served_longctx_worker(sp, tiny):
+    """Subprocess body of the long-context axis: THIS process was
+    spawned with `--xla_force_host_platform_device_count=sp`, serves
+    the SAME fixed-seed huge prompts (each several chunk budgets long,
+    so prefill cost IS the TTFT) sequentially through the
+    sequence-parallel packed prefill at that sp degree, and prints ONE
+    JSON dict: client-side TTFT percentiles, prefill dispatch count
+    (sp multiplies the chunk budget, so dispatches divide by ~sp —
+    exact), tok/s + latency, and the md5 stream signature the parent
+    asserts across sp degrees. The sp=1 worker also runs the host-RAM
+    KV tier churn probe (`_longctx_tier_probe`)."""
+    import hashlib
+    import time as _time
+
+    from paddle_tpu.inference import PagedGenerationServer
+    from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+    from paddle_tpu.serving_dist import ShardedEngineConfig
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    sp = int(sp)
+    sharding = ShardedEngineConfig(sp=sp) if sp > 1 else None
+    rng = np.random.RandomState(17)
+    n_req = 3 if tiny else 6
+    lens = [int(rng.randint(72, 96)) for _ in range(n_req)]
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    new, bs, chunk = 8, 8, 16
+    srv = PagedGenerationServer(
+        model, max_slots=2, block_size=bs, max_prompt_len=112,
+        max_new_tokens=new, prefill_chunk_tokens=chunk, num_blocks=64,
+        sharding=sharding, temperature=0.0).start()
+    try:
+        def drain(ttfts=None):
+            outs = []
+            for p in prompts:  # sequential: TTFT is pure prefill
+                first = []
+
+                def on_tok(_tok, _reason, first=first):
+                    if not first:
+                        first.append(_time.perf_counter())
+                t0 = _time.perf_counter()
+                outs.append(srv.submit(p, on_token=on_tok)
+                            .result(timeout=600))
+                if ttfts is not None:
+                    ttfts.append((first[0] - t0) * 1e3)
+            return outs
+
+        drain()  # warm/compile pass
+        srv.reset_stats()
+        ttfts = []
+        outs = drain(ttfts)
+        st = srv.stats()
+    finally:
+        srv.stop()
+    sig = hashlib.md5(
+        b"|".join(np.asarray(o, np.int64).tobytes()
+                  for o in outs)).hexdigest()
+    ttfts.sort()
+    tier = _longctx_tier_probe(model, cfg, tiny) if sp == 1 else None
+    print(json.dumps({
+        "sp": sp, "prompt_tokens": lens,
+        "ttft_p50_ms": ttfts[len(ttfts) // 2],
+        "ttft_p99_ms": ttfts[min(len(ttfts) - 1,
+                                 int(0.99 * len(ttfts)))],
+        "tokens_per_sec": st["tokens_per_sec"],
+        "p99_ms": st["p99_ms"],
+        "itl_p99_ms": st["itl_p99_ms"],
+        "prefill_dispatches": st["prefill_dispatches"],
+        "token_sig": sig,
+        "sharding": st["sharding"],
+        "tier": tier,
+    }))
+
+
+def _bench_served_longctx(on_tpu, tiny):
+    """Long-context axis (r21): the SAME fixed-seed huge prompts
+    prefilled at sp∈{1,2,4} forced-host CPU devices (tiny: 1/2), one
+    subprocess per sp degree so each gets its own
+    `--xla_force_host_platform_device_count`.  Reports TTFT scaling
+    with sp, the exact prefill-dispatch division, token parity across
+    degrees, and (from the sp=1 worker) the host-RAM KV tier's
+    session-capacity numbers.  Always a CPU host-mesh measurement —
+    the sp shards share one core, so the dispatch division and the
+    tier capacity are the CPU-provable halves and the TTFT wall-clock
+    scaling is a chip number (rerun queued)."""
+    counts = (1, 2) if tiny else (1, 2, 4)
+    results = {}
+    for n in counts:
+        env = dict(os.environ,
+                   PADDLE_TPU_BENCH_PROBED="1", JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS=f"--xla_force_host_platform_device_count={n}")
+        args = [sys.executable, os.path.abspath(__file__),
+                "served-longctx-worker", str(n)]
+        if tiny:
+            args.append("--tiny")
+        r = subprocess.run(args, env=env, capture_output=True,
+                           text=True, timeout=900,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"long-context worker (sp={n}) failed:\n"
+                f"{r.stderr[-2000:]}")
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        results[n] = json.loads(line)
+    return results
+
+
 def _served_collectives_worker(ndev, tiny):
     """Subprocess body of the quantized-collectives axis: THIS process
     was spawned with `--xla_force_host_platform_device_count=ndev`,
@@ -2560,6 +2872,11 @@ def main():
             # (this process was spawned with the forced-host device
             # count already in XLA_FLAGS)
             _served_sharded_worker(int(pos[1]), tiny)
+            return
+        if axis == "served-longctx-worker":
+            # internal: subprocess body of the long-context axis
+            # (forced-host device count = sp already in XLA_FLAGS)
+            _served_longctx_worker(int(pos[1]), tiny)
             return
         if axis == "served-collectives-worker":
             # internal: subprocess body of the quantized-collectives

@@ -50,6 +50,19 @@ attach/retain/truncate/swap-out automatically — and the jitted
 writers quantize on append while the attention kernels dequantize on
 read, so a bf16 copy of the cache never exists in HBM.
 
+Host-RAM tier (long-context serving round): attaching a
+`kv_tier.HostKVTier` gives cold retained content a second life BELOW
+the device pool. Pool pressure (watermark or an allocation's reclaim)
+DEMOTES the LRU retained block: its index entries move to the tier's
+host-side index (int8 codes+scales — bit-exact for an int8 pool,
+`kv_quant` encode for a dense one) and the device slot frees. A later
+`attach_prefix` / `match_prefix_len` / `export_prefix` whose chain
+continues into the tier PROMOTES those entries back into device blocks
+first (prefetch-on-attach: the host->device writes dispatch
+asynchronously at match time, before the attach claims the chain).
+Without a tier nothing changes — reclaim drops entries exactly as
+before.
+
 Invariants (fuzz-tested in tests/test_prefix_cache.py):
   * free list, retention list and the union of live block tables
     PARTITION the usable pool (block 0 in none of them);
@@ -57,7 +70,10 @@ Invariants (fuzz-tested in tests/test_prefix_cache.py):
     leaves the partition's "live" class exactly when it hits zero;
   * an index entry (hash -> block, fill) only ever describes rows
     `[0, fill)` of its block, and those rows are immutable while the
-    entry exists (writers CoW or drop the entry first).
+    entry exists (writers CoW or drop the entry first);
+  * a chain hash lives in EITHER the device index or the tier index,
+    never both (promotion pops the tier entry, demotion drops the
+    device entry, re-publication drops the stale tier copy).
 """
 from __future__ import annotations
 
@@ -76,16 +92,25 @@ from ..observability import metrics as _metrics
 # serving cache plus an offline generate(), say — can no longer alias
 # each other's gauges.
 _POOL_LABEL = ("pool",)
+# Block-count gauges carry a `tier` label (long-context round):
+# tier="device" is the in-pool series (the only one when no host tier
+# is attached); tier="host" reports the HostKVTier — used is always 0
+# there (tier content backs no live table), retained is the resident
+# promotable entries, free is the remaining tier capacity.
+_POOL_TIER_LABELS = ("pool", "tier")
 _m_used_blocks = _metrics.gauge(
-    "kv_pool_used_blocks", "allocated blocks (trash block excluded)",
-    labelnames=_POOL_LABEL)
+    "kv_pool_used_blocks", "allocated blocks (trash block excluded); "
+    "tier='device' in-pool, tier='host' always 0",
+    labelnames=_POOL_TIER_LABELS)
 _m_free_blocks = _metrics.gauge(
-    "kv_pool_free_blocks", "blocks available for allocation",
-    labelnames=_POOL_LABEL)
+    "kv_pool_free_blocks", "blocks available for allocation "
+    "(tier='host': remaining HostKVTier entry capacity)",
+    labelnames=_POOL_TIER_LABELS)
 _m_retained_blocks = _metrics.gauge(
     "kv_pool_retained_blocks", "freed-but-indexed blocks parked in the "
-    "prefix-cache LRU retention list (reclaimed under pool pressure)",
-    labelnames=_POOL_LABEL)
+    "prefix-cache LRU retention list (reclaimed under pool pressure); "
+    "tier='host': promotable entries resident in the HostKVTier",
+    labelnames=_POOL_TIER_LABELS)
 _m_utilization = _metrics.gauge(
     "kv_pool_utilization", "live tokens / usable pool tokens",
     labelnames=_POOL_LABEL)
@@ -145,6 +170,25 @@ _m_prefix_cow = _metrics.counter(
     "kv_prefix_cache_cow_copies_total",
     "copy-on-write block copies (a write landed in a shared or "
     "index-claimed block)", labelnames=_POOL_LABEL)
+
+# Host-RAM tier telemetry (long-context serving round).
+_m_tier_demotions = _metrics.counter(
+    "kv_tier_demotions_total",
+    "retained blocks demoted from the device pool into the host tier "
+    "(index entries moved, device slot freed)", labelnames=_POOL_LABEL)
+_m_tier_promotions = _metrics.counter(
+    "kv_tier_promotions_total",
+    "tier entries promoted back into device blocks ahead of a prefix "
+    "match (prefetch-on-attach)", labelnames=_POOL_LABEL)
+_m_tier_bytes = _metrics.counter(
+    "kv_tier_bytes_total",
+    "host tier traffic in encoded (int8 codes+scales) bytes; "
+    "direction='out' = device->host demotion, 'in' = host->device "
+    "promotion", labelnames=("pool", "direction"))
+_m_tier_hit_tokens = _metrics.counter(
+    "kv_tier_hit_tokens_total",
+    "prompt tokens served from promoted tier blocks instead of prefill "
+    "recompute (counted once, at promotion)", labelnames=_POOL_LABEL)
 
 _pool_ids = itertools.count()
 
@@ -222,10 +266,15 @@ class PagedKVCache:
     name: label for the `kv_pool_*` / `kv_prefix_cache_*` metric series
         (auto-assigned "poolN" when omitted, so concurrent caches never
         alias each other's telemetry).
+    tier: optional `kv_tier.HostKVTier` (or True for the default one)
+        attached below the pool — cold retained blocks demote to host
+        RAM instead of being dropped, and prefix matches promote them
+        back. None (default) keeps the pre-tier behaviour exactly.
     """
 
     def __init__(self, num_layers, num_heads, head_dim, *, block_size=128,
-                 num_blocks=64, dtype=None, kv_dtype=None, name=None):
+                 num_blocks=64, dtype=None, kv_dtype=None, name=None,
+                 tier=None):
         import jax.numpy as jnp
 
         if num_blocks < 2:
@@ -278,6 +327,18 @@ class PagedKVCache:
         self._lookup_tokens = 0
         self._evictions = 0
         self._cow_copies = 0
+        # host-RAM tier (long-context round): None = pre-tier behaviour
+        self._tier = None
+        #: optional callback(kind, **fields) the engine wires to its
+        #: flight recorder / tracing — kind is "demote" or "promote"
+        self.on_tier_event = None
+        self._tier_demotions = 0
+        self._tier_promotions = 0
+        self._tier_bytes_out = 0
+        self._tier_bytes_in = 0
+        self._tier_hit_tokens = 0
+        if tier is not None:
+            self.attach_tier(tier)
 
     # ---- pool bookkeeping (host-side) ---------------------------------
     @property
@@ -292,7 +353,11 @@ class PagedKVCache:
     def available_block_count(self):
         """Blocks an allocation can obtain: the free list plus the
         LRU-retained blocks it may reclaim — the number admission
-        control should reason about."""
+        control should reason about. Invariant under tiering: a
+        demotion moves a block retained -> free (the sum is
+        unchanged), so admission never under-counts when content is
+        parked in the host tier — the tiered entries cost no device
+        block until a match promotes them back into this sum."""
         return len(self._free) + len(self._retained)
 
     @property
@@ -386,7 +451,12 @@ class PagedKVCache:
 
     def _reclaim_lru(self):
         """Evict the least-recently-retained block: drop its index
-        entries and return it to the free list."""
+        entries and return it to the free list. With a host tier
+        attached the content is demoted instead of dropped — the
+        device slot still frees, but the entries stay promotable."""
+        if self._tier is not None:
+            self._demote_lru()
+            return
         b, _ = self._retained.popitem(last=False)
         for h in list(self._block_entries.get(b, ())):
             self._drop_entry(h)
@@ -399,6 +469,224 @@ class PagedKVCache:
         self._block_entries.setdefault(block, set()).add(h)
         fills = self._child_fills.setdefault(parent, {})
         fills[fill] = fills.get(fill, 0) + 1
+        if self._tier is not None:
+            # move semantics: a hash never lives in both indexes — the
+            # freshly written device copy wins over a stale tier copy
+            self._tier.drop(h)
+
+    # ---- host-RAM tier (long-context serving round) -------------------
+    def attach_tier(self, tier):
+        """Attach a `kv_tier.HostKVTier` below this pool (True builds
+        the default tier; None detaches — resident tier content is
+        simply forgotten). Returns the attached tier (or None)."""
+        from .kv_tier import normalize_kv_tier
+
+        self._tier = normalize_kv_tier(tier)
+        self._push_gauges()
+        return self._tier
+
+    @property
+    def tier(self):
+        return self._tier
+
+    def _tier_grab(self, b, fill):
+        """Host-side copy of rows [0, fill) of block `b` in the tier
+        codec: the pool's native codes+scales for an int8 pool
+        (bit-exact round trip), `kv_quant.kv_encode` for a dense one."""
+        from .kv_quant import QuantizedKV, kv_encode
+
+        if self.kv_dtype == "int8":
+            def grab(arr):
+                return QuantizedKV(
+                    np.asarray(arr.codes[:, b, :fill]),
+                    np.asarray(arr.scales[:, b, :fill]))
+        else:
+            def grab(arr):
+                codes, scales = kv_encode(arr[:, b, :fill])
+                return QuantizedKV(np.asarray(codes),
+                                   np.asarray(scales))
+        return grab(self.k_blocks), grab(self.v_blocks)
+
+    def _tier_install(self, b, fill, k_pay, v_pay):
+        """Write a tier payload into rows [0, fill) of device block
+        `b`. The .at[].set dispatches ASYNCHRONOUSLY — this is the
+        prefetch: by the time the next jitted dispatch consumes the
+        pool arrays, the copy has overlapped with host work."""
+        import jax.numpy as jnp
+
+        from .kv_quant import kv_decode
+
+        if self.kv_dtype == "int8":
+            def put(arr, pay):
+                return type(arr)(
+                    arr.codes.at[:, b, :fill].set(
+                        jnp.asarray(pay.codes, arr.codes.dtype)),
+                    arr.scales.at[:, b, :fill].set(
+                        jnp.asarray(pay.scales, arr.scales.dtype)))
+        else:
+            def put(arr, pay):
+                rows = kv_decode(jnp.asarray(pay.codes),
+                                 jnp.asarray(pay.scales), arr.dtype)
+                return arr.at[:, b, :fill].set(rows)
+        self.k_blocks = put(self.k_blocks, k_pay)
+        self.v_blocks = put(self.v_blocks, v_pay)
+
+    @staticmethod
+    def _payload_bytes(*payloads):
+        return sum(int(p.codes.nbytes) + int(p.scales.nbytes)
+                   for p in payloads)
+
+    def _demote_lru(self):
+        """Demote the LRU retained block into the host tier: every
+        index entry on it MOVES to the tier (with an encoded host copy
+        of its rows) and the device slot joins the free list."""
+        b, _ = self._retained.popitem(last=False)
+        moved = 0
+        nbytes = 0
+        for h in list(self._block_entries.get(b, ())):
+            _blk, fill, parent = self._index[h]
+            kp, vp = self._tier_grab(b, fill)
+            self._tier.put(h, fill, parent, kp, vp)
+            nbytes += self._payload_bytes(kp, vp)
+            self._drop_entry(h)
+            moved += 1
+        self._free.append(b)
+        self._tier_demotions += 1
+        self._tier_bytes_out += nbytes
+        if _metrics.enabled():
+            _m_tier_demotions.labels(pool=self._name).inc()
+            _m_tier_bytes.labels(pool=self._name,
+                                 direction="out").inc(nbytes)
+        cb = self.on_tier_event
+        if cb is not None:
+            cb("demote", block=b, entries=moved, bytes=nbytes)
+
+    def maybe_demote(self):
+        """Watermark-driven demotion sweep: while the free list is
+        below `tier.watermark` of the usable pool and retained blocks
+        remain, demote the coldest. Called from every release path;
+        cheap no-op without a tier. Returns blocks demoted."""
+        if self._tier is None or self._tier.watermark <= 0:
+            return 0
+        low = int(self._tier.watermark * (self.num_blocks - 1))
+        n = 0
+        while len(self._free) < low and self._retained:
+            self._demote_lru()
+            n += 1
+        if n:
+            self._push_gauges()
+        return n
+
+    def demote_cold(self, n=1):
+        """Explicitly demote up to `n` LRU retained blocks to the tier
+        (operator / test hook — the watermark sweep is the automatic
+        path). Returns blocks actually demoted."""
+        moved = 0
+        while (moved < int(n) and self._retained
+               and self._tier is not None):
+            self._demote_lru()
+            moved += 1
+        if moved:
+            self._push_gauges()
+        return moved
+
+    def _promote_entry(self, h):
+        """Pull one tier entry back into a device block: allocate,
+        decode the payload in, register + park in retention (MRU) so
+        the caller's chain walk claims it. Returns False when the
+        entry is gone or no device block is obtainable."""
+        ent = self._tier.get(h)
+        if ent is None:
+            return False
+        if h in self._index:
+            # the device re-published the same hash meanwhile — the
+            # device copy wins, the tier copy is redundant
+            self._tier.drop(h)
+            return True
+        if self.available_block_count < 1:
+            return False
+        fill, parent, kp, vp = ent
+        b = self._take_blocks(1)[0]
+        self._tier_install(b, fill, kp, vp)
+        self._tier.pop(h)
+        self._register_entry(h, b, fill, parent)
+        self._release_block(b)  # refcount 0 + indexed -> retention MRU
+        nbytes = self._payload_bytes(kp, vp)
+        self._tier_promotions += 1
+        self._tier_bytes_in += nbytes
+        if _metrics.enabled():
+            _m_tier_promotions.labels(pool=self._name).inc()
+            _m_tier_bytes.labels(pool=self._name,
+                                 direction="in").inc(nbytes)
+        cb = self.on_tier_event
+        if cb is not None:
+            cb("promote", block=b, tokens=fill, bytes=nbytes)
+        return True
+
+    def _promote_for(self, ids, max_match):
+        """Prefetch-on-match: walk the DEVICE chain along `ids` to its
+        end, then continue the walk through the TIER index, promoting
+        each tiered entry back into the device pool so the subsequent
+        `_match_chain` (and the attach claim on top of it) sees one
+        unbroken device chain. Returns tokens promoted."""
+        if self._tier is None or not len(self._tier):
+            return 0
+        n = int(ids.size)
+        h = ROOT_HASH
+        pos = 0
+        # device half: same longest-match walk as _match_chain, but
+        # tracking the chain hash so the tier walk continues from it
+        while pos < max_match:
+            cand = self._child_fills.get(h)
+            hit = None
+            if cand:
+                avail = n - pos
+                for f in sorted(cand, reverse=True):
+                    if f > avail:
+                        continue
+                    hh = prefix_block_hash(h, ids[pos:pos + f])
+                    if hh in self._index:
+                        hit = (hh, f)
+                        break
+            if hit is None:
+                break
+            hh, f = hit
+            use = min(f, max_match - pos)
+            pos += use
+            if f < self.block_size or use < f:
+                return 0       # partial block ends the chain for good
+            h = hh
+        promoted_tokens = 0
+        while pos < max_match:
+            cand = self._tier.child_fills(h)
+            hit = None
+            if cand:
+                avail = n - pos
+                for f in sorted(cand, reverse=True):
+                    if f > avail:
+                        continue
+                    hh = prefix_block_hash(h, ids[pos:pos + f])
+                    if self._tier.has(hh):
+                        hit = (hh, f)
+                        break
+            if hit is None:
+                break
+            hh, f = hit
+            if not self._promote_entry(hh):
+                break          # pool full — serve what promoted so far
+            use = min(f, max_match - pos)
+            promoted_tokens += use
+            pos += use
+            if f < self.block_size or use < f:
+                break
+            h = hh
+        if promoted_tokens:
+            self._tier_hit_tokens += promoted_tokens
+            if _metrics.enabled():
+                _m_tier_hit_tokens.labels(pool=self._name).inc(
+                    promoted_tokens)
+            self._push_gauges()
+        return promoted_tokens
 
     def _drop_entry(self, h):
         block, fill, parent = self._index.pop(h)
@@ -423,9 +711,16 @@ class PagedKVCache:
         p = self._name
         used = self.num_blocks - 1 - len(self._free) - len(self._retained)
         held = sum(self._lens.values())
-        _m_used_blocks.labels(pool=p).set(used)
-        _m_free_blocks.labels(pool=p).set(len(self._free))
-        _m_retained_blocks.labels(pool=p).set(len(self._retained))
+        _m_used_blocks.labels(pool=p, tier="device").set(used)
+        _m_free_blocks.labels(pool=p, tier="device").set(len(self._free))
+        _m_retained_blocks.labels(pool=p,
+                                  tier="device").set(len(self._retained))
+        if self._tier is not None:
+            t = self._tier
+            _m_used_blocks.labels(pool=p, tier="host").set(0)
+            _m_free_blocks.labels(pool=p, tier="host").set(
+                max(0, t.capacity_blocks - len(t)))
+            _m_retained_blocks.labels(pool=p, tier="host").set(len(t))
         _m_sequences.labels(pool=p).set(len(self._tables))
         _m_utilization.labels(pool=p).set(held / (self.capacity_tokens
                                                   or 1))
@@ -493,6 +788,7 @@ class PagedKVCache:
             if grow:
                 table.extend(self._take_blocks(grow))
             self._lens[seq_id] = max(self._lens.get(seq_id, 0), n)
+        self.maybe_demote()    # allocation raised pool pressure
         self._push_gauges()
 
     def append(self, seq_id, n=1):
@@ -510,6 +806,7 @@ class PagedKVCache:
         del self._lens[seq_id]
         for b in reversed(table):
             self._release_block(b)
+        self.maybe_demote()    # retention may have grown past watermark
         self._push_gauges()
         return len(table)
 
@@ -546,6 +843,7 @@ class PagedKVCache:
         self._lens[seq_id] = new_len
         for b in reversed(dropped):
             self._release_block(b)
+        self.maybe_demote()
         self._push_gauges()
         return len(dropped)
 
@@ -618,8 +916,15 @@ class PagedKVCache:
         always recomputed), with zero side effects: nothing is
         claimed, no hit/lookup counter moves. The fleet router's
         prefix-aware placement signal (route a request to the replica
-        already holding its longest prefix)."""
+        already holding its longest prefix).
+
+        With a host tier attached the probe is no longer free: a chain
+        continuing into the tier is PROMOTED first (prefetch-on-match
+        — by the time the admission decision lands the blocks are
+        device-resident), so the returned length counts tiered
+        content too."""
         ids = np.asarray(token_ids).reshape(-1)
+        self._promote_for(ids, int(ids.size) - 1)
         return self._match_chain(ids, int(ids.size) - 1)[2]
 
     def attach_prefix(self, seq_id, token_ids):
@@ -648,6 +953,7 @@ class PagedKVCache:
             _m_prefix_lookups.labels(pool=self._name).inc()
             _m_prefix_lookup_tokens.labels(pool=self._name).inc(
                 max(0, max_match))
+        self._promote_for(ids, max_match)  # prefetch tiered chain tail
         matched, _fills, pos = self._match_chain(ids, max_match)
         if pos == 0:
             return 0
@@ -773,11 +1079,14 @@ class PagedKVCache:
         Returns None when the index covers nothing. The inverse,
         `import_prefix`, re-publishes the chain into a
         layout-identical pool so a later `attach_prefix` there resumes
-        the session with zero prefill recompute. Read-only here — the
-        source blocks stay exactly as retained/shared as they were."""
+        the session with zero prefill recompute. Read-only on the
+        DEVICE chain — but a chain continuing into the host tier is
+        promoted first, so a partially-tiered session migrates whole
+        (the payload always carries the longest recoverable chain)."""
         import jax
 
         ids = np.asarray(token_ids).reshape(-1)
+        self._promote_for(ids, int(ids.size))
         blocks, fills, pos = self._match_chain(ids, int(ids.size))
         if pos == 0:
             return None
@@ -846,6 +1155,7 @@ class PagedKVCache:
             if f < self.block_size:
                 break                  # partial tail ends the chain
             h = hh
+        self.maybe_demote()
         self._push_gauges()
         return pos
 
@@ -924,4 +1234,27 @@ class PagedKVCache:
                 "evictions": self._evictions,
                 "cow_copies": self._cow_copies,
             },
+            # host-RAM tier block: zeroed-when-disabled, so the schema
+            # is identical with and without a tier attached
+            "tier": self._tier_stats(),
+        }
+
+    def _tier_stats(self):
+        from .kv_tier import disabled_tier_stats
+
+        if self._tier is None:
+            return disabled_tier_stats()
+        s = self._tier.stats()
+        return {
+            "enabled": True,
+            "capacity_blocks": s["capacity_blocks"],
+            "tiered_blocks": s["tiered_blocks"],
+            "tiered_tokens": s["tiered_tokens"],
+            "bytes_resident": s["bytes_resident"],
+            "demotions": self._tier_demotions,
+            "promotions": self._tier_promotions,
+            "evictions": s["evictions"],
+            "bytes_out": self._tier_bytes_out,
+            "bytes_in": self._tier_bytes_in,
+            "hit_tokens": self._tier_hit_tokens,
         }

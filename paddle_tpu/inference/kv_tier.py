@@ -1,0 +1,205 @@
+"""Host-RAM KV tier — the capacity layer BELOW the device block pool
+(long-context serving round).
+
+`PagedKVCache` retention parks cold published prefix blocks in-pool:
+they cost device HBM until pool pressure reclaims them, and a reclaim
+DESTROYS the cached content — a preempted session or a shared system
+prompt that lost its blocks pays full prefill recompute on return.
+`HostKVTier` adds a second chance: instead of dropping a cold retained
+block's index entries, the cache DEMOTES the block to pinned host
+memory (this module) and frees the device slot; a later
+`attach_prefix`/`match_prefix_len` whose chain continues into the tier
+PROMOTES the blocks back into the pool before the attach claims them
+(prefetch-on-attach: promotion happens at admission-match time, and
+the host->device writes dispatch asynchronously — the engine only
+synchronizes when the next dispatch consumes the pool arrays).
+
+Tier format — the r20 int8 codes+scales codec (`kv_quant`):
+
+  * int8 pools demote/promote their native codes+scales BIT-EXACTLY
+    (a round-trip through the tier is the identity);
+  * dense pools encode on demote (`kv_encode`: per-vector absmax int8,
+    |x - deq| <= absmax/254) and decode on promote — the same error
+    envelope the quantized-KV serving path runs under, so the pinned
+    parity workloads stay token-identical (tested) at ~4x fewer host
+    bytes than a raw bf16/f32 park.
+
+The tier is dumb indexed storage: one entry per prefix-chain hash
+(`kv_cache.prefix_block_hash`), carrying the entry's fill, parent hash
+and the encoded K/V rows.  The DEVICE cache drives every policy
+decision (watermark demotion, promotion walks, disjointness of the
+device and tier indexes); `capacity_blocks` bounds host memory with
+its own LRU — a tier eviction is the true end of the content.
+
+Ownership invariant (fuzz-tested): a chain hash lives in EITHER the
+device index or the tier index, never both, and tier entries never
+name device blocks — so free ∪ retained ∪ live tables still partition
+the device pool exactly as before, with the tier a disjoint host-side
+class.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+
+class HostKVTier:
+    """Host-memory tier below one `PagedKVCache`.
+
+    capacity_blocks: max resident tier entries (each holds <= one
+        block's rows).  The tier LRU-evicts past it — that eviction is
+        the real content drop the device retention list used to do.
+    watermark: demotion trigger — whenever the DEVICE pool's free-list
+        fraction drops below this, the cache demotes LRU retained
+        blocks into the tier until the free fraction recovers (or no
+        retained blocks remain).  0 disables pressure-driven demotion
+        (reclaim-path demotion still applies: an allocation that would
+        have evicted a retained block demotes it instead).
+    """
+
+    def __init__(self, capacity_blocks=256, watermark=0.25):
+        self.capacity_blocks = int(capacity_blocks)
+        if self.capacity_blocks < 1:
+            raise ValueError(
+                f"capacity_blocks must be >= 1, got {capacity_blocks}")
+        self.watermark = float(watermark)
+        if not 0.0 <= self.watermark < 1.0:
+            raise ValueError(
+                f"watermark must be in [0, 1), got {watermark}")
+        # hash -> (fill, parent, k_payload, v_payload); insertion order
+        # doubles as the LRU (move_to_end on touch)
+        self._entries: "OrderedDict[int, tuple]" = OrderedDict()
+        # parent hash -> {fill: count} — the same candidate-fills walk
+        # shape as the device index, so the cache's chain walk continues
+        # seamlessly from device into tier
+        self._child_fills: dict[int, dict[int, int]] = {}
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def has(self, h):
+        return h in self._entries
+
+    def child_fills(self, parent):
+        """Candidate fills published under `parent` (the chain-walk
+        probe — same contract as the device `_child_fills`)."""
+        return self._child_fills.get(parent)
+
+    def put(self, h, fill, parent, k_payload, v_payload):
+        """Store one demoted entry; first publisher wins (a duplicate
+        hash keeps the resident copy and refreshes its LRU position).
+        Returns the number of entries the capacity LRU evicted."""
+        if h in self._entries:
+            self._entries.move_to_end(h)
+            return 0
+        self._entries[h] = (int(fill), int(parent), k_payload, v_payload)
+        fills = self._child_fills.setdefault(int(parent), {})
+        fills[int(fill)] = fills.get(int(fill), 0) + 1
+        evicted = 0
+        while len(self._entries) > self.capacity_blocks:
+            _old, ent = self._entries.popitem(last=False)
+            self._unlink_fills(ent[0], ent[1])
+            self.evictions += 1
+            evicted += 1
+        return evicted
+
+    def _unlink_fills(self, fill, parent):
+        fills = self._child_fills.get(parent)
+        if fills is None:
+            return
+        left = fills.get(fill, 1) - 1
+        if left > 0:
+            fills[fill] = left
+        else:
+            fills.pop(fill, None)
+            if not fills:
+                del self._child_fills[parent]
+
+    def get(self, h):
+        """(fill, parent, k_payload, v_payload) or None; touches LRU."""
+        ent = self._entries.get(h)
+        if ent is not None:
+            self._entries.move_to_end(h)
+        return ent
+
+    def pop(self, h):
+        """Remove and return an entry (promotion takes ownership —
+        move semantics keep the device/tier indexes disjoint)."""
+        ent = self._entries.pop(h, None)
+        if ent is not None:
+            self._unlink_fills(ent[0], ent[1])
+        return ent
+
+    def drop(self, h):
+        """Discard a stale entry (e.g. the device re-published the same
+        hash — the device copy wins and the tier copy is redundant)."""
+        self.pop(h)
+
+    def tokens_resident(self):
+        return sum(ent[0] for ent in self._entries.values())
+
+    def bytes_resident(self):
+        total = 0
+        for _fill, _parent, kp, vp in self._entries.values():
+            for pay in (kp, vp):
+                total += sum(int(np.asarray(a).nbytes)
+                             for a in _leaves(pay))
+        return total
+
+    def stats(self):
+        return {
+            "capacity_blocks": self.capacity_blocks,
+            "watermark": self.watermark,
+            "tiered_blocks": len(self._entries),
+            "tiered_tokens": self.tokens_resident(),
+            "bytes_resident": self.bytes_resident(),
+            "evictions": self.evictions,
+        }
+
+
+def _leaves(payload):
+    """Flatten a tier payload: plain ndarray, or a (codes, scales)
+    QuantizedKV-like pair (duck-typed — this module must not import
+    jax)."""
+    if hasattr(payload, "codes"):
+        return (payload.codes, payload.scales)
+    if isinstance(payload, (tuple, list)):
+        out = []
+        for p in payload:
+            out.extend(_leaves(p))
+        return out
+    return (payload,)
+
+
+def normalize_kv_tier(kv_tier):
+    """Normalize the server's `kv_tier=` ctor value: None stays off,
+    True builds the default tier, an instance passes through."""
+    if kv_tier is None or kv_tier is False:
+        return None
+    if kv_tier is True:
+        return HostKVTier()
+    if not isinstance(kv_tier, HostKVTier):
+        raise TypeError(f"kv_tier must be a HostKVTier, True or None, "
+                        f"got {type(kv_tier).__name__}")
+    return kv_tier
+
+
+def disabled_tier_stats():
+    """Zeroed, schema-congruent `stats()["tier"]` block (the standing
+    zeroed-when-disabled convention: dashboards and bench records need
+    no gating)."""
+    return {
+        "enabled": False,
+        "capacity_blocks": 0,
+        "tiered_blocks": 0,
+        "tiered_tokens": 0,
+        "bytes_resident": 0,
+        "demotions": 0,
+        "promotions": 0,
+        "evictions": 0,
+        "bytes_out": 0,
+        "bytes_in": 0,
+        "hit_tokens": 0,
+    }

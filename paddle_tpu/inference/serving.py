@@ -654,6 +654,16 @@ class PagedGenerationServer:
     Default OFF: a disabled server takes the exact pre-cache
     allocation path (no lookups, no publishes, no spare block).
 
+    kv_tier (long-context round) adds a HOST-RAM TIER below the device
+    pool (True for the default `kv_tier.HostKVTier`, or an instance
+    for explicit capacity/watermark; requires enable_prefix_cache).
+    Cold retained prefix blocks demote to pinned host memory as int8
+    codes+scales instead of being dropped under pool pressure, and a
+    later prompt/resume whose prefix chain continues into the tier
+    promotes them back before the attach (prefetch-on-attach) — so
+    preempted sessions and shared system prompts survive pool churn
+    without recompute. kv_tier=None keeps the exact pre-tier engine.
+
     QUANTIZED SERVING (this round): `quantization="w8a16"` packs the
     decoder weights to int8 ONCE at construction
     (`model.quantize_weights()`, the shared PTQ implementation) and
@@ -791,7 +801,8 @@ class PagedGenerationServer:
                  weight_quant=None, quantization=None, kv_dtype=None,
                  steps_per_dispatch=1,
                  prefill_chunk_tokens=512, pack_align=None,
-                 enable_prefix_cache=False, detokenize=None,
+                 enable_prefix_cache=False, kv_tier=None,
+                 detokenize=None,
                  stop_tail_tokens=16, speculation=None, sharding=None,
                  unified_round=False, async_rounds=False,
                  expose_port=None, flight_recorder=None,
@@ -831,6 +842,13 @@ class PagedGenerationServer:
             from ..serving_dist import normalize_sharding
 
             sharding = normalize_sharding(sharding, cfg.num_heads)
+        # sequence-parallel prefill (long-context round): sp multiplies
+        # the packed chunk budget — the sp-sharded program prefills
+        # sp * prefill_chunk_tokens prompt tokens per dispatch at the
+        # same per-shard token load, so one huge prompt stops
+        # serializing through a single replica's budget. sp=1 (or
+        # unsharded) keeps the exact pre-round budget and programs.
+        self._sp_degree = sharding.sp if sharding is not None else 1
         self._spec_k = (speculation.max_draft_tokens
                         if speculation is not None else 0)
         self._drafter = (speculation.make_drafter()
@@ -855,6 +873,13 @@ class PagedGenerationServer:
                 "unified_round/async_rounds require steps_per_dispatch"
                 "=1 (the fused round already amortizes the dispatch "
                 "floor over the whole round)")
+        if self._unified and self._sp_degree > 1:
+            raise ValueError(
+                "sequence-parallel prefill (ShardedEngineConfig.sp > 1) "
+                "requires the split scheduler path — the unified round "
+                "packs decode/verify rows into the same stream the sp "
+                "program would shard, and decode stays TP by design "
+                "(set unified_round/async_rounds False)")
         self._uk1 = self._spec_k + 1  # pinned unified readout width
         # overrun horizon past the budget: a multi-step scan may write
         # up to k-1 discarded tokens, and a verify dispatch up to K
@@ -932,10 +957,20 @@ class PagedGenerationServer:
             # up so the explicit placement divides evenly (the extra
             # blocks are just capacity)
             num_blocks = -(-int(num_blocks) // sharding.dp) * sharding.dp
+        # host-RAM KV tier (long-context round): True -> default
+        # HostKVTier, or an instance for explicit capacity/watermark.
+        # Needs the prefix cache — tiering demotes/promotes INDEXED
+        # retained content, which only exists when publishing is on.
+        if kv_tier is not None and kv_tier is not False \
+                and not self.enable_prefix_cache:
+            raise ValueError(
+                "kv_tier requires enable_prefix_cache=True (the tier "
+                "holds demoted prefix-index content)")
         self.cache = PagedKVCache(
             cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, block_size=self.block_size,
-            num_blocks=int(num_blocks), dtype=dt, kv_dtype=kv_dtype)
+            num_blocks=int(num_blocks), dtype=dt, kv_dtype=kv_dtype,
+            tier=kv_tier)
         self._blocks_for = blocks_for
         # sharded serving (serving_dist round): a ShardedEngineConfig
         # (or True for defaults) places the snapshotted/quantized
@@ -1132,6 +1167,10 @@ class PagedGenerationServer:
         self.stall_timeout_s = float(stall_timeout_s)
         self._watchdog = None
         self.exporter = None
+        # tier telemetry: demote/promote land in the flight recorder
+        # ring and the trace stream (kv_tier_demote / kv_tier_promote)
+        if self.cache.tier is not None:
+            self.cache.on_tier_event = self._on_tier_event
         # process-wide compile accounting: this engine answers "am I
         # serving live work" for the in-flight label, mirrors compile
         # events into its flight recorder, and windows the counter for
@@ -1206,6 +1245,16 @@ class PagedGenerationServer:
                               available_block_count)
         if self._recorder.enabled:
             self._recorder.dump(trigger="stall")
+
+    def _on_tier_event(self, kind, **fields):
+        """Cache tier callback -> flight recorder ring + trace event
+        (literal names so the metric/span docs checker sees them)."""
+        if kind == "demote":
+            self._recorder.record("kv_tier_demote", **fields)
+            _tracing.event("kv_tier_demote", **fields)
+        else:
+            self._recorder.record("kv_tier_promote", **fields)
+            _tracing.event("kv_tier_promote", **fields)
 
     # ---- causal tracing + SLOs (ISSUE 14) -------------------------------
     def _tr(self, req):
@@ -1991,7 +2040,10 @@ class PagedGenerationServer:
             return self._warm_unified_buckets(modes)
         jnp = self._jnp
         align = self._pack_align
-        budget = self.prefill_chunk_tokens
+        # sp-sharded prefill reaches sp x the replica budget per
+        # dispatch (the _prefill_packed plan), so the reachable (T, P)
+        # bucket family scales with it
+        budget = self.prefill_chunk_tokens * self._sp_degree
         pairs = set()
         for rows in range(1, min(self.max_slots, budget) + 1):
             P = 1
@@ -2542,7 +2594,8 @@ class PagedGenerationServer:
         off (without importing serving_dist on the disabled path)."""
         if self.sharding is None:
             return {"enabled": False, "mesh_shape": {}, "tp_degree": 0,
-                    "dp_degree": 0, "collective_quant": "none"}
+                    "dp_degree": 0, "sp_degree": 0,
+                    "collective_quant": "none"}
         return self.sharding.stats_block()
 
     def _collectives_stats(self):
@@ -2829,7 +2882,10 @@ class PagedGenerationServer:
         sample their first token here (that is their TTFT)."""
         jnp = self._jnp
         align = self._pack_align
-        budget = self.prefill_chunk_tokens
+        # sp multiplies the per-dispatch chunk budget: the sp-sharded
+        # packed program runs T/sp tokens per shard, so sp chunks'
+        # worth of prompt tokens cost one replica-budget dispatch
+        budget = self.prefill_chunk_tokens * self._sp_degree
         # chunk-budget sharing (round 12): the scheduler orders the
         # feeding slots (interactive/EDF first) and may cap each slot's
         # share of this chunk so one lane cannot monopolize the budget;

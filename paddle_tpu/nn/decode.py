@@ -378,8 +378,57 @@ def _build_paged_fns(spec, block_size, return_logits, mode,
     return prefill_fn, step_fn
 
 
+def _sp_stream_pin(sp_mesh):
+    """Token-axis pin for the SEQUENCE-PARALLEL packed trunk (long-
+    context round): constrain a [T, ...] stream tensor to shard its
+    token axis over the mesh `sp` axis.  The per-token trunk work —
+    embed, layer norms, QKV/out projections, the MLP — is data-parallel
+    over tokens, so anchoring x at the embed and at every block output
+    lets the partitioner run the whole trunk at T/sp tokens per shard
+    without any re-association of contractions (the reduction axes stay
+    whole, which is why sp is token-identical).  None is the identity
+    (the unsharded / sp=1 trace is byte-for-byte the pre-round one)."""
+    if sp_mesh is None:
+        return lambda x: x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def pin(x):
+        spec = P(*(("sp",) + (None,) * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(sp_mesh, spec))
+
+    return pin
+
+
+def _sp_kv_gather(sp_mesh):
+    """The explicit shard_map seam of the sp packed trunk (r14/r20
+    seam discipline): re-replicate the sp-sharded K/V token stream over
+    `sp` BEFORE the paged-pool scatter.  Each sp shard computes the
+    K/V projections for ITS T/sp slice of the packed stream; the pool
+    is REPLICATED over sp (kv_pool_specs shards heads over mp and
+    blocks over dp only), so a shard-local scatter would leave the sp
+    replicas divergent.  One tiled all-gather over sp per (layer, k/v)
+    moves exactly the freshly-projected chunk bytes — [T, H/mp, Dh]
+    per shard — after which every shard performs the identical full
+    scatter and the replicas stay bitwise in lockstep.  The head axis
+    keeps its mp sharding through the seam (in/out specs name it), so
+    tp x sp meshes compose."""
+    if sp_mesh is None:
+        return lambda t: t
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map(
+        lambda t: jax.lax.all_gather(t, "sp", axis=0, tiled=True),
+        mesh=sp_mesh, in_specs=P("sp", "mp", None),
+        out_specs=P(None, "mp", None), check_rep=False)
+
+
 @functools.lru_cache(maxsize=32)
-def _packed_trunk(spec, block_size, kv_quant=False, cq=None):
+def _packed_trunk(spec, block_size, kv_quant=False, cq=None,
+                  sp_mesh=None):
     """Shared packed ragged forward trunk: embed a token-packed
     multi-sequence stream, write each token's K/V into its paged block
     rows, and run segment-causal attention per layer. Returns the final
@@ -387,7 +436,20 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None):
     BOTH `packed_prefill` (PR 3 chunked prefill) and `packed_verify`
     (speculative decoding) — the two programs differ only in their
     readout: one sample position per segment vs. one per draft
-    position."""
+    position.
+
+    sp_mesh (long-context round): a Mesh with an `sp` axis makes the
+    trunk SEQUENCE-PARALLEL over the packed token axis — x is pinned
+    to shard [T] over sp (`_sp_stream_pin`), each shard projects Q/K/V
+    for its T/sp token slice, the `_sp_kv_gather` shard_map seam
+    re-replicates K/V before the pool scatter, and segment-causal
+    attention runs with sp-sharded queries against the sp-replicated
+    pool (the softmax reduction is over KV positions — whole per
+    query — so sharding queries reassociates nothing).  The Pallas
+    stream kernel is bypassed inside the sp trunk (its sp-local
+    tile_base wiring over shard_map is the ROADMAP follow-up); the
+    XLA fallback partitions cleanly.  None traces the exact pre-round
+    trunk."""
     import jax.numpy as jnp
 
     L, H, Dh, E, eps, tied = spec
@@ -395,6 +457,8 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None):
     BS = int(block_size)
     kv_write, kv_layer = _kv_io(bool(kv_quant))
     hp = _layer_helpers(spec, cq)
+    spin = _sp_stream_pin(sp_mesh)
+    spg = _sp_kv_gather(sp_mesh)
 
     def trunk(params, toks, seg, pos, tables, kc, vc):
         from ..ops.attention import ragged_prefill_attention
@@ -404,7 +468,7 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None):
         embed, _head = hp.make_embed_head(params, dt)
         valid = pos >= 0
         p0 = jnp.where(valid, pos, 0)
-        x = embed(toks) + params["wpe.weight"][p0]        # [T, E]
+        x = spin(embed(toks) + params["wpe.weight"][p0])  # [T, E]
         # pad tokens write to the trash block; their attention output is
         # finite garbage (uniform weights over masked -inf scores) that
         # no sample index ever reads
@@ -414,12 +478,13 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None):
             a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
                       params[f"h.{i}.ln_1.bias"])
             q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
-            kc = kv_write(kc, i, blk, off, k)
-            vc = kv_write(vc, i, blk, off, v)
-            o = ragged_prefill_attention(q, kv_layer(kc, i),
-                                         kv_layer(vc, i), tables, seg,
-                                         pos, scale=scale).reshape(T, E)
-            x = hp.block_and_mlp(params, i, x, o, dt)
+            kc = kv_write(kc, i, blk, off, spg(k))
+            vc = kv_write(vc, i, blk, off, spg(v))
+            o = ragged_prefill_attention(
+                q, kv_layer(kc, i), kv_layer(vc, i), tables, seg,
+                pos, scale=scale,
+                allow_pallas=sp_mesh is None).reshape(T, E)
+            x = spin(hp.block_and_mlp(params, i, x, o, dt))
         return x, kc, vc
 
     return trunk
@@ -427,17 +492,24 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None):
 
 @functools.lru_cache(maxsize=64)
 def _build_packed_prefill(spec, block_size, return_logits, mode,
-                          kv_quant=False, rep_constraint=None, cq=None):
+                          kv_quant=False, rep_constraint=None, cq=None,
+                          sp_mesh=None):
     """Packed ragged prefill: ONE dispatch prefills a token-packed
     multi-sequence chunk stream (the tentpole of the chunked-prefill
-    scheduler, inference/serving.py). Raw and jittable."""
+    scheduler, inference/serving.py). Raw and jittable.
+
+    sp_mesh (long-context round): sequence-parallel trunk over the
+    packed token axis (see `_packed_trunk`); the readout rows are
+    pinned replicated before the sampling pipeline, so sampling stays
+    bitwise the single-stream pipeline.  None = the exact pre-round
+    program."""
     import jax.numpy as jnp
 
     from ..sampling import processors as _proc
 
     sampled, penalties = mode
     hp = _layer_helpers(spec, cq)
-    trunk = _packed_trunk(spec, block_size, bool(kv_quant), cq)
+    trunk = _packed_trunk(spec, block_size, bool(kv_quant), cq, sp_mesh)
     pin = _rep_pin(rep_constraint)
     readout = _make_readout(cq, pin, mode, _proc)
 
@@ -467,6 +539,11 @@ def _build_packed_prefill(spec, block_size, return_logits, mode,
         _embed, head = hp.make_embed_head(
             params, params["ln_f.weight"].dtype)
         xf = x[sample_idx]                                # [B, E]
+        if sp_mesh is not None:
+            # the sp trunk leaves x token-sharded; the B readout rows
+            # are gathered to every shard so the sampling pipeline
+            # computes replicated (the _rep_pin discipline)
+            xf = pin(xf)
         xf = hp.ln(xf, params["ln_f.weight"], params["ln_f.bias"])
         tok, logits = readout(head, xf, sp, return_logits)
         B = sample_idx.shape[0]
@@ -840,15 +917,23 @@ def _sharded_jits(spec, block_size, return_logits, donate, mode,
     path jits — sharding is a placement property, so XLA partitions the
     same HLO and inserts the TP collectives itself.  Cached
     process-wide per (program, mode, shardings bundle) — the bundle is
-    hashable, so servers on equal meshes share compiled programs."""
+    hashable, so servers on equal meshes share compiled programs.
+
+    A mesh with sp > 1 (long-context round) swaps ONLY the packed-
+    prefill program for its sequence-parallel variant (`_packed_trunk`
+    sp_mesh path); decode/verify/unified stay the plain TP programs —
+    decode stays TP by design, and sp=1 meshes trace the exact
+    pre-round programs bitwise."""
     import jax
 
     pr, kv, rep = sh.params, sh.kv, sh.rep
+    sp_mesh = (sh.mesh
+               if dict(sh.mesh.shape).get("sp", 1) > 1 else None)
     prefill_fn, step_fn = _build_paged_fns(spec, block_size,
                                            return_logits, mode, kv_quant,
                                            rep, cq)
     packed_fn = _build_packed_prefill(spec, block_size, return_logits,
-                                      mode, kv_quant, rep, cq)
+                                      mode, kv_quant, rep, cq, sp_mesh)
     verify_fn = _build_packed_verify(spec, block_size, mode, kv_quant,
                                      rep, cq)
     unified_fn = _build_unified_round(spec, block_size, mode, kv_quant,

@@ -196,7 +196,7 @@ def paged_decode_attention(q, k_blocks, v_blocks, block_tables, ctx_lens,
 
 
 def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
-                             scale=None):
+                             scale=None, allow_pallas=True):
     """Packed ragged prefill attention over a PAGED KV cache: every token
     of a token-packed multi-sequence stream attends its OWN sequence's
     cache positions [0, pos] — both the K/V this chunk just wrote and
@@ -226,14 +226,21 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     call instead of a relayout inside every batched matmul — a
     measured 3.4x on the same shapes), and applies the row-AND-position
     mask before a joint softmax over all rows — exactly the per-row
-    softmax, because only the query's own row has unmasked columns."""
+    softmax, because only the query's own row has unmasked columns.
+
+    allow_pallas=False forces the XLA fallback even on TPU: the
+    sequence-parallel packed trunk (long-context round) runs with
+    sp-sharded queries under GSPMD, where a pallas_call is an opaque
+    per-device program — the sp-local stream-kernel wiring (tile_base
+    shard offsets, ops/pallas/unified_attention.py) is the ROADMAP
+    follow-up."""
     quant = _is_quantized_kv(k_blocks)
     kcodes = k_blocks.codes if quant else k_blocks
     T, H, Dh = q.shape
     _, BS, _, _ = kcodes.shape
     B, M = block_tables.shape
     sc = (Dh ** -0.5) if scale is None else scale
-    if _on_tpu():
+    if allow_pallas and _on_tpu():
         try:
             from .pallas.unified_attention import (
                 Q_TILE, supported_shapes, unified_ragged_attention_kernel)

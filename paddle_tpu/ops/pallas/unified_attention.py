@@ -136,7 +136,7 @@ def _load_kv(ref, sref, dt):
 # ---- stream kernel (prefill chunks / decode rows / verify regions) ----
 
 def _stream_kernel(tile_seg_ref, tile_pos_ref, tables_ref, q_ref,
-                   *refs, scale, nm, qt, quant):
+                   *refs, scale, nm, qt, quant, tile_base):
     if quant:
         k_ref, ks_ref, v_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
     else:
@@ -151,7 +151,9 @@ def _stream_kernel(tile_seg_ref, tile_pos_ref, tables_ref, q_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q0 = tile_pos_ref[qi]  # abs position of the tile's first query; -1 pad
+    # abs position of the tile's first query (-1 pad); tile_base shifts
+    # a shard-local grid into the GLOBAL prefetch arrays (sp shards)
+    q0 = tile_pos_ref[qi + tile_base]
     bs = k_ref.shape[1]
 
     # a kv block matters iff it starts at or before the tile's LAST
@@ -188,10 +190,12 @@ def _stream_kernel(tile_seg_ref, tile_pos_ref, tables_ref, q_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "q_tile", "interpret"))
+                   static_argnames=("scale", "q_tile", "interpret",
+                                    "tile_base"))
 def unified_ragged_attention_kernel(q, k_blocks, v_blocks, tables,
                                     tile_seg, tile_pos, *, scale=None,
-                                    q_tile=None, interpret=False):
+                                    q_tile=None, interpret=False,
+                                    tile_base=0):
     """Pallas segment-causal stream attention: ONE launch scores a
     token-packed stream mixing prefill chunks, plain decode rows and
     speculative verify regions (see module docstring for the layout
@@ -201,9 +205,18 @@ def unified_ragged_attention_kernel(q, k_blocks, v_blocks, tables,
     scalar-prefetched block index as their codes and dequant happens
     in VMEM (`_load_kv`).  q_tile defaults to the production
     Q_TILE=128 (interpret-mode tests shrink it to exercise tiny
-    shapes)."""
+    shapes).
+
+    tile_base (long-context round): static tile offset into the
+    scalar-prefetched tile_seg/tile_pos arrays — a SEQUENCE-PARALLEL
+    shard holding tiles [base, base + T_local/QT) of a global packed
+    stream passes its LOCAL q slice with the GLOBAL prefetch arrays
+    and tile_base=base, and the block-index maps (`tb[ts[qi+base], m]`)
+    DMA exactly the pool blocks the shard's own tiles name.  0 (the
+    default) is the exact pre-round single-stream kernel."""
     quant, operands = kv_operands(k_blocks, v_blocks)
     qt = Q_TILE if q_tile is None else int(q_tile)
+    tile_base = int(tile_base)
     T, H, Dh = q.shape
     _, BS, _, _ = operands[0].shape
     M = tables.shape[1]
@@ -211,6 +224,10 @@ def unified_ragged_attention_kernel(q, k_blocks, v_blocks, tables,
         raise ValueError(f"packed length {T} not a multiple of the "
                          f"query tile {qt}")
     NQ = T // qt
+    if tile_base < 0 or tile_base + NQ > tile_seg.shape[0]:
+        raise ValueError(
+            f"tile_base {tile_base} + local tiles {NQ} exceeds the "
+            f"global tile arrays ({tile_seg.shape[0]} tiles)")
     scale = (Dh ** -0.5) if scale is None else float(scale)
 
     qh = q.transpose(1, 0, 2)  # [H, T, Dh]: heads ride the sublane axis
@@ -218,9 +235,9 @@ def unified_ragged_attention_kernel(q, k_blocks, v_blocks, tables,
                           lambda qi, m, ts, tp, tb: (0, qi, 0))
     in_specs = [q_spec] + kv_operand_specs(
         BS, H, Dh, quant,
-        lambda qi, m, ts, tp, tb: tb[ts[qi], m])
+        lambda qi, m, ts, tp, tb: tb[ts[qi + tile_base], m])
     kernel = functools.partial(_stream_kernel, scale=scale, nm=M,
-                               qt=qt, quant=quant)
+                               qt=qt, quant=quant, tile_base=tile_base)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # tile_seg, tile_pos, tables steer the DMA
         grid=(NQ, M),

@@ -4,8 +4,13 @@ Reuses the canonical mesh builder (`parallel/mesh.py`, axes dp/pp/mp/sp)
 so serving and training agree on axis names: serving tensor parallel IS
 the training `mp` axis (column/row-split weights, vocab-parallel head)
 and the optional slot/data axis is `dp` (the KV pool's block dimension
-shards over it).  pp/sp stay 1 — pipeline and sequence parallel are
-training-side schedules with no decode analogue here.
+shards over it).  pp stays 1 — pipeline parallel is a training-side
+schedule with no decode analogue here.  `sp` (long-context round) is
+the SEQUENCE-PARALLEL axis of the packed PREFILL stream: one huge
+prompt's chunk stream shards its token axis over sp, multiplying the
+per-dispatch chunk budget by sp, while decode stays pure TP and the KV
+pool stays replicated over sp (every shard owns the writes of its own
+stream slice and the seams re-replicate them — see nn/decode.py).
 """
 from __future__ import annotations
 
@@ -23,8 +28,18 @@ class ShardedEngineConfig:
     dp: optional data/slot degree — the KV pool's BLOCK axis
         additionally shards over the mesh `dp` axis (per-device pool
         bytes divide by tp*dp).  Weights are replicated over dp.
+    sp: sequence-parallel degree for the PACKED PREFILL stream (long-
+        context round): the engine's per-dispatch chunk budget becomes
+        `prefill_chunk_tokens * sp` and the packed-prefill program
+        shards its token axis over the mesh `sp` axis, so ONE huge
+        prompt stops serializing through a single replica's budget.
+        Decode/verify/unified programs are untouched (decode stays
+        TP), the KV pool is replicated over sp, and sp=1 traces the
+        exact pre-round programs bitwise.  sp>1 requires dp==1 — sp
+        shards one stream; dp replicates independent pools, and the
+        composed layout is future work (ROADMAP).
     devices: explicit device list (tests / subsets); None = the first
-        tp*dp of `jax.devices()`.
+        tp*dp*sp of `jax.devices()`.
     collective_quant: None (default — the exact pre-round bf16
         collectives) | "int8" | "int4g": quantize the decode hot
         path's mp-axis collectives (row-split psums, embed psum,
@@ -37,17 +52,25 @@ class ShardedEngineConfig:
 
     tp: int = 1
     dp: int = 1
+    sp: int = 1
     devices: tuple = None
     collective_quant: str = None
     int4_group: int = 32
 
     def __post_init__(self):
-        for field_name in ("tp", "dp", "int4_group"):
+        for field_name in ("tp", "dp", "sp", "int4_group"):
             v = getattr(self, field_name)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
                 raise ValueError(
                     f"ShardedEngineConfig.{field_name}={v!r} must be a "
                     f"positive int")
+        if self.sp > 1 and self.dp > 1:
+            raise ValueError(
+                f"ShardedEngineConfig(sp={self.sp}, dp={self.dp}): "
+                f"sp>1 requires dp==1 — sequence parallel shards ONE "
+                f"packed prefill stream while dp shards the pool's "
+                f"block axis across replicas; the composed layout is "
+                f"not implemented")
         from .collectives import normalize_collective_quant
 
         normalize_collective_quant(self.collective_quant)
@@ -56,13 +79,13 @@ class ShardedEngineConfig:
 
     @property
     def total(self):
-        return self.tp * self.dp
+        return self.tp * self.dp * self.sp
 
     def build_mesh(self):
         """Build the (dp, pp, mp, sp) mesh this config shards over —
-        pp = sp = 1, mp = tp.  Raises naming the shortfall when the
-        backend has fewer devices than tp*dp (the forced-host CPU flag
-        or a real slice provides them)."""
+        pp = 1, mp = tp, sp = sp.  Raises naming the shortfall when
+        the backend has fewer devices than tp*dp*sp (the forced-host
+        CPU flag or a real slice provides them)."""
         import jax
 
         from ..parallel.mesh import make_mesh
@@ -72,7 +95,8 @@ class ShardedEngineConfig:
             avail = jax.devices()
             if len(avail) < self.total:
                 raise ValueError(
-                    f"ShardedEngineConfig(tp={self.tp}, dp={self.dp}) "
+                    f"ShardedEngineConfig(tp={self.tp}, dp={self.dp}, "
+                    f"sp={self.sp}) "
                     f"needs {self.total} devices, backend has "
                     f"{len(avail)} (on CPU set XLA_FLAGS="
                     f"--xla_force_host_platform_device_count="
@@ -81,9 +105,10 @@ class ShardedEngineConfig:
             devices = avail[:self.total]
         elif len(devices) != self.total:
             raise ValueError(
-                f"ShardedEngineConfig(tp={self.tp}, dp={self.dp}) needs "
+                f"ShardedEngineConfig(tp={self.tp}, dp={self.dp}, "
+                f"sp={self.sp}) needs "
                 f"exactly {self.total} devices, got {len(devices)}")
-        return make_mesh(dp=self.dp, mp=self.tp, pp=1, sp=1,
+        return make_mesh(dp=self.dp, mp=self.tp, pp=1, sp=self.sp,
                          devices=list(devices))
 
     def stats_block(self):
@@ -91,9 +116,10 @@ class ShardedEngineConfig:
         disabled form is zeroed by the engine — schema-congruent)."""
         return {
             "enabled": True,
-            "mesh_shape": {"dp": self.dp, "mp": self.tp},
+            "mesh_shape": {"dp": self.dp, "mp": self.tp, "sp": self.sp},
             "tp_degree": self.tp,
             "dp_degree": self.dp,
+            "sp_degree": self.sp,
             "collective_quant": self.collective_quant or "none",
         }
 
@@ -128,5 +154,6 @@ def disabled_stats_block():
         "mesh_shape": {},
         "tp_degree": 0,
         "dp_degree": 0,
+        "sp_degree": 0,
         "collective_quant": "none",
     }

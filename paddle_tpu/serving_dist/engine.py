@@ -27,7 +27,10 @@ def apply_sharding(server, cfg):
     mesh = cfg.build_mesh()
     server._params = place_decode_params(mesh, server._params)
     place_kv_pool(mesh, server.cache)
-    server.cache.set_shard_count(cfg.total)
+    # per-shard byte accounting divides by the axes that actually SPLIT
+    # the pool (heads over mp, blocks over dp); sp replicates the pool,
+    # so each sp shard holds a full tp*dp-divided copy
+    server.cache.set_shard_count(cfg.tp * cfg.dp)
     server.sharding = cfg
     server._mesh = mesh
     return build_decode_shardings(mesh, server._params,

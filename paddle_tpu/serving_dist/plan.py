@@ -133,9 +133,15 @@ class DecodeShardings:
         """The `shard` label the ops plane's compile metrics carry
         (serving_xla_compiles_total{..., shard=}): the mesh shape in
         axis=size form, e.g. "mp2xdp1" — so a fleet scraping several
-        mesh configs can tell whose jit cache went cold."""
+        mesh configs can tell whose jit cache went cold.  A sequence-
+        parallel mesh (long-context round) appends "xsp{n}"; sp=1
+        keeps the exact pre-round label so existing dashboards and
+        the r14 gauge assertions never see a rename."""
         shape = dict(self.mesh.shape)
-        return f"mp{shape.get('mp', 1)}xdp{shape.get('dp', 1)}"
+        label = f"mp{shape.get('mp', 1)}xdp{shape.get('dp', 1)}"
+        if shape.get("sp", 1) > 1:
+            label += f"xsp{shape['sp']}"
+        return label
 
     def _key(self):
         return (self.mesh, self._params_items, self.kv, self.rep)

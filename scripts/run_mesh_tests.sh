@@ -20,7 +20,8 @@ cd "$(dirname "$0")/.."
 
 ARGS=("$@")
 if [ ${#ARGS[@]} -eq 0 ]; then
-  ARGS=(tests/test_serving_dist.py tests/test_quantized_collectives.py
+  ARGS=(tests/test_serving_dist.py tests/test_sp_prefill.py
+        tests/test_quantized_collectives.py
         tests/test_distributed.py
         tests/test_pipeline.py tests/test_fleet_gpt2.py
         tests/test_gpt2_pipeline.py tests/test_moe.py

@@ -176,8 +176,10 @@ class TestOneDeviceMeshBitwise:
                          sharding=ShardedEngineConfig(tp=1))
         assert out == ref
         assert st["sharding"] == {"enabled": True,
-                                  "mesh_shape": {"dp": 1, "mp": 1},
+                                  "mesh_shape": {"dp": 1, "mp": 1,
+                                                 "sp": 1},
                                   "tp_degree": 1, "dp_degree": 1,
+                                  "sp_degree": 1,
                                   "collective_quant": "none"}
 
     def test_decoder_logits_bitwise(self, tiny_model):
@@ -288,7 +290,8 @@ class TestMeshParity:
         ref, _ = _serve(model, prompts, sps, **kw)
         out, st = _serve(model, prompts, sps, sharding=TP4, **kw)
         assert out == ref
-        assert st["sharding"]["mesh_shape"] == {"dp": 1, "mp": 4}
+        assert st["sharding"]["mesh_shape"] == {"dp": 1, "mp": 4,
+                                                "sp": 1}
 
     def test_dp_axes(self, tiny_model):
         """dp shards the pool's block axis (pure placement — bitwise
@@ -302,7 +305,8 @@ class TestMeshParity:
             out, st = _serve(model, prompts, sps,
                              sharding=ShardedEngineConfig(tp=tp, dp=dp))
             assert out == ref, (tp, dp)
-            assert st["sharding"]["mesh_shape"] == {"dp": dp, "mp": tp}
+            assert st["sharding"]["mesh_shape"] == {"dp": dp, "mp": tp,
+                                                    "sp": 1}
 
     def test_preempt_resume_parity(self, tiny_model):
         """Preempt-then-resume through the SHARDED pool: swap-out
@@ -350,7 +354,7 @@ class TestStatsAndTelemetry:
                                     max_prompt_len=16, max_new_tokens=4)
         st = srv.stats()["sharding"]
         assert st == {"enabled": False, "mesh_shape": {},
-                      "tp_degree": 0, "dp_degree": 0,
+                      "tp_degree": 0, "dp_degree": 0, "sp_degree": 0,
                       "collective_quant": "none"}
 
     def test_sharding_block_reset_coherent(self, tiny_model):

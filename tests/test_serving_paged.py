@@ -318,12 +318,13 @@ def test_served_bench_axis_emits_records():
     """`bench.py served` (mixed-length traffic: padded vs paged
     closed-loop, the open-loop Poisson axis, the shared-prefix caching
     axis, the round-11 speculation axis, the round-12 front-door
-    axis, the quantization axis, the sharded mesh axis, and the r18
-    fleet axis) must emit all the JSON records; slow-marked so tier-1
-    stays fast."""
+    axis, the quantization axis, the sharded mesh axis, the r18
+    fleet axis, and the r21 long-context axis) must emit all the JSON
+    records; slow-marked so tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 12, stdout
+    assert len(recs) == 13, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("longcontext" in rec["metric"] for rec in recs)
     assert any("quantcollectives" in rec["metric"] for rec in recs)
     assert any("fleet" in rec["metric"] for rec in recs)
     assert any("unifiedround" in rec["metric"] for rec in recs)
@@ -425,6 +426,31 @@ def test_served_bench_axis_emits_records():
     assert fl["failover_sessions"] >= 1, fl
     assert fl["migrated_sessions"] >= 1, fl
     assert fl["replica_counts"] == [1, 2, 4], fl
+    # the long-context acceptance bars (r21): sp multiplies the packed
+    # prefill chunk budget, so the SAME huge prompts take strictly
+    # fewer prefill dispatches at every higher sp degree with
+    # md5-identical token streams (the structural/exact half; TTFT
+    # wall-clock scaling is a chip number on the shared-core host
+    # mesh), and the host-RAM KV tier backs >= 3x the resumable
+    # long-context sessions at fixed per-device pool bytes, with the
+    # churn mechanism (demote/promote, no recompute on resume, token
+    # parity) proven empirically
+    lc = next(r for r in recs if "longcontext" in r["metric"])
+    assert lc["sp_degrees"] == [1, 2, 4], lc
+    assert lc["token_parity"] is True, lc
+    d = [lc["prefill_dispatches_by_sp"][str(n)] for n in (1, 2, 4)]
+    assert d[0] > d[1] > d[2], lc
+    assert lc["sessions_at_itl_bar_tier_on"] \
+        > lc["sessions_at_itl_bar_tier_off"], lc
+    assert lc["session_capacity_ratio"] >= 3.0, lc
+    assert lc["max_resident_context_tokens_tier_on"] \
+        > lc["max_resident_context_tokens_tier_off"], lc
+    assert lc["resume_prefill_dispatches_tier_on"] \
+        < lc["resume_prefill_dispatches_tier_off"], lc
+    assert lc["tier_demotions"] >= 1, lc
+    assert lc["tier_promotions"] >= 1, lc
+    assert lc["tier_hit_tokens"] > 0, lc
+    assert lc["tier_token_parity"] is True, lc
 
 
 def test_served_bench_openloop_tiny_schema():
@@ -432,8 +458,8 @@ def test_served_bench_openloop_tiny_schema():
     bench must run fast and its records must carry the schema fields —
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
-    recs, stdout = _run_served_bench("--tiny", timeout=720)
-    assert len(recs) == 12, stdout
+    recs, stdout = _run_served_bench("--tiny", timeout=900)
+    assert len(recs) == 13, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
@@ -444,6 +470,7 @@ def test_served_bench_openloop_tiny_schema():
                  and "sharded" not in r["metric"]
                  and "unifiedround" not in r["metric"]
                  and "degradedmode" not in r["metric"]
+                 and "longcontext" not in r["metric"]
                  and "fleet" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
@@ -456,8 +483,9 @@ def test_served_bench_openloop_tiny_schema():
                   if "quantcollectives" in r["metric"])
     dg_rec = next(r for r in recs if "degradedmode" in r["metric"])
     fl_rec = next(r for r in recs if "fleet" in r["metric"])
+    lc_rec = next(r for r in recs if "longcontext" in r["metric"])
     for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec,
-                qz_rec, sh_rec, qc_rec, dg_rec, fl_rec):
+                qz_rec, sh_rec, qc_rec, dg_rec, fl_rec, lc_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -630,3 +658,37 @@ def test_served_bench_openloop_tiny_schema():
     assert fl_rec["failover_sessions"] >= 1, fl_rec
     assert fl_rec["migrated_sessions"] >= 1, fl_rec
     assert len(fl_rec["parity_md5"]) == 32, fl_rec
+    # long-context axis (r21): huge prompts at sp∈{1,2} (tiny) — the
+    # smoke asserts the schema, the exact prefill-dispatch division,
+    # md5 token parity across sp degrees, and the host-RAM KV tier's
+    # capacity + churn-mechanism fields
+    for fld in ("vs_baseline", "sp_degrees", "prompt_tokens",
+                "ttft_p50_ms_by_sp", "prefill_dispatches_by_sp",
+                "token_parity", "parity_md5",
+                "sessions_at_itl_bar_tier_on",
+                "sessions_at_itl_bar_tier_off",
+                "session_capacity_ratio",
+                "max_resident_context_tokens_tier_on",
+                "max_resident_context_tokens_tier_off",
+                "pool_budget_bytes", "host_budget_bytes",
+                "resume_ttft_p50_ms_tier_on",
+                "resume_ttft_p50_ms_tier_off",
+                "resume_prefill_dispatches_tier_on",
+                "resume_prefill_dispatches_tier_off",
+                "tier_demotions", "tier_promotions",
+                "tier_hit_tokens", "tier_token_parity",
+                "n_sessions", "cpu_host_mesh"):
+        assert fld in lc_rec, lc_rec
+    assert lc_rec["sp_degrees"] == [1, 2], lc_rec
+    assert lc_rec["token_parity"] is True, lc_rec
+    assert len(lc_rec["parity_md5"]) == 32, lc_rec
+    assert lc_rec["prefill_dispatches_by_sp"]["2"] \
+        < lc_rec["prefill_dispatches_by_sp"]["1"], lc_rec
+    assert lc_rec["sessions_at_itl_bar_tier_on"] \
+        > lc_rec["sessions_at_itl_bar_tier_off"], lc_rec
+    assert lc_rec["resume_prefill_dispatches_tier_on"] \
+        < lc_rec["resume_prefill_dispatches_tier_off"], lc_rec
+    assert lc_rec["tier_demotions"] >= 1, lc_rec
+    assert lc_rec["tier_promotions"] >= 1, lc_rec
+    assert lc_rec["tier_hit_tokens"] > 0, lc_rec
+    assert lc_rec["tier_token_parity"] is True, lc_rec
