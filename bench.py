@@ -613,6 +613,9 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
                                  max_prompt_len=hi, max_new_tokens=new,
                                  steps_per_dispatch=k,
                                  prefill_chunk_tokens=chunk,
+                                 attribution=True,  # ISSUE 17: the
+                                 # record proves the cost ledger's
+                                 # conservation on the measured window
                                  **ops_kw).start()
     if ops_kw:
         from paddle_tpu import observability as _obs
@@ -833,6 +836,32 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
         "goodput_ratio": round(st_paged["goodput"]["goodput_ratio"],
                                4),
     }
+    # attribution + capacity (ISSUE 17): the measured window's
+    # per-tenant ledger (all traffic is tenant "default" here) plus
+    # the conservation residuals — zero by construction — and one
+    # fresh pressure snapshot. compare_bench.py treats the per-tenant
+    # breakdowns as non-gating metadata.
+    attr = st_paged["attribution"]
+    cap = psrv.capacity_snapshot()
+    rec_paged.update({
+        "attribution_enabled": attr["enabled"],
+        "tenant_device_s": {t: a["device_s"]
+                            for t, a in attr["tenants"].items()},
+        "tenant_kv_block_s": {t: a["kv_block_s"]
+                              for t, a in attr["tenants"].items()},
+        "tenant_requests": {t: a["requests"]
+                            for t, a in attr["tenants"].items()},
+        "attribution_device_residual_ns":
+            attr["conservation"]["device_residual_ns"],
+        "attribution_block_residual_ns":
+            attr["conservation"]["block_residual_ns"],
+        "capacity_schema_version": cap["schema_version"],
+        "capacity_free_blocks": cap["pool"]["free_blocks"],
+        "capacity_available_blocks": cap["pool"]["available_blocks"],
+        "capacity_queue_depth": cap["queues"]["queue_depth"],
+        "capacity_exhaustion_eta_s":
+            cap["forecast"]["exhaustion_eta_s"],
+    })
     rec_open = {
         "metric": f"{base}_openloop_paged_tokens_per_sec{suffix}",
         "value": round(st_open["tokens_per_sec"], 1),

@@ -116,6 +116,15 @@ class Replica:
         except Exception:  # noqa: BLE001 — placement is advisory
             return 0
 
+    def capacity(self):
+        """This replica's versioned pressure snapshot (ISSUE 17) — the
+        per-replica feed `FleetRouter.capacity()` federates. Raises on
+        a dead replica; the federation layer converts that into the
+        snapshot's `{"error": ...}` slot (dead-source tolerance)."""
+        if self.dead:
+            raise RuntimeError(f"replica {self.name} is dead")
+        return self.server.capacity_snapshot()
+
     def metrics_text(self):
         """This replica's Prometheus page for federation. In-process
         replicas share the process registry (their per-pool series are

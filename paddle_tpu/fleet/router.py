@@ -299,7 +299,9 @@ class FleetRouter:
                 statusz_fn=self.statusz, healthz_fn=self.health,
                 metrics_fn=self.metrics_text,
                 slo_fn=(self.slo_report if self._slo is not None
-                        else None)).start(port=self._expose_port)
+                        else None),
+                capacity_fn=self.capacity).start(
+                    port=self._expose_port)
         return self
 
     def stop(self):
@@ -672,6 +674,17 @@ class FleetRouter:
             _m_state.labels(replica=rep.name).set(
                 _STATE_CODE["not_ready"])
 
+    def capacity(self):
+        """The fleet /capacity endpoint payload (ISSUE 17): every
+        replica's versioned pressure snapshot federated under its
+        name, dead replicas contributing `{"error": ...}` instead of
+        failing the page — the fleet-level ROADMAP-3 Autoscaler
+        input."""
+        from ..observability.capacity import federate_capacity
+
+        return federate_capacity(
+            {rep.name: rep.capacity for rep in self.replicas})
+
     def slo_report(self):
         """The fleet /slo endpoint payload."""
         if self._slo is None:
@@ -795,7 +808,10 @@ class FleetRouter:
         imported = 0
         if payload is not None:
             try:
-                imported = target.server.import_kv_payload(payload)
+                tenant = (ent.get("meta") or {}).get("tenant",
+                                                     "default")
+                imported = target.server.import_kv_payload(
+                    payload, owner=(tenant, rid))
             except Exception as e:  # noqa: BLE001 — pool pressure on
                 # the target: journal replay still completes the
                 # session, just without the zero-recompute warm attach

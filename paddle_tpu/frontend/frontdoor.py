@@ -234,3 +234,15 @@ class FrontDoor:
     def export_timeline(self, path):
         """Write the engine's Chrome/Perfetto timeline (ISSUE 14)."""
         return self.server.export_timeline(path)
+
+    def capacity(self):
+        """The engine's versioned pressure snapshot (ISSUE 17) — pool
+        headroom + exhaustion forecast, tier occupancy, lane/tenant
+        queue depths, shed pressure and SLO burns; also served at the
+        ops endpoint's /capacity."""
+        return self.server.capacity_snapshot()
+
+    def cost_report(self):
+        """The engine's per-tenant `CostReport` billing export
+        (ISSUE 17); None when the engine runs without attribution."""
+        return self.server.cost_report()

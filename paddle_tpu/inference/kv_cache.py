@@ -337,6 +337,20 @@ class PagedKVCache:
         self._tier_bytes_out = 0
         self._tier_bytes_in = 0
         self._tier_hit_tokens = 0
+        #: resource attribution (ISSUE 17): an
+        #: `observability.attribution.ResourceLedger` the engine
+        #: attaches BEFORE the first allocation. Every non-free block
+        #: then carries exactly one (tenant, rid) owner — assigned
+        #: when `_take_blocks` pulls it off the free list, cleared
+        #: only when the block returns there — so per-tenant block
+        #: counts sum to pool occupancy no matter how prefix sharing,
+        #: retention, revival or CoW shuffle the references
+        #: (the publisher keeps paying for shared blocks; attachers
+        #: are credited prefix savings instead).
+        self.ledger = None
+        self._seq_owner: dict[object, tuple] = {}   # seq -> (tenant, rid)
+        self._block_owner: dict[int, tuple] = {}    # block -> (tenant, rid)
+        self._tier_owner: dict[int, tuple] = {}     # hash -> (tenant, bytes)
         if tier is not None:
             self.attach_tier(tier)
 
@@ -412,11 +426,36 @@ class PagedKVCache:
                 f"in this cache (live sequences: {len(self._tables)})"
             ) from None
 
-    def _take_blocks(self, n):
+    def set_seq_owner(self, seq_id, tenant, rid=None):
+        """Register who pays for `seq_id`'s future allocations
+        (attribution, ISSUE 17). The engine calls this at slot install,
+        before the first `ensure_many` growth; unowned sequences charge
+        the "default" tenant. Cleared by `free`."""
+        self._seq_owner[seq_id] = (str(tenant), rid)
+
+    def _ledger_block_freed(self, b):
+        """A block re-entered the free list: close out its ownership."""
+        own = self._block_owner.pop(b, None)
+        if own is not None and self.ledger is not None:
+            self.ledger.block_event(own[0], own[1], -1)
+
+    def _ledger_tier_add(self, h, tenant, nbytes):
+        if self.ledger is None or h in self._tier_owner:
+            return
+        self._tier_owner[h] = (tenant, nbytes)
+        self.ledger.host_bytes_event(tenant, nbytes)
+
+    def _ledger_tier_drop(self, h):
+        own = self._tier_owner.pop(h, None)
+        if own is not None and self.ledger is not None:
+            self.ledger.host_bytes_event(own[0], -own[1])
+
+    def _take_blocks(self, n, owner=None):
         """Pop `n` blocks off the free list (refcount 1 each),
         reclaiming LRU-retained prefix blocks as needed. Callers must
         pre-check availability when they need all-or-nothing semantics
-        (`ensure_many` does)."""
+        (`ensure_many` does). `owner` is the (tenant, rid) pair charged
+        for the blocks while they stay off the free list."""
         while len(self._free) < n and self._retained:
             self._reclaim_lru()
         if n > len(self._free):
@@ -428,6 +467,11 @@ class PagedKVCache:
         taken = [self._free.pop() for _ in range(n)]
         for b in taken:
             self._ref[b] = 1
+        if self.ledger is not None and taken:
+            tenant, rid = owner if owner is not None else ("default", None)
+            for b in taken:
+                self._block_owner[b] = (tenant, rid)
+            self.ledger.block_event(tenant, rid, len(taken))
         used = self.num_blocks - 1 - len(self._free) - len(self._retained)
         self._peak_blocks = max(self._peak_blocks, used)
         return taken
@@ -448,6 +492,7 @@ class PagedKVCache:
                                       len(self._retained))
         else:
             self._free.append(b)
+            self._ledger_block_freed(b)
 
     def _reclaim_lru(self):
         """Evict the least-recently-retained block: drop its index
@@ -461,6 +506,7 @@ class PagedKVCache:
         for h in list(self._block_entries.get(b, ())):
             self._drop_entry(h)
         self._free.append(b)
+        self._ledger_block_freed(b)
         self._evictions += 1
         _m_prefix_evictions.labels(pool=self._name).inc()
 
@@ -473,6 +519,7 @@ class PagedKVCache:
             # move semantics: a hash never lives in both indexes — the
             # freshly written device copy wins over a stale tier copy
             self._tier.drop(h)
+            self._ledger_tier_drop(h)
 
     # ---- host-RAM tier (long-context serving round) -------------------
     def attach_tier(self, tier):
@@ -482,6 +529,9 @@ class PagedKVCache:
         from .kv_tier import normalize_kv_tier
 
         self._tier = normalize_kv_tier(tier)
+        if self._tier is None:
+            for h in list(self._tier_owner):  # forgotten content is
+                self._ledger_tier_drop(h)     # no longer anyone's cost
         self._push_gauges()
         return self._tier
 
@@ -541,16 +591,24 @@ class PagedKVCache:
         index entry on it MOVES to the tier (with an encoded host copy
         of its rows) and the device slot joins the free list."""
         b, _ = self._retained.popitem(last=False)
+        owner = self._block_owner.get(b, ("default", None))
         moved = 0
         nbytes = 0
         for h in list(self._block_entries.get(b, ())):
             _blk, fill, parent = self._index[h]
             kp, vp = self._tier_grab(b, fill)
-            self._tier.put(h, fill, parent, kp, vp)
-            nbytes += self._payload_bytes(kp, vp)
+            evicted = self._tier.put(h, fill, parent, kp, vp)
+            per = self._payload_bytes(kp, vp)
+            nbytes += per
+            # the demoting block's owner keeps paying — now in host
+            # byte-seconds; a capacity eviction ends the old owner's
+            self._ledger_tier_add(h, owner[0], per)
+            for old in evicted:
+                self._ledger_tier_drop(old)
             self._drop_entry(h)
             moved += 1
         self._free.append(b)
+        self._ledger_block_freed(b)
         self._tier_demotions += 1
         self._tier_bytes_out += nbytes
         if _metrics.enabled():
@@ -602,13 +660,19 @@ class PagedKVCache:
             # the device re-published the same hash meanwhile — the
             # device copy wins, the tier copy is redundant
             self._tier.drop(h)
+            self._ledger_tier_drop(h)
             return True
         if self.available_block_count < 1:
             return False
         fill, parent, kp, vp = ent
-        b = self._take_blocks(1)[0]
+        # the promoted device block belongs to whoever paid for the
+        # tier entry (the demoter), not whoever triggered the match
+        own = self._tier_owner.get(h)
+        b = self._take_blocks(
+            1, owner=(own[0], None) if own is not None else None)[0]
         self._tier_install(b, fill, kp, vp)
         self._tier.pop(h)
+        self._ledger_tier_drop(h)
         self._register_entry(h, b, fill, parent)
         self._release_block(b)  # refcount 0 + indexed -> retention MRU
         nbytes = self._payload_bytes(kp, vp)
@@ -786,7 +850,8 @@ class PagedKVCache:
         for (seq_id, n), grow in zip(updates, need):
             table = self._tables.setdefault(seq_id, [])
             if grow:
-                table.extend(self._take_blocks(grow))
+                table.extend(self._take_blocks(
+                    grow, owner=self._seq_owner.get(seq_id)))
             self._lens[seq_id] = max(self._lens.get(seq_id, 0), n)
         self.maybe_demote()    # allocation raised pool pressure
         self._push_gauges()
@@ -804,6 +869,7 @@ class PagedKVCache:
         table = self._get_table(seq_id, "free")
         del self._tables[seq_id]
         del self._lens[seq_id]
+        self._seq_owner.pop(seq_id, None)
         for b in reversed(table):
             self._release_block(b)
         self.maybe_demote()    # retention may have grown past watermark
@@ -1027,7 +1093,8 @@ class PagedKVCache:
         if not shared and not blocking:
             return False               # exclusive + unclaimed rows
         if self.available_block_count >= 1:
-            new = self._take_blocks(1)[0]
+            new = self._take_blocks(
+                1, owner=self._seq_owner.get(seq_id))[0]
             fn = _copy_block_fn(jax.default_backend() not in ("cpu",))
             self.k_blocks, self.v_blocks = fn(
                 self.k_blocks, self.v_blocks, jnp.int32(block),
@@ -1106,7 +1173,7 @@ class PagedKVCache:
             "v": [grab(self.v_blocks, b) for b in blocks],
         }
 
-    def import_prefix(self, payload):
+    def import_prefix(self, payload, owner=None):
         """Install an `export_prefix` payload into THIS pool: allocate
         blocks, write the K/V contents on device, and register the
         chain in the content index exactly as `publish_prefix` would
@@ -1117,7 +1184,9 @@ class PagedKVCache:
         import block returns to the free list. Raises
         BlockPoolExhausted when the pool cannot cover the chain (the
         caller falls back to journal-replay resume) and ValueError on
-        a layout mismatch. Returns the number of tokens published."""
+        a layout mismatch. `owner` is the attribution (tenant, rid)
+        charged for the imported blocks (migration target side).
+        Returns the number of tokens published."""
         import jax
 
         for field in ("block_size", "kv_dtype", "num_layers",
@@ -1133,7 +1202,8 @@ class PagedKVCache:
             raise ValueError(
                 f"import_prefix payload inconsistent: {ids.size} "
                 f"tokens vs fills {fills}")
-        new_blocks = self._take_blocks(len(fills))  # may raise
+        new_blocks = self._take_blocks(len(fills),
+                                       owner=owner)  # may raise
         for b, pk, pv in zip(new_blocks, payload["k"], payload["v"]):
             self.k_blocks = jax.tree.map(
                 lambda a, p, _b=b: a.at[:, _b].set(p),
@@ -1187,6 +1257,23 @@ class PagedKVCache:
         dict (both serving engines sample it every decode round)."""
         used = self.num_blocks - 1 - len(self._free) - len(self._retained)
         return sum(self._lens.values()) / ((used * self.block_size) or 1)
+
+    def headroom(self):
+        """Lightweight capacity view for the pressure sampler (ISSUE
+        17): host-side counters only — no device-array touches, safe
+        at per-round sampling rates."""
+        held = sum(self._lens.values())
+        used = self.num_blocks - 1 - len(self._free) - len(self._retained)
+        return {
+            "num_blocks": self.num_blocks - 1,
+            "used_blocks": used,
+            "free_blocks": len(self._free),
+            "retained_blocks": len(self._retained),
+            "available_blocks": len(self._free) + len(self._retained),
+            "sequences": len(self._tables),
+            "held_tokens": held,
+            "utilization": held / (self.capacity_tokens or 1),
+        }
 
     def stats(self):
         used = self.num_blocks - 1 - len(self._free) - len(self._retained)

@@ -90,19 +90,21 @@ class HostKVTier:
     def put(self, h, fill, parent, k_payload, v_payload):
         """Store one demoted entry; first publisher wins (a duplicate
         hash keeps the resident copy and refreshes its LRU position).
-        Returns the number of entries the capacity LRU evicted."""
+        Returns the list of hashes the capacity LRU evicted (`len()`
+        of it is the old eviction count; the hashes let the owning
+        cache settle per-tenant host-byte attribution)."""
         if h in self._entries:
             self._entries.move_to_end(h)
-            return 0
+            return []
         self._entries[h] = (int(fill), int(parent), k_payload, v_payload)
         fills = self._child_fills.setdefault(int(parent), {})
         fills[int(fill)] = fills.get(int(fill), 0) + 1
-        evicted = 0
+        evicted = []
         while len(self._entries) > self.capacity_blocks:
-            _old, ent = self._entries.popitem(last=False)
+            old, ent = self._entries.popitem(last=False)
             self._unlink_fills(ent[0], ent[1])
             self.evictions += 1
-            evicted += 1
+            evicted.append(old)
         return evicted
 
     def _unlink_fills(self, fill, parent):
@@ -171,6 +173,13 @@ def _leaves(payload):
             out.extend(_leaves(p))
         return out
     return (payload,)
+
+
+def payload_nbytes(payload):
+    """Total bytes of one K/V payload tree (plain ndarrays, nested
+    tuples/lists, or QuantizedKV codes+scales pairs) — the unit the
+    tier residency accounting and the migration wire charge share."""
+    return sum(int(np.asarray(a).nbytes) for a in _leaves(payload))
 
 
 def normalize_kv_tier(kv_tier):

@@ -38,6 +38,9 @@ from ..observability import flight_recorder as _flight
 from ..observability import log as _obs_log
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..observability.attribution import (ResourceLedger,
+                                         disabled_attribution_stats)
+from ..observability.capacity import PressureSignals
 from ..observability.slo import SLO, SLOEngine
 from ..observability.trace_context import TraceContext
 from ..reliability import (AdmissionShed, QuarantinedRequest,
@@ -45,6 +48,7 @@ from ..reliability import (AdmissionShed, QuarantinedRequest,
                            SessionJournal, resolve_fault_plan)
 from ..sampling import SamplingParams
 from .kv_cache import BlockPoolExhausted
+from .kv_tier import payload_nbytes as _payload_nbytes
 
 _logger = _obs_log.get_logger(__name__)
 
@@ -281,7 +285,7 @@ class GenerationServer:
     def __init__(self, program, batch_size=None, prompt_len=None,
                  pad_token_id=0, max_wait_ms=5.0, temperature=0.0,
                  seed=0, eos_token_id=-1, top_p=1.0,
-                 strict_pad_check=False):
+                 strict_pad_check=False, attribution=False):
         self._program = program
         # export_generator artifacts record prompt_len and batch_size
         # (batch_size None = batch-polymorphic: the server picks its own)
@@ -329,6 +333,11 @@ class GenerationServer:
         self._rows = 0
         self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
         self._t0 = None
+        # attribution (ISSUE 17): same ledger class as the paged
+        # server — the dense batcher charges whole-batch device time
+        # apportioned evenly over its rows (rows cost the same at
+        # fixed B by construction)
+        self._ledger = ResourceLedger() if attribution else None
 
     def _req_sig(self, sampling):
         """Program-level parameter signature a batch must share: the
@@ -368,7 +377,7 @@ class GenerationServer:
                 bool(s.stop_token_ids))
 
     # ---- client API ----------------------------------------------------
-    def submit(self, ids, sampling=None):
+    def submit(self, ids, sampling=None, tenant="default"):
         """Enqueue one prompt (list/array of ints, length <= prompt_len).
         Returns a Future resolving to the [prompt_len + new] int32 row.
 
@@ -376,7 +385,9 @@ class GenerationServer:
         (temperature, top_p, seed, eos) per dispatch, so requests are
         batched with same-signature peers; per-slot fields (top_k,
         min_p, penalties, stop strings, per-request budgets) raise
-        eagerly — the paged server supports them."""
+        eagerly — the paged server supports them.
+        tenant: attribution account the request's device time is
+        charged to when the server was built with attribution=True."""
         if sampling is not None and not isinstance(sampling,
                                                    SamplingParams):
             raise TypeError(f"sampling must be a SamplingParams, "
@@ -404,8 +415,11 @@ class GenerationServer:
         row[self.prompt_len - ids.size:] = ids  # LEFT padding
         req = _Req(ids=row, future=Future(), t_submit=time.perf_counter(),
                    padded=ids.size < self.prompt_len,
-                   rid=f"d{next(_req_ids)}", sampling=sampling)
+                   rid=f"d{next(_req_ids)}", sampling=sampling,
+                   meta=RequestMeta(tenant=str(tenant)))
         req.sig = sig
+        if self._ledger is not None:
+            self._ledger.request_begin(req.rid, str(tenant))
         with self._lock:
             if self._stop:
                 raise RuntimeError("server stopped")
@@ -449,6 +463,8 @@ class GenerationServer:
             self._batches_at_reset = self._batches
             self._stop_reasons = dict.fromkeys(STOP_REASONS, 0)
             self._t0 = time.perf_counter()
+        if self._ledger is not None:
+            self._ledger.reset()
 
     def stats(self):
         """Throughput and latency of the current measurement WINDOW —
@@ -473,8 +489,18 @@ class GenerationServer:
                 "p99_ms": pct(0.99) * 1e3,
                 "stop_reasons": dict(self._stop_reasons),
                 "quantization": dict(self._quant_stats),
+                # attribution (ISSUE 17): same schema as the paged
+                # server — zeroed when the ledger is off
+                "attribution": (self._ledger.stats()
+                                if self._ledger is not None
+                                else disabled_attribution_stats()),
                 "wall_s": dt,
             }
+
+    def cost_report(self):
+        """`CostReport` billing export for the current window (ISSUE
+        17); None when the server was built without attribution."""
+        return self._ledger.report() if self._ledger is not None else None
 
     # ---- batcher loop --------------------------------------------------
     def _take_batch(self):
@@ -538,6 +564,7 @@ class GenerationServer:
                 # (identical prompts -> identical completions, forever)
                 defaults[0] = np.uint32(
                     (int(self._defaults[0]) + self._batches) & 0xFFFFFFFF)
+            t_disp = time.perf_counter()
             try:
                 with _tracing.span("decode_dispatch",
                                    request_ids=[r.rid for r in batch],
@@ -549,6 +576,10 @@ class GenerationServer:
                     r.future.set_exception(e)
                 continue
             t_done = time.perf_counter()
+            if self._ledger is not None:
+                self._ledger.charge_device(
+                    int((t_done - t_disp) * 1e9),
+                    [(r.meta.tenant, r.rid, 1) for r in batch])
             new_tokens = out.shape[1] - self.prompt_len
             # stop accounting (schema-congruent with the paged server):
             # the program keeps emitting eos after a hit, so "did any
@@ -569,8 +600,10 @@ class GenerationServer:
                     self._stop_reasons[reasons[i]] += 1
             _m_slots_busy.labels(server="dense").set(0)
             for i, r in enumerate(batch):
+                cost = (self._ledger.request_done(r.rid, new_tokens)
+                        if self._ledger is not None else None)
                 _tracing.event("request_done", request_id=r.rid,
-                               new_tokens=int(new_tokens))
+                               new_tokens=int(new_tokens), cost=cost)
                 _m_requests_done.labels(server="dense").inc()
                 _m_stop_reason.labels(server="dense",
                                       reason=reasons[i]).inc()
@@ -807,7 +840,8 @@ class PagedGenerationServer:
                  unified_round=False, async_rounds=False,
                  expose_port=None, flight_recorder=None,
                  stall_timeout_s=30.0, fault_plan=None, recovery=True,
-                 journal=None, shed_queue_depth=None, slos=None):
+                 journal=None, shed_queue_depth=None, slos=None,
+                 attribution=None):
         import jax
         import jax.numpy as jnp
 
@@ -1167,6 +1201,32 @@ class PagedGenerationServer:
         self.stall_timeout_s = float(stall_timeout_s)
         self._watchdog = None
         self.exporter = None
+        # ---- attribution + capacity (ISSUE 17) -----------------------
+        # attribution: None auto-enables with the ops plane or a live
+        # metrics registry (the cost plane rides the telemetry
+        # opt-in); True/False force. The ledger attaches to the cache
+        # BEFORE any allocation, so block ownership is complete from
+        # block one and the conservation invariants hold exactly.
+        if attribution is None:
+            attribution = expose_port is not None or _metrics.enabled()
+        self._ledger = ResourceLedger() if attribution else None
+        self.cache.ledger = self._ledger
+        self._attr_parts = None  # parts of the dispatch in flight
+        self._wire_mark = None   # decoder wire-byte level before it
+        # deterministic pressure-signal bus: always constructed (one
+        # sample is cheap and pull-only); auto-sampled at round
+        # boundaries only when the telemetry plane is on, and always
+        # sampled fresh by capacity_snapshot() / the /capacity
+        # endpoint. Schema is the ROADMAP-3 Autoscaler contract.
+        self._capacity = PressureSignals({
+            "pool": self._cap_pool,
+            "tier": self._cap_tier,
+            "queues": self._cap_queues,
+            "admission": self._cap_admission,
+            "slo": self._cap_slo,
+        })
+        self._cap_auto = (self._recorder.enabled
+                          or expose_port is not None)
         # tier telemetry: demote/promote land in the flight recorder
         # ring and the trace stream (kv_tier_demote / kv_tier_promote)
         if self.cache.tier is not None:
@@ -1195,7 +1255,9 @@ class PagedGenerationServer:
                 livez_fn=self.liveness,
                 readyz_fn=self.readiness,
                 slo_fn=(self.slo_report if self._slo is not None
-                        else None)).start(port=expose_port)
+                        else None),
+                capacity_fn=self.capacity_snapshot).start(
+                    port=expose_port)
             # pull-time health gauge; like the watchdog heartbeat
             # gauge, it follows the most recently built ops-plane
             # server when several are live
@@ -1238,6 +1300,14 @@ class PagedGenerationServer:
             "compile", program=ev["program"],
             dur_s=round(ev["dur_s"], 4), in_flight=ev["in_flight"],
             shard=ev["shard"])
+        # attribution: an in-window compile is charged to the requests
+        # the triggering dispatch computed for (compile wall time is
+        # INSIDE the measured dispatch time — a parallel annotation,
+        # like the trace assembler's compile_overlap_ms, not a
+        # subtraction from it)
+        if self._ledger is not None and self._attr_parts:
+            self._ledger.charge_compile(int(ev["dur_s"] * 1e9),
+                                        self._attr_parts)
 
     def _on_stall(self):
         self._recorder.record("stall", progress=self._ops_progress,
@@ -1255,6 +1325,109 @@ class PagedGenerationServer:
         else:
             self._recorder.record("kv_tier_promote", **fields)
             _tracing.event("kv_tier_promote", **fields)
+
+    # ---- capacity signals (ISSUE 17) ------------------------------------
+    def _cap_pool(self):
+        return self.cache.headroom()
+
+    def _cap_tier(self):
+        return self.cache._tier_stats()
+
+    def _cap_queues(self):
+        out = {"queue_depth": len(self._queue),
+               "busy_slots": sum(1 for s in self._slots if s is not None),
+               "max_slots": self.max_slots,
+               "lanes": {}, "tenants": {}}
+        sched = self._sched
+        if sched is not None:
+            try:
+                out["queue_depth"] = sched.depth()
+                out["lanes"] = sched.lane_depths()
+                out["tenants"] = sched.tenant_depths()
+            except Exception:  # noqa: BLE001 — a torn-down scheduler
+                pass           # must not poison the snapshot
+        return out
+
+    def _cap_admission(self):
+        info = self._last_error_info
+        return {
+            "sheds": self._sheds,
+            "shed_queue_depth": self._shed_depth,
+            "draining": self._draining,
+            # structured BlockPoolExhausted pressure (r18): how short
+            # the last failed allocation fell — zeroed when healthy
+            "exhaustion_needed": (info or {}).get("needed", 0),
+            "exhaustion_available": (info or {}).get("available", 0),
+        }
+
+    def _cap_slo(self):
+        if self._slo is None:
+            return {"enabled": False, "slos": []}
+        rep = self._slo.report()
+        return {"enabled": True, "worst": rep["worst"],
+                "slos": [{"name": s["name"], "state": s["state"],
+                          "burn_fast": s["burn_fast"],
+                          "burn_slow": s["burn_slow"],
+                          "budget_remaining": s["budget_remaining"]}
+                         for s in rep["slos"]]}
+
+    def capacity_snapshot(self):
+        """One fresh `PressureSignals` snapshot — the `/capacity`
+        endpoint payload and the fleet router's per-replica feed
+        (schema_version 1; the ROADMAP-3 Autoscaler input)."""
+        return self._capacity.sample()
+
+    def _maybe_sample_capacity(self):
+        """Round-boundary auto-sample (telemetry plane on only): a
+        min-interval-gated snapshot recorded into the flight-recorder
+        ring, so stall/exception dumps carry the pressure history."""
+        if not self._cap_auto:
+            return
+        snap = self._capacity.maybe_sample()
+        if snap is None:
+            return
+        pool = snap.get("pool", {})
+        fc = snap.get("forecast", {})
+        self._recorder.record(
+            "capacity_sample",
+            free_blocks=pool.get("free_blocks"),
+            available_blocks=pool.get("available_blocks"),
+            queue_depth=snap.get("queues", {}).get("queue_depth"),
+            exhaustion_eta_s=fc.get("exhaustion_eta_s"))
+
+    # ---- attribution (ISSUE 17) -----------------------------------------
+    def _charge_dispatch(self, dur_s, parts):
+        """Charge one dispatch's wall time to its resident requests
+        and reconcile the collective-wire delta (sharded decode). The
+        same `parts` drove any in-window compile charge — see
+        `_on_compile_event`."""
+        led = self._ledger
+        if led is None or not parts:
+            return
+        led.charge_device(int(dur_s * 1e9), parts)
+        if self._wire_mark is not None:
+            total = self._decoder.wire_stats()["bytes_total"]
+            delta = total - self._wire_mark
+            self._wire_mark = total
+            if delta > 0:
+                led.charge_wire(delta, parts, kind="collective")
+
+    def _attr_begin(self, parts):
+        """Note the dispatch about to run (compile-charge target) and
+        the decoder's wire-byte level before it."""
+        if self._ledger is None:
+            return
+        self._attr_parts = parts
+        if self._decoder.tp_degree > 1:
+            self._wire_mark = self._decoder.wire_stats()["bytes_total"]
+
+    @staticmethod
+    def _cost_parts(pairs):
+        """Apportionment rows [(tenant, rid, weight)] from (req,
+        weight) pairs — weight is the request's share of the dispatch
+        (tokens fed / tokens decoded / drafts verified)."""
+        return [(r.meta.tenant if r.meta is not None else "default",
+                 r.rid, int(w)) for r, w in pairs]
 
     # ---- causal tracing + SLOs (ISSUE 14) -------------------------------
     def _tr(self, req):
@@ -1554,8 +1727,11 @@ class PagedGenerationServer:
                               seq=seq, seam=where, failures=failures,
                               error=f"{type(e).__name__}: {e}",
                               **self._tr(req))
+        cost = (self._ledger.request_done(req.rid)
+                if self._ledger is not None else None)
         _tracing.event("quarantined", request_id=req.rid, slot=i,
-                       seam=where, failures=failures, **self._tr(req))
+                       seam=where, failures=failures, cost=cost,
+                       **self._tr(req))
         self._slo_avail(req, False)
         _logger.error("quarantined request %s after %d consecutive "
                       "failure(s) at seam %s: %s", req.rid, failures,
@@ -1584,6 +1760,8 @@ class PagedGenerationServer:
                     self.cache.free(s["seq"])
                 self._worst.pop(s["seq"], None)
                 self._slo_avail(s["req"], False)
+                if self._ledger is not None:
+                    self._ledger.request_done(s["req"].rid)
                 s["req"].future.set_exception(e)
                 self._slots[i] = None
                 self._sp_store.clear_slot(i)
@@ -1684,8 +1862,11 @@ class PagedGenerationServer:
         self._recorder.record("request_timeout", request_id=req.rid,
                               waited_s=round(now - req.t_submit, 4),
                               timeout_s=req.timeout_s, **self._tr(req))
+        cost = (self._ledger.request_done(req.rid)
+                if self._ledger is not None else None)
         _tracing.event("request_timeout", request_id=req.rid,
-                       waited_s=now - req.t_submit, **self._tr(req))
+                       waited_s=now - req.t_submit, cost=cost,
+                       **self._tr(req))
         self._slo_avail(req, False)
         req.future.set_exception(RequestTimeout(
             req.rid, now - req.t_submit, req.timeout_s))
@@ -1887,8 +2068,20 @@ class PagedGenerationServer:
                     if include_kv and self.enable_prefix_cache:
                         payload = self.cache.export_prefix(
                             req.resume_ids)
+                    if payload is not None and self._ledger is not None:
+                        # migration wire bytes, charged export-side to
+                        # the departing session's tenant
+                        nbytes = (_payload_nbytes(payload["k"])
+                                  + _payload_nbytes(payload["v"]))
+                        self._ledger.charge_wire(
+                            nbytes, self._cost_parts([(req, 1)]),
+                            kind="migration")
                     if self._journal is not None:
                         self._journal.record_done(rid, "migrated")
+                    if self._ledger is not None:
+                        # the session leaves this replica — close its
+                        # per-request view (tenant window totals stay)
+                        self._ledger.request_done(rid)
                     self._recorder.record(
                         "migrate_out", request_id=rid,
                         tokens_done=len(req.gen0),
@@ -1919,21 +2112,25 @@ class PagedGenerationServer:
             ent = SessionJournal.entry_for(req)
             if self._journal is not None:
                 self._journal.record_done(rid, "migrated")
+            if self._ledger is not None:
+                self._ledger.request_done(rid)
             self._recorder.record("migrate_out", request_id=rid,
                                   tokens_done=len(req.gen0),
                                   kv_tokens=0, **self._tr(req))
             return ent, None
         return self.run_host_op(op)
 
-    def import_kv_payload(self, payload):
+    def import_kv_payload(self, payload, owner=None):
         """Planned-migration TARGET hook: install an `export_prefix`
         payload into this server's pool (on the engine thread — see
         `run_host_op`) so the follow-up `admit_journal_entry` attaches
         it instead of re-prefilling. Returns tokens imported; raises
         BlockPoolExhausted when the pool cannot hold the chain (the
-        router then falls back to plain journal replay)."""
+        router then falls back to plain journal replay). `owner` is
+        the attribution (tenant, rid) the imported blocks' residency
+        charges to on THIS replica."""
         return self.run_host_op(
-            lambda: self.cache.import_prefix(payload))
+            lambda: self.cache.import_prefix(payload, owner=owner))
 
     def _build_resume_req(self, ent):
         """One journal entry -> a resume-state `_Req` (bypasses
@@ -2297,6 +2494,11 @@ class PagedGenerationServer:
                 # request's first token record
                 self._journal.record_accept(req)
             self._lock.notify()
+        if self._ledger is not None:
+            # only ADMITTED requests enter the cost ledger (a shed or
+            # bounded-queue reject raised above, nothing enqueued)
+            self._ledger.request_begin(
+                req.rid, meta.tenant if meta is not None else "default")
         self._recorder.record(
             "submit", request_id=req.rid, prompt_len=int(ids.size),
             budget=budget,
@@ -2394,6 +2596,11 @@ class PagedGenerationServer:
                 self._sched.reset_window()
             self._decoder.reset_wire_stats()
             self._t0 = time.perf_counter()
+        if self._ledger is not None:
+            # window accounts zero; occupancy LEVELS carry forward so
+            # both sides of each conservation equation restart at zero
+            self._ledger.reset()
+            self._wire_mark = (None if self._wire_mark is None else 0)
 
     def stats(self):
         """Window stats. ITL (inter-token latency) is per GENERATED
@@ -2578,6 +2785,12 @@ class PagedGenerationServer:
                 "wall_s": dt,
             }
             out["kv_cache"] = self.cache.stats()
+        # per-tenant cost attribution (ISSUE 17): evaluated OUTSIDE
+        # the engine lock (the ledger has its own) — zeroed congruent
+        # schema when attribution is off, reset-coherent
+        out["attribution"] = (self._ledger.stats()
+                              if self._ledger is not None
+                              else disabled_attribution_stats())
         # SLO burn-rate block (ISSUE 14): evaluated OUTSIDE the engine
         # lock (the SLO engine has its own) — schema-stable zeroed
         # shape when the server runs without SLOs
@@ -2587,6 +2800,11 @@ class PagedGenerationServer:
                      if self._slo is not None else []),
         }
         return out
+
+    def cost_report(self):
+        """Frozen per-tenant billing export for the current window
+        (`CostReport`, ISSUE 17); None when attribution is off."""
+        return self._ledger.report() if self._ledger is not None else None
 
     def _sharding_stats(self):
         """The stats()["sharding"] block: the ShardedEngineConfig's
@@ -2684,6 +2902,12 @@ class PagedGenerationServer:
         seq = self._seq_counter
         self._seq_counter += 1
         self._worst[seq] = worst
+        tenant = (req.meta.tenant if req.meta is not None
+                  else "default")
+        if self._ledger is not None:
+            # tag the sequence BEFORE any block is taken: every
+            # _take_blocks under this seq charges this (tenant, rid)
+            self.cache.set_seq_owner(seq, tenant, req.rid)
         prompt = req.resume_ids if req.resume_ids is not None else req.ids
         # prefix caching: attach the longest cached block chain and
         # mark those tokens already-fed — the packed prefill below
@@ -2692,6 +2916,11 @@ class PagedGenerationServer:
         cached = 0
         if self.enable_prefix_cache:
             cached = self.cache.attach_prefix(seq, prompt)
+            if cached and self._ledger is not None:
+                # attacher's saved recompute, credited at the measured
+                # per-token prefill cost (publisher keeps paying the
+                # blocks' residency — single-owner model)
+                self._ledger.credit_prefix(tenant, req.rid, cached)
         # WARM RESUME fast path (round 12): when every context
         # position but the last attached from the cache and at least
         # one token was emitted before the preemption, the slot is
@@ -2946,6 +3175,9 @@ class PagedGenerationServer:
             "prefill_chunk", packed=int(T), rows=len(plan),
             tokens=int(sum(p[2] for p in plan)),
             free_blocks=self.cache.available_block_count)
+        parts = self._cost_parts(
+            [(self._slots[i]["req"], n) for i, _start, n, _o in plan])
+        self._attr_begin(parts)
         t0 = time.perf_counter()
         try:
             with _tracing.span(
@@ -3019,6 +3251,13 @@ class PagedGenerationServer:
                            for i, *_ in plan
                            if self._slots[i] is not None])
         t_now = time.perf_counter()
+        self._charge_dispatch(t_now - t0, parts)
+        if self._ledger is not None:
+            # feed the measured prefill unit cost (EMA) — the rate the
+            # prefix-cache savings credit is priced at
+            self._ledger.note_prefill_cost(
+                int((t_now - t0) * 1e9),
+                int(sum(p[2] for p in plan)))
         self._ops_progress += 1
         if decoding:
             _m_decode_stall.observe(t_now - t0)
@@ -3152,9 +3391,12 @@ class PagedGenerationServer:
             self._recorder.record("request_done", request_id=req.rid,
                                   slot=i, new_tokens=len(slot["toks"]),
                                   reason=reason, **self._tr(req))
+            cost = (self._ledger.request_done(req.rid,
+                                              len(slot["toks"]))
+                    if self._ledger is not None else None)
             _tracing.event("request_done", request_id=req.rid,
                            new_tokens=len(slot["toks"]),
-                           ttft_s=req.ttft, reason=reason,
+                           ttft_s=req.ttft, reason=reason, cost=cost,
                            **self._tr(req))
             self._slo_avail(req, True)
             with _tracing.span("detokenize", request_id=req.rid,
@@ -3231,6 +3473,9 @@ class PagedGenerationServer:
             if self._slo is not None:
                 self._slo_goodput_round()
         _m_round_dispatches.observe(float(n_dispatches))
+        # capacity auto-sampling (ISSUE 17): min-interval gated, so
+        # this is a near-free no-op on almost every round
+        self._maybe_sample_capacity()
 
     def _round_split(self):
         """One scheduler round of the SPLIT path (the pre-r16 loop
@@ -3560,6 +3805,16 @@ class PagedGenerationServer:
             chunk_rows=plan["n_chunk"], step_rows=plan["n_step"],
             proposed=plan["n_drafts"],
             free_blocks=self.cache.available_block_count)
+        # chunk rows weigh their fed tokens, step rows their verify
+        # positions (drafts + the step token) — the same work split
+        # the packed program computes
+        parts = self._cost_parts(
+            [(self._slots[row["slot"]]["req"],
+              row["n"] if row["kind"] == "chunk"
+              else row["drafts"].size + 1) for row in rows])
+        plan["cost_parts"] = parts  # _process_round charges its sync
+        self._attr_begin(parts)     # wait to the same rows
+        t0 = time.perf_counter()
         try:
             with _tracing.span(
                     "round", packed=plan["T"], segments=len(rows),
@@ -3663,6 +3918,12 @@ class PagedGenerationServer:
                            if self._slots[row["slot"]] is not None])
         if self._async:
             self._carry = (nct, ncp, ncs)
+        self._charge_dispatch(time.perf_counter() - t0, parts)
+        if self._ledger is not None and plan["n_chunk"]:
+            chunk_toks = sum(row["n"] for row in rows
+                             if row["kind"] == "chunk")
+            self._ledger.note_prefill_cost(
+                int((time.perf_counter() - t0) * 1e9), chunk_toks)
         self._ops_progress += 1
         # host-deterministic bookkeeping (valid before any sync): fed
         # positions advance, dispatch/mode counters, spec proposals
@@ -3722,10 +3983,15 @@ class PagedGenerationServer:
         was freed since planning (async overshoot past a stop the host
         had not yet seen) are discarded as replay, token-identically
         to the split path."""
+        t_sync0 = time.perf_counter()
         vtok_h = np.asarray(outs[0])
         acc_h = np.asarray(outs[1])
         stop_h = np.asarray(outs[2])
         t_now = time.perf_counter()
+        # async: the asarray above is where the host actually waits on
+        # the device — busy time the dispatch-site charge missed
+        self._charge_dispatch(t_now - t_sync0,
+                              plan.get("cost_parts") or ())
         self._ops_progress += 1
         decoded = 0
         discarded = 0
@@ -3880,6 +4146,10 @@ class PagedGenerationServer:
             "decode_dispatch", slots=len(active_idx), k=k,
             sampled=bool(sp_mode[0]),
             free_blocks=self.cache.available_block_count)
+        parts = self._cost_parts(
+            [(self._slots[i]["req"], k) for i in active_idx])
+        self._attr_begin(parts)
+        t0 = time.perf_counter()
         try:
             with _tracing.span(
                     "decode_dispatch", k=k,
@@ -3927,6 +4197,7 @@ class PagedGenerationServer:
                            for i in active_idx
                            if self._slots[i] is not None])
         t_now = time.perf_counter()
+        self._charge_dispatch(t_now - t0, parts)
         self._ops_progress += 1
         decoded = toks.shape[0] * len(active_idx)
         discarded = 0
@@ -4029,6 +4300,11 @@ class PagedGenerationServer:
             "verify_dispatch", rows=plan.rows, proposed=proposed,
             free_blocks=self.cache.available_block_count)
         P = plan.dlen.shape[0]
+        parts = self._cost_parts(
+            [(self._slots[i]["req"], plan.drafts[r].size + 1)
+             for r, i in enumerate(plan.slots)])
+        self._attr_begin(parts)
+        t0 = time.perf_counter()
         try:
             with _tracing.span(
                     "verify_dispatch", segments=plan.rows,
@@ -4076,6 +4352,7 @@ class PagedGenerationServer:
                            if self._slots[i] is not None])
         _m_spec_verify.inc()
         t_now = time.perf_counter()
+        self._charge_dispatch(t_now - t0, parts)
         self._ops_progress += 1
         verify_discarded = 0
         with self._lock:

@@ -1103,6 +1103,12 @@ class PagedDecoder:
         self._variants = {}
         self._msteps = {}
 
+    @property
+    def tp_degree(self):
+        """Mesh tensor-parallel degree the decoder dispatches over
+        (1 = unsharded: no collective wire, `wire_stats` stays zero)."""
+        return self._tp
+
     def _check_kv(self, kc, vc):
         """Eager dtype-consistency assert (CI/tooling satellite): the
         cache arrays must match the decoder's kv_dtype BEFORE any jit

@@ -38,15 +38,30 @@ Pillars, shared by serving, training, and bench:
   * `timeline` — Chrome/Perfetto trace-event JSON export of the span
     sink + flight-recorder rings, per-replica-per-track
     (`FleetRouter.export_timeline`, `bench.py served --timeline`).
+  * `attribution` — ISSUE 17: per-tenant / per-request cost ledgers
+    with exact integer conservation (device-seconds, KV
+    block-seconds, host byte-seconds, wire bytes, compile time,
+    prefix savings); `serving_tenant_*` metrics,
+    `stats()["attribution"]`, `CostReport.to_json()` billing export.
+  * `capacity` — ISSUE 17: the deterministic `PressureSignals` bus —
+    pool headroom + reclaim trend + exhaustion-ETA forecast, tier
+    occupancy, queue depths, shed/exhaustion pressure and SLO burns
+    in one versioned snapshot (`/capacity` endpoint, federated by
+    the fleet router; the ROADMAP-3 Autoscaler input contract).
 
 One switch turns metrics+tracing on: PADDLE_TPU_TELEMETRY=1 in the
 environment, or `observability.enable()` at runtime.
 """
 from __future__ import annotations
 
+from . import attribution, capacity  # noqa: F401
 from . import compile_tracker, exporter, flight_recorder  # noqa: F401
 from . import log, metrics, slo, timeline, trace_context  # noqa: F401
 from . import tracing  # noqa: F401
+from .attribution import (CostReport, ResourceLedger,  # noqa: F401
+                          apportion, disabled_attribution_stats)
+from .capacity import (PressureSignals,  # noqa: F401
+                       federate_capacity)
 from .exporter import OpsEndpoint  # noqa: F401
 from .flight_recorder import FlightRecorder, StallWatchdog  # noqa: F401
 from .log import get_logger  # noqa: F401

@@ -27,6 +27,11 @@ watchable from outside the process:
     slow burn rates and error-budget accounting; a paging report
     answers 503. Served only when the owner wired an SLO engine
     (engine `slos=` / router `slos=`).
+  * `/capacity` — the versioned `PressureSignals` snapshot (ISSUE 17):
+    pool headroom + exhaustion forecast, tier occupancy, queue
+    depths, shed/exhaustion pressure and SLO burn states — the
+    ROADMAP-3 Autoscaler input. Served only when the owner wired a
+    capacity provider (paged engine / fleet router federation).
 
 Binding is ephemeral-port friendly (`port=0` → the kernel picks; the
 bound port is on `.port`/`.url` after `start()` returns), which is how
@@ -51,7 +56,7 @@ HEALTH_STATES = ("ok", "degraded", "stalled")
 _m_scrapes = _metrics.counter(
     "serving_ops_scrapes_total",
     "ops-endpoint requests served, by endpoint "
-    "(metrics | statusz | healthz | livez | readyz | slo)",
+    "(metrics | statusz | healthz | livez | readyz | slo | capacity)",
     labelnames=("endpoint",))
 
 
@@ -74,11 +79,15 @@ class OpsEndpoint:
         "worst": ok|warn|page, "paging": [...]}) served at /slo —
         answers 200 while worst is ok or warn, 503 on page (the
         load-balancer drain signal); absent -> /slo answers 404.
+    capacity_fn: zero-arg callable returning the versioned capacity
+        snapshot (`observability.capacity.PressureSignals.sample()`
+        shape, or the fleet-federated twin) served at /capacity;
+        absent -> /capacity answers 404.
     """
 
     def __init__(self, registry=None, statusz_fn=None, healthz_fn=None,
                  livez_fn=None, readyz_fn=None, metrics_fn=None,
-                 slo_fn=None):
+                 slo_fn=None, capacity_fn=None):
         self._registry = registry or _metrics.REGISTRY
         self._statusz_fn = statusz_fn
         self._healthz_fn = healthz_fn
@@ -86,6 +95,7 @@ class OpsEndpoint:
         self._readyz_fn = readyz_fn
         self._metrics_fn = metrics_fn
         self._slo_fn = slo_fn
+        self._capacity_fn = capacity_fn
         self._httpd = None
         self._thread = None
         self.port = None
@@ -156,6 +166,12 @@ class OpsEndpoint:
                                 else 200)
                         self._send(code, json.dumps(report),
                                    "application/json")
+                    elif path == "/capacity" \
+                            and endpoint._capacity_fn is not None:
+                        _m_scrapes.labels(endpoint="capacity").inc()
+                        snap = endpoint._capacity_fn()
+                        self._send(200, json.dumps(snap, default=str),
+                                   "application/json")
                     else:
                         paths = ["/metrics", "/statusz", "/healthz"]
                         if endpoint._livez_fn is not None:
@@ -164,6 +180,8 @@ class OpsEndpoint:
                             paths.append("/healthz/ready")
                         if endpoint._slo_fn is not None:
                             paths.append("/slo")
+                        if endpoint._capacity_fn is not None:
+                            paths.append("/capacity")
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
                              "paths": paths}),

@@ -27,7 +27,10 @@ The serving engine emits a small vocabulary per request
                          `chunks`, the dispatches the prompt spanned
     decode_dispatch      span, request_ids=[...] (one batched step for
                          every active slot; k tokens when multi-step)
-    request_done         point event, request_id, new_tokens, ttft_s
+    request_done         point event, request_id, new_tokens, ttft_s,
+                         cost (the request's closed attribution
+                         account, ISSUE 17 — None when attribution
+                         is off)
     detokenize           span, request_id (assemble + resolve future)
 
 `assemble_request_traces` folds that stream back into one record per
@@ -353,6 +356,10 @@ def assemble_request_traces(evs=None, path=None):
             r["new_tokens"] = ev.get("new_tokens")
             if ev.get("ttft_s") is not None:
                 r["ttft_ms"] = ev["ttft_s"] * 1e3
+            if ev.get("cost") is not None:
+                # per-request cost attribution (ISSUE 17): the closed
+                # ledger account the engine attached at completion
+                r["cost"] = ev["cost"]
         elif name == "detokenize" and rid is not None:
             rec(rid)["t_end"] = ev["ts"] + ev.get("dur", 0.0)
         elif name == "compile":
@@ -393,6 +400,9 @@ def assemble_request_traces(evs=None, path=None):
         }
         if "prefill_chunks" in r:  # chunked prefill (paged server)
             out[rid]["prefill_chunks"] = r["prefill_chunks"]
+        if r.get("cost") is not None:  # per-request attribution
+            # account closed at completion (ISSUE 17)
+            out[rid]["cost"] = r["cost"]
         if r.get("preemptions"):  # front door (round 12): the decode
             # phase of a preempted request absorbs its swap-out,
             # requeue wait, and resume re-prefill; requeue_ms says how

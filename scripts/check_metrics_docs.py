@@ -11,6 +11,12 @@ SURVEY.md figures. AST-based on the code side (registration calls are
 a literal first argument, the repo-wide convention), brace-expansion-
 aware on the docs side (`kv_pool_{used,free}_blocks` is two names).
 
+ISSUE 17 extension: the documented LABEL SET must match the
+registered `labelnames=` too — a doc row `name{tenant,kind}` claims
+exactly the labels the registration call declares (value
+enumerations after `=`, e.g. `{reason=eos\\|budget}`, are
+documentation only and not checked).
+
 Exit 0 clean, 1 with the drift listing — wired into tier-1 as
 tests/test_metrics_docs.py.
 """
@@ -70,6 +76,71 @@ def collect_code_metrics(pkg_dir=PKG):
     return out
 
 
+def _literal_labels(node, consts):
+    """A `labelnames=` value -> frozenset of label names: a literal
+    tuple/list of strings, or a module-level NAME bound to one (the
+    kv_cache `_POOL_TIER_LABELS = ("pool", "tier")` convention)."""
+    if isinstance(node, ast.Name):
+        node = consts.get(node.id)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return frozenset(e.value for e in node.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    return None
+
+
+def collect_code_labels(pkg_dir=PKG):
+    """{metric_name: frozenset(labelnames)} for every registration
+    call `collect_code_metrics` sees — the `labelnames=` keyword
+    resolved through module-level constant names (absent -> the
+    empty set)."""
+    out = {}
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read(),
+                                 filename=path)
+            except SyntaxError:
+                continue
+            consts = {}
+            for stmt in tree.body:
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            consts[tgt.id] = stmt.value
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in REGISTER_FNS):
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and _checked(arg.value)):
+                    continue
+                labels = frozenset()
+                for kw in node.keywords:
+                    if kw.arg == "labelnames":
+                        got = _literal_labels(kw.value, consts)
+                        if got is not None:
+                            labels = got
+                out.setdefault(arg.value, labels)
+    return out
+
+
+def _split_label_set(token):
+    """`name{a,b=x\\|y}` -> (`name`, frozenset({a, b})); a token with
+    no trailing brace group carries the empty label set."""
+    m = re.search(r"\{([^{}]*)\}$", token)
+    if m is None:
+        return token, frozenset()
+    labels = frozenset(p.split("=", 1)[0].strip()
+                       for p in m.group(1).split(",") if p.strip())
+    return token[:m.start()], labels
+
+
 def _expand_braces(name):
     """kv_pool_{used,free,retained}_blocks -> the three names."""
     m = re.search(r"\{([^{}]*,[^{}]*)\}", name)
@@ -119,6 +190,34 @@ def collect_doc_metrics(doc_path=DOC):
     return out
 
 
+def collect_doc_labels(doc_path=DOC):
+    """{metric_name: frozenset(label names)} documented in the metric
+    table — the trailing `{...}` group of each first-cell token, value
+    enumerations (`reason=eos\\|budget`) reduced to the label name."""
+    out = {}
+    in_span_section = False
+    for line in open(doc_path, encoding="utf-8"):
+        line = line.strip()
+        if line.startswith(SPAN_DOC_HEADING):
+            in_span_section = True
+            continue
+        if in_span_section and line.startswith("#"):
+            in_span_section = False
+        if in_span_section or not line.startswith("|"):
+            continue
+        cells = re.split(r"(?<!\\)\|", line)
+        first_cell = cells[1] if len(cells) >= 2 else ""
+        for code in re.findall(r"`([^`]+)`", first_cell):
+            for token in re.split(r"[\s,]+(?![^{]*\})", code):
+                base, labels = _split_label_set(token.strip())
+                if not base.startswith(PREFIXES):
+                    continue
+                for name in _expand_braces(base):
+                    if re.fullmatch(r"[a-z0-9_]+", name):
+                        out.setdefault(name, labels)
+    return out
+
+
 def run_check():
     """Returns (errors, code_names, doc_names)."""
     code = collect_code_metrics()
@@ -132,6 +231,23 @@ def run_check():
         errors.append(
             f"docs/OBSERVABILITY.md documents {name!r} but no library "
             f"code registers it")
+    return errors, code, docs
+
+
+def run_label_check():
+    """Returns (errors, code_labels, doc_labels): for every metric
+    both sides know, the documented label set must equal the
+    registered `labelnames` exactly (ISSUE 17 satellite)."""
+    code = collect_code_labels()
+    docs = collect_doc_labels()
+    errors = []
+    for name in sorted(set(code) & set(docs)):
+        if code[name] != docs[name]:
+            errors.append(
+                f"label drift on {name!r}: code registers "
+                f"{{{', '.join(sorted(code[name])) or ''}}} but "
+                f"docs/OBSERVABILITY.md documents "
+                f"{{{', '.join(sorted(docs[name])) or ''}}}")
     return errors, code, docs
 
 
@@ -219,8 +335,9 @@ def run_span_check():
 
 def main():
     errors, code, docs = run_check()
+    label_errors, code_labels, _doc_labels = run_label_check()
     span_errors, spans, span_docs = run_span_check()
-    errors = errors + span_errors
+    errors = errors + label_errors + span_errors
     if errors:
         for e in errors:
             print(e)  # cli-print
@@ -229,8 +346,10 @@ def main():
               f"documented; {len(spans)} spans emitted, "
               f"{len(span_docs)} documented)")
         return 1
+    labeled = sum(1 for ls in code_labels.values() if ls)
     print(f"metrics<->docs in sync: {len(code)} registered "  # cli-print
           f"{PREFIXES} metrics all documented, no stale doc rows; "
+          f"{labeled} label sets verified; "
           f"{len(spans)} span/event names all in the registry")
     return 0
 

@@ -37,6 +37,14 @@ _LOWER_BETTER_PAT = re.compile(
     r"ttft|itl|latency|p50|p90|p99|overhead|stall|_ms\b|_s\b")
 _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "milliseconds"}
 
+# per-tenant attribution breakdowns (ISSUE 17) are workload-mix
+# dependent — a tenant-skew shift between captures is not a perf
+# regression. Axes matching this ride the report as non-gating
+# metadata (the in-record `tenant_*` dict fields are skipped anyway
+# by the numeric-value filter; this covers flattened per-tenant axes
+# a future capture shape might emit).
+_METADATA_PAT = re.compile(r"(?:^|_)tenant_|_by_tenant\b")
+
 
 def lower_is_better(metric, unit=""):
     """Direction of goodness for one bench metric."""
@@ -115,9 +123,13 @@ def compare(old_records, new_records, threshold=DEFAULT_THRESHOLD):
     old = {r["metric"]: r for r in old_records}
     new = {r["metric"]: r for r in new_records}
     report = {"regressions": [], "improvements": [], "unchanged": [],
+              "metadata": [],
               "added": sorted(set(new) - set(old)),
               "removed": sorted(set(old) - set(new))}
     for metric in sorted(set(old) & set(new)):
+        if _METADATA_PAT.search(metric):
+            report["metadata"].append(metric)
+            continue
         try:
             ov = float(old[metric]["value"])
             nv = float(new[metric]["value"])
@@ -161,7 +173,8 @@ def format_report(report, old_path="old", new_path="new",
     lines.append(
         f"  {len(report['unchanged'])} within threshold, "
         f"{len(report['added'])} new axis(es), "
-        f"{len(report['removed'])} retired")
+        f"{len(report['removed'])} retired, "
+        f"{len(report.get('metadata', []))} non-gating metadata")
     return "\n".join(lines)
 
 
@@ -173,6 +186,9 @@ _TINY_OLD = [
     {"metric": "gpt2s_served_ttft_p99_ms", "value": 50.0, "unit": "ms"},
     {"metric": "gpt2s_served_goodput_ratio", "value": 0.95, "unit": ""},
     {"metric": "gpt2s_served_itl_p99_ms", "value": 12.0, "unit": "ms"},
+    # per-tenant attribution axis (ISSUE 17): huge swing, must NOT gate
+    {"metric": "gpt2s_served_tenant_device_s_free", "value": 1.0,
+     "unit": "s"},
     {"metric": "retired_axis", "value": 1.0, "unit": ""},
 ]
 _TINY_NEW = [
@@ -185,6 +201,9 @@ _TINY_NEW = [
     {"metric": "gpt2s_served_goodput_ratio", "value": 0.94, "unit": ""},
     # itl IMPROVED 50% -> not a regression
     {"metric": "gpt2s_served_itl_p99_ms", "value": 6.0, "unit": "ms"},
+    # tenant skew shifted 10x: non-gating metadata, never a regression
+    {"metric": "gpt2s_served_tenant_device_s_free", "value": 10.0,
+     "unit": "s"},
     {"metric": "new_axis", "value": 2.0, "unit": ""},
 ]
 
@@ -204,6 +223,9 @@ def run_tiny():
         == ["gpt2s_served_goodput_ratio"], report["unchanged"]
     assert report["added"] == ["new_axis"]
     assert report["removed"] == ["retired_axis"]
+    # the 10x tenant-skew swing classified as metadata, not regression
+    assert report["metadata"] \
+        == ["gpt2s_served_tenant_device_s_free"], report["metadata"]
     # direction inference sanity
     assert lower_is_better("x_ttft_p99_ms")
     assert lower_is_better("whatever", "ms")

@@ -1,0 +1,285 @@
+"""Capacity pressure-signal bus (ISSUE 17): deterministic sampling on
+an explicit clock, the blocks-exhaustion forecast, dead-source
+tolerance, the engine's `/capacity` ops endpoint, flight-recorder
+`capacity_sample` auto-sampling, and fleet federation with a dead
+replica."""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt2 import GPT2, GPT2Config
+from paddle_tpu.observability.capacity import (SCHEMA_VERSION,
+                                               PressureSignals,
+                                               federate_capacity)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(29)
+    cfg = GPT2Config.tiny()
+    cfg.dropout = 0.0
+    model = GPT2(cfg)
+    model.eval()
+    return model, cfg
+
+
+@pytest.fixture
+def metrics_gate_restore():
+    from paddle_tpu.observability import metrics as M
+
+    was = M.REGISTRY.enabled
+    yield
+    M.REGISTRY.enabled = was
+    M.REGISTRY.reset()
+
+
+def _get(url, timeout=10):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestPressureSignals:
+    def test_deterministic_replay(self):
+        """Same clock sequence + same source readings -> byte-identical
+        snapshot sequences (the TokenBucket discipline)."""
+
+        def run():
+            clk = FakeClock()
+            state = {"free": 100}
+            ps = PressureSignals(
+                {"pool": lambda: {"free_blocks": state["free"]}},
+                min_interval_s=1.0, clock=clk)
+            out = []
+            for step in range(10):
+                clk.t = step * 0.7
+                state["free"] = 100 - 7 * step
+                snap = ps.maybe_sample()
+                if snap is not None:
+                    out.append(json.dumps(snap, sort_keys=True))
+            return out
+
+        a, b = run(), run()
+        assert a == b
+        # 0.7s steps against a 1.0s gate: samples at t=0, 1.4, 2.1...
+        assert 1 < len(a) < 10
+
+    def test_min_interval_gates(self):
+        clk = FakeClock()
+        ps = PressureSignals({"pool": lambda: {"free_blocks": 5}},
+                             min_interval_s=1.0, clock=clk)
+        assert ps.maybe_sample() is not None  # first always samples
+        clk.t = 0.5
+        assert ps.maybe_sample() is None
+        clk.t = 1.0
+        assert ps.maybe_sample() is not None
+        # sample() is unconditional
+        assert ps.sample() is not None
+
+    def test_snapshot_schema_and_counter(self):
+        clk = FakeClock()
+        ps = PressureSignals({"pool": lambda: {"free_blocks": 5},
+                              "extra": lambda: {"x": 1}}, clock=clk)
+        s1 = ps.sample()
+        clk.t = 2.0
+        s2 = ps.sample()
+        assert s1["schema_version"] == SCHEMA_VERSION == 1
+        assert s1["samples"] == 1 and s2["samples"] == 2
+        assert s2["ts"] == 2.0
+        assert s2["extra"] == {"x": 1}
+        assert ps.history_len() == 2
+
+    def test_exhaustion_forecast_linear_drain(self):
+        """free_blocks draining at an exact 10 blocks/s must forecast
+        slope -10 and ETA free/10."""
+        clk = FakeClock()
+        free = {"v": 200}
+        ps = PressureSignals({"pool": lambda: {"free_blocks": free["v"]}},
+                             clock=clk)
+        for step in range(5):
+            clk.t = float(step)
+            free["v"] = 200 - 10 * step
+            snap = ps.sample()
+        fc = snap["forecast"]
+        assert fc["free_blocks_slope_per_s"] == pytest.approx(-10.0)
+        # last reading 160 blocks at 10 blocks/s -> 16 s to the wall
+        assert fc["exhaustion_eta_s"] == pytest.approx(16.0)
+        assert fc["window_samples"] == 5
+
+    def test_no_eta_when_refilling_or_flat(self):
+        clk = FakeClock()
+        free = {"v": 10}
+        ps = PressureSignals({"pool": lambda: {"free_blocks": free["v"]}},
+                             clock=clk)
+        for step in range(4):
+            clk.t = float(step)
+            free["v"] = 10 + step  # refilling
+            snap = ps.sample()
+        assert snap["forecast"]["exhaustion_eta_s"] is None
+
+    def test_dead_source_tolerance(self):
+        def boom():
+            raise RuntimeError("pool gone")
+
+        ps = PressureSignals({"pool": boom,
+                              "queues": lambda: {"queue_depth": 1}},
+                             clock=FakeClock())
+        snap = ps.sample()
+        assert "RuntimeError" in snap["pool"]["error"]
+        assert snap["queues"] == {"queue_depth": 1}  # unaffected
+        # a dead pool source can't feed the forecast either
+        assert snap["forecast"]["free_blocks_slope_per_s"] is None
+
+    def test_federate_with_dead_source(self):
+        def dead():
+            raise RuntimeError("replica killed")
+
+        fed = federate_capacity(
+            {"a": lambda: {"schema_version": 1, "pool": {}},
+             "b": dead})
+        assert fed["schema_version"] == SCHEMA_VERSION
+        assert fed["replicas"]["a"]["pool"] == {}
+        assert "RuntimeError" in fed["replicas"]["b"]["error"]
+
+
+class TestEngineCapacity:
+    def test_capacity_snapshot_schema(self, tiny_model):
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        srv = PagedGenerationServer(model, max_slots=2, block_size=4,
+                                    max_prompt_len=16,
+                                    max_new_tokens=3).start()
+        try:
+            rs = np.random.RandomState(1)
+            p = rs.randint(1, cfg.vocab_size, (6,)).astype(np.int32)
+            srv.submit(p).result(timeout=300)
+            snap = srv.capacity_snapshot()
+            assert snap["schema_version"] == 1
+            for slot in ("pool", "tier", "queues", "admission", "slo",
+                         "forecast"):
+                assert slot in snap, sorted(snap)
+            pool = snap["pool"]
+            assert pool["num_blocks"] > 0
+            assert pool["free_blocks"] + pool["used_blocks"] \
+                + pool["retained_blocks"] == pool["num_blocks"]
+            q = snap["queues"]
+            assert q["queue_depth"] == 0 and q["max_slots"] == 2
+            assert snap["admission"]["sheds"] == 0
+            assert snap["slo"]["enabled"] is False
+            assert json.loads(json.dumps(snap))  # JSON-able
+        finally:
+            srv.stop()
+
+    def test_capacity_endpoint_and_ring_samples(
+            self, tiny_model, metrics_gate_restore):
+        """/capacity answers the federable snapshot; with the ops
+        plane on, decode rounds land min-interval-gated
+        `capacity_sample` entries in the flight-recorder ring."""
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+        srv = PagedGenerationServer(model, max_slots=2, block_size=4,
+                                    max_prompt_len=16,
+                                    max_new_tokens=4,
+                                    expose_port=0).start()
+        try:
+            rs = np.random.RandomState(2)
+            futs = [srv.submit(rs.randint(1, cfg.vocab_size, (n,))
+                               .astype(np.int32))
+                    for n in (3, 7, 5)]
+            for f in futs:
+                f.result(timeout=300)
+            code, body = _get(srv.exporter.url + "/capacity")
+            assert code == 200, body
+            snap = json.loads(body)
+            assert snap["schema_version"] == 1
+            assert snap["pool"]["num_blocks"] > 0
+            # the 404 page advertises the path
+            code, body = _get(srv.exporter.url + "/nope")
+            assert code == 404 and "/capacity" in body
+            # round-boundary auto-sampling into the ring
+            dump = srv.dump_flight_recorder()
+            caps = [e for e in dump["events"]
+                    if e["name"] == "capacity_sample"]
+            assert caps, [e["name"] for e in dump["events"]][:20]
+            assert caps[0]["free_blocks"] is not None
+        finally:
+            srv.stop()
+
+    def test_endpoint_404_without_capacity_fn(self):
+        from paddle_tpu.observability.exporter import OpsEndpoint
+
+        ep = OpsEndpoint().start(port=0)
+        try:
+            code, body = _get(ep.url + "/capacity")
+            assert code == 404
+            assert "/capacity" not in json.loads(body)["paths"]
+        finally:
+            ep.stop()
+
+    def test_frontdoor_passthrough(self, tiny_model):
+        from paddle_tpu.frontend import FrontDoor
+
+        model, cfg = tiny_model
+        fd = FrontDoor(model, max_slots=1, block_size=4,
+                       max_prompt_len=16, max_new_tokens=2)
+        fd.start()
+        try:
+            snap = fd.capacity()
+            assert snap["schema_version"] == 1
+            # the front-door scheduler's lane/tenant depths surface
+            assert "lanes" in snap["queues"]
+        finally:
+            fd.stop()
+
+
+class TestFleetCapacity:
+    def test_federated_snapshot_tolerates_dead_replica(self,
+                                                       tiny_model):
+        from paddle_tpu.fleet import FleetRouter, Replica
+        from paddle_tpu.inference import PagedGenerationServer
+
+        model, cfg = tiny_model
+
+        def mk():
+            return PagedGenerationServer(model, max_slots=1,
+                                         block_size=4,
+                                         max_prompt_len=16,
+                                         max_new_tokens=2)
+
+        router = FleetRouter([Replica("r0", mk()),
+                              Replica("r1", mk())])
+        router.start()
+        try:
+            rs = np.random.RandomState(4)
+            p = rs.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+            router.submit(p).result(timeout=300)
+            fed = router.capacity()
+            assert fed["schema_version"] == 1
+            assert set(fed["replicas"]) == {"r0", "r1"}
+            for snap in fed["replicas"].values():
+                assert snap["schema_version"] == 1
+            # kill one replica: its slot degrades to an error entry,
+            # the survivor still answers (dead-source tolerance)
+            router.replicas[1].kill()
+            fed = router.capacity()
+            assert fed["replicas"]["r0"]["schema_version"] == 1
+            assert "error" in fed["replicas"]["r1"]
+            assert "dead" in fed["replicas"]["r1"]["error"]
+        finally:
+            router.stop()

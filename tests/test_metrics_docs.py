@@ -55,6 +55,35 @@ def test_spans_and_docs_in_sync():
     assert len(docs) >= 30, sorted(docs)
 
 
+def test_labels_and_docs_in_sync():
+    """ISSUE 17 satellite: documented label sets (the `{a,b=x|y}`
+    suffix on a metric-table row) match the `labelnames=` each metric
+    is registered with — name-level sync alone would let a renamed or
+    dropped label drift silently."""
+    mod = _load()
+    errors, code, docs = mod.run_label_check()
+    assert not errors, "\n".join(errors)
+    assert len(set(code) & set(docs)) >= 40, (len(code), len(docs))
+
+
+def test_label_scan_sees_known_anchors():
+    mod = _load()
+    code = mod.collect_code_labels()
+    docs = mod.collect_doc_labels()
+    for name, labels in (
+            ("serving_tenant_wire_bytes_total", {"tenant", "kind"}),
+            ("serving_tenant_device_seconds_total", {"tenant"}),
+            ("kv_pool_used_blocks", {"pool", "tier"}),  # via module
+            # constant labelnames=_POOL_TIER_LABELS — the Name-
+            # resolution path, not a literal tuple
+            ("serving_collective_bytes_total",
+             {"collective", "dtype"}),
+            ("serving_requests_total", {"server"}),
+            ("serving_ttft_seconds", frozenset())):
+        assert code.get(name) == frozenset(labels), (name, code.get(name))
+        assert docs.get(name) == frozenset(labels), (name, docs.get(name))
+
+
 def test_span_scan_sees_known_anchors():
     mod = _load()
     code = mod.collect_code_spans()

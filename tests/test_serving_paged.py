@@ -497,6 +497,21 @@ def test_served_bench_openloop_tiny_schema():
         assert "compiles_in_window" in rec, rec
         assert "compiles_in_flight_window" in rec, rec
         assert 0 < rec["goodput_ratio"] <= 1.0, rec
+    # attribution + capacity (ISSUE 17): the paged record carries the
+    # per-tenant ledger view with ZERO conservation residuals (the
+    # ledger's exactness proven on the bench workload, not just unit
+    # inputs) plus one capacity snapshot's headline fields
+    assert paged["attribution_enabled"] is True, paged
+    assert paged["tenant_requests"].get("default", 0) >= 1, paged
+    assert paged["tenant_device_s"]["default"] > 0, paged
+    assert paged["attribution_device_residual_ns"] == 0, paged
+    assert paged["attribution_block_residual_ns"] == 0, paged
+    assert paged["capacity_schema_version"] == 1, paged
+    assert paged["capacity_free_blocks"] >= 0, paged
+    assert paged["capacity_available_blocks"] \
+        >= paged["capacity_free_blocks"], paged
+    assert "capacity_queue_depth" in paged, paged
+    assert "capacity_exhaustion_eta_s" in paged, paged
     # mixed-sampling axis (round 10): fixed-seed 50/50 workload whose
     # record carries the pipeline-overhead fields
     for fld in ("sampling_overhead_pct", "sampled_fraction",
