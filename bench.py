@@ -1278,6 +1278,24 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
         # md5 proof: identical token streams at every sp degree
         "token_parity": len(lc_sigs) == 1,
         "parity_md5": lc1["token_sig"],
+        # ---- sp_attention A/B (ISSUE 18): the highest-sp worker runs
+        # the SAME prompts again through the memory-flat ring exchange.
+        # peak bytes = the engine's per-dispatch fresh-K/V gauge; the
+        # ratio is the memory the all-gather materializes beyond ring's
+        # O(block) rotating window (grows with chunk length; flat for
+        # ring). Token parity proves the exchange rewrite is exact.
+        "sp_attention_modes": ["allgather", "ring"],
+        "sp_attention_peak_bytes_allgather":
+            lc_hi["sp_ab"]["allgather_peak_bytes"],
+        "sp_attention_peak_bytes_ring":
+            lc_hi["sp_ab"]["ring_peak_bytes"],
+        "sp_attention_peak_bytes_ratio": round(
+            lc_hi["sp_ab"]["allgather_peak_bytes"]
+            / max(lc_hi["sp_ab"]["ring_peak_bytes"], 1), 3),
+        "ttft_p50_ms_ring": round(
+            lc_hi["sp_ab"]["ring_ttft_p50_ms"], 2),
+        "sp_attention_token_parity":
+            lc_hi["sp_ab"]["ring_token_sig"] == lc_hi["token_sig"],
         # ---- host-RAM KV tier half: long-context session capacity.
         # "sessions at the ITL bar" = sessions whose history stays
         # RESIDENT (device or host tier), so a resume re-attaches the
@@ -1313,6 +1331,24 @@ def _bench_served(on_tpu, telemetry=False, tiny=False,
         "tier_hit_tokens": lc_tier["hit_tokens"],
         # tier ON streams byte-identical to tier OFF on the resumes
         "tier_token_parity": lc_tier["sig_on"] == lc_tier["sig_off"],
+        # ---- tier prefetch-ahead A/B (ISSUE 18): queued-behind-busy
+        # resumes, promote overlapped with the occupier's rounds vs
+        # paid synchronously at admission (same fixed-seed busy work)
+        "resume_ttft_p50_ms_tier_prefetch":
+            round(lc_tier["resume_ttft_p50_ms_prefetch"], 2),
+        "resume_ttft_p50_ms_tier_sync":
+            round(lc_tier["resume_ttft_p50_ms_sync"], 2),
+        "tier_prefetch_hit_rate":
+            round(lc_tier["prefetch"]["hit_rate"], 3),
+        "tier_prefetch_issued_blocks":
+            lc_tier["prefetch"]["issued_blocks"],
+        "tier_prefetch_wasted_blocks":
+            lc_tier["prefetch"]["wasted_blocks"],
+        "tier_prefetch_overlap_promote_s":
+            round(lc_tier["prefetch"]["overlap_promote_s"], 4),
+        "tier_prefetch_token_parity":
+            lc_tier["sig_prefetch"] == lc_tier["sig_sync"]
+            == lc_tier["sig_on"],
         "n_sessions": lc_tier["n_sessions"],
         # schema-congruence fields shared by every served record
         "tokens_per_sec": round(lc_hi["tokens_per_sec"], 1),
@@ -2216,6 +2252,46 @@ def _longctx_tier_probe(model, cfg, tiny):
 
     off = run(None)
     on = run(HostKVTier(capacity_blocks=64, watermark=0.5))
+
+    def run_queued(prefetch):
+        """Prefetch A/B half (ISSUE 18): the same churned resumes, but
+        each resume is submitted while a short busy request still
+        occupies the single slot — the round the engine is computing
+        IS the window the tier prefetch-ahead promotes into. Sync
+        (prefetch off) pays the promote at admission instead; the busy
+        work is fixed-seed identical either way, so the resume-wall
+        delta is exactly the promote cost hidden vs exposed."""
+        srv = PagedGenerationServer(
+            model, max_slots=1, block_size=bs, max_prompt_len=64,
+            max_new_tokens=new, prefill_chunk_tokens=chunk,
+            num_blocks=nb, enable_prefix_cache=True, kv_dtype="int8",
+            kv_tier=HostKVTier(capacity_blocks=64, watermark=0.5),
+            tier_prefetch=(True if prefetch else None),
+            temperature=0.0).start()
+        try:
+            turn1 = [np.asarray(srv.submit(h).result(timeout=600))
+                     for h in histories]
+            srv.reset_stats()
+            t_res, outs = [], []
+            for i in range(n_sess):
+                p = np.concatenate([turn1[i], tails[i]])
+                busy = srv.submit(tails[(i + 1) % n_sess])
+                t0 = _time.perf_counter()
+                fut = srv.submit(p)
+                busy.result(timeout=600)
+                outs.append(np.asarray(fut.result(timeout=600)))
+                t_res.append((_time.perf_counter() - t0) * 1e3)
+            st = srv.stats()
+        finally:
+            srv.stop()
+        sig = hashlib.md5(
+            b"|".join(o.astype(np.int64).tobytes()
+                      for o in outs)).hexdigest()
+        return {"resume_ms": sorted(t_res), "sig": sig,
+                "prefetch": st["tier_prefetch"]}
+
+    pf_sync = run_queued(False)
+    pf_on = run_queued(True)
     # reservation-backed capacity at FIXED per-device pool bytes: a
     # session is "at the ITL bar" when its history is resident
     # (device or host), so a resume re-attaches instead of recomputing
@@ -2241,6 +2317,12 @@ def _longctx_tier_probe(model, cfg, tiny):
         "promotions": on["tier"]["promotions"],
         "hit_tokens": on["tier"]["hit_tokens"],
         "sig_on": on["sig"], "sig_off": off["sig"],
+        "resume_ttft_p50_ms_prefetch":
+            pf_on["resume_ms"][len(pf_on["resume_ms"]) // 2],
+        "resume_ttft_p50_ms_sync":
+            pf_sync["resume_ms"][len(pf_sync["resume_ms"]) // 2],
+        "prefetch": pf_on["prefetch"],
+        "sig_prefetch": pf_on["sig"], "sig_sync": pf_sync["sig"],
         "pool_budget_bytes": budget,
         "host_budget_bytes": host_x * budget,
         "sessions_at_bar_on": int(resident_on),
@@ -2276,44 +2358,66 @@ def _served_longctx_worker(sp, tiny):
     model = GPT2(cfg)
     model.eval()
     sp = int(sp)
-    sharding = ShardedEngineConfig(sp=sp) if sp > 1 else None
     rng = np.random.RandomState(17)
     n_req = 3 if tiny else 6
     lens = [int(rng.randint(72, 96)) for _ in range(n_req)]
     prompts = [rng.randint(1, cfg.vocab_size, (n,)).astype(np.int32)
                for n in lens]
     new, bs, chunk = 8, 8, 16
-    srv = PagedGenerationServer(
-        model, max_slots=2, block_size=bs, max_prompt_len=112,
-        max_new_tokens=new, prefill_chunk_tokens=chunk, num_blocks=64,
-        sharding=sharding, temperature=0.0).start()
-    try:
-        def drain(ttfts=None):
-            outs = []
-            for p in prompts:  # sequential: TTFT is pure prefill
-                first = []
 
-                def on_tok(_tok, _reason, first=first):
-                    if not first:
-                        first.append(_time.perf_counter())
-                t0 = _time.perf_counter()
-                outs.append(srv.submit(p, on_token=on_tok)
-                            .result(timeout=600))
-                if ttfts is not None:
-                    ttfts.append((first[0] - t0) * 1e3)
-            return outs
+    def measure(sp_attention):
+        """One server at this sp degree with the given fresh-K/V
+        exchange mode; returns (sorted ttfts, md5 sig, stats)."""
+        sharding = (ShardedEngineConfig(sp=sp, sp_attention=sp_attention)
+                    if sp > 1 else None)
+        srv = PagedGenerationServer(
+            model, max_slots=2, block_size=bs, max_prompt_len=112,
+            max_new_tokens=new, prefill_chunk_tokens=chunk,
+            num_blocks=64, sharding=sharding, temperature=0.0).start()
+        try:
+            def drain(ttfts=None):
+                outs = []
+                for p in prompts:  # sequential: TTFT is pure prefill
+                    first = []
 
-        drain()  # warm/compile pass
-        srv.reset_stats()
-        ttfts = []
-        outs = drain(ttfts)
-        st = srv.stats()
-    finally:
-        srv.stop()
-    sig = hashlib.md5(
-        b"|".join(np.asarray(o, np.int64).tobytes()
-                  for o in outs)).hexdigest()
-    ttfts.sort()
+                    def on_tok(_tok, _reason, first=first):
+                        if not first:
+                            first.append(_time.perf_counter())
+                    t0 = _time.perf_counter()
+                    outs.append(srv.submit(p, on_token=on_tok)
+                                .result(timeout=600))
+                    if ttfts is not None:
+                        ttfts.append((first[0] - t0) * 1e3)
+                return outs
+
+            drain()  # warm/compile pass
+            srv.reset_stats()
+            ttfts = []
+            outs = drain(ttfts)
+            st = srv.stats()
+        finally:
+            srv.stop()
+        sig = hashlib.md5(
+            b"|".join(np.asarray(o, np.int64).tobytes()
+                      for o in outs)).hexdigest()
+        ttfts.sort()
+        return ttfts, sig, st
+
+    ttfts, sig, st = measure("allgather")
+    # sp_attention A/B (ISSUE 18): the SAME prompts through the
+    # memory-flat ring exchange — token parity + the peak fresh-K/V
+    # bytes both modes report through the engine's per-dispatch gauge
+    sp_ab = None
+    if sp > 1:
+        r_tt, r_sig, r_st = measure("ring")
+        sp_ab = {
+            "ring_ttft_p50_ms": r_tt[len(r_tt) // 2],
+            "ring_token_sig": r_sig,
+            "ring_peak_bytes":
+                r_st["sharding"]["sp_attention_bytes_peak"],
+            "allgather_peak_bytes":
+                st["sharding"]["sp_attention_bytes_peak"],
+        }
     tier = _longctx_tier_probe(model, cfg, tiny) if sp == 1 else None
     print(json.dumps({
         "sp": sp, "prompt_tokens": lens,
@@ -2326,6 +2430,7 @@ def _served_longctx_worker(sp, tiny):
         "prefill_dispatches": st["prefill_dispatches"],
         "token_sig": sig,
         "sharding": st["sharding"],
+        "sp_ab": sp_ab,
         "tier": tier,
     }))
 

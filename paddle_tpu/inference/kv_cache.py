@@ -80,6 +80,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import itertools
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -651,19 +652,21 @@ class PagedKVCache:
     def _promote_entry(self, h):
         """Pull one tier entry back into a device block: allocate,
         decode the payload in, register + park in retention (MRU) so
-        the caller's chain walk claims it. Returns False when the
+        the caller's chain walk claims it. Returns the promoted
+        payload bytes (0 when the device re-published the hash
+        meanwhile and the chain walk just continues), or None when the
         entry is gone or no device block is obtainable."""
         ent = self._tier.get(h)
         if ent is None:
-            return False
+            return None
         if h in self._index:
             # the device re-published the same hash meanwhile — the
             # device copy wins, the tier copy is redundant
             self._tier.drop(h)
             self._ledger_tier_drop(h)
-            return True
+            return 0
         if self.available_block_count < 1:
-            return False
+            return None
         fill, parent, kp, vp = ent
         # the promoted device block belongs to whoever paid for the
         # tier entry (the demoter), not whoever triggered the match
@@ -685,14 +688,26 @@ class PagedKVCache:
         cb = self.on_tier_event
         if cb is not None:
             cb("promote", block=b, tokens=fill, bytes=nbytes)
-        return True
+        return nbytes
 
-    def _promote_for(self, ids, max_match):
+    def _promote_for(self, ids, max_match, limit_blocks=None,
+                     overlapped=False, collect=None):
         """Prefetch-on-match: walk the DEVICE chain along `ids` to its
         end, then continue the walk through the TIER index, promoting
         each tiered entry back into the device pool so the subsequent
         `_match_chain` (and the attach claim on top of it) sees one
-        unbroken device chain. Returns tokens promoted."""
+        unbroken device chain. Returns tokens promoted.
+
+        The tier half of the walk is TIMED and, when it promoted
+        anything, reported as ONE aggregated `tier_promote` callback
+        event (blocks/tokens/bytes/dur_s/overlapped) — the serving
+        layer turns it into its own trace event so promotion wall time
+        never hides inside the admission span (the per-entry `promote`
+        events are kept for block-level forensics).  `limit_blocks`
+        bounds how many device blocks one walk may consume (the
+        prefetch tick's anti-thrash budget); `overlapped=True` marks a
+        prefetch-ahead walk riding the async round window; `collect`
+        (a list) receives the chain hashes actually promoted."""
         if self._tier is None or not len(self._tier):
             return 0
         n = int(ids.size)
@@ -721,7 +736,12 @@ class PagedKVCache:
                 return 0       # partial block ends the chain for good
             h = hh
         promoted_tokens = 0
+        blocks = 0
+        nbytes = 0
+        t0 = time.perf_counter()
         while pos < max_match:
+            if limit_blocks is not None and blocks >= int(limit_blocks):
+                break
             cand = self._tier.child_fills(h)
             hit = None
             if cand:
@@ -736,14 +756,27 @@ class PagedKVCache:
             if hit is None:
                 break
             hh, f = hit
-            if not self._promote_entry(hh):
+            nb = self._promote_entry(hh)
+            if nb is None:
                 break          # pool full — serve what promoted so far
+            if nb > 0:
+                blocks += 1
+                nbytes += nb
+                if collect is not None:
+                    collect.append(hh)
             use = min(f, max_match - pos)
             promoted_tokens += use
             pos += use
             if f < self.block_size or use < f:
                 break
             h = hh
+        if blocks:
+            cb = self.on_tier_event
+            if cb is not None:
+                cb("tier_promote", blocks=blocks,
+                   tokens=promoted_tokens, bytes=nbytes,
+                   dur_s=time.perf_counter() - t0,
+                   overlapped=bool(overlapped))
         if promoted_tokens:
             self._tier_hit_tokens += promoted_tokens
             if _metrics.enabled():
@@ -751,6 +784,32 @@ class PagedKVCache:
                     promoted_tokens)
             self._push_gauges()
         return promoted_tokens
+
+    def prefetch_promote(self, ids, limit_blocks=None):
+        """Tier prefetch-ahead (serving round): promote the tiered
+        chain tail for `ids` NOW, while the current round's dispatch
+        computes, so a later `attach_prefix` for the same stream finds
+        the chain already device-resident and pays no promotion wall
+        time.  The `_tier_install` writes dispatch asynchronously —
+        host→device copies overlap whatever the device is running.
+        `limit_blocks` caps the device blocks one call may consume.
+        Returns (hashes, tokens, bytes) of what was actually promoted;
+        content-identical to the synchronous attach-time promote (the
+        same MOVE-semantics walk), so a prefetch that never lands is
+        only a wasted copy, never a wrong one."""
+        ids = np.asarray(ids).reshape(-1)
+        hashes: list = []
+        before = self._tier_bytes_in
+        tokens = self._promote_for(
+            ids, int(ids.size) - 1, limit_blocks=limit_blocks,
+            overlapped=True, collect=hashes)
+        return hashes, tokens, self._tier_bytes_in - before
+
+    def device_resident_count(self, hashes):
+        """How many of `hashes` are device-index-resident right now —
+        the prefetch settlement probe (hit = a prefetched block still
+        resident when its session is admitted)."""
+        return sum(1 for h in hashes if h in self._index)
 
     def _drop_entry(self, h):
         block, fill, parent = self._index.pop(h)

@@ -205,6 +205,33 @@ _m_timeouts = _metrics.counter(
     "requests cancelled by their per-request timeout_s (queued or "
     "resident; the slot and its blocks are freed, the stream "
     "terminates with reason='timeout')")
+# Memory-flat long-context round: sequence-parallel attention byte
+# accounting + KV-tier prefetch-ahead.
+_m_sp_peak_bytes = _metrics.gauge(
+    "serving_sp_attention_bytes_peak",
+    "peak per-shard cross-shard fresh-K/V bytes any packed-prefill "
+    "dispatch of this server materialized (analytic accounting from "
+    "serving_dist.sp_attention — linear in chunk length for "
+    "'allgather', flat O(block) for 'ring'/'ulysses'; 0 when sp<=1)")
+_m_prefetch_issued = _metrics.counter(
+    "kv_tier_prefetch_issued_total",
+    "host-tier blocks promoted AHEAD of admission by the prefetch "
+    "loop, overlapped with the in-flight round's device execution")
+_m_prefetch_hit = _metrics.counter(
+    "kv_tier_prefetch_hit_total",
+    "prefetched tier blocks still device-resident when their request "
+    "was admitted — promotion wall time the admission path never paid")
+_m_prefetch_wasted = _metrics.counter(
+    "kv_tier_prefetch_wasted_total",
+    "prefetched tier blocks whose request left the queue unadmitted "
+    "(timeout/stop) or that pool pressure reclaimed before admission")
+_m_promote_overlap = _metrics.histogram(
+    "kv_tier_promote_overlap_seconds",
+    "wall time of overlapped (prefetch-ahead) tier promote batches — "
+    "host copy time hidden behind device execution instead of being "
+    "charged to the admission path",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.5))
 _req_ids = itertools.count()
 
 STOP_REASONS = ("eos", "stop_token", "stop_string", "budget")
@@ -841,7 +868,7 @@ class PagedGenerationServer:
                  expose_port=None, flight_recorder=None,
                  stall_timeout_s=30.0, fault_plan=None, recovery=True,
                  journal=None, shed_queue_depth=None, slos=None,
-                 attribution=None):
+                 attribution=None, tier_prefetch=None):
         import jax
         import jax.numpy as jnp
 
@@ -883,6 +910,13 @@ class PagedGenerationServer:
         # serializing through a single replica's budget. sp=1 (or
         # unsharded) keeps the exact pre-round budget and programs.
         self._sp_degree = sharding.sp if sharding is not None else 1
+        # sp attention strategy (memory-flat long-context round): how
+        # the sp>1 packed-prefill trunk attends across shards —
+        # "allgather" (exact r21 seam, linear peak bytes) or the
+        # memory-flat "ring"/"ulysses" modes (config-validated and
+        # sp=1-normalized by ShardedEngineConfig itself)
+        self._sp_attention = (sharding.sp_attention
+                              if sharding is not None else "allgather")
         self._spec_k = (speculation.max_draft_tokens
                         if speculation is not None else 0)
         self._drafter = (speculation.make_drafter()
@@ -1000,6 +1034,35 @@ class PagedGenerationServer:
             raise ValueError(
                 "kv_tier requires enable_prefix_cache=True (the tier "
                 "holds demoted prefix-index content)")
+        # tier prefetch-ahead (memory-flat long-context round): promote
+        # a QUEUED request's cold tier blocks into the device pool
+        # WHILE the current round computes, so admission's
+        # attach_prefix finds the chain device-resident and pays no
+        # promotion wall time. True -> lookahead 2 queued requests; an
+        # int sets the lookahead depth. None/False = OFF (the exact
+        # synchronous promote-on-attach path).
+        if tier_prefetch is not None and tier_prefetch is not False:
+            if kv_tier is None or kv_tier is False:
+                raise ValueError(
+                    "tier_prefetch requires kv_tier (prefetch-ahead "
+                    "promotes host-tier content ahead of admission; "
+                    "without a tier there is nothing to promote)")
+            look = 2 if tier_prefetch is True else int(tier_prefetch)
+            if look < 1:
+                raise ValueError(
+                    f"tier_prefetch={tier_prefetch!r} must be True or "
+                    f"a positive lookahead depth (queued requests "
+                    f"scanned per round)")
+        else:
+            look = 0
+        self._prefetch_look = look
+        self._prefetched: dict = {}    # rid -> set of prefetched hashes
+        self._prefetch_done: set = set()  # rids whose walk went dry
+        self._prefetch_issued = 0
+        self._prefetch_hits = 0
+        self._prefetch_wasted = 0
+        self._prefetch_overlap_s = 0.0
+        self._promote_ctx = None  # rid the in-progress attach serves
         self.cache = PagedKVCache(
             cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, block_size=self.block_size,
@@ -1030,7 +1093,21 @@ class PagedGenerationServer:
         self._decoder = PagedDecoder.for_config(
             cfg, self.block_size, kv_dtype=kv_dtype,
             shardings=decode_shardings,
-            collective_quant=collective_quant)
+            collective_quant=collective_quant,
+            sp_attention=self._sp_attention)
+        # analytic per-dispatch sp-attention byte accounting (host-side
+        # arithmetic — the r20 dispatch_wire_bytes discipline): the
+        # high-water mark feeds the serving_sp_attention_bytes_peak
+        # gauge and, for ring/ulysses, every dispatch is asserted
+        # under the chunk-length-independent flat bound
+        self._sp_peak_bytes = 0
+        self._sp_bytes_kw = dict(
+            sp=self._sp_degree,
+            tp=(sharding.tp if sharding is not None else 1),
+            num_heads=cfg.num_heads,
+            head_dim=cfg.hidden_size // cfg.num_heads,
+            kv_quant=kv_dtype == "int8",
+            itemsize=jnp.dtype(dt).itemsize)
         # per-slot sampling state (round 10): struct-of-arrays param
         # buffers + the [slots, V] penalty count buffer, scattered on
         # admit/refill. Constructor temperature is the DEFAULT for
@@ -1322,9 +1399,99 @@ class PagedGenerationServer:
         if kind == "demote":
             self._recorder.record("kv_tier_demote", **fields)
             _tracing.event("kv_tier_demote", **fields)
+        elif kind == "tier_promote":
+            # one aggregated promote BATCH (the whole tier-chain walk
+            # of an attach or a prefetch tick): its wall time is split
+            # OUT of the admission span into this dedicated event, so
+            # the phase-tiling invariant holds — admission no longer
+            # absorbs promotion time it didn't spend. Overlapped
+            # batches (prefetch-ahead) also feed the overlap histogram:
+            # copy time hidden behind device execution.
+            if fields.get("overlapped"):
+                dur = float(fields.get("dur_s", 0.0))
+                _m_promote_overlap.observe(dur)
+                with self._lock:
+                    self._prefetch_overlap_s += dur
+            if self._promote_ctx is not None:
+                fields = dict(fields, request_id=self._promote_ctx)
+            self._recorder.record("tier_promote", **fields)
+            _tracing.event("tier_promote", **fields)
         else:
             self._recorder.record("kv_tier_promote", **fields)
             _tracing.event("kv_tier_promote", **fields)
+
+    # ---- tier prefetch-ahead (memory-flat long-context round) -----------
+    def _tier_prefetch_tick(self):
+        """Promote the next queued requests' cold tier blocks into the
+        device pool — called right after a round's dispatch is issued,
+        so the host-side tier decodes overlap the device execution
+        (pure host work: no device state is read or written). MOVE
+        semantics are untouched — `prefetch_promote` runs the same
+        promote walk an attach would, just earlier; a prefetched block
+        that is reclaimed before admission simply re-promotes (or
+        re-computes) on attach, token-identically. Budgeted by the
+        FREE list only: prefetch fills idle capacity and never
+        reclaims retained content from live traffic."""
+        if not self._prefetch_look or self.cache.tier is None:
+            return
+        with self._lock:
+            if self._sched is not None:
+                # front-door lanes reorder admission — peeking the lane
+                # queues would need scheduler cooperation (ROADMAP);
+                # the FIFO queue is the long-context serving shape
+                return
+            heads = [r for r in self._queue[:self._prefetch_look]
+                     if r.rid not in self._prefetch_done]
+        budget = self.cache.free_block_count
+        for r in heads:
+            if budget <= 0:
+                break
+            prompt = (r.resume_ids if r.resume_ids is not None
+                      else r.ids)
+            hashes, _tokens, _nbytes = self.cache.prefetch_promote(
+                prompt, limit_blocks=budget)
+            if hashes:
+                budget -= len(hashes)
+                _m_prefetch_issued.inc(len(hashes))
+                with self._lock:
+                    self._prefetch_issued += len(hashes)
+                    self._prefetched.setdefault(
+                        r.rid, set()).update(hashes)
+            else:
+                # dry walk: nothing tiered (left) along this chain —
+                # skip the rid until settlement, so an idle queue
+                # doesn't re-hash long prompts every round
+                with self._lock:
+                    self._prefetch_done.add(r.rid)
+
+    def _settle_prefetch_locked(self, rid):
+        """Admission settlement: prefetched blocks still device-
+        resident are HITS (their promotion wall time was hidden);
+        blocks pool pressure reclaimed meanwhile are wasted. Caller
+        holds the lock."""
+        self._prefetch_done.discard(rid)
+        pref = self._prefetched.pop(rid, None)
+        if not pref:
+            return
+        hit = self.cache.device_resident_count(pref)
+        wasted = len(pref) - hit
+        self._prefetch_hits += hit
+        self._prefetch_wasted += wasted
+        if hit:
+            _m_prefetch_hit.inc(hit)
+        if wasted:
+            _m_prefetch_wasted.inc(wasted)
+
+    def _abandon_prefetch_locked(self, rid):
+        """A queued request left without admission (timeout, stop) —
+        everything prefetched for it is wasted. The blocks themselves
+        stay parked in prefix-index retention and age out like any
+        other published content. Caller holds the lock."""
+        self._prefetch_done.discard(rid)
+        pref = self._prefetched.pop(rid, None)
+        if pref:
+            self._prefetch_wasted += len(pref)
+            _m_prefetch_wasted.inc(len(pref))
 
     # ---- capacity signals (ISSUE 17) ------------------------------------
     def _cap_pool(self):
@@ -1855,6 +2022,7 @@ class PagedGenerationServer:
     def _fail_timeout_req(self, req, now):
         """Fail one expired request (already detached from any queue
         or slot). Caller holds the lock."""
+        self._abandon_prefetch_locked(req.rid)
         self._timeouts += 1
         _m_timeouts.inc()
         if self._journal is not None:
@@ -2534,6 +2702,7 @@ class PagedGenerationServer:
             if self._sched is not None:
                 pending.extend(self._sched.drain())
             for req in pending:
+                self._abandon_prefetch_locked(req.rid)
                 req.future.set_exception(RuntimeError("server stopped"))
         # ops plane teardown: release the port and the watchdog thread
         if self._watchdog is not None:
@@ -2587,6 +2756,11 @@ class PagedGenerationServer:
             self._preemptions = 0
             self._resumes = 0
             self._preempt_cached_tokens = 0
+            self._prefetch_issued = 0
+            self._prefetch_hits = 0
+            self._prefetch_wasted = 0
+            self._prefetch_overlap_s = 0.0
+            self._sp_peak_bytes = 0
             self._deadline_requests = {}
             self._deadline_misses = {}
             self._lane_ttft = {}
@@ -2681,6 +2855,20 @@ class PagedGenerationServer:
                 # trivially reset-coherent: it is construction config,
                 # not a window counter)
                 "sharding": self._sharding_stats(),
+                # tier prefetch-ahead (memory-flat long-context round):
+                # blocks promoted ahead of admission and how they
+                # settled — zeroed-when-disabled congruent schema,
+                # reset-coherent window counters
+                "tier_prefetch": {
+                    "enabled": bool(self._prefetch_look),
+                    "lookahead": self._prefetch_look,
+                    "issued_blocks": self._prefetch_issued,
+                    "hit_blocks": self._prefetch_hits,
+                    "wasted_blocks": self._prefetch_wasted,
+                    "hit_rate": (self._prefetch_hits
+                                 / (self._prefetch_issued or 1)),
+                    "overlap_promote_s": self._prefetch_overlap_s,
+                },
                 # quantized collectives (this round): analytic wire-byte
                 # accounting of the sharded decode collectives this
                 # window — bytes_total is the dispatched path,
@@ -2813,8 +3001,39 @@ class PagedGenerationServer:
         if self.sharding is None:
             return {"enabled": False, "mesh_shape": {}, "tp_degree": 0,
                     "dp_degree": 0, "sp_degree": 0,
-                    "collective_quant": "none"}
-        return self.sharding.stats_block()
+                    "collective_quant": "none",
+                    "sp_attention": "none",
+                    "sp_attention_bytes_peak": 0}
+        out = self.sharding.stats_block()
+        out["sp_attention_bytes_peak"] = self._sp_peak_bytes
+        return out
+
+    def _note_sp_peak(self, packed_tokens):
+        """Analytic per-dispatch sp-attention byte accounting (memory-
+        flat long-context round): compute the cross-shard fresh-K/V
+        bytes THIS packed dispatch materializes per shard, keep the
+        high-water mark (gauge + stats), and — for the memory-flat
+        modes — assert the dispatch stays under the chunk-length-
+        independent flat bound, every dispatch, on every backend (the
+        invariant ring/ulysses exist to hold)."""
+        from ..serving_dist.sp_attention import (sp_attention_flat_bound,
+                                                 sp_attention_peak_bytes)
+
+        mode = self._sp_attention
+        peak = sp_attention_peak_bytes(mode, int(packed_tokens),
+                                       **self._sp_bytes_kw)
+        if mode != "allgather":
+            kw = dict(self._sp_bytes_kw)
+            kw.pop("sp")
+            bound = sp_attention_flat_bound(mode, **kw)
+            if peak > bound:
+                raise AssertionError(
+                    f"sp_attention={mode!r}: dispatch peak {peak} B "
+                    f"exceeds the chunk-length-independent flat bound "
+                    f"{bound} B — the O(block) memory invariant broke")
+        if peak > self._sp_peak_bytes:
+            self._sp_peak_bytes = peak
+            _m_sp_peak_bytes.set(float(peak))
 
     def _collectives_stats(self):
         """The stats()["collectives"] block: the decoder's window wire
@@ -2915,7 +3134,16 @@ class PagedGenerationServer:
         # the blocks its own swap-out published (near-zero recompute).
         cached = 0
         if self.enable_prefix_cache:
-            cached = self.cache.attach_prefix(seq, prompt)
+            # prefetch settlement FIRST (hit = still device-resident at
+            # this instant — the attach below would re-publish walked
+            # hashes and make every block read as a hit), then stamp
+            # the request id onto any tier_promote the attach fires
+            self._settle_prefetch_locked(req.rid)
+            self._promote_ctx = req.rid
+            try:
+                cached = self.cache.attach_prefix(seq, prompt)
+            finally:
+                self._promote_ctx = None
             if cached and self._ledger is not None:
                 # attacher's saved recompute, credited at the measured
                 # per-token prefill cost (publisher keeps paying the
@@ -3175,6 +3403,8 @@ class PagedGenerationServer:
             "prefill_chunk", packed=int(T), rows=len(plan),
             tokens=int(sum(p[2] for p in plan)),
             free_blocks=self.cache.available_block_count)
+        if self._sp_degree > 1:
+            self._note_sp_peak(T)
         parts = self._cost_parts(
             [(self._slots[i]["req"], n) for i, _start, n, _o in plan])
         self._attr_begin(parts)
@@ -3511,6 +3741,10 @@ class PagedGenerationServer:
                          and self._slots[i] is not None]
             if plain_idx:
                 self._decode_plain(plain_idx)
+        # tier prefetch-ahead: promote the NEXT queued requests' cold
+        # blocks now, before the coming round boundary's admission
+        # pass runs attach_prefix (one `look` check when disabled)
+        self._tier_prefetch_tick()
         d1 = (self._prefill_dispatches + self._steps
               + self._spec_dispatches)
         if d1 > d0:
@@ -3534,6 +3768,12 @@ class PagedGenerationServer:
         plan = self._plan_round()
         outs = self._dispatch_round(plan) if plan is not None else None
         t1 = time.perf_counter()
+        # tier prefetch-ahead: the dispatch above is in flight on
+        # device — promote the next queued requests' cold tier blocks
+        # through this host-side window (the r16 async seam: the
+        # overlapped work is pure host state, outside the overlap
+        # measurement so the planner metric stays comparable)
+        self._tier_prefetch_tick()
         if not self._async:
             if outs is not None:
                 self._process_round(plan, outs)

@@ -428,7 +428,7 @@ def _sp_kv_gather(sp_mesh):
 
 @functools.lru_cache(maxsize=32)
 def _packed_trunk(spec, block_size, kv_quant=False, cq=None,
-                  sp_mesh=None):
+                  sp_mesh=None, sp_attention="allgather"):
     """Shared packed ragged forward trunk: embed a token-packed
     multi-sequence stream, write each token's K/V into its paged block
     rows, and run segment-causal attention per layer. Returns the final
@@ -449,7 +449,19 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None,
     stream kernel is bypassed inside the sp trunk (its sp-local
     tile_base wiring over shard_map is the ROADMAP follow-up); the
     XLA fallback partitions cleanly.  None traces the exact pre-round
-    trunk."""
+    trunk.
+
+    sp_attention (memory-flat round): "allgather" (default) keeps the
+    r21 seam above; "ring"/"ulysses" replace BOTH the K/V all-gather
+    and the attention with the serving_dist.sp_attention shard_map
+    seam — fresh K/V sub-blocks rotate (ring) or all-to-all (ulysses)
+    around sp, each shard scatters every visiting block into its pool
+    replica and folds it into an online-softmax accumulator, so peak
+    cross-shard fresh-K/V bytes per shard are O(block), flat in chunk
+    length.  The pool pass inside the seam covers columns before this
+    dispatch's first written position per segment (`segment_starts`);
+    fresh rows cover the rest — the union is exactly the all-gather
+    path's key set."""
     import jax.numpy as jnp
 
     L, H, Dh, E, eps, tied = spec
@@ -459,6 +471,12 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None,
     hp = _layer_helpers(spec, cq)
     spin = _sp_stream_pin(sp_mesh)
     spg = _sp_kv_gather(sp_mesh)
+    sp_flat = sp_mesh is not None and sp_attention != "allgather"
+    if sp_flat:
+        from ..serving_dist import sp_attention as _spa
+
+        sp_attn = _spa.build_sp_fresh_attention(
+            sp_mesh, sp_attention, bool(kv_quant), BS, scale)
 
     def trunk(params, toks, seg, pos, tables, kc, vc):
         from ..ops.attention import ragged_prefill_attention
@@ -474,16 +492,29 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None,
         # no sample index ever reads
         blk = jnp.where(valid, tables[seg, p0 // BS], 0)  # [T]
         off = p0 % BS
+        if sp_flat:
+            from ..serving_dist.sp_attention import (kv_set_layer,
+                                                     segment_starts)
+
+            starts = segment_starts(seg, pos, tables.shape[0])
         for i in range(L):
             a = hp.ln(x, params[f"h.{i}.ln_1.weight"],
                       params[f"h.{i}.ln_1.bias"])
             q, k, v = hp.qkv_split(params, i, a)          # [T, H, Dh]
-            kc = kv_write(kc, i, blk, off, spg(k))
-            vc = kv_write(vc, i, blk, off, spg(v))
-            o = ragged_prefill_attention(
-                q, kv_layer(kc, i), kv_layer(vc, i), tables, seg,
-                pos, scale=scale,
-                allow_pallas=sp_mesh is None).reshape(T, E)
+            if sp_flat:
+                o, kc_i, vc_i = sp_attn(
+                    q, k, v, kv_layer(kc, i), kv_layer(vc, i),
+                    tables, seg, pos, starts)
+                kc = kv_set_layer(kc, i, kc_i, bool(kv_quant))
+                vc = kv_set_layer(vc, i, vc_i, bool(kv_quant))
+                o = o.reshape(T, E)
+            else:
+                kc = kv_write(kc, i, blk, off, spg(k))
+                vc = kv_write(vc, i, blk, off, spg(v))
+                o = ragged_prefill_attention(
+                    q, kv_layer(kc, i), kv_layer(vc, i), tables, seg,
+                    pos, scale=scale,
+                    allow_pallas=sp_mesh is None).reshape(T, E)
             x = spin(hp.block_and_mlp(params, i, x, o, dt))
         return x, kc, vc
 
@@ -493,7 +524,7 @@ def _packed_trunk(spec, block_size, kv_quant=False, cq=None,
 @functools.lru_cache(maxsize=64)
 def _build_packed_prefill(spec, block_size, return_logits, mode,
                           kv_quant=False, rep_constraint=None, cq=None,
-                          sp_mesh=None):
+                          sp_mesh=None, sp_attention="allgather"):
     """Packed ragged prefill: ONE dispatch prefills a token-packed
     multi-sequence chunk stream (the tentpole of the chunked-prefill
     scheduler, inference/serving.py). Raw and jittable.
@@ -509,7 +540,8 @@ def _build_packed_prefill(spec, block_size, return_logits, mode,
 
     sampled, penalties = mode
     hp = _layer_helpers(spec, cq)
-    trunk = _packed_trunk(spec, block_size, bool(kv_quant), cq, sp_mesh)
+    trunk = _packed_trunk(spec, block_size, bool(kv_quant), cq, sp_mesh,
+                          sp_attention)
     pin = _rep_pin(rep_constraint)
     readout = _make_readout(cq, pin, mode, _proc)
 
@@ -907,7 +939,7 @@ def _jitted_paged_fns(spec, block_size, return_logits, donate, mode,
 
 @functools.lru_cache(maxsize=32)
 def _sharded_jits(spec, block_size, return_logits, donate, mode,
-                  kv_quant, sh, cq=None):
+                  kv_quant, sh, cq=None, sp_attention="allgather"):
     """The four decode programs jitted with EXPLICIT in/out shardings
     (sharded-serving round): params per the serving_dist plan, kc/vc
     pinned to the per-shard pool layout on BOTH sides (so the pool
@@ -933,7 +965,8 @@ def _sharded_jits(spec, block_size, return_logits, donate, mode,
                                            return_logits, mode, kv_quant,
                                            rep, cq)
     packed_fn = _build_packed_prefill(spec, block_size, return_logits,
-                                      mode, kv_quant, rep, cq, sp_mesh)
+                                      mode, kv_quant, rep, cq, sp_mesh,
+                                      sp_attention)
     verify_fn = _build_packed_verify(spec, block_size, mode, kv_quant,
                                      rep, cq)
     unified_fn = _build_unified_round(spec, block_size, mode, kv_quant,
@@ -1067,7 +1100,8 @@ class PagedDecoder:
     counted for the actual path AND the bf16 baseline)."""
 
     def __init__(self, spec, block_size, return_logits=False, donate=None,
-                 kv_dtype=None, shardings=None, collective_quant=None):
+                 kv_dtype=None, shardings=None, collective_quant=None,
+                 sp_attention="allgather"):
         import jax
 
         if donate is None:  # CPU donation is a no-op warning in jaxlib
@@ -1075,6 +1109,22 @@ class PagedDecoder:
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
                              "(supported: None, 'int8')")
+        if sp_attention != "allgather":
+            # the default mode needs no validation and must not pull
+            # serving_dist in (the unsharded path never imports it);
+            # any non-default value — including a bogus one — takes
+            # this branch and validates against the canonical tuple
+            from ..serving_dist.config import SP_ATTENTION_MODES
+
+            if sp_attention not in SP_ATTENTION_MODES:
+                raise ValueError(
+                    f"PagedDecoder(sp_attention={sp_attention!r}): "
+                    f"must be one of {SP_ATTENTION_MODES}")
+        if sp_attention != "allgather" and shardings is None:
+            raise ValueError(
+                f"PagedDecoder(sp_attention={sp_attention!r}) requires "
+                f"shardings with an sp>1 mesh — memory-flat sequence-"
+                f"parallel attention only exists on an sp mesh")
         if collective_quant is not None and shardings is None:
             raise ValueError(
                 "collective_quant requires shardings: quantized "
@@ -1090,6 +1140,14 @@ class PagedDecoder:
         # mesh (None = the exact pre-round process-cached jits)
         self._shardings = shardings
         self._cq = collective_quant
+        # sp_attention (memory-flat round): how the sp>1 packed-prefill
+        # trunk attends across shards; "allgather" is the exact r21
+        # path, and sp=1 meshes normalize ring/ulysses back to it (the
+        # degenerate mesh has nothing to rotate — config.py logs it)
+        if shardings is not None \
+                and int(dict(shardings.mesh.shape).get("sp", 1)) <= 1:
+            sp_attention = "allgather"
+        self._sp_attention = sp_attention
         # wire-byte accounting (sharded decoders only): {(collective,
         # dtype): bytes} incremented host-side per dispatch, the
         # "baseline" dtype carrying what bf16 would have shipped
@@ -1155,7 +1213,7 @@ class PagedDecoder:
                  uniwin) = _sharded_jits(
                     self.spec, self.block_size, self.return_logits,
                     self._donate, mode, self._kv_quant,
-                    self._shardings, self._cq)
+                    self._shardings, self._cq, self._sp_attention)
             else:
                 prefill, step = _jitted_paged_fns(
                     self.spec, self.block_size, self.return_logits,

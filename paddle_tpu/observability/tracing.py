@@ -362,6 +362,15 @@ def assemble_request_traces(evs=None, path=None):
                 r["cost"] = ev["cost"]
         elif name == "detokenize" and rid is not None:
             rec(rid)["t_end"] = ev["ts"] + ev.get("dur", 0.0)
+        elif name == "tier_promote" and rid is not None:
+            # aggregated host-tier promote batch attributed to this
+            # request's admission attach (overlapped prefetch batches
+            # carry no request_id — they ran before admission)
+            r = rec(rid)
+            r["tier_promote_ms"] = (r.get("tier_promote_ms", 0.0)
+                                    + ev.get("dur_s", 0.0) * 1e3)
+            r["tier_promote_blocks"] = (r.get("tier_promote_blocks", 0)
+                                        + ev.get("blocks", 0))
         elif name == "compile":
             compiles.append((ev["ts"], ev.get("dur", 0.0),
                              ev.get("program")))
@@ -403,6 +412,14 @@ def assemble_request_traces(evs=None, path=None):
         if r.get("cost") is not None:  # per-request attribution
             # account closed at completion (ISSUE 17)
             out[rid]["cost"] = r["cost"]
+        if r.get("tier_promote_ms"):  # host-tier promote wall time of
+            # this request's admission attach — its own trace event
+            # now (not silently absorbed into the admission span); a
+            # parallel "of which, tier promote" annotation inside the
+            # admission phase, the compile_overlap_ms discipline —
+            # the phase tiling of wall clock is untouched
+            out[rid]["tier_promote_ms"] = round(r["tier_promote_ms"], 4)
+            out[rid]["tier_promote_blocks"] = r["tier_promote_blocks"]
         if r.get("preemptions"):  # front door (round 12): the decode
             # phase of a preempted request absorbs its swap-out,
             # requeue wait, and resume re-prefill; requeue_ms says how

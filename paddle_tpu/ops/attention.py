@@ -219,6 +219,12 @@ def ragged_prefill_attention(q, k_blocks, v_blocks, block_tables, seg, pos,
     packed region starts at a multiple of Q_TILE=128, so one query tile
     never mixes segments.
 
+    The (seg, pos) row metadata defines the segment-causal masking
+    contract shared by the Pallas kernels and the sequence-parallel
+    serving seams (`serving_dist.sp_attention` splits this exact key
+    set into a resident-pool pass and a rotating fresh-block pass; see
+    ops/pallas/unified_attention.py for the normative statement).
+
     The XLA fallback gathers ONE [B, M*BS, ...] copy per slot ROW
     (never per token — a [T, M*BS, ...] materialization measured 8x
     slower than the sequential prefill at bench shapes), scores every

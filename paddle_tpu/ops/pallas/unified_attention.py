@@ -54,6 +54,17 @@ horizon (and pad tiles) still occupy grid steps but are predicated
 off — raggedness saves the gather traffic and the compute, not the
 grid iterations.
 
+Segment-causal masking contract (normative for every implementation of
+stream attention, not just this kernel): a query row carrying
+(seg, pos) attends exactly the keys of ITS segment at positions
+0 <= kpos <= pos — resident paged-cache positions and fresh stream
+rows alike — and pad rows (pos == -1) attend nothing.  The XLA
+fallback (`ops.attention.ragged_prefill_attention`) and the
+sequence-parallel seams (`serving_dist.sp_attention` ring/ulysses,
+where per-row seg/pos metadata must SURVIVE block rotation so
+cross-shard causality stays exact) implement this same contract and
+are parity-tested against each other.
+
 Per (tile, kv-block) step the score tile is [H, QT, BS] from a
 head-batched dot over Dh; online-softmax state (m, l, acc) rides VMEM
 scratch across the M dimension exactly like flash_attention.py, with

@@ -56,11 +56,17 @@ from .engine import (apply_sharding, max_slots_for_budget,
                      pool_blocks_for_budget)
 from .collectives import (CollectiveQuant, build_collective_quant,
                           normalize_collective_quant)
+from .config import SP_ATTENTION_MODES
+from .sp_attention import (build_sp_fresh_attention,
+                           sp_attention_flat_bound,
+                           sp_attention_peak_bytes)
 
 __all__ = [
     "ShardedEngineConfig", "normalize_sharding", "disabled_stats_block", "DecodeShardings", "decode_spec_for",
     "kv_pool_specs", "build_decode_shardings", "place_decode_params",
     "place_kv_pool", "apply_sharding", "pool_blocks_for_budget",
     "max_slots_for_budget", "CollectiveQuant", "build_collective_quant",
-    "normalize_collective_quant",
+    "normalize_collective_quant", "SP_ATTENTION_MODES",
+    "build_sp_fresh_attention", "sp_attention_peak_bytes",
+    "sp_attention_flat_bound",
 ]

@@ -14,7 +14,19 @@ stream slice and the seams re-replicate them — see nn/decode.py).
 """
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
+
+_log = logging.getLogger(__name__)
+
+#: Attention strategies for the sequence-parallel packed-prefill trunk.
+#: "allgather" is the r21 seam (each shard all-gathers the full K/V
+#: stream — linear memory in chunk length); "ring" rotates fixed-size
+#: K/V sub-blocks around the sp axis with online-softmax accumulation;
+#: "ulysses" all-to-alls heads<->sequence so each shard attends its own
+#: head slice over the full stream block-by-block.  Both keep peak live
+#: K/V bytes per shard O(block), flat in chunk length.
+SP_ATTENTION_MODES = ("allgather", "ring", "ulysses")
 
 
 @dataclass(frozen=True)
@@ -48,6 +60,12 @@ class ShardedEngineConfig:
         programs.  tp=1 meshes ignore it (no inter-chip wire).
     int4_group: scale-group width of the "int4g" wire (snapped to a
         divisor of each chunk; ignored by "int8").
+    sp_attention: how the sp>1 packed-prefill trunk attends across
+        shards — one of SP_ATTENTION_MODES.  "allgather" (default) is
+        the exact r21 path; "ring"/"ulysses" are memory-flat (peak live
+        K/V bytes per shard stay O(block) instead of O(chunk)) and
+        token-parity-tested against it.  sp=1 normalizes ring/ulysses
+        back to "allgather" (degenerate mesh — nothing to rotate).
     """
 
     tp: int = 1
@@ -56,6 +74,7 @@ class ShardedEngineConfig:
     devices: tuple = None
     collective_quant: str = None
     int4_group: int = 32
+    sp_attention: str = "allgather"
 
     def __post_init__(self):
         for field_name in ("tp", "dp", "sp", "int4_group"):
@@ -74,6 +93,18 @@ class ShardedEngineConfig:
         from .collectives import normalize_collective_quant
 
         normalize_collective_quant(self.collective_quant)
+        if self.sp_attention not in SP_ATTENTION_MODES:
+            raise ValueError(
+                f"ShardedEngineConfig.sp_attention="
+                f"{self.sp_attention!r} must be one of "
+                f"{SP_ATTENTION_MODES}")
+        if self.sp == 1 and self.sp_attention != "allgather":
+            _log.debug(
+                "ShardedEngineConfig(sp=1, sp_attention=%r): degenerate "
+                "sp mesh has nothing to rotate; normalizing to "
+                "'allgather' (bitwise-identical programs)",
+                self.sp_attention)
+            object.__setattr__(self, "sp_attention", "allgather")
         if self.devices is not None:
             object.__setattr__(self, "devices", tuple(self.devices))
 
@@ -121,6 +152,7 @@ class ShardedEngineConfig:
             "dp_degree": self.dp,
             "sp_degree": self.sp,
             "collective_quant": self.collective_quant or "none",
+            "sp_attention": self.sp_attention,
         }
 
 
@@ -142,6 +174,15 @@ def normalize_sharding(sharding, num_heads):
             f"ShardedEngineConfig.tp={sharding.tp} must divide the "
             f"model's num_heads={num_heads}: the KV pool shards its "
             f"head axis over the mp mesh axis")
+    if sharding.sp_attention == "ulysses":
+        local_heads = num_heads // sharding.tp
+        if local_heads % sharding.sp:
+            raise ValueError(
+                f"ShardedEngineConfig(sp_attention='ulysses', "
+                f"sp={sharding.sp}, tp={sharding.tp}): ulysses needs "
+                f"the mp-local head count ({local_heads}) divisible by "
+                f"sp ({sharding.sp}); use ring attention for "
+                f"head-count-agnostic sequence parallelism")
     return sharding
 
 
@@ -156,4 +197,5 @@ def disabled_stats_block():
         "dp_degree": 0,
         "sp_degree": 0,
         "collective_quant": "none",
+        "sp_attention": "none",
     }

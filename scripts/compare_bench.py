@@ -45,6 +45,38 @@ _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "milliseconds"}
 # a future capture shape might emit).
 _METADATA_PAT = re.compile(r"(?:^|_)tenant_|_by_tenant\b")
 
+# in-record fields that gate as their own `metric::field` pseudo-axes
+# (ISSUE 18): these carry acceptance-bar numbers the headline `value`
+# does not — the memory-flat sp_attention ratio and the tier
+# prefetch-ahead hit rate / overlapped-vs-sync resume TTFT pair.
+# Direction rides the same name inference as top-level metrics (the
+# ttft fields read lower-better, the ratio/hit-rate higher-better).
+_GATED_FIELDS = (
+    "sp_attention_peak_bytes_ratio",
+    "tier_prefetch_hit_rate",
+    "resume_ttft_p50_ms_tier_prefetch",
+    "resume_ttft_p50_ms_tier_sync",
+)
+
+
+def explode_gated_fields(records):
+    """Append a synthetic record per (record, gated numeric field)
+    pair, named `metric::field`, so `compare` diffs the in-record
+    acceptance numbers axis-by-axis like any top-level metric."""
+    out = list(records)
+    for r in records:
+        for f in _GATED_FIELDS:
+            v = r.get(f)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                # direction from the FIELD name alone — the joined
+                # pseudo-name inherits the parent metric's "ttft",
+                # which would misread hit_rate/ratio as lower-better
+                out.append({"metric": f"{r['metric']}::{f}",
+                            "value": v,
+                            "unit": "ms" if "_ms" in f else "",
+                            "lower_better": lower_is_better(f)})
+    return out
+
 
 def lower_is_better(metric, unit=""):
     """Direction of goodness for one bench metric."""
@@ -120,8 +152,8 @@ def compare(old_records, new_records, threshold=DEFAULT_THRESHOLD):
     {"regressions": [...], "improvements": [...], "unchanged": [...],
      "added": [...], "removed": [...]} — each entry carries metric,
     old/new value, relative change, and direction."""
-    old = {r["metric"]: r for r in old_records}
-    new = {r["metric"]: r for r in new_records}
+    old = {r["metric"]: r for r in explode_gated_fields(old_records)}
+    new = {r["metric"]: r for r in explode_gated_fields(new_records)}
     report = {"regressions": [], "improvements": [], "unchanged": [],
               "metadata": [],
               "added": sorted(set(new) - set(old)),
@@ -135,7 +167,9 @@ def compare(old_records, new_records, threshold=DEFAULT_THRESHOLD):
             nv = float(new[metric]["value"])
         except (KeyError, TypeError, ValueError):
             continue
-        lower = lower_is_better(metric, new[metric].get("unit", ""))
+        lower = new[metric].get("lower_better")
+        if lower is None:
+            lower = lower_is_better(metric, new[metric].get("unit", ""))
         if ov == 0:
             rel = 0.0 if nv == 0 else float("inf")
         else:
@@ -189,6 +223,13 @@ _TINY_OLD = [
     # per-tenant attribution axis (ISSUE 17): huge swing, must NOT gate
     {"metric": "gpt2s_served_tenant_device_s_free", "value": 1.0,
      "unit": "s"},
+    # long-context axis (ISSUE 18): the headline TTFT holds but the
+    # in-record prefetch hit rate collapses — must gate via the
+    # exploded `::` pseudo-metric
+    {"metric": "gpt2s_served_longcontext_ttft_p50_ms", "value": 30.0,
+     "unit": "ms", "tier_prefetch_hit_rate": 1.0,
+     "sp_attention_peak_bytes_ratio": 4.0,
+     "resume_ttft_p50_ms_tier_prefetch": 8.0},
     {"metric": "retired_axis", "value": 1.0, "unit": ""},
 ]
 _TINY_NEW = [
@@ -204,6 +245,13 @@ _TINY_NEW = [
     # tenant skew shifted 10x: non-gating metadata, never a regression
     {"metric": "gpt2s_served_tenant_device_s_free", "value": 10.0,
      "unit": "s"},
+    # hit rate halved (higher_better regression through the :: route
+    # despite the parent metric name reading "ttft"); the ratio holds
+    # and the prefetch TTFT drifts within threshold
+    {"metric": "gpt2s_served_longcontext_ttft_p50_ms", "value": 30.0,
+     "unit": "ms", "tier_prefetch_hit_rate": 0.5,
+     "sp_attention_peak_bytes_ratio": 4.0,
+     "resume_ttft_p50_ms_tier_prefetch": 8.2},
     {"metric": "new_axis", "value": 2.0, "unit": ""},
 ]
 
@@ -215,12 +263,25 @@ def run_tiny():
     the report; raises AssertionError on any miss."""
     report = compare(_TINY_OLD, _TINY_NEW, threshold=0.10)
     flagged = {e["metric"] for e in report["regressions"]}
-    assert flagged == {"gpt2s_served_paged_tokens_per_sec",
-                       "gpt2s_served_ttft_p99_ms"}, flagged
+    assert flagged == {
+        "gpt2s_served_paged_tokens_per_sec",
+        "gpt2s_served_ttft_p99_ms",
+        "gpt2s_served_longcontext_ttft_p50_ms"
+        "::tier_prefetch_hit_rate"}, flagged
+    # the halved hit rate gated as HIGHER-better (a drop), not as an
+    # improvement misread off the parent metric's "ttft" substring
+    hr = next(e for e in report["regressions"]
+              if e["metric"].endswith("tier_prefetch_hit_rate"))
+    assert hr["direction"] == "higher_better", hr
     improved = {e["metric"] for e in report["improvements"]}
     assert improved == {"gpt2s_served_itl_p99_ms"}, improved
-    assert [e["metric"] for e in report["unchanged"]] \
-        == ["gpt2s_served_goodput_ratio"], report["unchanged"]
+    assert {e["metric"] for e in report["unchanged"]} == {
+        "gpt2s_served_goodput_ratio",
+        "gpt2s_served_longcontext_ttft_p50_ms",
+        "gpt2s_served_longcontext_ttft_p50_ms"
+        "::sp_attention_peak_bytes_ratio",
+        "gpt2s_served_longcontext_ttft_p50_ms"
+        "::resume_ttft_p50_ms_tier_prefetch"}, report["unchanged"]
     assert report["added"] == ["new_axis"]
     assert report["removed"] == ["retired_axis"]
     # the 10x tenant-skew swing classified as metadata, not regression
@@ -230,6 +291,8 @@ def run_tiny():
     assert lower_is_better("x_ttft_p99_ms")
     assert lower_is_better("whatever", "ms")
     assert not lower_is_better("x_tokens_per_sec", "tokens/s")
+    assert not lower_is_better("tier_prefetch_hit_rate")
+    assert lower_is_better("resume_ttft_p50_ms_tier_prefetch")
     # record extraction handles the harness capture shape (tail lines
     # with an embedded parsed_all)
     capture = {"n": 1, "cmd": "bench", "rc": 0, "tail": "\n".join(

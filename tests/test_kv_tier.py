@@ -378,3 +378,152 @@ def srv_tail(payload):
     """Matchable slack: the attach cap (last prompt token is always
     recomputed) plus a possible partial-tail stop."""
     return payload["block_size"] + 1
+
+
+class TestTierPrefetchAhead:
+    """Overlapped tier prefetch-ahead (memory-flat long-context round,
+    part b): a QUEUED request's cold tier blocks promote into the
+    device pool while the current round computes, so admission's
+    attach finds them resident — token-identical either way (the
+    synchronous promote-on-attach path remains the fallback)."""
+
+    def test_ctor_validation(self, tiny_model):
+        model, _ = tiny_model
+        with pytest.raises(ValueError, match="tier_prefetch"):
+            PagedGenerationServer(model, tier_prefetch=True,
+                                  enable_prefix_cache=True)
+        with pytest.raises(ValueError, match="tier_prefetch"):
+            PagedGenerationServer(model, tier_prefetch=0, kv_tier=True,
+                                  enable_prefix_cache=True)
+
+    def test_prefetch_ahead_hits_and_token_parity(self, tiny_model):
+        """Demote a finished prompt's chain, occupy the only slot, and
+        queue the same prompt again: the prefetch tick promotes the
+        chain DURING the occupier's rounds, the admission settles every
+        block as a hit, and the tokens match the first run exactly."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(9)
+        prompt = rng.randint(1, cfg.vocab_size, (21,)).astype(np.int32)
+        other = rng.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+        srv = PagedGenerationServer(
+            model, max_slots=1, block_size=8, max_prompt_len=32,
+            max_new_tokens=16, enable_prefix_cache=True,
+            kv_tier=HostKVTier(capacity_blocks=16, watermark=0.0),
+            tier_prefetch=True, prefill_chunk_tokens=16,
+            flight_recorder=True).start()
+        try:
+            first = srv.submit(prompt).result(timeout=600)
+            assert srv.cache.demote_cold(16) > 0
+            fa = srv.submit(other)   # occupies the single slot
+            fb = srv.submit(prompt)  # queued behind it -> prefetched
+            fa.result(timeout=600)
+            again = fb.result(timeout=600)
+            st = srv.stats()
+            ring = [e for e in srv._recorder.events()
+                    if e["name"] == "tier_promote"]
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(first, again)
+        tp = st["tier_prefetch"]
+        assert tp["enabled"] and tp["lookahead"] == 2
+        assert tp["issued_blocks"] > 0, "prefetch never fired"
+        assert tp["hit_blocks"] == tp["issued_blocks"]
+        assert tp["hit_rate"] > 0.8
+        assert tp["overlap_promote_s"] > 0.0
+        # the overlapped batch recorded its own aggregated event with
+        # byte/block accounting (satellite: promote time is no longer
+        # silently folded into the admission span)
+        ov = [e for e in ring if e.get("overlapped")]
+        assert ov and ov[0]["blocks"] > 0 and ov[0]["bytes"] > 0
+        assert ov[0]["dur_s"] > 0
+
+    def test_sync_promote_event_split_from_admission(self, tiny_model):
+        """Fix satellite: the synchronous promote-on-attach walk now
+        emits a dedicated `tier_promote` trace event carrying the
+        request id, and the assembler reports it as a parallel
+        `tier_promote_ms` annotation (the compile_overlap_ms
+        discipline — phase tiling of wall clock is untouched)."""
+        from paddle_tpu.observability import tracing as T
+
+        model, cfg = tiny_model
+        rng = np.random.RandomState(13)
+        prompt = rng.randint(1, cfg.vocab_size, (21,)).astype(np.int32)
+        T.TRACER.reset()
+        T.enable()
+        try:
+            srv = PagedGenerationServer(
+                model, max_slots=1, block_size=8, max_prompt_len=32,
+                max_new_tokens=4, enable_prefix_cache=True,
+                kv_tier=HostKVTier(capacity_blocks=16, watermark=0.0),
+                prefill_chunk_tokens=16).start()
+            try:
+                srv.submit(prompt).result(timeout=600)
+                assert srv.cache.demote_cold(16) > 0
+                srv.submit(prompt).result(timeout=600)
+            finally:
+                srv.stop()
+            evs = T.events()
+            proms = [e for e in evs if e.get("name") == "tier_promote"]
+            assert proms, "sync attach promoted without the event"
+            ev = proms[-1]
+            assert ev["blocks"] > 0 and ev["bytes"] > 0
+            assert ev["overlapped"] is False
+            assert ev.get("request_id"), "promote not attributed"
+            traces = T.assemble_request_traces(evs)
+            rec = traces[ev["request_id"]]
+            assert rec["tier_promote_ms"] > 0
+            assert rec["tier_promote_blocks"] == ev["blocks"]
+            # parallel annotation: the phase breakdown still tiles the
+            # request's wall clock (same approx bar as
+            # test_observability) — tier_promote_ms rides alongside, it
+            # is not a sixth phase
+            assert "tier_promote" not in rec["phases_ms"]
+            assert sum(rec["phases_ms"].values()) == \
+                pytest.approx(rec["wall_ms"], rel=0.10)
+        finally:
+            T.disable()
+            T.TRACER.reset()
+
+    def test_wasted_on_timeout_expiry(self, tiny_model):
+        """A queued request that times out before admission settles its
+        prefetched blocks as wasted (the blocks themselves just age in
+        prefix-index retention)."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(17)
+        prompt = rng.randint(1, cfg.vocab_size, (21,)).astype(np.int32)
+        other = rng.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+        from paddle_tpu.inference.serving import RequestTimeout
+
+        srv = PagedGenerationServer(
+            model, max_slots=1, block_size=8, max_prompt_len=32,
+            max_new_tokens=24, enable_prefix_cache=True,
+            kv_tier=HostKVTier(capacity_blocks=16, watermark=0.0),
+            tier_prefetch=True, prefill_chunk_tokens=16).start()
+        try:
+            srv.submit(prompt).result(timeout=600)
+            assert srv.cache.demote_cold(16) > 0
+            fa = srv.submit(other)
+            fb = srv.submit(prompt, timeout_s=0.01)
+            with pytest.raises(RequestTimeout):
+                fb.result(timeout=600)
+            fa.result(timeout=600)
+            st = srv.stats()
+        finally:
+            srv.stop()
+        tp = st["tier_prefetch"]
+        if tp["issued_blocks"]:  # timing-dependent: only assert the
+            # settlement bookkeeping when the tick beat the expiry
+            assert tp["issued_blocks"] == (tp["hit_blocks"]
+                                           + tp["wasted_blocks"])
+
+    def test_stats_schema_zeroed_when_disabled(self, tiny_model):
+        model, _ = tiny_model
+        srv = PagedGenerationServer(model, max_slots=1,
+                                    max_prompt_len=16,
+                                    max_new_tokens=4)
+        off = srv.stats()["tier_prefetch"]
+        assert off["enabled"] is False
+        assert all(off[k] == 0 for k in off if k != "enabled")
+        assert set(off) == {"enabled", "lookahead", "issued_blocks",
+                            "hit_blocks", "wasted_blocks", "hit_rate",
+                            "overlap_promote_s"}

@@ -180,7 +180,9 @@ class TestOneDeviceMeshBitwise:
                                                  "sp": 1},
                                   "tp_degree": 1, "dp_degree": 1,
                                   "sp_degree": 1,
-                                  "collective_quant": "none"}
+                                  "collective_quant": "none",
+                                  "sp_attention": "allgather",
+                                  "sp_attention_bytes_peak": 0}
 
     def test_decoder_logits_bitwise(self, tiny_model):
         """Zero logit drift on a 1-device mesh — not just same argmax:
@@ -355,7 +357,9 @@ class TestStatsAndTelemetry:
         st = srv.stats()["sharding"]
         assert st == {"enabled": False, "mesh_shape": {},
                       "tp_degree": 0, "dp_degree": 0, "sp_degree": 0,
-                      "collective_quant": "none"}
+                      "collective_quant": "none",
+                      "sp_attention": "none",
+                      "sp_attention_bytes_peak": 0}
 
     def test_sharding_block_reset_coherent(self, tiny_model):
         model, _ = tiny_model

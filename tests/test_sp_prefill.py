@@ -158,6 +158,122 @@ class TestSpParity:
         assert out == ref
         assert st4["prefill_dispatches"] < st1["prefill_dispatches"]
 
+class TestSpAttentionModes:
+    """Memory-flat sequence-parallel attention (ring/ulysses): knob
+    validation, sp=1 normalization, and token parity vs the allgather
+    seam on the pinned workloads — including the composed acceptance
+    stack."""
+
+    def test_knob_validated_eagerly(self):
+        with pytest.raises(ValueError, match="sp_attention"):
+            ShardedEngineConfig(sp=2, sp_attention="flash")
+
+    def test_sp1_normalizes_to_allgather(self):
+        """Default-compat satellite: sp=1 (degenerate mesh — nothing
+        to rotate) silently normalizes ring/ulysses to allgather, so
+        the exact pre-round programs trace."""
+        for mode in ("ring", "ulysses"):
+            cfg = ShardedEngineConfig(sp=1, sp_attention=mode)
+            assert cfg.sp_attention == "allgather"
+        assert ShardedEngineConfig(
+            sp=2, sp_attention="ring").sp_attention == "ring"
+
+    def test_ulysses_head_divisibility_checked(self):
+        from paddle_tpu.serving_dist import normalize_sharding
+
+        with pytest.raises(ValueError, match="ulysses"):
+            normalize_sharding(
+                ShardedEngineConfig(tp=2, sp=4,
+                                    sp_attention="ulysses"), 4)
+        # ring has no head-count requirement at the same shape
+        normalize_sharding(
+            ShardedEngineConfig(tp=2, sp=4, sp_attention="ring"), 4)
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_mode_token_parity(self, tiny_model, mode):
+        """ring/ulysses at sp in {2, 4}: token-identical to the
+        unsharded engine (== the allgather seam, which the base suite
+        pins) on the long-prompt greedy + sampled workload, with the
+        peak-bytes gauge live."""
+        model, cfg = tiny_model
+        prompts, sps = _long_workload(cfg)
+        ref, _ = _serve(model, prompts, sps)
+        for sp in (2, 4):
+            out, st = _serve(
+                model, prompts, sps,
+                sharding=ShardedEngineConfig(sp=sp, sp_attention=mode))
+            assert out == ref, (mode, sp)
+            assert st["sharding"]["sp_attention"] == mode
+            assert st["sharding"]["sp_attention_bytes_peak"] > 0
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_mode_composed_acceptance_workload(self, tiny_model, mode):
+        """The acceptance pin for the memory-flat modes: prefix cache
+        ON, speculation ON, int8 KV + W8A16, tp x sp (+ quantized
+        collectives for ring) — token-identical to the same features
+        unsharded."""
+        model, cfg = tiny_model
+        prompts, sps = _long_workload(cfg)
+        kw = dict(enable_prefix_cache=True, speculation=True,
+                  kv_dtype="int8", quantization="w8a16")
+        ref, _ = _serve(model, prompts, sps, **kw)
+        cq = "int8" if mode == "ring" else None
+        out, _ = _serve(
+            model, prompts, sps,
+            sharding=ShardedEngineConfig(tp=2, sp=2, sp_attention=mode,
+                                         collective_quant=cq), **kw)
+        assert out == ref, mode
+
+
+class TestMemoryFlatness:
+    """The regression the modes exist to hold: peak per-shard
+    cross-shard fresh-K/V bytes CONSTANT across a 16x chunk sweep for
+    ring/ulysses, linear for allgather (analytic accounting — the
+    r20 wire-bytes discipline: exact on any backend; the engine
+    asserts every real dispatch under the same bound)."""
+
+    def test_peak_bytes_flat_across_chunk_sweep(self):
+        from paddle_tpu.serving_dist import (sp_attention_flat_bound,
+                                             sp_attention_peak_bytes)
+
+        kw = dict(sp=4, tp=1, num_heads=8, head_dim=64)
+        sweep = (2048, 8192, 32768)
+        for kv_quant in (False, True):
+            for mode in ("ring", "ulysses"):
+                peaks = [sp_attention_peak_bytes(
+                    mode, t, kv_quant=kv_quant, **kw) for t in sweep]
+                assert max(peaks) <= 1.25 * min(peaks), (mode, peaks)
+                bound = sp_attention_flat_bound(
+                    mode, 1, 8, 64, kv_quant=kv_quant)
+                assert all(p <= bound for p in peaks), (mode, peaks)
+            ag = [sp_attention_peak_bytes(
+                "allgather", t, kv_quant=kv_quant, **kw)
+                for t in sweep]
+            assert ag[2] == 16 * ag[0] and ag[1] == 4 * ag[0], ag
+            # the flat modes beat allgather as soon as the chunk
+            # outgrows the rotation sub-block
+            assert peaks[-1] < ag[-1]
+        with pytest.raises(ValueError, match="sp_attention"):
+            sp_attention_peak_bytes("flash", 2048, **kw)
+
+    def test_engine_asserts_flat_bound_per_dispatch(self, tiny_model):
+        """A served ring run keeps the gauge under the analytic flat
+        bound (the engine raises on violation — this pins the wiring,
+        not just the formula)."""
+        from paddle_tpu.serving_dist import sp_attention_flat_bound
+
+        model, cfg = tiny_model
+        prompts, sps = _long_workload(cfg)
+        out, st = _serve(model, prompts, sps,
+                         sharding=ShardedEngineConfig(
+                             sp=2, sp_attention="ring"))
+        peak = st["sharding"]["sp_attention_bytes_peak"]
+        assert 0 < peak <= sp_attention_flat_bound(
+            "ring", 1, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads)
+
+
+class TestSpFrontdoor:
     def test_sp_frontdoor_preempt_resume(self, tiny_model):
         """Preempt/resume through the sp-sharded engine: swap-out,
         warm resume and the interactive lane all token-identical to
