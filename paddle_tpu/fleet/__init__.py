@@ -24,6 +24,7 @@ failover-vs-migration decision table, the parity guarantee and what
 is NOT recoverable.
 """
 from ..reliability import ReplicaUnavailable  # noqa: F401 (re-export)
+from .disagg import DisaggRouter, FleetLanes  # noqa: F401
 from .federation import (add_label_to_prom_text,  # noqa: F401
                          federate_metrics, http_fetcher)
 from .health import ReplicaHealth  # noqa: F401
@@ -31,9 +32,11 @@ from .migration import (deserialize_kv_payload,  # noqa: F401
                         serialize_kv_payload)
 from .replica import Replica  # noqa: F401
 from .router import FleetRouter  # noqa: F401
+from .transport import RemoteEngine, RemoteReplica  # noqa: F401
 
 __all__ = [
     "FleetRouter", "Replica", "ReplicaHealth", "ReplicaUnavailable",
+    "RemoteEngine", "RemoteReplica", "DisaggRouter", "FleetLanes",
     "federate_metrics", "add_label_to_prom_text", "http_fetcher",
     "serialize_kv_payload", "deserialize_kv_payload",
 ]

@@ -207,13 +207,16 @@ class FleetRouter:
         "stop routing new work at a latency-burning replica" hook.
     slo_degrade_sustain_s: how long a replica-scoped SLO must page
         continuously before the degrade hook fires.
+    capacity_timeout_s: /capacity federation deadline per replica —
+        a hung remote replica degrades to an `{"error": ...}` slot
+        instead of stalling the snapshot (None = synchronous).
     """
 
     def __init__(self, replicas, *, journal=None, seed=0,
                  probe_interval_s=1.0, shed_queue_depth=None,
                  submit_retries=2, fault_plan=None, detokenize=None,
                  stream_buffer=256, expose_port=None, slos=None,
-                 slo_degrade_sustain_s=2.0):
+                 slo_degrade_sustain_s=2.0, capacity_timeout_s=2.0):
         reps = []
         for i, r in enumerate(replicas):
             if isinstance(r, Replica):
@@ -254,6 +257,12 @@ class FleetRouter:
         else:
             self._slo = SLOEngine(slos)
         self.slo_degrade_sustain_s = float(slo_degrade_sustain_s)
+        # /capacity federation deadline: a HUNG replica (wedged
+        # subprocess) degrades to an error slot instead of stalling
+        # the snapshot; None = synchronous (never for remote fleets)
+        self.capacity_timeout_s = (
+            None if capacity_timeout_s is None
+            else float(capacity_timeout_s))
         self._slo_degraded: dict[str, float] = {}  # replica -> since
         self._lock = threading.RLock()
         self._sessions: dict[str, _Session] = {}
@@ -683,7 +692,8 @@ class FleetRouter:
         from ..observability.capacity import federate_capacity
 
         return federate_capacity(
-            {rep.name: rep.capacity for rep in self.replicas})
+            {rep.name: rep.capacity for rep in self.replicas},
+            timeout_s=self.capacity_timeout_s)
 
     def slo_report(self):
         """The fleet /slo endpoint payload."""

@@ -228,6 +228,59 @@ class LaneScheduler:
                 return head
         return None
 
+    def peek(self, now, n):
+        """Up to `n` queued requests in approximate admission order
+        WITHOUT popping, charging rate buckets, or counting throttle
+        skips — the tier-prefetch tick's lane-aware look-ahead
+        (ROADMAP 5d). Order is advisory: lanes rank by their current
+        served/weight counters, interactive requests EDF across
+        tenants, batch tenants by stride vtime then FIFO — the same
+        keys `next_request` uses, minus the per-admission counter
+        advances, so the set of likely-next requests is right even
+        when the exact interleave shifts by the time they admit."""
+        n = int(n)
+        if n <= 0 or self._depth == 0:
+            return []
+        lanes = sorted(LANES, key=lambda ln: (self._served[ln]
+                                              / self._weights[ln],
+                                              LANES.index(ln)))
+        out = []
+        for lane in lanes:
+            if len(out) >= n:
+                break
+            if lane == "interactive":
+                entries = []
+                for tname, dq in self._q[lane].items():
+                    if not dq or self._peek_throttled(tname, dq[0],
+                                                      now):
+                        continue
+                    for r in dq:
+                        dl = r.meta.deadline_s
+                        key = ((0, req_deadline(r), r.t_submit)
+                               if dl is not None
+                               else (1, 0.0, r.t_submit))
+                        entries.append((key, r))
+                entries.sort(key=lambda kr: kr[0])
+                out.extend(r for _, r in entries)
+            else:
+                tnames = sorted(
+                    (t for t, dq in self._q[lane].items() if dq),
+                    key=lambda t: self._tenants[t].vtime)
+                for tname in tnames:
+                    dq = self._q[lane][tname]
+                    if self._peek_throttled(tname, dq[0], now):
+                        continue
+                    out.extend(dq)
+        return out[:n]
+
+    def _peek_throttled(self, tname, head, now):
+        """`_lane_head`'s eligibility test, side-effect-free (no
+        throttle counters; the bucket refill is idempotent)."""
+        ts = self._tenants[tname]
+        return (ts.bucket is not None
+                and not getattr(head, "_fd_charged", False)
+                and not ts.bucket.affords(head.meta.cost, now))
+
     def pop(self, req, now):
         """Remove an admitted request from its queue; charge its
         tenant's rate bucket (once per request lifetime) and advance

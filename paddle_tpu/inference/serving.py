@@ -1436,12 +1436,20 @@ class PagedGenerationServer:
             return
         with self._lock:
             if self._sched is not None:
-                # front-door lanes reorder admission — peeking the lane
-                # queues would need scheduler cooperation (ROADMAP);
-                # the FIFO queue is the long-context serving shape
-                return
-            heads = [r for r in self._queue[:self._prefetch_look]
-                     if r.rid not in self._prefetch_done]
+                # front-door lanes reorder admission: ask the
+                # scheduler for its likely-next candidates
+                # (LaneScheduler.peek — advisory order, no pops, no
+                # rate charges). A scheduler without a peek hook
+                # keeps the old skip behavior.
+                peek = getattr(self._sched, "peek", None)
+                if peek is None:
+                    return
+                heads = [r for r in peek(time.perf_counter(),
+                                         self._prefetch_look)
+                         if r.rid not in self._prefetch_done]
+            else:
+                heads = [r for r in self._queue[:self._prefetch_look]
+                         if r.rid not in self._prefetch_done]
         budget = self.cache.free_block_count
         for r in heads:
             if budget <= 0:

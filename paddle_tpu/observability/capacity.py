@@ -136,7 +136,7 @@ class PressureSignals:
             return len(self._free_series)
 
 
-def federate_capacity(sources):
+def federate_capacity(sources, timeout_s=None):
     """Fold named per-replica capacity callables into one fleet
     snapshot, tolerating dead sources — the JSON twin of
     `fleet.federation.federate_metrics`.
@@ -144,11 +144,45 @@ def federate_capacity(sources):
     `sources`: dict name -> zero-arg callable returning a snapshot
     dict. A source that raises contributes `{"error": ...}` under its
     name instead of failing the page.
+
+    `timeout_s`: per-snapshot deadline. A source that HANGS (e.g. a
+    wedged subprocess replica whose socket accepts but never answers)
+    degrades to an error slot exactly like a dead one, instead of
+    stalling the whole page: sources run on daemon worker threads and
+    any still unfinished at the deadline is abandoned (its thread
+    dies with the process; the next snapshot probes it afresh).
+    None = synchronous in-caller calls (no threads), the in-process
+    fleet shape.
     """
     replicas = {}
+    if timeout_s is None:
+        for name, fn in sources.items():
+            try:
+                replicas[name] = fn()
+            except Exception as e:
+                replicas[name] = {"error": f"{type(e).__name__}: {e}"}
+        return {"schema_version": SCHEMA_VERSION, "replicas": replicas}
+
+    results = {}
+    threads = {}
     for name, fn in sources.items():
-        try:
-            replicas[name] = fn()
-        except Exception as e:
-            replicas[name] = {"error": f"{type(e).__name__}: {e}"}
+        def _run(n=name, f=fn):
+            try:
+                results[n] = f()
+            except Exception as e:  # noqa: BLE001 — error slot
+                results[n] = {"error": f"{type(e).__name__}: {e}"}
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"capacity-{name}")
+        t.start()
+        threads[name] = t
+    deadline = time.monotonic() + float(timeout_s)
+    for name, t in threads.items():
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if name in results:
+            replicas[name] = results[name]
+        else:
+            replicas[name] = {
+                "error": f"timeout: no capacity snapshot within "
+                         f"{float(timeout_s):g}s"}
     return {"schema_version": SCHEMA_VERSION, "replicas": replicas}

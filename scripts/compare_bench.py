@@ -45,6 +45,15 @@ _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "milliseconds"}
 # a future capture shape might emit).
 _METADATA_PAT = re.compile(r"(?:^|_)tenant_|_by_tenant\b")
 
+# topology provenance fields (r19 bench hygiene): a fleet record
+# measured over a different transport ("inproc" vs "http") or pool
+# topology ("pooled" vs "disagg:...") is a DIFFERENT experiment, not
+# a before/after pair — comparing them would read the wire overhead
+# or the pool split as a perf regression (or mask one). Records whose
+# provenance differs between captures are reported LOUDLY in their
+# own section and never diffed.
+_TOPOLOGY_FIELDS = ("transport", "pool_topology")
+
 # in-record fields that gate as their own `metric::field` pseudo-axes
 # (ISSUE 18): these carry acceptance-bar numbers the headline `value`
 # does not — the memory-flat sp_attention ratio and the tier
@@ -71,11 +80,29 @@ def explode_gated_fields(records):
                 # direction from the FIELD name alone — the joined
                 # pseudo-name inherits the parent metric's "ttft",
                 # which would misread hit_rate/ratio as lower-better
-                out.append({"metric": f"{r['metric']}::{f}",
-                            "value": v,
-                            "unit": "ms" if "_ms" in f else "",
-                            "lower_better": lower_is_better(f)})
+                sub = {"metric": f"{r['metric']}::{f}",
+                       "value": v,
+                       "unit": "ms" if "_ms" in f else "",
+                       "lower_better": lower_is_better(f)}
+                # pseudo-axes inherit the parent's topology
+                # provenance so the cross-topology guard covers them
+                for tf in _TOPOLOGY_FIELDS:
+                    if tf in r:
+                        sub[tf] = r[tf]
+                out.append(sub)
     return out
+
+
+def topology_mismatch(old_rec, new_rec):
+    """The provenance fields on which `old_rec` and `new_rec` differ
+    (a field present on one side only counts), or [] when the pair is
+    comparable."""
+    diffs = []
+    for f in _TOPOLOGY_FIELDS:
+        if f in old_rec or f in new_rec:
+            if old_rec.get(f) != new_rec.get(f):
+                diffs.append(f)
+    return diffs
 
 
 def lower_is_better(metric, unit=""):
@@ -155,12 +182,23 @@ def compare(old_records, new_records, threshold=DEFAULT_THRESHOLD):
     old = {r["metric"]: r for r in explode_gated_fields(old_records)}
     new = {r["metric"]: r for r in explode_gated_fields(new_records)}
     report = {"regressions": [], "improvements": [], "unchanged": [],
-              "metadata": [],
+              "metadata": [], "topology_skipped": [],
               "added": sorted(set(new) - set(old)),
               "removed": sorted(set(old) - set(new))}
     for metric in sorted(set(old) & set(new)):
         if _METADATA_PAT.search(metric):
             report["metadata"].append(metric)
+            continue
+        mismatch = topology_mismatch(old[metric], new[metric])
+        if mismatch:
+            report["topology_skipped"].append({
+                "metric": metric,
+                "fields": mismatch,
+                "old": {f: old[metric].get(f)
+                        for f in _TOPOLOGY_FIELDS},
+                "new": {f: new[metric].get(f)
+                        for f in _TOPOLOGY_FIELDS},
+            })
             continue
         try:
             ov = float(old[metric]["value"])
@@ -204,11 +242,18 @@ def format_report(report, old_path="old", new_path="new",
         lines.append(
             f"  improved   {e['metric']}: {e['old']:g} -> {e['new']:g} "
             f"({e['rel_change']:+.1%})")
+    for e in report.get("topology_skipped", []):
+        lines.append(
+            f"  TOPOLOGY-SKIPPED {e['metric']}: measured on "
+            f"{e['old']} before vs {e['new']} now — different "
+            f"experiment, NOT diffed (fields: "
+            f"{', '.join(e['fields'])})")
     lines.append(
         f"  {len(report['unchanged'])} within threshold, "
         f"{len(report['added'])} new axis(es), "
         f"{len(report['removed'])} retired, "
-        f"{len(report.get('metadata', []))} non-gating metadata")
+        f"{len(report.get('metadata', []))} non-gating metadata, "
+        f"{len(report.get('topology_skipped', []))} topology-skipped")
     return "\n".join(lines)
 
 
@@ -230,6 +275,12 @@ _TINY_OLD = [
      "unit": "ms", "tier_prefetch_hit_rate": 1.0,
      "sp_attention_peak_bytes_ratio": 4.0,
      "resume_ttft_p50_ms_tier_prefetch": 8.0},
+    # fleet axis measured IN-PROCESS in the old capture; the new
+    # capture ran it over the HTTP wire — a 40% "drop" that is pure
+    # topology change and must be skipped loudly, never gated
+    {"metric": "gpt2s_served_fleet_tokens_per_sec", "value": 200.0,
+     "unit": "tokens/s", "transport": "inproc",
+     "pool_topology": "pooled"},
     {"metric": "retired_axis", "value": 1.0, "unit": ""},
 ]
 _TINY_NEW = [
@@ -252,6 +303,11 @@ _TINY_NEW = [
      "unit": "ms", "tier_prefetch_hit_rate": 0.5,
      "sp_attention_peak_bytes_ratio": 4.0,
      "resume_ttft_p50_ms_tier_prefetch": 8.2},
+    # same metric name, DIFFERENT transport: the cross-topology guard
+    # must skip it instead of flagging the wire hop as a regression
+    {"metric": "gpt2s_served_fleet_tokens_per_sec", "value": 120.0,
+     "unit": "tokens/s", "transport": "http",
+     "pool_topology": "pooled"},
     {"metric": "new_axis", "value": 2.0, "unit": ""},
 ]
 
@@ -287,6 +343,20 @@ def run_tiny():
     # the 10x tenant-skew swing classified as metadata, not regression
     assert report["metadata"] \
         == ["gpt2s_served_tenant_device_s_free"], report["metadata"]
+    # the inproc->http fleet pair skipped via the topology guard —
+    # the 40% wire "drop" is a different experiment, not a regression
+    ts = report["topology_skipped"]
+    assert [e["metric"] for e in ts] \
+        == ["gpt2s_served_fleet_tokens_per_sec"], ts
+    assert ts[0]["fields"] == ["transport"], ts
+    assert "gpt2s_served_fleet_tokens_per_sec" not in flagged
+    assert topology_mismatch({"transport": "inproc"},
+                             {"transport": "http"}) == ["transport"]
+    assert topology_mismatch({"transport": "http"},
+                             {"transport": "http"}) == []
+    # a record that GAINS provenance fields is also incomparable
+    assert topology_mismatch({}, {"transport": "http"}) \
+        == ["transport"]
     # direction inference sanity
     assert lower_is_better("x_ttft_p99_ms")
     assert lower_is_better("whatever", "ms")

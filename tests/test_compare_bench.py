@@ -114,3 +114,97 @@ def test_regression_gate_over_newest_full_records():
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, \
         f"bench regression past threshold:\n{out.stdout}{out.stderr}"
+
+
+def test_topology_guard_skips_cross_transport_pairs():
+    """r19 bench hygiene: records measured over different transports
+    or pool topologies are different EXPERIMENTS — the comparator
+    must refuse to diff them (loud `topology_skipped` entry) instead
+    of reading the wire hop or the pool split as a regression."""
+    m = _load()
+    old = [{"metric": "f_fleet_tokens_per_sec", "value": 200.0,
+            "unit": "tokens/s", "transport": "inproc",
+            "pool_topology": "pooled"},
+           {"metric": "g_fleet_ttft_p99_ms", "value": 10.0,
+            "unit": "ms", "transport": "http",
+            "pool_topology": "pooled"}]
+    new = [{"metric": "f_fleet_tokens_per_sec", "value": 120.0,
+            "unit": "tokens/s", "transport": "http",
+            "pool_topology": "pooled"},          # 40% wire "drop"
+           {"metric": "g_fleet_ttft_p99_ms", "value": 10.5,
+            "unit": "ms", "transport": "http",
+            "pool_topology": "pooled"}]          # same topology: diffed
+    rep = m.compare(old, new, threshold=0.10)
+    assert [e["metric"] for e in rep["topology_skipped"]] \
+        == ["f_fleet_tokens_per_sec"], rep
+    assert rep["topology_skipped"][0]["fields"] == ["transport"]
+    assert rep["regressions"] == [], rep
+    assert [e["metric"] for e in rep["unchanged"]] \
+        == ["g_fleet_ttft_p99_ms"], rep
+    # the skip is LOUD in the human report
+    txt = m.format_report(rep)
+    assert "TOPOLOGY-SKIPPED f_fleet_tokens_per_sec" in txt, txt
+    assert "topology-skipped" in txt.splitlines()[-1], txt
+    # pool split changes guard too, and gaining provenance counts
+    assert m.topology_mismatch(
+        {"transport": "http", "pool_topology": "pooled"},
+        {"transport": "http", "pool_topology": "disagg:1p+1d"}) \
+        == ["pool_topology"]
+    assert m.topology_mismatch({}, {"pool_topology": "pooled"}) \
+        == ["pool_topology"]
+    # provenance-free records (every non-fleet axis) are untouched
+    assert m.topology_mismatch({"metric": "a"}, {"metric": "a"}) == []
+
+
+@pytest.mark.slow
+def test_threshold_smoke_over_real_served_records():
+    """r19 satellite: `compare_bench.py --threshold` smoke over REAL
+    `bench.py served --tiny` records — bench-record schema drift (a
+    renamed metric, a value field that stops parsing, a fleet record
+    that loses its topology provenance) breaks HERE instead of on the
+    next chip round. One tiny bench run plays both captures; a
+    synthetic 60% collapse on the paged axis proves the gate fires."""
+    import tempfile
+
+    env = dict(os.environ)
+    env.update({"PADDLE_TPU_BENCH_PROBED": "1",
+                "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(HERE)
+    r = subprocess.run([sys.executable, "bench.py", "served",
+                        "--tiny"], env=env, capture_output=True,
+                       text=True, timeout=900, cwd=repo)
+    assert r.returncode == 0, r.stderr[-3000:]
+    recs = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert recs, r.stdout
+    # every fleet record carries its topology provenance (satellite:
+    # compare_bench must never diff across topologies silently)
+    fleet = [rec for rec in recs if "fleet" in rec["metric"]]
+    assert fleet and all(
+        rec.get("transport") in ("inproc", "http")
+        and rec.get("pool_topology") for rec in fleet), fleet
+    with tempfile.TemporaryDirectory() as td:
+        with open(os.path.join(td, "BENCH_r01.json"), "w") as f:
+            json.dump(recs, f)
+        with open(os.path.join(td, "BENCH_r02.json"), "w") as f:
+            json.dump(recs, f)
+        out = subprocess.run(
+            [sys.executable, SCRIPT, "--threshold=0.10", td],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "0 new axis(es)" in out.stdout, out.stdout
+        # engineered collapse: the same records with the paged tok/s
+        # down 60% must flip the exit code through the same CLI path
+        bad = [dict(rec) for rec in recs]
+        for rec in bad:
+            if "paged" in rec["metric"] and "fleet" not in \
+                    rec["metric"]:
+                rec["value"] = rec["value"] * 0.4
+        with open(os.path.join(td, "BENCH_r03.json"), "w") as f:
+            json.dump(bad, f)
+        out = subprocess.run(
+            [sys.executable, SCRIPT, "--threshold=0.10", td],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 1, out.stdout + out.stderr
+        assert "REGRESSION" in out.stdout, out.stdout

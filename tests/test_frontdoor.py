@@ -576,3 +576,86 @@ class TestEngineSatellites:
         assert rec["prefill_chunks"] == 3
         assert sum(rec["phases_ms"].values()) == \
             pytest.approx(rec["wall_ms"])
+
+
+class TestLaneSchedulerPeek:
+    """`peek` (r19, ROADMAP 5d): the tier-prefetch tick's lane-aware
+    look-ahead — the same ordering keys `next_request` uses, with NO
+    pops, NO rate-bucket charges, and NO throttle-skip counting."""
+
+    def test_orders_like_next_request_without_popping(self):
+        from paddle_tpu.frontend import LaneScheduler
+
+        s = LaneScheduler()
+        late = _fake_req(deadline=9.0, t_submit=0.0)
+        soon = _fake_req(deadline=1.0, t_submit=0.1, tenant="other")
+        undated = _fake_req(t_submit=0.05)
+        batch = _fake_req(lane="batch", t_submit=0.0)
+        for r in (late, soon, undated, batch):
+            s.on_submit(r, 0.2)
+        got = s.peek(0.2, 10)
+        # interactive lane first (served/weight ties, LANES order),
+        # EDF across tenants, undated after dated, batch last
+        assert got == [soon, late, undated, batch], got
+        assert got[0] is s.next_request(0.2)
+        assert s.depth() == 4                       # nothing popped
+        assert s.peek(0.2, 10) == got               # idempotent
+        assert s.peek(0.2, 2) == [soon, late]       # n caps
+        assert s.peek(0.2, 0) == []
+        # popping an interactive request advances that lane's served
+        # counter, so the batch lane ranks first — peek tracks the
+        # same served/weight order next_request uses
+        s.pop(soon, 0.2)
+        got = s.peek(0.2, 10)
+        assert got == [batch, late, undated], got
+        assert got[0] is s.next_request(0.2)
+
+    def test_skips_throttled_tenant_without_charging_or_counting(self):
+        from paddle_tpu.frontend import LaneScheduler, TenantConfig
+
+        s = LaneScheduler([TenantConfig("t", rate_tokens_per_s=10.0,
+                                        burst_tokens=10.0),
+                           TenantConfig("u")])
+        a = _fake_req(tenant="t", cost=10, t_submit=0.0)
+        b = _fake_req(tenant="t", cost=10, t_submit=1.0)
+        c = _fake_req(tenant="u", cost=1, t_submit=2.0)
+        for r in (a, b, c):
+            s.on_submit(r, 0.0)
+        s.pop(s.next_request(0.0), 0.0)   # a admits; bucket -> 0
+        # b's tenant cannot afford its head: peek skips the WHOLE
+        # tenant queue, surfaces the affordable tenant, and leaves
+        # the throttle counters and the bucket untouched
+        throttled_before = s.window_stats()["rate_throttled_skips"]
+        level = s.tenant("t").bucket.level
+        assert s.peek(0.0, 10) == [c]
+        assert s.window_stats()["rate_throttled_skips"] \
+            == throttled_before
+        assert s.tenant("t").bucket.level == level
+        # once the bucket refills the tenant reappears, EDF-ordered
+        assert s.peek(1.0, 10) == [b, c]
+
+    def test_empty_and_batch_vtime_order(self):
+        from paddle_tpu.frontend import LaneScheduler, TenantConfig
+
+        s = LaneScheduler([TenantConfig("heavy", weight=2.0),
+                           TenantConfig("light", weight=1.0)])
+        assert s.peek(0.0, 4) == []
+        reqs = []
+        for k in range(2):
+            h = _fake_req(lane="batch", tenant="heavy", cost=10,
+                          t_submit=float(k))
+            li = _fake_req(lane="batch", tenant="light", cost=10,
+                           t_submit=float(k))
+            s.on_submit(h, 0.0)
+            s.on_submit(li, 0.0)
+            reqs.append((h, li))
+        # both tenants at vtime 0: dict order breaks the tie, but
+        # each tenant's queue stays FIFO and all requests surface
+        got = s.peek(0.0, 10)
+        assert len(got) == 4
+        assert got.index(reqs[0][0]) < got.index(reqs[1][0])
+        assert got.index(reqs[0][1]) < got.index(reqs[1][1])
+        # advance heavy's vtime: light's queue now peeks first
+        s.pop(reqs[0][0], 0.0)
+        got = s.peek(0.0, 10)
+        assert got[0] is reqs[0][1], got

@@ -527,3 +527,52 @@ class TestTierPrefetchAhead:
         assert set(off) == {"enabled", "lookahead", "issued_blocks",
                             "hit_blocks", "wasted_blocks", "hit_rate",
                             "overlap_promote_s"}
+
+    def test_prefetch_fires_under_frontdoor_lane_scheduler(self,
+                                                           tiny_model):
+        """ROADMAP 5d: with the r12 `LaneScheduler` installed the
+        prefetch tick used to return early (it only knew how to read
+        the FIFO queue), so fronted deployments silently lost the
+        overlap. The scheduler now exposes a non-popping `peek` and the
+        tick walks that instead — queued-behind-busy requests promote
+        their cold chains under `FrontDoor` exactly as under plain
+        FIFO, and lane/tenant accounting is untouched by the peek."""
+        from paddle_tpu.frontend import FrontDoor
+
+        model, cfg = tiny_model
+        rng = np.random.RandomState(23)
+        prompt = rng.randint(1, cfg.vocab_size, (21,)).astype(np.int32)
+        other = rng.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
+        fd = FrontDoor(
+            model, max_slots=1, block_size=8, max_prompt_len=32,
+            max_new_tokens=16, enable_prefix_cache=True,
+            kv_tier=HostKVTier(capacity_blocks=16, watermark=0.0),
+            tier_prefetch=True, prefill_chunk_tokens=16).start()
+        try:
+            first = fd.submit(prompt, lane="batch").result(timeout=600)
+            assert fd.server.cache.demote_cold(16) > 0
+            # occupy the single slot, then queue the demoted prompt on
+            # a different lane/tenant: only the scheduler (not the
+            # FIFO queue) knows it is pending, so a hit here proves
+            # the peek-based look-ahead path
+            fa = fd.submit(other, lane="interactive", tenant="a")
+            fb = fd.submit(prompt, lane="batch", tenant="b")
+            fa.result(timeout=600)
+            again = fb.result(timeout=600)
+            st = fd.stats()
+        finally:
+            fd.stop()
+        np.testing.assert_array_equal(first, again)
+        tp = st["tier_prefetch"]
+        assert tp["issued_blocks"] > 0, \
+            "prefetch never fired under the lane scheduler"
+        assert tp["hit_blocks"] == tp["issued_blocks"]
+        assert tp["hit_rate"] > 0.8
+        # peeking never popped or charged anyone: all three requests
+        # completed through normal lane admission with TTFT samples on
+        # both lanes, and no tenant was rate-skipped by the look-ahead
+        lanes = st["frontdoor"]["lanes"]
+        assert lanes["batch"]["ttft"]["n"] == 2
+        assert lanes["interactive"]["ttft"]["n"] == 1
+        assert st["frontdoor"]["rate_throttled_skips"] == 0
+        assert st["requests"] == 3

@@ -322,8 +322,9 @@ def test_served_bench_axis_emits_records():
     fleet axis, and the r21 long-context axis) must emit all the JSON
     records; slow-marked so tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 13, stdout
+    assert len(recs) == 14, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("fleetprocs" in rec["metric"] for rec in recs)
     assert any("longcontext" in rec["metric"] for rec in recs)
     assert any("quantcollectives" in rec["metric"] for rec in recs)
     assert any("fleet" in rec["metric"] for rec in recs)
@@ -420,12 +421,22 @@ def test_served_bench_axis_emits_records():
     # the fleet acceptance bars (r18): ZERO token divergence across
     # the forced mid-run replica kill and the live migration — every
     # request's output md5 is identical at every replica count
-    fl = next(r for r in recs if "fleet" in r["metric"])
+    fl = next(r for r in recs if "_fleet_" in r["metric"])
     assert fl["survivor_token_parity"] is True, fl
     assert fl["replica_kills"] >= 1, fl
     assert fl["failover_sessions"] >= 1, fl
     assert fl["migrated_sessions"] >= 1, fl
     assert fl["replica_counts"] == [1, 2, 4], fl
+    # the fleet-procs acceptance bars (r19): the subprocess fleet's
+    # output md5s are IDENTICAL to the in-process twin at every OS
+    # process count, and the disaggregated prefill/decode pool
+    # streamed its handoffs over the wire token-identically
+    fp = next(r for r in recs if "fleetprocs" in r["metric"])
+    assert fp["wire_token_parity"] is True, fp
+    assert fp["process_counts"] == [1, 2, 4], fp
+    assert fp["transport"] == "http", fp
+    assert fp["disagg_token_parity"] is True, fp
+    assert fp["disagg_handoffs"] >= 1, fp
     # the long-context acceptance bars (r21): sp multiplies the packed
     # prefill chunk budget, so the SAME huge prompts take strictly
     # fewer prefill dispatches at every higher sp degree with
@@ -477,7 +488,7 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=900)
-    assert len(recs) == 13, stdout
+    assert len(recs) == 14, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
@@ -500,10 +511,12 @@ def test_served_bench_openloop_tiny_schema():
     qc_rec = next(r for r in recs
                   if "quantcollectives" in r["metric"])
     dg_rec = next(r for r in recs if "degradedmode" in r["metric"])
-    fl_rec = next(r for r in recs if "fleet" in r["metric"])
+    fl_rec = next(r for r in recs if "_fleet_" in r["metric"])
+    fp_rec = next(r for r in recs if "fleetprocs" in r["metric"])
     lc_rec = next(r for r in recs if "longcontext" in r["metric"])
     for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec,
-                qz_rec, sh_rec, qc_rec, dg_rec, fl_rec, lc_rec):
+                qz_rec, sh_rec, qc_rec, dg_rec, fl_rec, lc_rec,
+                fp_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -691,6 +704,30 @@ def test_served_bench_openloop_tiny_schema():
     assert fl_rec["failover_sessions"] >= 1, fl_rec
     assert fl_rec["migrated_sessions"] >= 1, fl_rec
     assert len(fl_rec["parity_md5"]) == 32, fl_rec
+    assert fl_rec["transport"] == "inproc", fl_rec
+    assert fl_rec["pool_topology"] == "pooled", fl_rec
+    # fleet-procs axis (r19): REAL OS-process workers behind the
+    # HTTP wire transport at 1/2 processes (tiny) — schema, the
+    # wire md5 parity proof vs the in-process twin fleet, topology
+    # provenance, and the disaggregated prefill/decode burst A/B
+    for fld in ("vs_baseline", "process_counts",
+                "tokens_per_sec_by_procs", "ttft_p99_ms_by_procs",
+                "ttft_p99_ms", "tokens_per_sec_inproc_1",
+                "wire_token_parity", "parity_md5", "transport",
+                "pool_topology", "burst_n_requests",
+                "burst_ttft_p99_ms_pooled",
+                "burst_ttft_p99_ms_disagg", "disagg_handoffs",
+                "disagg_handoffs_failed", "disagg_token_parity",
+                "n_requests"):
+        assert fld in fp_rec, fp_rec
+    assert fp_rec["wire_token_parity"] is True, fp_rec
+    assert fp_rec["process_counts"] == [1, 2], fp_rec
+    assert fp_rec["transport"] == "http", fp_rec
+    assert fp_rec["pool_topology"] == "pooled", fp_rec
+    assert fp_rec["disagg_token_parity"] is True, fp_rec
+    assert fp_rec["disagg_handoffs"] >= 1, fp_rec
+    assert fp_rec["disagg_handoffs_failed"] == 0, fp_rec
+    assert len(fp_rec["parity_md5"]) == 32, fp_rec
     # long-context axis (r21): huge prompts at sp∈{1,2} (tiny) — the
     # smoke asserts the schema, the exact prefill-dispatch division,
     # md5 token parity across sp degrees, and the host-RAM KV tier's
