@@ -24,6 +24,8 @@ failover-vs-migration decision table, the parity guarantee and what
 is NOT recoverable.
 """
 from ..reliability import ReplicaUnavailable  # noqa: F401 (re-export)
+from .autoscale import (Autoscaler, AutoscalePolicy,  # noqa: F401
+                        ScaleDecision)
 from .disagg import DisaggRouter, FleetLanes  # noqa: F401
 from .federation import (add_label_to_prom_text,  # noqa: F401
                          federate_metrics, http_fetcher)
@@ -37,6 +39,7 @@ from .transport import RemoteEngine, RemoteReplica  # noqa: F401
 __all__ = [
     "FleetRouter", "Replica", "ReplicaHealth", "ReplicaUnavailable",
     "RemoteEngine", "RemoteReplica", "DisaggRouter", "FleetLanes",
+    "Autoscaler", "AutoscalePolicy", "ScaleDecision",
     "federate_metrics", "add_label_to_prom_text", "http_fetcher",
     "serialize_kv_payload", "deserialize_kv_payload",
 ]

@@ -84,6 +84,13 @@ class Replica:
             return False, {"killed": True}
         return self.server.readiness()
 
+    def set_draining(self, draining=True):
+        """Drain toggle passthrough (the scale-down state machine's
+        first step): readiness flips false while resident sessions
+        keep decoding to completion."""
+        self.server.set_draining(bool(draining))
+        return self
+
     def load(self):
         """Instantaneous placement load: busy slots + queued requests
         (lock-free int reads — staleness only skews a tiebreak)."""

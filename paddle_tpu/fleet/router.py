@@ -110,9 +110,28 @@ _m_state = _metrics.gauge(
     "fleet_replica_state",
     "replica state machine position (0 ok, 1 degraded, 2 open/"
     "half_open, 3 not_ready, 4 dead)", labelnames=("replica",))
+_m_replicas = _metrics.gauge(
+    "fleet_replicas",
+    "current routing-set size (dynamic membership, ISSUE 20)")
+_m_added = _metrics.counter(
+    "fleet_replicas_added_total",
+    "replicas admitted at runtime via add_replica (warm-gated "
+    "scale-up actuation)")
+_m_removed = _metrics.counter(
+    "fleet_replicas_removed_total",
+    "replicas retired at runtime via remove_replica (post-drain "
+    "scale-down actuation)")
 
 _STATE_CODE = {"ok": 0.0, "degraded": 1.0, "open": 2.0,
                "half_open": 2.0, "not_ready": 3.0, "dead": 4.0}
+
+#: the `stats()["autoscale"]` shape with no autoscaler attached —
+#: zeroed-when-disabled, same keys `Autoscaler.stats_block` fills
+AUTOSCALE_ZERO = {
+    "enabled": False, "ticks": 0, "decisions": 0, "scale_ups": 0,
+    "scale_downs": 0, "rebalances": 0, "holds": 0, "errors": 0,
+    "migrations": 0, "replica_seconds": 0.0, "last_decision": None,
+}
 
 _rids = itertools.count()
 
@@ -283,6 +302,14 @@ class FleetRouter:
         self._retries = 0
         self._prefix_routed = 0
         self._placements = 0
+        # dynamic membership (ISSUE 20): auto-name counter for bare
+        # engines admitted at runtime, window counters, and the
+        # autoscaler hook (fleet.autoscale.Autoscaler attaches itself
+        # so stats()["autoscale"] is live; None = zeroed block)
+        self._rep_ids = itertools.count(len(reps))
+        self._replicas_added = 0
+        self._replicas_removed = 0
+        self._autoscaler = None
         self.exporter = None
         self._expose_port = expose_port
 
@@ -295,6 +322,7 @@ class FleetRouter:
         self._t0 = time.perf_counter()
         for rep in self.replicas:
             rep.start()
+        _m_replicas.set(float(len(self.replicas)))
         self._probe_thread = threading.Thread(
             target=self._probe_loop, daemon=True,
             name="paddle-tpu-fleet-probe")
@@ -845,6 +873,161 @@ class FleetRouter:
                        **sess._tr(replica=target.name))
         return target.name
 
+    # ---- dynamic membership (ISSUE 20) ---------------------------------
+    def add_replica(self, replica, *, require_warm=True):
+        """Admit one replica into the live routing set (the
+        autoscaler's scale-up actuation; callable directly). Accepts
+        a `fleet.Replica` (incl. a spawned `RemoteReplica`) or a bare
+        not-yet-started `PagedGenerationServer`.
+
+        Readiness gate: with `require_warm=True` (default) the
+        replica is only admitted once its engine PROVES
+        `warm_buckets()` ran (the `warmed` readiness detail), so a
+        fresh replica never pays an XLA compile inside a request
+        window. A not-yet-started in-process engine that skipped the
+        warm is warmed HERE (before start — the engine loop owns the
+        cache arrays after); a remote replica must have been spawned
+        warm (`warm_start`, the spawn default) or admission is
+        refused. Returns the admitted `Replica`."""
+        rep = (replica if isinstance(replica, Replica)
+               else Replica(f"replica{next(self._rep_ids)}", replica))
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("router stopped")
+            if any(r.name == rep.name for r in self.replicas):
+                raise ValueError(f"duplicate replica name: "
+                                 f"{rep.name!r}")
+        if require_warm:
+            srv = rep.server
+            if (hasattr(srv, "warm_buckets")
+                    and not getattr(srv, "_warm_ran", False)
+                    and getattr(srv, "_thread", None) is None):
+                srv.warm_buckets()
+        if self._started:
+            rep.start()
+        if require_warm:
+            _ready, detail = rep.readiness()
+            if not detail.get("warmed", False):
+                rep.stop()
+                raise RuntimeError(
+                    f"replica {rep.name} failed the warm readiness "
+                    f"gate (no proof warm_buckets ran); spawn with "
+                    f"warm_start=True or pass require_warm=False")
+        with self._lock:
+            # copy-on-write: placement/probe iterations hold a
+            # consistent snapshot, never a half-mutated list
+            self.replicas = self.replicas + [rep]
+            self._replicas_added += 1
+            total = len(self.replicas)
+        _m_added.inc()
+        _m_replicas.set(float(total))
+        _tracing.event("fleet_add_replica", replica=rep.name,
+                       total=total)
+        _logger.info("replica %s admitted (fleet size %d)", rep.name,
+                     total)
+        return rep
+
+    def remove_replica(self, name, *, force=False):
+        """Remove one replica from the routing set and stop it.
+        Refuses (unless `force=True`) while unfinished sessions are
+        resident on a live replica — `retire_replica` runs the full
+        drain-first state machine. Residents still present at removal
+        (force, or a dead replica) fail over to survivors via the
+        router journal. Returns the removed `Replica`."""
+        with self._lock:
+            rep = next((r for r in self.replicas if r.name == name),
+                       None)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            if len(self.replicas) == 1:
+                raise ValueError("cannot remove the last replica")
+            residents = [s for s in self._sessions.values()
+                         if s.replica is rep and not s.done]
+            if residents and not force and not rep.dead:
+                raise RuntimeError(
+                    f"replica {name} has {len(residents)} resident "
+                    f"session(s); retire_replica drains first "
+                    f"(or pass force=True)")
+            self.replicas = [r for r in self.replicas if r is not rep]
+            self._replicas_removed += 1
+            total = len(self.replicas)
+        _m_removed.inc()
+        _m_replicas.set(float(total))
+        _tracing.event("fleet_remove_replica", replica=rep.name,
+                       total=total)
+        # anything still resident moves NOW, before the engine stops
+        self._failover_replica(rep, why="removed from fleet")
+        rep.stop()
+        _logger.info("replica %s removed (fleet size %d)", name, total)
+        return rep
+
+    def retire_replica(self, name, *, now=None):
+        """Scale-down actuation, as one deterministic state machine:
+
+        1. DRAIN — `set_draining(True)` on the engine and not_ready
+           in the health machine: residents keep decoding, placement
+           weight drops to 0 immediately.
+        2. MIGRATE — every resident session moves to best-prefix/
+           least-loaded survivors over the migration wire in accept
+           order (zero prefill recompute); a SIGKILL mid-drain
+           degrades the remaining moves to the r18 journal failover,
+           token-identically.
+        3. RETIRE — `remove_replica` drops and stops the engine.
+
+        Returns {"replica", "migrated", "failed_over"}."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            rep = next((r for r in self.replicas if r.name == name),
+                       None)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            if len(self.replicas) == 1:
+                raise ValueError("cannot retire the last replica")
+        _tracing.event("fleet_retire_replica", replica=rep.name)
+        try:
+            rep.set_draining(True)
+        except Exception:  # noqa: BLE001 — a replica dying mid-call
+            pass           # drains by failover below
+        rep.health.note_not_ready(now, "draining (retire)")
+        _m_state.labels(replica=rep.name).set(
+            _STATE_CODE["not_ready"])
+        migrated = 0
+        failed_over = 0
+        # bounded sweep: weight 0 stops new placements, but a submit
+        # racing the drain flip can land one more resident
+        for _round in range(8):
+            with self._lock:
+                residents = sorted(
+                    (s for s in self._sessions.values()
+                     if s.replica is rep and not s.done),
+                    key=lambda s: s.rid)
+            if not residents:
+                break
+            progress = False
+            for sess in residents:
+                try:
+                    was_dead = rep.dead
+                    target = self.migrate_session(sess.rid)
+                    if target != rep.name:
+                        progress = True
+                        if was_dead:
+                            failed_over += 1
+                        else:
+                            migrated += 1
+                except KeyError:
+                    progress = True  # finished while we looked
+                except Exception as e:  # noqa: BLE001 — no survivor
+                    # for this session: leave it to remove_replica's
+                    # failover (which fails the future if the fleet
+                    # truly has nowhere to put it)
+                    _logger.warning("retire %s: moving %s failed "
+                                    "(%s)", rep.name, sess.rid, e)
+            if not progress:
+                break
+        self.remove_replica(name, force=True)
+        return {"replica": name, "migrated": migrated,
+                "failed_over": failed_over}
+
     # ---- probes --------------------------------------------------------
     def _probe_loop(self):
         while not self._stop:
@@ -1023,7 +1206,12 @@ class FleetRouter:
             self._retries = 0
             self._prefix_routed = 0
             self._placements = 0
+            self._replicas_added = 0
+            self._replicas_removed = 0
             self._t0 = time.perf_counter()
+        # reset-coherent with the attached autoscaler's window
+        if self._autoscaler is not None:
+            self._autoscaler.reset_stats()
 
     def stats(self):
         with self._lock:
@@ -1048,6 +1236,8 @@ class FleetRouter:
                 "failovers": self._failovers,
                 "failover_sessions": self._failover_sessions,
                 "migrations": self._migrations,
+                "replicas_added": self._replicas_added,
+                "replicas_removed": self._replicas_removed,
                 "replica_kills": self._replica_kills,
                 "sheds": self._sheds,
                 "submit_retries": self._retries,
@@ -1060,4 +1250,7 @@ class FleetRouter:
                     "enabled": self._slo is not None,
                     "degraded_replicas": sorted(self._slo_degraded),
                 },
+                "autoscale": (self._autoscaler.stats_block()
+                              if self._autoscaler is not None
+                              else dict(AUTOSCALE_ZERO)),
             }

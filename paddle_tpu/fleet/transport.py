@@ -255,7 +255,8 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 self._send_json(200, {
                     "name": st.name, "pid": os.getpid(),
                     "max_new": srv.max_new, "max_slots": srv.max_slots,
-                    "enable_prefix_cache": srv.enable_prefix_cache})
+                    "enable_prefix_cache": srv.enable_prefix_cache,
+                    "warmed": bool(getattr(srv, "_warm_ran", False))})
             elif self.path == "/healthz/live":
                 live, detail = st.probe.liveness()
                 self._send_json(200, {"live": bool(live),
@@ -305,6 +306,11 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 n = self._state().probe.prefix_match_len(
                     np.asarray(body.get("ids", []), np.int32))
                 self._send_json(200, {"match_len": int(n)})
+            elif self.path == "/drain":
+                body = json.loads(self._read_body() or b"{}")
+                self._state().srv.set_draining(
+                    bool(body.get("draining", True)))
+                self._send_json(200, {"ok": True})
             elif self.path == "/shutdown":
                 self._send_json(200, {"ok": True})
                 threading.Thread(target=self.server.initiate_shutdown,
@@ -459,6 +465,18 @@ def serve_worker(config):
     name = config.get("name", f"worker-{os.getpid()}")
     srv = build_worker_server(config)
     srv.trace_name = name
+    # warm-start (ISSUE 20): pre-compile every reachable jit bucket
+    # BEFORE the handshake, so a freshly spawned replica never pays an
+    # XLA compile inside a request window — /healthz/ready is
+    # unreachable (no HTTP server) and false (engine not started)
+    # until the warm completes. Opt out with "warm_start": false.
+    if config.get("warm_start", True):
+        modes = config.get("warm_modes")
+        if modes is not None:
+            modes = [tuple(bool(x) for x in m) for m in modes]
+            srv.warm_buckets(modes)
+        else:
+            srv.warm_buckets()
     srv.start()
     state = _WorkerState(name, srv)
     httpd = _WorkerHTTPServer(
@@ -529,6 +547,7 @@ class RemoteEngine:
                                else float(read_timeout_s))
         self._recorder = _WireRecorder(self)
         info = self._get_json("/info", timeout=30.0)
+        self.info = dict(info)  # connect-time worker facts (warmed, pid)
         self.max_new = int(info["max_new"])
         self.max_slots = int(info["max_slots"])
         self.enable_prefix_cache = bool(
@@ -726,6 +745,17 @@ class RemoteEngine:
         return int(json.loads(data)["tokens"])
 
     # -- misc engine surface ---------------------------------------------
+    def set_draining(self, draining=True):
+        status, data = self._post_raw(
+            "/drain",
+            json.dumps({"draining": bool(draining)}).encode(),
+            headers={"Content-Type": "application/json"},
+            timeout=self.probe_timeout_s)
+        if status != 200:
+            raise RuntimeError(f"wire drain -> {status}: "
+                               f"{data[:200]!r}")
+        return self
+
     def stats(self):
         return self._get_json("/stats", timeout=self.probe_timeout_s)
 

@@ -1251,6 +1251,11 @@ class PagedGenerationServer:
         # placements).
         self._host_ops: list = []
         self._draining = False
+        # elastic fleet (ISSUE 20): proof that warm_buckets() completed
+        # before start() — the router's add_replica readiness gate
+        # reads it, so a fresh replica never compiles inside a request
+        # window
+        self._warm_ran = False
         # window counters (reset_stats-coherent)
         self._faults_injected = 0
         self._dispatch_retries = 0
@@ -1726,6 +1731,7 @@ class PagedGenerationServer:
         stalled = self._watchdog is not None and self._watchdog.stalled
         ready = alive and not stalled and not self._draining
         detail = dict(detail, stalled=stalled, draining=self._draining,
+                      warmed=self._warm_ran,
                       queue_depth=(self._sched.depth()
                                    if self._sched is not None
                                    else len(self._queue)))
@@ -2410,7 +2416,9 @@ class PagedGenerationServer:
         if self._unified:
             # the unified loop never dispatches packed_prefill — its
             # bucket space is the combined-round (T, P) family
-            return self._warm_unified_buckets(modes)
+            n = self._warm_unified_buckets(modes)
+            self._warm_ran = True
+            return n
         jnp = self._jnp
         align = self._pack_align
         # sp-sharded prefill reaches sp x the replica budget per
@@ -2467,6 +2475,7 @@ class PagedGenerationServer:
         _logger.info("warm_buckets: compiled %d packed-prefill "
                      "variants (%d shape pairs x %d widths x %d modes)",
                      n, len(pairs), len(widths), len(modes))
+        self._warm_ran = True
         return n
 
     def _warm_unified_buckets(self, modes):

@@ -27,6 +27,11 @@ from collections import deque
 
 SCHEMA_VERSION = 1
 
+#: the FEDERATED snapshot's version (ISSUE 20): 2 added the
+#: fleet-level "aggregate" block alongside the per-replica slots.
+#: Per-replica snapshots keep their own SCHEMA_VERSION.
+FLEET_SCHEMA_VERSION = 2
+
 
 def _linear_slope(points):
     """Least-squares slope of (t, v) points; None with < 2 points."""
@@ -136,6 +141,88 @@ class PressureSignals:
             return len(self._free_series)
 
 
+def fleet_aggregate(replicas):
+    """Fold per-replica capacity snapshots into the fleet-level
+    aggregate block (ISSUE 20 satellite) so autoscale policies never
+    re-derive it: total free/used blocks, min headroom fraction, max
+    SLO burn, summed queue depth and shed pressure, plus the soonest
+    blocks-exhaustion ETA. Tolerates old-shape sources — a replica
+    slot that is an error, or predates a field, simply contributes
+    nothing to that field."""
+    agg = {
+        "replicas_total": len(replicas),
+        "replicas_ok": 0,
+        "replicas_error": 0,
+        "free_blocks_total": 0,
+        "used_blocks_total": 0,
+        "num_blocks_total": 0,
+        "min_headroom_frac": None,
+        "max_burn": None,
+        "queue_depth_total": 0,
+        "busy_slots_total": 0,
+        "max_slots_total": 0,
+        "sheds_total": 0,
+        "draining": 0,
+        "min_exhaustion_eta_s": None,
+    }
+    for snap in replicas.values():
+        if not isinstance(snap, dict) or "error" in snap:
+            agg["replicas_error"] += 1
+            continue
+        agg["replicas_ok"] += 1
+        pool = snap.get("pool")
+        if isinstance(pool, dict) and "error" not in pool:
+            free = pool.get("free_blocks")
+            used = pool.get("used_blocks")
+            num = pool.get("num_blocks")
+            if isinstance(free, (int, float)):
+                agg["free_blocks_total"] += int(free)
+            if isinstance(used, (int, float)):
+                agg["used_blocks_total"] += int(used)
+            if isinstance(num, (int, float)):
+                agg["num_blocks_total"] += int(num)
+            if (isinstance(free, (int, float))
+                    and isinstance(num, (int, float)) and num > 0):
+                frac = free / num
+                if (agg["min_headroom_frac"] is None
+                        or frac < agg["min_headroom_frac"]):
+                    agg["min_headroom_frac"] = frac
+        queues = snap.get("queues")
+        if isinstance(queues, dict) and "error" not in queues:
+            for src, dst in (("queue_depth", "queue_depth_total"),
+                             ("busy_slots", "busy_slots_total"),
+                             ("max_slots", "max_slots_total")):
+                v = queues.get(src)
+                if isinstance(v, (int, float)):
+                    agg[dst] += int(v)
+        adm = snap.get("admission")
+        if isinstance(adm, dict) and "error" not in adm:
+            sheds = adm.get("sheds")
+            if isinstance(sheds, (int, float)):
+                agg["sheds_total"] += int(sheds)
+            if adm.get("draining"):
+                agg["draining"] += 1
+        slo = snap.get("slo")
+        if isinstance(slo, dict) and slo.get("enabled"):
+            for s in slo.get("slos") or ():
+                if not isinstance(s, dict):
+                    continue
+                for k in ("burn_fast", "burn_slow"):
+                    b = s.get(k)
+                    if isinstance(b, (int, float)) and (
+                            agg["max_burn"] is None
+                            or b > agg["max_burn"]):
+                        agg["max_burn"] = b
+        fc = snap.get("forecast")
+        if isinstance(fc, dict):
+            eta = fc.get("exhaustion_eta_s")
+            if isinstance(eta, (int, float)) and (
+                    agg["min_exhaustion_eta_s"] is None
+                    or eta < agg["min_exhaustion_eta_s"]):
+                agg["min_exhaustion_eta_s"] = eta
+    return agg
+
+
 def federate_capacity(sources, timeout_s=None):
     """Fold named per-replica capacity callables into one fleet
     snapshot, tolerating dead sources — the JSON twin of
@@ -161,7 +248,9 @@ def federate_capacity(sources, timeout_s=None):
                 replicas[name] = fn()
             except Exception as e:
                 replicas[name] = {"error": f"{type(e).__name__}: {e}"}
-        return {"schema_version": SCHEMA_VERSION, "replicas": replicas}
+        return {"schema_version": FLEET_SCHEMA_VERSION,
+                "replicas": replicas,
+                "aggregate": fleet_aggregate(replicas)}
 
     results = {}
     threads = {}
@@ -185,4 +274,6 @@ def federate_capacity(sources, timeout_s=None):
             replicas[name] = {
                 "error": f"timeout: no capacity snapshot within "
                          f"{float(timeout_s):g}s"}
-    return {"schema_version": SCHEMA_VERSION, "replicas": replicas}
+    return {"schema_version": FLEET_SCHEMA_VERSION,
+            "replicas": replicas,
+            "aggregate": fleet_aggregate(replicas)}

@@ -31,7 +31,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "paddle_tpu")
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
-PREFIXES = ("serving_", "kv_", "frontdoor_", "fleet_", "slo_")
+PREFIXES = ("serving_", "kv_", "frontdoor_", "fleet_", "slo_",
+            "autoscale_")
 REGISTER_FNS = {"counter", "gauge", "histogram", "gauge_fn"}
 
 # span/trace-event registry check (ISSUE 14 satellite): every name

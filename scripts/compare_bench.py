@@ -33,9 +33,13 @@ import sys
 DEFAULT_THRESHOLD = 0.10
 
 # substrings that mark a lower-is-better metric; unit fallback below
+# (replica_seconds is the elastic axis's cost denominator — fewer
+# replica-seconds for the same trace is the win)
 _LOWER_BETTER_PAT = re.compile(
-    r"ttft|itl|latency|p50|p90|p99|overhead|stall|_ms\b|_s\b")
-_LOWER_BETTER_UNITS = {"ms", "s", "seconds", "milliseconds"}
+    r"ttft|itl|latency|p50|p90|p99|overhead|stall|replica_seconds"
+    r"|_ms\b|_s\b")
+_LOWER_BETTER_UNITS = {"ms", "s", "seconds", "milliseconds",
+                       "replica_s", "replica-seconds"}
 
 # per-tenant attribution breakdowns (ISSUE 17) are workload-mix
 # dependent — a tenant-skew shift between captures is not a perf
@@ -363,6 +367,9 @@ def run_tiny():
     assert not lower_is_better("x_tokens_per_sec", "tokens/s")
     assert not lower_is_better("tier_prefetch_hit_rate")
     assert lower_is_better("resume_ttft_p50_ms_tier_prefetch")
+    # the elastic axis's cost metric: fewer replica-seconds is better
+    assert lower_is_better("gpt2s_served_elastic_replica_seconds")
+    assert lower_is_better("whatever", "replica_s")
     # record extraction handles the harness capture shape (tail lines
     # with an embedded parsed_all)
     capture = {"n": 1, "cmd": "bench", "rc": 0, "tail": "\n".join(

@@ -12,9 +12,11 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.models.gpt2 import GPT2, GPT2Config
-from paddle_tpu.observability.capacity import (SCHEMA_VERSION,
+from paddle_tpu.observability.capacity import (FLEET_SCHEMA_VERSION,
+                                               SCHEMA_VERSION,
                                                PressureSignals,
-                                               federate_capacity)
+                                               federate_capacity,
+                                               fleet_aggregate)
 
 
 @pytest.fixture(scope="module")
@@ -151,9 +153,64 @@ class TestPressureSignals:
         fed = federate_capacity(
             {"a": lambda: {"schema_version": 1, "pool": {}},
              "b": dead})
-        assert fed["schema_version"] == SCHEMA_VERSION
+        assert fed["schema_version"] == FLEET_SCHEMA_VERSION == 2
         assert fed["replicas"]["a"]["pool"] == {}
         assert "RuntimeError" in fed["replicas"]["b"]["error"]
+        # the v2 aggregate counts the dead slot without poisoning
+        agg = fed["aggregate"]
+        assert agg["replicas_total"] == 2
+        assert agg["replicas_ok"] == 1
+        assert agg["replicas_error"] == 1
+
+    def test_fleet_aggregate_block(self):
+        """The federated snapshot's fleet-level aggregate (ISSUE 20
+        satellite): block totals, min headroom, max burn, summed
+        queues — old-shape sources contribute nothing, not errors."""
+        fed = federate_capacity({
+            "a": lambda: {
+                "schema_version": 1,
+                "pool": {"num_blocks": 100, "free_blocks": 10,
+                         "used_blocks": 90},
+                "queues": {"queue_depth": 3, "busy_slots": 2,
+                           "max_slots": 4},
+                "admission": {"sheds": 1, "draining": False},
+                "slo": {"enabled": True,
+                        "slos": [{"burn_fast": 2.5,
+                                  "burn_slow": 0.5}]},
+                "forecast": {"exhaustion_eta_s": 12.0},
+            },
+            "b": lambda: {
+                "schema_version": 1,
+                "pool": {"num_blocks": 100, "free_blocks": 80,
+                         "used_blocks": 20},
+                "queues": {"queue_depth": 1, "busy_slots": 1,
+                           "max_slots": 4},
+                "admission": {"sheds": 0, "draining": True},
+                "slo": {"enabled": True,
+                        "slos": [{"burn_fast": 0.2,
+                                  "burn_slow": 0.1}]},
+                "forecast": {"exhaustion_eta_s": None},
+            },
+            # old-shape source: no pool/queues — tolerated
+            "legacy": lambda: {"schema_version": 1},
+        })
+        agg = fed["aggregate"]
+        assert agg["replicas_total"] == 3
+        assert agg["replicas_ok"] == 3
+        assert agg["free_blocks_total"] == 90
+        assert agg["used_blocks_total"] == 110
+        assert agg["num_blocks_total"] == 200
+        assert agg["min_headroom_frac"] == pytest.approx(0.1)
+        assert agg["max_burn"] == pytest.approx(2.5)
+        assert agg["queue_depth_total"] == 4
+        assert agg["busy_slots_total"] == 3
+        assert agg["max_slots_total"] == 8
+        assert agg["sheds_total"] == 1
+        assert agg["draining"] == 1
+        assert agg["min_exhaustion_eta_s"] == pytest.approx(12.0)
+        # the aggregate alone over the same slots is the same fold
+        assert fleet_aggregate(fed["replicas"]) == agg
+        assert json.loads(json.dumps(fed))  # JSON-able
 
 
 class TestEngineCapacity:
@@ -270,10 +327,14 @@ class TestFleetCapacity:
             p = rs.randint(1, cfg.vocab_size, (5,)).astype(np.int32)
             router.submit(p).result(timeout=300)
             fed = router.capacity()
-            assert fed["schema_version"] == 1
+            # federated schema v2 (aggregate block); per-replica
+            # snapshots keep their own v1 schema
+            assert fed["schema_version"] == FLEET_SCHEMA_VERSION
             assert set(fed["replicas"]) == {"r0", "r1"}
             for snap in fed["replicas"].values():
                 assert snap["schema_version"] == 1
+            assert fed["aggregate"]["replicas_ok"] == 2
+            assert fed["aggregate"]["num_blocks_total"] > 0
             # kill one replica: its slot degrades to an error entry,
             # the survivor still answers (dead-source tolerance)
             router.replicas[1].kill()

@@ -157,6 +157,37 @@ class TestWireSurface:
         with pytest.raises(ValueError):
             w.server.submit(too_long).result(timeout=60)
 
+    def test_worker_spawned_warm_by_default(self, workers):
+        """ISSUE 20 satellite: the worker runs `warm_buckets()` BEFORE
+        the stdout handshake, so by the time spawn() returns the
+        remote engine already proves warm — a spawned replica passes
+        `add_replica`'s readiness gate without a parent-side warm."""
+        w = workers[0]
+        ready, detail = w.readiness()
+        assert ready is True
+        assert detail.get("warmed") is True, detail
+        assert w.server.info.get("warmed") is True
+
+    def test_warm_start_opt_out_and_drain_route(self):
+        """`warm_start: false` skips the pre-handshake warm (the
+        engine reports warmed=False), and the /drain wire route flips
+        readiness without touching resident sessions."""
+        cfg = dict(WCONFIG, warm_start=False)
+        w = RemoteReplica.spawn("cold0", cfg, keep_alive_on_stop=True)
+        try:
+            ready, detail = w.readiness()
+            assert ready is True  # ready, just not pre-warmed
+            assert detail.get("warmed") is False, detail
+            # the drain toggle rides the wire (scale-down step 1)
+            w.server.set_draining(True)
+            ready, detail = w.readiness()
+            assert ready is False and detail.get("draining") is True
+            w.server.set_draining(False)
+            ready, detail = w.readiness()
+            assert ready is True and detail.get("draining") is False
+        finally:
+            w.terminate()
+
 
 class TestWireParity:
     def test_two_process_fleet_md5_parity_with_live_migration(

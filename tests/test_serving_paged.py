@@ -322,8 +322,9 @@ def test_served_bench_axis_emits_records():
     fleet axis, and the r21 long-context axis) must emit all the JSON
     records; slow-marked so tier-1 stays fast."""
     recs, stdout = _run_served_bench()
-    assert len(recs) == 14, stdout
+    assert len(recs) == 15, stdout
     assert any("paged" in rec["metric"] for rec in recs)
+    assert any("elastic" in rec["metric"] for rec in recs)
     assert any("fleetprocs" in rec["metric"] for rec in recs)
     assert any("longcontext" in rec["metric"] for rec in recs)
     assert any("quantcollectives" in rec["metric"] for rec in recs)
@@ -480,6 +481,25 @@ def test_served_bench_axis_emits_records():
     assert lc["tier_prefetch_token_parity"] is True, lc
     assert lc["resume_ttft_p50_ms_tier_prefetch"] \
         <= lc["resume_ttft_p50_ms_tier_sync"] * 1.25, lc
+    # the elastic acceptance bars (ISSUE 20): the autoscaled fleet
+    # holds the declared p99 TTFT SLO at >= 20% fewer replica-seconds
+    # than the best static size that also holds it; the md5 over every
+    # request's output tokens is IDENTICAL across every static size
+    # AND the autoscaled drive (scale-ups, drain migrations and
+    # retires are token-invisible); and the live decision journal
+    # replays byte-for-byte from the recorded tick log
+    el = next(r for r in recs if "elastic" in r["metric"])
+    assert el["slo_met_autoscaled"] is True, el
+    assert el["replica_seconds_saved_frac"] >= 0.20, el
+    assert el["vs_baseline"] <= 0.80, el
+    assert el["scale_ups"] >= 1, el
+    assert el["scale_downs"] >= 1, el
+    assert el["autoscale_errors"] == 0, el
+    assert el["token_parity"] is True, el
+    assert len(el["parity_md5"]) == 32, el
+    assert el["decision_replay_identical"] is True, el
+    assert el["transport"] == "inproc", el
+    assert el["pool_topology"] == "pooled", el
 
 
 def test_served_bench_openloop_tiny_schema():
@@ -488,7 +508,7 @@ def test_served_bench_openloop_tiny_schema():
     a regression in the record format (including the shared-prefix
     cache-on/off axis) fails loudly here, not in a chip session."""
     recs, stdout = _run_served_bench("--tiny", timeout=900)
-    assert len(recs) == 14, stdout
+    assert len(recs) == 15, stdout
     paged = next(r for r in recs if "openloop" not in r["metric"]
                  and "sharedprefix" not in r["metric"]
                  and "mixedsampling" not in r["metric"]
@@ -500,6 +520,7 @@ def test_served_bench_openloop_tiny_schema():
                  and "unifiedround" not in r["metric"]
                  and "degradedmode" not in r["metric"]
                  and "longcontext" not in r["metric"]
+                 and "elastic" not in r["metric"]
                  and "fleet" not in r["metric"])
     mix_rec = next(r for r in recs if "mixedsampling" in r["metric"])
     open_rec = next(r for r in recs if "openloop" in r["metric"])
@@ -514,9 +535,10 @@ def test_served_bench_openloop_tiny_schema():
     fl_rec = next(r for r in recs if "_fleet_" in r["metric"])
     fp_rec = next(r for r in recs if "fleetprocs" in r["metric"])
     lc_rec = next(r for r in recs if "longcontext" in r["metric"])
+    el_rec = next(r for r in recs if "elastic" in r["metric"])
     for rec in (paged, mix_rec, open_rec, sp_rec, spec_rec, fd_rec,
                 qz_rec, sh_rec, qc_rec, dg_rec, fl_rec, lc_rec,
-                fp_rec):
+                fp_rec, el_rec):
         assert rec["value"] > 0
         assert rec.get("degraded") is True
         assert "prefill_dispatches" in rec
@@ -786,3 +808,32 @@ def test_served_bench_openloop_tiny_schema():
     # rate and TTFT bars are the slow test's)
     assert lc_rec["tier_prefetch_token_parity"] is True, lc_rec
     assert 0.0 <= lc_rec["tier_prefetch_hit_rate"] <= 1.0, lc_rec
+    # elastic axis (ISSUE 20): the fixed-seed diurnal + flash-crowd
+    # trace through static vs autoscaled fleets — the smoke asserts
+    # the record schema (replica-seconds cost fields, scale-event
+    # accounting, parity md5, decision-replay identity); the >= 20%
+    # replica-seconds saving and the SLO bar are the slow test's
+    for fld in ("vs_baseline", "replica_counts", "slo_ttft_ms",
+                "ttft_p99_ms_by_static", "ttft_p99_ms",
+                "slo_met_autoscaled", "best_static_replicas",
+                "replica_seconds_by_static",
+                "replica_seconds_best_static",
+                "replica_seconds_saved_frac", "scale_ups",
+                "scale_downs", "decisions_total", "autoscale_errors",
+                "migrated_sessions", "failover_sessions",
+                "token_parity", "parity_md5",
+                "decision_replay_identical", "n_requests"):
+        assert fld in el_rec, el_rec
+    assert el_rec["unit"] == "replica_s", el_rec
+    assert el_rec["replica_counts"] == [1, 2], el_rec
+    assert el_rec["transport"] == "inproc", el_rec
+    assert el_rec["pool_topology"] == "pooled", el_rec
+    # even the tiny trace forces one full scale-up/scale-down cycle
+    # through the warm gate and the drain state machine
+    assert el_rec["scale_ups"] >= 1, el_rec
+    assert el_rec["scale_downs"] >= 1, el_rec
+    assert el_rec["autoscale_errors"] == 0, el_rec
+    # the parity + determinism proofs hold even at smoke scale
+    assert el_rec["token_parity"] is True, el_rec
+    assert len(el_rec["parity_md5"]) == 32, el_rec
+    assert el_rec["decision_replay_identical"] is True, el_rec
